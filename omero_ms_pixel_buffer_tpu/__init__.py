@@ -1,0 +1,38 @@
+"""omero_ms_pixel_buffer_tpu — a TPU-native tile-serving framework.
+
+A brand-new framework with the capabilities of the
+glencoesoftware/omero-ms-pixel-buffer microservice (reference:
+/root/reference, 906 lines of Java): an HTTP microservice serving
+microscopy image tiles::
+
+    GET /tile/{imageId}/{z}/{c}/{t}?x&y&w&h&resolution&format
+
+as raw pixels, PNG, or TIFF — authenticated against OMERO.web sessions,
+reading classic OMERO pixel buffers or OME-NGFF/Zarr.
+
+Architecture (TPU-first, not a port):
+
+- ``http/``     — async HTTP front (routes, headers, error mapping;
+                  reference: PixelBufferMicroserviceVerticle.java)
+- ``auth/``     — OMERO.web session adoption (reference: omero-ms-core
+                  OmeroWebSessionRequestHandler + session stores)
+- ``dispatch/`` — the in-process "event bus": request/reply with deadline
+                  + a shape-bucketed batching queue that coalesces
+                  concurrent tile requests into fixed-shape TPU batches
+                  (reference: Vert.x EventBus + worker verticle pool)
+- ``models/``   — the tile pipeline "model": batched crop → convert →
+                  encode graphs that run under jit/shard_map
+- ``ops/``      — JAX/Pallas compute: region crop, dtype/endian convert,
+                  PNG filtering + deflate (stored + fixed-Huffman),
+                  adler32/crc32, TIFF synthesis
+- ``io/``       — pixel I/O: OME-NGFF/Zarr and OME-TIFF pyramid readers,
+                  ROMIO planes, pixels-service registry, memo cache
+                  (reference: ome.io.nio.PixelsService/PixelBuffer,
+                  ZarrPixelsService)
+- ``parallel/`` — device meshes, shard_map shardings, collectives for
+                  multi-chip tile serving
+- ``utils/``    — config, tracing (reference span taxonomy), Prometheus
+                  metrics, logging
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,67 @@
+"""Failure taxonomy mirroring the reference's error mapping.
+
+The reference maps failures to HTTP-ish codes at two layers:
+
+- worker verticle (PixelBufferVerticle.java:90-147): bad ctx JSON -> 400
+  "Illegal tile context"; missing image / unknown format / encode failure
+  -> 404 "Cannot find Image:<id>"; Glacier2 PermissionDenied /
+  CannotCreateSession -> 403 "Permission denied"; IllegalArgument -> 400
+  with the exception message; anything else -> 500 "Exception while
+  retrieving tile".
+- HTTP front (PixelBufferMicroserviceVerticle.java:354-370): a reply
+  failure carries its failureCode as status; non-reply failures -> 404;
+  a failure code < 1 -> 500.
+"""
+
+from __future__ import annotations
+
+
+class TileError(Exception):
+    """A failure with an HTTP-ish failure code, the event-bus ``fail``
+    analog (reference: io.vertx Message.fail)."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+class BadRequestError(TileError):
+    """400 — unparseable ctx or illegal argument
+    (PixelBufferVerticle.java:95-100,137-140)."""
+
+    def __init__(self, message: str):
+        super().__init__(400, message)
+
+
+class PermissionDeniedError(TileError):
+    """403 — session join refused, the Glacier2
+    PermissionDenied/CannotCreateSession analog
+    (PixelBufferVerticle.java:131-136)."""
+
+    def __init__(self, message: str = "Permission denied"):
+        super().__init__(403, message)
+
+
+class NotFoundError(TileError):
+    """404 — image missing, or the pipeline returned nothing
+    (PixelBufferVerticle.java:111-114)."""
+
+    def __init__(self, message: str):
+        super().__init__(404, message)
+
+
+class InternalError(TileError):
+    """500 — any other failure (PixelBufferVerticle.java:141-146)."""
+
+    def __init__(self, message: str = "Exception while retrieving tile"):
+        super().__init__(500, message)
+
+
+def http_status_for_failure(exc: BaseException) -> int:
+    """Map a dispatch failure to an HTTP status, mirroring
+    PixelBufferMicroserviceVerticle.java:356-370: TileError carries its
+    own code (coerced to 500 if < 1); any other exception is 404."""
+    if isinstance(exc, TileError):
+        return exc.code if exc.code >= 1 else 500
+    return 404

@@ -1,0 +1,153 @@
+"""Tile request context — the typed DTO that crosses the dispatch boundary.
+
+Mirrors the reference's TileCtx (TileCtx.java:30-92): path params
+imageId/z/c/t are required integers; query params x/y/w/h default to 0;
+``resolution`` is an optional integer; ``format`` is an optional string.
+A parse failure is a 400 (PixelBufferMicroserviceVerticle.java:340-348).
+The ctx also carries the OMERO session key and the trace context so spans
+propagate across the dispatch boundary (OmeroRequestCtx contract,
+TileCtx.java:30,68; injection at
+PixelBufferMicroserviceVerticle.java:349).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Optional
+
+from .errors import BadRequestError
+
+
+@dataclasses.dataclass
+class RegionDef:
+    """Mutable x/y/w/h rectangle (omeis.providers.re.data.RegionDef as
+    used at TileRequestHandler.java:88-99)."""
+
+    x: int = 0
+    y: int = 0
+    width: int = 0
+    height: int = 0
+
+    def __str__(self) -> str:  # matches the debug-log style usage
+        return f"RegionDef(x={self.x} y={self.y} w={self.width} h={self.height})"
+
+
+def _require_int(params: Mapping[str, Any], key: str) -> int:
+    value = params.get(key)
+    if value is None:
+        raise BadRequestError(f"Missing parameter '{key}'")
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        # Long.parseLong's NumberFormatException message shape
+        raise BadRequestError(f'For input string: "{value}"') from None
+
+
+def _optional_int(params: Mapping[str, Any], key: str, default=None):
+    value = params.get(key)
+    if value is None:
+        return default
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        raise BadRequestError(f'For input string: "{value}"') from None
+
+
+@dataclasses.dataclass
+class TileCtx:
+    """Parsed /tile request (TileCtx.java:36-54,67-90)."""
+
+    image_id: int
+    z: int
+    c: int
+    t: int
+    region: RegionDef
+    resolution: Optional[int] = None
+    format: Optional[str] = None
+    omero_session_key: Optional[str] = None
+    trace_context: dict = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_params(
+        cls, params: Mapping[str, Any], omero_session_key: Optional[str]
+    ) -> "TileCtx":
+        """Parse path+query params with the reference's exact defaulting
+        (TileCtx.java:67-90): imageId/z/c/t required; x/y/w/h -> 0;
+        resolution -> None; format passed through verbatim."""
+        return cls(
+            image_id=_require_int(params, "imageId"),
+            z=_require_int(params, "z"),
+            c=_require_int(params, "c"),
+            t=_require_int(params, "t"),
+            region=RegionDef(
+                x=_optional_int(params, "x", 0),
+                y=_optional_int(params, "y", 0),
+                width=_optional_int(params, "w", 0),
+                height=_optional_int(params, "h", 0),
+            ),
+            resolution=_optional_int(params, "resolution", None),
+            format=params.get("format"),
+            omero_session_key=omero_session_key,
+        )
+
+    # -- dispatch-boundary (de)serialization -------------------------------
+    # The reference Jackson-round-trips the ctx over the event bus
+    # (PixelBufferMicroserviceVerticle.java:352-354,
+    # PixelBufferVerticle.java:91-100). We keep the same property, so the
+    # dispatch layer can be swapped for a cross-process transport.
+
+    def to_json(self) -> dict:
+        return {
+            "imageId": self.image_id,
+            "z": self.z,
+            "c": self.c,
+            "t": self.t,
+            "region": {
+                "x": self.region.x,
+                "y": self.region.y,
+                "width": self.region.width,
+                "height": self.region.height,
+            },
+            "resolution": self.resolution,
+            "format": self.format,
+            "omeroSessionKey": self.omero_session_key,
+            "traceContext": dict(self.trace_context),
+        }
+
+    @classmethod
+    def from_json(cls, obj: Any) -> "TileCtx":
+        try:
+            region = obj.get("region") or {}
+            return cls(
+                image_id=int(obj["imageId"]),
+                z=int(obj["z"]),
+                c=int(obj["c"]),
+                t=int(obj["t"]),
+                region=RegionDef(
+                    x=int(region.get("x", 0)),
+                    y=int(region.get("y", 0)),
+                    width=int(region.get("width", 0)),
+                    height=int(region.get("height", 0)),
+                ),
+                resolution=(
+                    None if obj.get("resolution") is None
+                    else int(obj["resolution"])
+                ),
+                format=obj.get("format"),
+                omero_session_key=obj.get("omeroSessionKey"),
+                trace_context=dict(obj.get("traceContext") or {}),
+            )
+        except BadRequestError:
+            raise
+        except Exception:
+            # worker-side decode failure (PixelBufferVerticle.java:95-100)
+            raise BadRequestError("Illegal tile context") from None
+
+    def filename(self) -> str:
+        """Reply filename header (PixelBufferVerticle.java:118-127)."""
+        ext = self.format if self.format is not None else "bin"
+        return (
+            f"image{self.image_id}_z{self.z}_c{self.c}_t{self.t}"
+            f"_x{self.region.x}_y{self.region.y}"
+            f"_w{self.region.width}_h{self.region.height}.{ext}"
+        )

@@ -1,0 +1,181 @@
+"""Layered YAML config with the reference's key schema, plus TPU keys.
+
+The reference uses Vert.x ConfigRetriever: default stores (sys props /
+env) overlaid with an optional ``conf/config.yaml``
+(PixelBufferMicroserviceVerticle.java:120-130; shipped config at
+src/dist/conf/config.yaml). Keys reproduced here:
+
+- ``port`` (8082), ``event-bus-send-timeout`` (15000 ms),
+  ``worker_pool_size`` (default 2 x CPUs,
+  PixelBufferMicroserviceVerticle.java:117-118)
+- ``omero.host`` / ``omero.port`` — OMERO server for session joins
+- ``omero.server.*`` — embedded data-layer properties (data dir, pixels
+  service selection, DB creds); config.yaml:12-19
+- ``session-store.{type,synchronicity,uri}`` — config.yaml:22-34;
+  missing block is a hard startup error
+  (PixelBufferMicroserviceVerticle.java:258-261)
+- ``http-tracing.{enabled,zipkin-url}``, ``jmx-metrics.enabled``
+
+New (TPU) keys live under ``backend``: engine selection, batching shape
+buckets, coalesce window, mesh axes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Optional
+
+try:  # PyYAML ships with the base image's dep chain; gate just in case.
+    import yaml
+except ImportError:  # pragma: no cover
+    yaml = None
+
+
+class ConfigError(ValueError):
+    """Hard startup error for missing required blocks
+    (PixelBufferMicroserviceVerticle.java:155-158,258-261,270-273)."""
+
+
+@dataclasses.dataclass
+class SessionStoreConfig:
+    type: str = "memory"  # reference: "redis" | "postgres"; we add "memory"
+    synchronicity: str = "async"
+    uri: Optional[str] = None
+
+
+@dataclasses.dataclass
+class BatchingConfig:
+    """TPU batch-executor tuning (no reference analog; replaces the
+    worker-pool sizing knob as the throughput control)."""
+
+    # Shape buckets (square tile edge) requests are padded up to.
+    buckets: tuple = (256, 512, 1024)
+    # Max lanes coalesced into one TPU batch.
+    max_batch: int = 32
+    # How long the coalescer waits to fill a batch before flushing.
+    coalesce_window_ms: float = 2.0
+    # Encode on device (Pallas deflate) vs host zlib.
+    device_encode: bool = True
+
+
+@dataclasses.dataclass
+class BackendConfig:
+    engine: str = "jax"  # "jax" | "host" (pure-CPU fallback, same API)
+    mesh_axes: tuple = ("data",)
+    batching: BatchingConfig = dataclasses.field(default_factory=BatchingConfig)
+
+
+@dataclasses.dataclass
+class Config:
+    port: int = 8082
+    event_bus_send_timeout_ms: int = 15000  # config.yaml:5
+    worker_pool_size: Optional[int] = None  # default 2 x CPUs at deploy
+    omero_host: str = "localhost"
+    omero_port: int = 4064
+    omero_server: dict = dataclasses.field(default_factory=dict)
+    session_store: SessionStoreConfig = dataclasses.field(
+        default_factory=SessionStoreConfig
+    )
+    http_tracing_enabled: bool = False
+    zipkin_url: Optional[str] = None
+    jmx_metrics_enabled: bool = True  # config.yaml:43-44 analog
+    backend: BackendConfig = dataclasses.field(default_factory=BackendConfig)
+    # Filesystem image registry (stands in for the OMERO Postgres
+    # metadata plane when running without a server; see io.pixels_service).
+    image_registry: Optional[str] = None
+
+    @property
+    def effective_worker_pool_size(self) -> int:
+        if self.worker_pool_size is not None:
+            return self.worker_pool_size
+        return 2 * (os.cpu_count() or 1)
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "Config":
+        raw = dict(raw or {})
+        omero = raw.get("omero") or {}
+        ss_raw = raw.get("session-store")
+        if ss_raw is None:
+            raise ConfigError("'session-store' block missing from configuration")
+        ss = SessionStoreConfig(
+            type=ss_raw.get("type") or "",
+            synchronicity=ss_raw.get("synchronicity", "async"),
+            uri=ss_raw.get("uri"),
+        )
+        if ss.type not in ("redis", "postgres", "memory"):
+            raise ConfigError(
+                "Missing/invalid value for 'session-store.type' in config"
+            )
+        tracing = raw.get("http-tracing") or {}
+        jmx = raw.get("jmx-metrics") or {}
+        be_raw = raw.get("backend") or {}
+        batching_raw = be_raw.get("batching") or {}
+        mesh_axes = be_raw.get("mesh-axes", ("data",))
+        if isinstance(mesh_axes, str):  # scalar YAML spelling of one axis
+            mesh_axes = (mesh_axes,)
+        backend = BackendConfig(
+            engine=be_raw.get("engine", "jax"),
+            mesh_axes=tuple(mesh_axes),
+            batching=BatchingConfig(
+                buckets=tuple(batching_raw.get("buckets", (256, 512, 1024))),
+                max_batch=int(batching_raw.get("max-batch", 32)),
+                coalesce_window_ms=float(
+                    batching_raw.get("coalesce-window-ms", 2.0)
+                ),
+                device_encode=bool(batching_raw.get("device-encode", True)),
+            ),
+        )
+        return cls(
+            port=int(raw.get("port", 8082)),
+            event_bus_send_timeout_ms=int(
+                raw.get("event-bus-send-timeout", 15000)
+            ),
+            worker_pool_size=(
+                None if raw.get("worker_pool_size") is None
+                else int(raw["worker_pool_size"])
+            ),
+            omero_host=omero.get("host", "localhost"),
+            omero_port=int(omero.get("port", 4064)),
+            omero_server=dict(raw.get("omero.server") or {}),
+            session_store=ss,
+            http_tracing_enabled=bool(tracing.get("enabled", False)),
+            zipkin_url=tracing.get("zipkin-url"),
+            jmx_metrics_enabled=bool(jmx.get("enabled", True)),
+            backend=backend,
+            image_registry=raw.get("image-registry"),
+        )
+
+    @classmethod
+    def load(
+        cls,
+        path: Optional[str] = None,
+        default_memory_store: bool = False,
+    ) -> "Config":
+        """Layered load: YAML file (if present) under env overrides,
+        mirroring ConfigRetriever's default-stores + optional file.
+
+        A missing ``session-store`` block is a hard startup error like
+        the reference (PixelBufferMicroserviceVerticle.java:258-261)
+        unless the caller opts into the in-memory store explicitly
+        (dev/bench mode) with ``default_memory_store=True``.
+        """
+        raw: dict = {}
+        if path and os.path.exists(path):
+            if yaml is None:  # pragma: no cover
+                raise ConfigError("PyYAML unavailable; cannot read " + path)
+            with open(path) as f:
+                raw = yaml.safe_load(f) or {}
+        # An empty `session-store:` block parses to None; treat as {}.
+        if "session-store" in raw and raw["session-store"] is None:
+            raw["session-store"] = {}
+        # Env overrides (the sys-prop/env default stores analog).
+        if "OMPB_PORT" in os.environ:
+            raw["port"] = int(os.environ["OMPB_PORT"])
+        if "OMPB_SESSION_STORE" in os.environ:
+            raw.setdefault("session-store", {})["type"] = os.environ[
+                "OMPB_SESSION_STORE"
+            ]
+        if default_memory_store and "session-store" not in raw:
+            raw["session-store"] = {"type": "memory"}
+        return cls.from_dict(raw)

@@ -1,0 +1,54 @@
+"""Config schema and hard-failure contracts
+(reference: PixelBufferMicroserviceVerticle.java:120-137,155-158,258-273;
+src/dist/conf/config.yaml)."""
+
+import pytest
+
+from omero_ms_pixel_buffer_tpu.utils.config import Config, ConfigError
+
+
+def test_defaults_match_reference_shipped_config():
+    cfg = Config.from_dict({"session-store": {"type": "memory"}})
+    assert cfg.port == 8082
+    assert cfg.event_bus_send_timeout_ms == 15000
+    assert cfg.omero_port == 4064
+    assert cfg.effective_worker_pool_size >= 2  # 2 x CPUs default
+
+
+def test_missing_session_store_is_hard_error():
+    with pytest.raises(ConfigError):
+        Config.from_dict({"port": 9000})
+
+
+def test_invalid_session_store_type_is_hard_error():
+    with pytest.raises(ConfigError):
+        Config.from_dict({"session-store": {"type": "dynamo"}})
+
+
+def test_full_yaml_shape():
+    cfg = Config.from_dict(
+        {
+            "port": 9090,
+            "event-bus-send-timeout": 5000,
+            "worker_pool_size": 4,
+            "omero": {"host": "omero.example", "port": 4444},
+            "session-store": {
+                "type": "redis",
+                "synchronicity": "async",
+                "uri": "redis://h:6379/1",
+            },
+            "http-tracing": {"enabled": True, "zipkin-url": "http://z/api/v2"},
+            "backend": {
+                "engine": "jax",
+                "batching": {"buckets": [128, 256], "max-batch": 8},
+            },
+        }
+    )
+    assert cfg.port == 9090
+    assert cfg.event_bus_send_timeout_ms == 5000
+    assert cfg.worker_pool_size == 4
+    assert cfg.omero_host == "omero.example"
+    assert cfg.session_store.uri == "redis://h:6379/1"
+    assert cfg.http_tracing_enabled
+    assert cfg.backend.batching.buckets == (128, 256)
+    assert cfg.backend.batching.max_batch == 8

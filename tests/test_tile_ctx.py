@@ -1,0 +1,103 @@
+"""TileCtx parse/default semantics (reference: TileCtx.java:67-90) and
+error taxonomy (PixelBufferVerticle.java:90-147,
+PixelBufferMicroserviceVerticle.java:356-370)."""
+
+import pytest
+
+from omero_ms_pixel_buffer_tpu.errors import (
+    BadRequestError,
+    InternalError,
+    NotFoundError,
+    PermissionDeniedError,
+    TileError,
+    http_status_for_failure,
+)
+from omero_ms_pixel_buffer_tpu.tile_ctx import TileCtx
+
+
+def params(**kw):
+    base = {"imageId": "1", "z": "0", "c": "0", "t": "0"}
+    base.update({k: str(v) for k, v in kw.items()})
+    return base
+
+
+class TestParse:
+    def test_required_path_params(self):
+        ctx = TileCtx.from_params(params(), "key")
+        assert (ctx.image_id, ctx.z, ctx.c, ctx.t) == (1, 0, 0, 0)
+        assert ctx.omero_session_key == "key"
+
+    def test_region_defaults_to_zero(self):
+        ctx = TileCtx.from_params(params(), None)
+        r = ctx.region
+        assert (r.x, r.y, r.width, r.height) == (0, 0, 0, 0)
+
+    def test_region_parsed(self):
+        ctx = TileCtx.from_params(params(x=10, y=20, w=512, h=256), None)
+        r = ctx.region
+        assert (r.x, r.y, r.width, r.height) == (10, 20, 512, 256)
+
+    def test_resolution_defaults_none(self):
+        assert TileCtx.from_params(params(), None).resolution is None
+        assert TileCtx.from_params(params(resolution=2), None).resolution == 2
+
+    def test_format_passthrough(self):
+        assert TileCtx.from_params(params(), None).format is None
+        assert TileCtx.from_params(params(format="png"), None).format == "png"
+        # unknown formats parse fine; rejection happens in the pipeline
+        assert TileCtx.from_params(params(format="bmp"), None).format == "bmp"
+
+    @pytest.mark.parametrize("key", ["imageId", "z", "c", "t"])
+    def test_missing_required_is_400(self, key):
+        p = params()
+        del p[key]
+        with pytest.raises(BadRequestError) as ei:
+            TileCtx.from_params(p, None)
+        assert ei.value.code == 400
+
+    @pytest.mark.parametrize(
+        "bad", [{"imageId": "abc"}, {"z": "1.5"}, {"x": "NaNpx"}, {"resolution": ""}]
+    )
+    def test_unparseable_is_400(self, bad):
+        with pytest.raises(BadRequestError):
+            TileCtx.from_params(params(**bad), None)
+
+
+class TestRoundTrip:
+    def test_json_round_trip(self):
+        ctx = TileCtx.from_params(
+            params(x=1, y=2, w=3, h=4, resolution=1, format="tif"), "sk"
+        )
+        ctx.trace_context = {"traceId": "abc"}
+        back = TileCtx.from_json(ctx.to_json())
+        assert back == ctx
+
+    def test_garbage_json_is_400_illegal_tile_context(self):
+        with pytest.raises(BadRequestError) as ei:
+            TileCtx.from_json({"imageId": "x"})
+        assert ei.value.message == "Illegal tile context"
+
+
+class TestFilename:
+    def test_format_extension(self):
+        ctx = TileCtx.from_params(params(x=5, y=6, w=7, h=8, format="png"), None)
+        assert ctx.filename() == "image1_z0_c0_t0_x5_y6_w7_h8.png"
+
+    def test_default_bin_extension(self):
+        ctx = TileCtx.from_params(params(), None)
+        assert ctx.filename() == "image1_z0_c0_t0_x0_y0_w0_h0.bin"
+
+
+class TestErrorMapping:
+    def test_codes(self):
+        assert BadRequestError("x").code == 400
+        assert PermissionDeniedError().code == 403
+        assert PermissionDeniedError().message == "Permission denied"
+        assert NotFoundError("Cannot find Image:5").code == 404
+        assert InternalError().code == 500
+        assert InternalError().message == "Exception while retrieving tile"
+
+    def test_http_status_for_failure(self):
+        assert http_status_for_failure(NotFoundError("x")) == 404
+        assert http_status_for_failure(TileError(0, "bad")) == 500  # code < 1
+        assert http_status_for_failure(RuntimeError("x")) == 404  # non-reply
