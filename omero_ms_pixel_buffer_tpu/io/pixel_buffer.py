@@ -1,0 +1,158 @@
+"""The pixel-buffer contract.
+
+Re-implements the behavioral contract of ``ome.io.nio.PixelBuffer`` as
+used by the reference (TileRequestHandler.java:86-112): a closeable
+random-access pixel reader with ``setResolutionLevel(int)`` and
+``getTileDirect(z,c,t,x,y,w,h,buffer)`` semantics, plus the ``Pixels``
+metadata row (sizeX/Y/Z/C/T, pixelsType) the HQL query returns
+(TileRequestHandler.java:220-241).
+
+Differences from the reference, by design:
+
+- tiles come back as numpy arrays (native dtype) instead of a caller
+  byte[]; big-endian serialization happens at the output boundary
+  (ops/convert) so device pipelines can consume the arrays directly;
+- ``read_tiles`` gives readers an explicit batched entry point so the
+  dispatch layer can stage many tiles per host→HBM transfer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ops.convert import dtype_for
+
+
+@dataclasses.dataclass(frozen=True)
+class PixelsMeta:
+    """The ``Pixels`` row the reference fetches per request
+    (TileRequestHandler.java:220-241): dimensions + pixel type joined
+    with the image."""
+
+    image_id: int
+    size_x: int
+    size_y: int
+    size_z: int
+    size_c: int
+    size_t: int
+    pixels_type: str  # OMERO PixelsType enum value, e.g. "uint16"
+    image_name: str = ""
+
+    @property
+    def dtype(self) -> np.dtype:
+        return dtype_for(self.pixels_type)
+
+    @property
+    def bytes_per_pixel(self) -> int:
+        return self.dtype.itemsize
+
+
+class PixelBuffer:
+    """Abstract pixel reader (ome.io.nio.PixelBuffer contract)."""
+
+    def __init__(self, meta: PixelsMeta):
+        self.meta = meta
+        self._resolution_level = 0  # 0 = full resolution
+
+    # -- resolution pyramid (TileRequestHandler.java:89-91) ---------------
+
+    @property
+    def resolution_levels(self) -> int:
+        return 1
+
+    def set_resolution_level(self, level: int) -> None:
+        """Select a pyramid level; 0 is full resolution. Out-of-range is
+        an IllegalArgument -> 400 at the dispatch layer."""
+        if not 0 <= level < self.resolution_levels:
+            raise ValueError(
+                f"Resolution level {level} out of range "
+                f"[0, {self.resolution_levels})"
+            )
+        self._resolution_level = level
+
+    @property
+    def resolution_level(self) -> int:
+        return self._resolution_level
+
+    def level_size(self, level: Optional[int] = None) -> Tuple[int, int]:
+        """(size_x, size_y) at the given (default: current) level."""
+        lv = self._resolution_level if level is None else level
+        if lv == 0:
+            return self.meta.size_x, self.meta.size_y
+        raise NotImplementedError
+
+    @property
+    def size_x(self) -> int:
+        return self.level_size()[0]
+
+    @property
+    def size_y(self) -> int:
+        return self.level_size()[1]
+
+    # -- reads -------------------------------------------------------------
+    # Core reads take the level explicitly: buffers are cached and shared
+    # across concurrent requests (unlike the reference's per-request
+    # open/close, TileRequestHandler.java:86), so the mutable
+    # set_resolution_level cursor must not be the only addressing path.
+
+    def get_tile_at(
+        self, level: int, z: int, c: int, t: int,
+        x: int, y: int, w: int, h: int,
+    ) -> np.ndarray:
+        """The ``getTileDirect`` analog at an explicit resolution level:
+        (h, w) array in native dtype. Out-of-bounds raises (→ 404 like
+        the reference's broad catch)."""
+        raise NotImplementedError
+
+    def get_tile(
+        self, z: int, c: int, t: int, x: int, y: int, w: int, h: int
+    ) -> np.ndarray:
+        """Reference-shaped read using the level cursor set by
+        ``set_resolution_level`` (single-threaded use only)."""
+        return self.get_tile_at(self._resolution_level, z, c, t, x, y, w, h)
+
+    def read_tiles(
+        self,
+        coords: Sequence[Tuple[int, int, int, int, int, int, int]],
+        level: int = 0,
+    ) -> List[np.ndarray]:
+        """Batched read of (z,c,t,x,y,w,h) tuples. Default loops;
+        chunk-aware readers override to share chunk decode across tiles
+        in the same batch."""
+        return [self.get_tile_at(level, *co) for co in coords]
+
+    # -- lifecycle (try-with-resources close, TileRequestHandler.java:86) --
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self) -> "PixelBuffer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # safety net for cache-evicted buffers
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def check_bounds(
+    z: int, c: int, t: int, x: int, y: int, w: int, h: int,
+    size_x: int, size_y: int, size_z: int, size_c: int, size_t: int,
+) -> None:
+    """Shared coordinate validation for readers."""
+    if not (0 <= z < size_z and 0 <= c < size_c and 0 <= t < size_t):
+        raise ValueError(
+            f"Plane out of range: z={z}/{size_z} c={c}/{size_c} t={t}/{size_t}"
+        )
+    if x < 0 or y < 0 or w <= 0 or h <= 0 or x + w > size_x or y + h > size_y:
+        raise ValueError(
+            f"Region out of bounds: x={x} y={y} w={w} h={h} "
+            f"plane={size_x}x{size_y}"
+        )

@@ -1,0 +1,172 @@
+"""Pixels service: imageId -> metadata -> pixel buffer.
+
+Re-implements the two external contracts the reference's hot path leans
+on (SURVEY.md §2.2):
+
+- the **metadata plane** — the HQL ``Pixels`` query
+  (TileRequestHandler.java:220-241: Pixels joined with image + pixels
+  type, cross-group read, null when the image doesn't exist) — as a
+  ``MetadataResolver`` interface. The filesystem ``ImageRegistry``
+  implementation stands in for OMERO's Postgres when running
+  standalone; a network resolver can implement the same interface.
+- the **buffer plane** — ``PixelsService.getPixelBuffer`` +
+  ``ZarrPixelsService`` dispatch (TileRequestHandler.java:201-211,
+  beanRefContext.xml:51): resolve the metadata row to the right reader
+  for its storage (OME-NGFF/Zarr directory, OME-TIFF file, ROMIO plane
+  file), like the reference's service picks ROMIO / Bio-Formats /
+  pyramid / Zarr backends.
+
+Buffer instances are cached per image with an LRU bound — the
+Memoizer-style persistent acceleration state (SURVEY.md §5.4): parsing
+a TIFF IFD chain or a Zarr hierarchy is paid once, not per tile.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+from .ometiff import OmeTiffPixelBuffer
+from .pixel_buffer import PixelBuffer, PixelsMeta
+from .romio import RomioPixelBuffer
+from .zarr import ZarrPixelBuffer
+
+
+class MetadataResolver:
+    """The getPixels contract: imageId -> PixelsMeta or None
+    (TileRequestHandler.java:220-241)."""
+
+    def get_pixels(self, image_id: int) -> Optional[PixelsMeta]:
+        raise NotImplementedError
+
+
+class ImageRegistry(MetadataResolver):
+    """Filesystem metadata plane: a JSON registry mapping image ids to
+    storage paths (and, for ROMIO, explicit dimensions).
+
+    Registry file shape::
+
+        {"images": [
+            {"id": 1, "path": "images/a.ome.tiff", "name": "a"},
+            {"id": 2, "path": "images/b.zarr"},
+            {"id": 3, "path": "images/3", "type": "romio",
+             "sizeX": 512, "sizeY": 512, "sizeZ": 1, "sizeC": 1,
+             "sizeT": 1, "pixelsType": "uint16"}
+        ]}
+    """
+
+    def __init__(self, registry_path: Optional[str] = None):
+        self._images: dict[int, dict] = {}
+        self._root = "."
+        if registry_path:
+            self._root = os.path.dirname(os.path.abspath(registry_path))
+            with open(registry_path) as f:
+                doc = json.load(f)
+            for img in doc.get("images", []):
+                self._images[int(img["id"])] = img
+
+    def add(self, image_id: int, path: str, **extra) -> None:
+        self._images[int(image_id)] = {"id": int(image_id), "path": path, **extra}
+
+    def entry(self, image_id: int) -> Optional[dict]:
+        return self._images.get(int(image_id))
+
+    def resolve_path(self, entry: dict) -> str:
+        p = entry["path"]
+        return p if os.path.isabs(p) else os.path.join(self._root, p)
+
+    def get_pixels(self, image_id: int) -> Optional[PixelsMeta]:
+        entry = self._images.get(int(image_id))
+        if entry is None:
+            return None  # -> 404 "Cannot find Image:<id>"
+        if entry.get("type") == "romio":
+            return PixelsMeta(
+                image_id=int(image_id),
+                size_x=int(entry["sizeX"]), size_y=int(entry["sizeY"]),
+                size_z=int(entry.get("sizeZ", 1)),
+                size_c=int(entry.get("sizeC", 1)),
+                size_t=int(entry.get("sizeT", 1)),
+                pixels_type=entry["pixelsType"],
+                image_name=entry.get("name", str(image_id)),
+            )
+        # File-backed formats: the file itself carries the truth. Open
+        # transiently and close; the serving path goes through
+        # PixelsService.get_pixels, which answers from its buffer cache.
+        with _open_buffer(self, entry, int(image_id)) as buf:
+            return buf.meta
+
+
+def _open_buffer(
+    registry: ImageRegistry, entry: dict, image_id: int
+) -> PixelBuffer:
+    path = registry.resolve_path(entry)
+    name = entry.get("name", os.path.basename(path))
+    kind = entry.get("type")
+    if kind == "romio":
+        meta = registry.get_pixels(image_id)
+        return RomioPixelBuffer(path, meta)
+    if kind == "zarr" or (kind is None and os.path.isdir(path)):
+        return ZarrPixelBuffer(path, image_id=image_id, image_name=name)
+    if kind in ("ometiff", "tiff") or kind is None:
+        return OmeTiffPixelBuffer(path, image_id=image_id, image_name=name)
+    raise ValueError(f"Unknown image type: {kind}")
+
+
+class PixelsService:
+    """getPixelBuffer + buffer cache (the Spring-singleton
+    ZarrPixelsService analog, beanRefContext.xml:51-57)."""
+
+    def __init__(self, registry: ImageRegistry, max_open: int = 128):
+        self.registry = registry
+        self.max_open = max_open
+        self._cache: OrderedDict[int, PixelBuffer] = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get_pixels(self, image_id: int) -> Optional[PixelsMeta]:
+        """Metadata lookup answered from the cached buffer when one is
+        open (no per-request file open/parse — unlike the reference's
+        per-request HQL + buffer open, TileRequestHandler.java:201-241)."""
+        entry = self.registry.entry(image_id)
+        if entry is None:
+            return None
+        if entry.get("type") == "romio":
+            return self.registry.get_pixels(image_id)
+        buf = self.get_pixel_buffer(image_id)
+        return None if buf is None else buf.meta
+
+    def get_pixel_buffer(self, image_id: int) -> Optional[PixelBuffer]:
+        """Resolve an image id to an open, cached pixel buffer; None when
+        the image is unknown (-> 404)."""
+        image_id = int(image_id)
+        with self._lock:
+            buf = self._cache.get(image_id)
+            if buf is not None:
+                self._cache.move_to_end(image_id)
+                return buf
+        entry = self.registry.entry(image_id)
+        if entry is None:
+            return None
+        buf = _open_buffer(self.registry, entry, image_id)
+        with self._lock:
+            existing = self._cache.get(image_id)
+            if existing is not None:
+                buf.close()
+                self._cache.move_to_end(image_id)
+                return existing
+            self._cache[image_id] = buf
+            while len(self._cache) > self.max_open:
+                # Drop from the cache but do NOT close: concurrent
+                # requests may still be mid-read on the evicted buffer.
+                # Readers close on finalization (PixelBuffer.__del__)
+                # once the last in-flight reference drops.
+                self._cache.popitem(last=False)
+        return buf
+
+    def close(self) -> None:
+        with self._lock:
+            for buf in self._cache.values():
+                buf.close()
+            self._cache.clear()

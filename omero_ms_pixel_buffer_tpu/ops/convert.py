@@ -1,0 +1,85 @@
+"""Pixel-type handling and endian conversion.
+
+The reference's pixel types come from OMERO's ``PixelsType`` enum and
+reach the pipeline as ``bitSize/8`` bytes per pixel
+(TileRequestHandler.java:100-103); raw tile bytes are big-endian by
+OMERO/ROMIO convention, and encoded outputs declare BigEndian=true
+(createMetadata, TileRequestHandler.java:145-170).
+
+On TPU we compute in native dtypes and materialize big-endian *byte
+planes* only at the output boundary — as a vectorized shift/mask
+decomposition that XLA fuses into the surrounding kernel, never a host
+byteswap in the hot path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.lax as lax
+import jax.numpy as jnp
+import numpy as np
+
+# OMERO PixelsType enum values (ome.model.enums.PixelsType) -> numpy.
+OMERO_PIXEL_TYPES: Dict[str, np.dtype] = {
+    "int8": np.dtype(np.int8),
+    "uint8": np.dtype(np.uint8),
+    "int16": np.dtype(np.int16),
+    "uint16": np.dtype(np.uint16),
+    "int32": np.dtype(np.int32),
+    "uint32": np.dtype(np.uint32),
+    "float": np.dtype(np.float32),
+    "double": np.dtype(np.float64),
+}
+
+_NUMPY_TO_OMERO = {v: k for k, v in OMERO_PIXEL_TYPES.items()}
+
+
+def dtype_for(pixels_type: str) -> np.dtype:
+    """numpy dtype for an OMERO pixels-type name."""
+    try:
+        return OMERO_PIXEL_TYPES[pixels_type]
+    except KeyError:
+        raise ValueError(f"Unknown pixels type: {pixels_type}") from None
+
+
+def omero_type_for(dtype) -> str:
+    return _NUMPY_TO_OMERO[np.dtype(dtype)]
+
+
+def bytes_per_pixel(pixels_type: str) -> int:
+    """``bitSize/8`` (TileRequestHandler.java:100-103)."""
+    return dtype_for(pixels_type).itemsize
+
+
+def to_big_endian_bytes(x: jnp.ndarray) -> jnp.ndarray:
+    """Decompose an integer/float array of shape (..., W) into big-endian
+    bytes of shape (..., W*itemsize), staying on device.
+
+    uintN is split by shifts; signed and float types are bitcast to the
+    same-width unsigned first (two's-complement / IEEE bits pass through
+    unchanged, which is exactly what the wire formats want).
+    """
+    itemsize = x.dtype.itemsize
+    if itemsize == 1:
+        return lax.bitcast_convert_type(x, jnp.uint8)
+    if itemsize == 8:
+        # 64-bit dtypes don't exist on device without jax_enable_x64;
+        # the pipeline routes double/int64 tiles through the host path
+        # (to_big_endian_bytes_np).
+        raise ValueError("64-bit pixel types take the host conversion path")
+    unsigned = {2: jnp.uint16, 4: jnp.uint32}[itemsize]
+    bits = lax.bitcast_convert_type(x, unsigned)
+    planes = [
+        ((bits >> (8 * (itemsize - 1 - i))) & 0xFF).astype(jnp.uint8)
+        for i in range(itemsize)
+    ]
+    stacked = jnp.stack(planes, axis=-1)  # (..., W, itemsize)
+    return stacked.reshape(*x.shape[:-1], x.shape[-1] * itemsize)
+
+
+def to_big_endian_bytes_np(x: np.ndarray) -> np.ndarray:
+    """Host-side equivalent (CPU fallback engine and raw/TIFF output when
+    data never went to device)."""
+    be = np.ascontiguousarray(x.astype(x.dtype.newbyteorder(">"), copy=False))
+    return be.view(np.uint8).reshape(*x.shape[:-1], x.shape[-1] * x.dtype.itemsize)

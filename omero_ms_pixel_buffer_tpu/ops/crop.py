@@ -1,0 +1,69 @@
+"""Region crop semantics.
+
+Mirrors the reference's tile addressing (TileRequestHandler.java:89-112):
+``w==0 -> sizeX``, ``h==0 -> sizeY`` defaulting happens *before* the
+read; a region extending past the plane is an error (the reference's
+``getTileDirect`` throws, which the broad catch converts into a 404).
+
+Two implementations:
+
+- ``crop_plane`` — host/numpy, used by the per-request path and readers.
+- ``crop_batch`` — jit-friendly ``lax.dynamic_slice`` over a batch of
+  equally-shaped planes with per-lane origins (static tile shape), for
+  the coalesced TPU pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tile_ctx import RegionDef
+
+
+def resolve_region(
+    region: RegionDef, size_x: int, size_y: int
+) -> Tuple[int, int, int, int]:
+    """Apply w/h=0 defaulting and bounds-check against the plane.
+
+    Returns (x, y, w, h). Raises ValueError when the region falls outside
+    the plane (surfaces as 404 like the reference's broad catch,
+    TileRequestHandler.java:133-137) or is negative.
+    """
+    x, y, w, h = region.x, region.y, region.width, region.height
+    if w == 0:
+        w = size_x
+    if h == 0:
+        h = size_y
+    if x < 0 or y < 0 or w < 0 or h < 0:
+        raise ValueError(f"Negative region: x={x} y={y} w={w} h={h}")
+    if x + w > size_x or y + h > size_y:
+        raise ValueError(
+            f"Region out of bounds: x={x} y={y} w={w} h={h} "
+            f"plane={size_x}x{size_y}"
+        )
+    return x, y, w, h
+
+
+def crop_plane(plane: np.ndarray, x: int, y: int, w: int, h: int) -> np.ndarray:
+    """Host crop of a (Y, X) plane; caller has already resolved the
+    region."""
+    return np.ascontiguousarray(plane[y : y + h, x : x + w])
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def crop_batch(planes: jnp.ndarray, origins: jnp.ndarray, tile_h: int, tile_w: int):
+    """Batched device crop: ``planes`` is (B, Hp, Wp); ``origins`` is
+    (B, 2) int32 (y, x) per lane; tile shape is static so the whole batch
+    is one fused gather the MXU-side pipeline can consume.
+    """
+    def one(plane, origin):
+        return jax.lax.dynamic_slice(plane, (origin[0], origin[1]), (tile_h, tile_w))
+
+    return jax.vmap(one)(planes, origins)

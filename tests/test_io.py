@@ -1,0 +1,211 @@
+"""Pixel I/O layer: Zarr/OME-NGFF, OME-TIFF (pyramidal, tiled,
+compressed), ROMIO — fixture write -> reader round-trip, resolution
+levels, bounds, and the pixels-service resolution path
+(reference contracts: ome.io.nio.PixelBuffer getTileDirect /
+setResolutionLevel, ZarrPixelsService, PixelsService.getPixelBuffer)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from omero_ms_pixel_buffer_tpu.io.ometiff import (
+    OmeTiffPixelBuffer,
+    write_ome_tiff,
+)
+from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+    ImageRegistry,
+    PixelsService,
+)
+from omero_ms_pixel_buffer_tpu.io.romio import RomioPixelBuffer, write_romio
+from omero_ms_pixel_buffer_tpu.io.pixel_buffer import PixelsMeta
+from omero_ms_pixel_buffer_tpu.io.zarr import ZarrPixelBuffer, write_ngff
+
+rng = np.random.default_rng(7)
+
+
+def make_5d(t=1, c=2, z=3, y=100, x=120, dtype=np.uint16):
+    if np.dtype(dtype).kind == "f":
+        return rng.standard_normal((t, c, z, y, x)).astype(dtype)
+    hi = min(np.iinfo(dtype).max, 60000)
+    return rng.integers(0, hi, (t, c, z, y, x), dtype=dtype)
+
+
+class TestZarr:
+    @pytest.mark.parametrize("compressor", [None, "zlib", "gzip"])
+    def test_roundtrip(self, tmp_path, compressor):
+        data = make_5d()
+        root = str(tmp_path / "img.zarr")
+        write_ngff(root, data, chunks=(32, 32), compressor=compressor)
+        buf = ZarrPixelBuffer(root)
+        m = buf.meta
+        assert (m.size_t, m.size_c, m.size_z, m.size_y, m.size_x) == data.shape
+        assert m.pixels_type == "uint16"
+        tile = buf.get_tile_at(0, z=1, c=1, t=0, x=10, y=20, w=50, h=40)
+        np.testing.assert_array_equal(tile, data[0, 1, 1, 20:60, 10:60])
+
+    def test_pyramid_levels(self, tmp_path):
+        data = make_5d(z=1, c=1, y=128, x=128)
+        root = str(tmp_path / "pyr.zarr")
+        write_ngff(root, data, chunks=(32, 32), levels=3)
+        buf = ZarrPixelBuffer(root)
+        assert buf.resolution_levels == 3
+        assert buf.level_size(0) == (128, 128)
+        assert buf.level_size(1) == (64, 64)
+        assert buf.level_size(2) == (32, 32)
+        lvl1 = buf.get_tile_at(1, 0, 0, 0, 0, 0, 64, 64)
+        np.testing.assert_array_equal(lvl1, data[0, 0, 0, ::2, ::2])
+        # reference-shaped cursor API (TileRequestHandler.java:89-91)
+        buf.set_resolution_level(2)
+        np.testing.assert_array_equal(
+            buf.get_tile(0, 0, 0, 0, 0, 32, 32), data[0, 0, 0, ::4, ::4]
+        )
+
+    def test_out_of_bounds_raises(self, tmp_path):
+        data = make_5d(z=1, c=1)
+        root = str(tmp_path / "b.zarr")
+        write_ngff(root, data)
+        buf = ZarrPixelBuffer(root)
+        with pytest.raises(ValueError):
+            buf.get_tile_at(0, 0, 0, 0, 100, 0, 50, 10)  # x+w > 120
+        with pytest.raises(ValueError):
+            buf.get_tile_at(0, 5, 0, 0, 0, 0, 10, 10)  # z out of range
+        with pytest.raises(ValueError):
+            buf.set_resolution_level(3)
+
+    def test_batched_read_chunk_dedup(self, tmp_path):
+        data = make_5d(z=4, c=1)
+        root = str(tmp_path / "m.zarr")
+        write_ngff(root, data, chunks=(64, 64))
+        buf = ZarrPixelBuffer(root)
+        coords = [(z, 0, 0, 8, 8, 48, 48) for z in range(4)]
+        tiles = buf.read_tiles(coords)
+        for z, tile in enumerate(tiles):
+            np.testing.assert_array_equal(tile, data[0, 0, z, 8:56, 8:56])
+
+
+class TestOmeTiff:
+    @pytest.mark.parametrize("compression", [None, "zlib"])
+    @pytest.mark.parametrize("big_endian", [True, False])
+    def test_roundtrip(self, tmp_path, compression, big_endian):
+        data = make_5d()
+        path = str(tmp_path / "img.ome.tiff")
+        write_ome_tiff(
+            path, data, tile_size=(48, 48),
+            compression=compression, big_endian=big_endian,
+        )
+        buf = OmeTiffPixelBuffer(path)
+        m = buf.meta
+        assert (m.size_t, m.size_c, m.size_z, m.size_y, m.size_x) == data.shape
+        assert m.pixels_type == "uint16"
+        tile = buf.get_tile_at(0, z=2, c=1, t=0, x=30, y=10, w=64, h=80)
+        np.testing.assert_array_equal(tile, data[0, 1, 2, 10:90, 30:94])
+
+    def test_stripped_layout(self, tmp_path):
+        data = make_5d(c=1, z=1, dtype=np.uint8)
+        path = str(tmp_path / "strips.ome.tiff")
+        write_ome_tiff(path, data, tile_size=None)
+        buf = OmeTiffPixelBuffer(path)
+        tile = buf.get_tile_at(0, 0, 0, 0, 5, 7, 30, 20)
+        np.testing.assert_array_equal(tile, data[0, 0, 0, 7:27, 5:35])
+
+    def test_pyramid_subifds(self, tmp_path):
+        data = make_5d(c=1, z=1, y=256, x=256)
+        path = str(tmp_path / "pyr.ome.tiff")
+        write_ome_tiff(path, data, tile_size=(64, 64), pyramid_levels=3)
+        buf = OmeTiffPixelBuffer(path)
+        assert buf.resolution_levels == 3
+        assert buf.level_size(1) == (128, 128)
+        lvl2 = buf.get_tile_at(2, 0, 0, 0, 0, 0, 64, 64)
+        np.testing.assert_array_equal(lvl2, data[0, 0, 0, ::4, ::4])
+
+    def test_plane_order_xyczt(self, tmp_path):
+        data = make_5d(t=2, c=3, z=2, y=16, x=16)
+        path = str(tmp_path / "planes.ome.tiff")
+        write_ome_tiff(path, data, tile_size=None)
+        buf = OmeTiffPixelBuffer(path)
+        for t in range(2):
+            for c in range(3):
+                for z in range(2):
+                    tile = buf.get_tile_at(0, z, c, t, 0, 0, 16, 16)
+                    np.testing.assert_array_equal(tile, data[t, c, z])
+
+    @pytest.mark.parametrize("dtype", [np.uint8, np.int16, np.float32])
+    def test_dtypes(self, tmp_path, dtype):
+        data = make_5d(c=1, z=1, dtype=dtype)
+        path = str(tmp_path / "dt.ome.tiff")
+        write_ome_tiff(path, data)
+        buf = OmeTiffPixelBuffer(path)
+        tile = buf.get_tile_at(0, 0, 0, 0, 0, 0, 120, 100)
+        np.testing.assert_array_equal(tile, data[0, 0, 0])
+
+
+class TestRomio:
+    def test_roundtrip(self, tmp_path):
+        data = make_5d(t=2, c=2, z=2, y=40, x=50)
+        path = str(tmp_path / "42")
+        write_romio(path, data)
+        meta = PixelsMeta(
+            image_id=42, size_x=50, size_y=40, size_z=2, size_c=2,
+            size_t=2, pixels_type="uint16",
+        )
+        buf = RomioPixelBuffer(path, meta)
+        tile = buf.get_tile_at(0, z=1, c=1, t=1, x=5, y=10, w=20, h=15)
+        np.testing.assert_array_equal(tile, data[1, 1, 1, 10:25, 5:25])
+
+    def test_size_mismatch_rejected(self, tmp_path):
+        path = str(tmp_path / "bad")
+        with open(path, "wb") as f:
+            f.write(b"\x00" * 100)
+        meta = PixelsMeta(
+            image_id=1, size_x=50, size_y=40, size_z=1, size_c=1,
+            size_t=1, pixels_type="uint16",
+        )
+        with pytest.raises(ValueError):
+            RomioPixelBuffer(path, meta)
+
+
+class TestPixelsService:
+    def test_registry_resolution_and_cache(self, tmp_path):
+        tiff_data = make_5d(c=1, z=1)
+        zarr_data = make_5d(c=2, z=1, dtype=np.uint8)
+        write_ome_tiff(str(tmp_path / "a.ome.tiff"), tiff_data)
+        write_ngff(str(tmp_path / "b.zarr"), zarr_data)
+        romio_data = make_5d(c=1, z=1, y=32, x=32)
+        write_romio(str(tmp_path / "3"), romio_data)
+        registry_doc = {
+            "images": [
+                {"id": 1, "path": "a.ome.tiff", "name": "a"},
+                {"id": 2, "path": "b.zarr", "type": "zarr"},
+                {"id": 3, "path": "3", "type": "romio", "sizeX": 32,
+                 "sizeY": 32, "sizeZ": 1, "sizeC": 1, "sizeT": 1,
+                 "pixelsType": "uint16"},
+            ]
+        }
+        reg_path = str(tmp_path / "registry.json")
+        with open(reg_path, "w") as f:
+            json.dump(registry_doc, f)
+
+        svc = PixelsService(ImageRegistry(reg_path))
+        # metadata plane (getPixels contract: None for unknown image)
+        assert svc.get_pixels(999) is None
+        meta1 = svc.get_pixels(1)
+        assert meta1.pixels_type == "uint16" and meta1.size_x == 120
+        # buffer plane: correct reader per storage type
+        b1 = svc.get_pixel_buffer(1)
+        b2 = svc.get_pixel_buffer(2)
+        b3 = svc.get_pixel_buffer(3)
+        assert isinstance(b1, OmeTiffPixelBuffer)
+        assert isinstance(b2, ZarrPixelBuffer)
+        assert isinstance(b3, RomioPixelBuffer)
+        np.testing.assert_array_equal(
+            b1.get_tile_at(0, 0, 0, 0, 0, 0, 8, 8), tiff_data[0, 0, 0, :8, :8]
+        )
+        np.testing.assert_array_equal(
+            b2.get_tile_at(0, 0, 1, 0, 0, 0, 8, 8), zarr_data[0, 1, 0, :8, :8]
+        )
+        # cache: same instance back
+        assert svc.get_pixel_buffer(1) is b1
+        assert svc.get_pixel_buffer(999) is None
+        svc.close()

@@ -1,0 +1,160 @@
+"""Golden encode tests: PNG/TIFF streams must decode (via PIL, an
+independent decoder) to the exact source pixels — the decoded-pixel
+correctness contract from SURVEY.md §7 (viewers accept any valid
+stream; compare pixels, not bytes)."""
+
+import io
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from omero_ms_pixel_buffer_tpu.ops import png as png_ops
+from omero_ms_pixel_buffer_tpu.ops import tiff as tiff_ops
+from omero_ms_pixel_buffer_tpu.ops.convert import (
+    bytes_per_pixel,
+    dtype_for,
+    to_big_endian_bytes,
+    to_big_endian_bytes_np,
+)
+
+rng = np.random.default_rng(42)
+
+
+def pil_decode(data: bytes) -> np.ndarray:
+    return np.array(Image.open(io.BytesIO(data)))
+
+
+class TestConvert:
+    def test_bytes_per_pixel_matches_bitsize(self):
+        assert bytes_per_pixel("uint8") == 1
+        assert bytes_per_pixel("uint16") == 2
+        assert bytes_per_pixel("float") == 4
+        assert bytes_per_pixel("double") == 8
+
+    @pytest.mark.parametrize(
+        "dtype", ["uint8", "int8", "uint16", "int16", "uint32", "int32", "float"]
+    )
+    def test_device_big_endian_matches_numpy(self, dtype):
+        dt = dtype_for(dtype)
+        if dt.kind == "f":
+            arr = rng.standard_normal((5, 7)).astype(dt)
+        else:
+            info = np.iinfo(dt)
+            arr = rng.integers(info.min, info.max, (5, 7), dtype=dt)
+        dev = np.asarray(to_big_endian_bytes(arr))
+        host = to_big_endian_bytes_np(arr)
+        np.testing.assert_array_equal(dev, host)
+        # and against numpy's own big-endian serialization
+        np.testing.assert_array_equal(
+            host.reshape(-1),
+            np.frombuffer(arr.astype(dt.newbyteorder(">")).tobytes(), np.uint8),
+        )
+
+    def test_double_routes_to_host_path(self):
+        arr = rng.standard_normal((3, 3))
+        with pytest.raises(ValueError):
+            to_big_endian_bytes(arr)
+        host = to_big_endian_bytes_np(arr)
+        np.testing.assert_array_equal(
+            host.reshape(-1),
+            np.frombuffer(arr.astype(">f8").tobytes(), np.uint8),
+        )
+
+
+class TestPng:
+    @pytest.mark.parametrize("mode", ["none", "sub", "up", "average", "paeth", "adaptive"])
+    def test_uint8_roundtrip_all_filters(self, mode):
+        tile = rng.integers(0, 256, (33, 47), dtype=np.uint8)
+        data = png_ops.encode_png(tile, filter_mode=mode)
+        np.testing.assert_array_equal(pil_decode(data), tile)
+
+    @pytest.mark.parametrize("mode", ["none", "up", "paeth", "adaptive"])
+    def test_uint16_roundtrip_big_endian(self, mode):
+        tile = rng.integers(0, 65536, (16, 29), dtype=np.uint16)
+        data = png_ops.encode_png(tile, filter_mode=mode)
+        decoded = pil_decode(data)
+        np.testing.assert_array_equal(decoded.astype(np.uint16), tile)
+
+    def test_rgb_roundtrip(self):
+        tile = rng.integers(0, 256, (20, 20, 3), dtype=np.uint8)
+        data = png_ops.encode_png(tile, filter_mode="adaptive")
+        np.testing.assert_array_equal(pil_decode(data), tile)
+
+    def test_float_rejected(self):
+        with pytest.raises(png_ops.PngEncodeError):
+            png_ops.encode_png(np.zeros((4, 4), np.float32))
+
+    def test_own_decoder_agrees(self):
+        tile = rng.integers(0, 65536, (9, 11), dtype=np.uint16)
+        data = png_ops.encode_png(tile, filter_mode="paeth")
+        np.testing.assert_array_equal(png_ops.decode_png(data), tile)
+
+    @pytest.mark.parametrize("mode", ["none", "sub", "up", "average", "paeth"])
+    @pytest.mark.parametrize("dtype", [np.uint8, np.uint16])
+    def test_device_filter_matches_host(self, mode, dtype):
+        bpp = np.dtype(dtype).itemsize
+        tiles = rng.integers(0, np.iinfo(dtype).max, (3, 8, 12), dtype=dtype)
+        host = np.stack(
+            [
+                png_ops.filter_rows_np(
+                    to_big_endian_bytes_np(t), bpp, mode
+                )
+                for t in tiles
+            ]
+        )
+        rows_dev = to_big_endian_bytes(tiles)  # (3, 8, 12*bpp)
+        dev = np.asarray(png_ops.filter_batch(rows_dev, bpp, mode))
+        np.testing.assert_array_equal(dev, host)
+
+    def test_device_filtered_scanlines_make_valid_png(self):
+        tiles = rng.integers(0, 65536, (2, 10, 13), dtype=np.uint16)
+        rows = to_big_endian_bytes(tiles)
+        filtered = np.asarray(png_ops.filter_batch(rows, 2, "up"))
+        for i, t in enumerate(tiles):
+            data = png_ops.assemble_png(filtered[i].tobytes(), 13, 10, 16, 0)
+            np.testing.assert_array_equal(
+                pil_decode(data).astype(np.uint16), t
+            )
+
+
+class TestTiff:
+    @pytest.mark.parametrize(
+        "dtype", [np.uint8, np.uint16, np.int16, np.float32]
+    )
+    def test_roundtrip_pil(self, dtype):
+        if np.dtype(dtype).kind == "f":
+            tile = rng.standard_normal((15, 21)).astype(dtype)
+        else:
+            info = np.iinfo(dtype)
+            tile = rng.integers(info.min, info.max, (15, 21), dtype=dtype)
+        data = tiff_ops.encode_tiff(tile)
+        decoded = pil_decode(data)
+        np.testing.assert_array_equal(decoded.astype(dtype), tile)
+
+    def test_rgb_roundtrip(self):
+        tile = rng.integers(0, 256, (10, 12, 3), dtype=np.uint8)
+        data = tiff_ops.encode_tiff(tile)
+        np.testing.assert_array_equal(pil_decode(data), tile)
+
+    def test_big_endian_and_ome_xml(self):
+        tile = np.zeros((4, 6), np.uint16)
+        data = tiff_ops.encode_tiff(tile)
+        assert data[:2] == b"MM"  # BigEndian=true contract
+        assert b'DimensionOrder="XYCZT"' in data
+        assert b'BigEndian="true"' in data
+        assert b'Type="uint16"' in data
+        assert b'SizeX="6"' in data and b'SizeY="4"' in data
+
+    def test_own_decoder_agrees(self):
+        tile = rng.integers(-30000, 30000, (7, 9), dtype=np.int16)
+        data = tiff_ops.encode_tiff(tile)
+        np.testing.assert_array_equal(tiff_ops.decode_tiff(data), tile)
+
+    def test_uint32_and_double_supported(self):
+        t32 = rng.integers(0, 2**32, (5, 5), dtype=np.uint32)
+        d = tiff_ops.encode_tiff(t32)
+        np.testing.assert_array_equal(tiff_ops.decode_tiff(d), t32)
+        tf64 = rng.standard_normal((5, 5))
+        d = tiff_ops.encode_tiff(tf64)
+        np.testing.assert_array_equal(tiff_ops.decode_tiff(d), tf64)
