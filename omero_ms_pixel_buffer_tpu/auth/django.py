@@ -1,0 +1,91 @@
+"""Django / OMERO.web session payload decoding.
+
+The reference's session stores (omero-ms-core
+OmeroWebRedisSessionStore / OmeroWebJDBCSessionStore, installed at
+PixelBufferMicroserviceVerticle.java:262-276) read OMERO.web's Django
+session rows and extract the OMERO session key from the pickled
+``connector`` object inside the session dict.
+
+OMERO.web serializes sessions as base64(hmac_sha1 + ":" pickle) (the
+classic Django PickleSerializer layout) or raw pickle (cache backend).
+The connector is an ``omeroweb.connector.Connector`` instance — a class
+this process doesn't have — so unpickling uses a tolerant Unpickler
+that materializes unknown classes as attribute bags, then pulls
+``omero_session_key`` out of the connector.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import pickle
+import zlib
+from typing import Any, Optional
+
+
+class _Stub:
+    """Attribute bag standing in for unimportable classes
+    (omeroweb.connector.Connector et al.)."""
+
+    def __init__(self, *args, **kwargs):
+        self.__dict__["_args"] = args
+        self.__dict__.update(kwargs)
+
+    def __setstate__(self, state):
+        if isinstance(state, dict):
+            self.__dict__.update(state)
+        else:
+            self.__dict__["_state"] = state
+
+
+class _TolerantUnpickler(pickle.Unpickler):
+    """NEVER resolves real classes: every GLOBAL/STACK_GLOBAL opcode
+    materializes an inert attribute bag. Session payloads come from a
+    store an attacker may be able to write to (shared Redis), and a
+    resolving unpickler is arbitrary code execution (os.system via
+    REDUCE). Extraction only needs dicts/strings/attribute bags, which
+    pickle encodes without find_class."""
+
+    def find_class(self, module, name):
+        return type(name, (_Stub,), {"__module__": module})
+
+
+def _loads(data: bytes) -> Any:
+    return _TolerantUnpickler(io.BytesIO(data)).load()
+
+
+def decode_session_payload(payload: bytes) -> Optional[dict]:
+    """Decode a Django session payload into the session dict. Handles:
+    raw pickle, zlib pickle, and base64("hash:pickle") legacy layouts.
+    Returns None when nothing decodes."""
+    candidates = [payload]
+    try:
+        candidates.append(zlib.decompress(payload))
+    except Exception:
+        pass
+    try:
+        decoded = base64.b64decode(payload)
+        candidates.append(decoded)
+        if b":" in decoded:
+            candidates.append(decoded.split(b":", 1)[1])
+    except Exception:
+        pass
+    for cand in candidates:
+        try:
+            obj = _loads(cand)
+        except Exception:
+            continue
+        if isinstance(obj, dict):
+            return obj
+    return None
+
+
+def extract_omero_session_key(session: dict) -> Optional[str]:
+    """Pull the OMERO session key from a decoded OMERO.web session dict
+    (the OmeroWebSessionStore contract: session -> key or None)."""
+    connector = session.get("connector")
+    if connector is None:
+        return None
+    if isinstance(connector, dict):
+        return connector.get("omero_session_key")
+    return getattr(connector, "omero_session_key", None)

@@ -1,0 +1,52 @@
+"""Per-request OMERO session validation.
+
+Replaces omero-ms-core's ``OmeroRequest``
+(PixelBufferVerticle.java:106-110): the reference joins the OMERO
+server session over Ice/Glacier2 per request; a bad key raises
+PermissionDenied/CannotCreateSession -> 403.
+
+The validator interface keeps that contract at the dispatch boundary.
+Implementations:
+
+- ``AllowListValidator`` — standalone/bench mode: a key is valid when
+  the session store produced it (it came from an authenticated
+  OMERO.web session) and matches the optional allow-set.
+- ``IceSessionValidator`` — placeholder for a real Glacier2 join; the
+  environment has no Ice runtime or OMERO server, so constructing it
+  raises with a clear message. The wire contract (join by key, fail
+  403) is what matters for parity; plugging a real client in later
+  touches only this module.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Set
+
+
+class SessionValidator:
+    async def validate(self, omero_session_key: Optional[str]) -> bool:
+        raise NotImplementedError
+
+
+class AllowListValidator(SessionValidator):
+    """Accepts any non-empty key (the store already authenticated the
+    browser session), optionally restricted to an explicit allow-set."""
+
+    def __init__(self, allowed: Optional[Iterable[str]] = None):
+        self.allowed: Optional[Set[str]] = set(allowed) if allowed else None
+
+    async def validate(self, omero_session_key: Optional[str]) -> bool:
+        if not omero_session_key:
+            return False
+        if self.allowed is not None:
+            return omero_session_key in self.allowed
+        return True
+
+
+class IceSessionValidator(SessionValidator):
+    def __init__(self, host: str, port: int):
+        raise NotImplementedError(
+            "Glacier2 session join requires the Ice runtime (zeroc-ice), "
+            "which this build does not bundle. Use the allow-list "
+            "validator, or deploy alongside an Ice-enabled sidecar."
+        )

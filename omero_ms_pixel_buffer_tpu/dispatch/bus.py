@@ -1,0 +1,69 @@
+"""In-process event bus — the dispatch boundary.
+
+Replaces Vert.x EventBus request/reply as used by the reference
+(PixelBufferMicroserviceVerticle.java:352-354 request with
+DeliveryOptions sendTimeout; PixelBufferVerticle.java:86-88 consumer;
+fail(code, message) replies): named addresses, JSON-able payloads,
+per-request deadline, typed failure codes.
+
+This is the plugin boundary the north star preserves: the HTTP front
+only ever talks to ``GET_TILE_EVENT``; swapping the consumer (single
+worker, batching executor, remote process) never touches the routes.
+
+Timeout semantics mirror Vert.x: a reply that misses the deadline
+fails with code -1, which the HTTP mapping coerces to 500
+(PixelBufferMicroserviceVerticle.java:364-368).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+
+from ..errors import TileError
+
+# address constant (PixelBufferVerticle.java:52-53)
+GET_TILE_EVENT = "omero.pixel_buffer.get_tile"
+
+Handler = Callable[[Any], Awaitable[Tuple[Any, Dict[str, str]]]]
+
+
+class Message:
+    """Reply envelope: body + headers (the reference's filename header
+    rides here, PixelBufferVerticle.java:118-127)."""
+
+    __slots__ = ("body", "headers")
+
+    def __init__(self, body: Any, headers: Optional[Dict[str, str]] = None):
+        self.body = body
+        self.headers = headers or {}
+
+
+class EventBus:
+    def __init__(self):
+        self._consumers: Dict[str, Handler] = {}
+
+    def consumer(self, address: str, handler: Handler) -> None:
+        """Register the handler for an address. Handlers return
+        (body, headers) or raise TileError for typed failures."""
+        self._consumers[address] = handler
+
+    async def request(
+        self, address: str, payload: Any, timeout_ms: float = 15000.0
+    ) -> Message:
+        handler = self._consumers.get(address)
+        if handler is None:
+            # Vert.x NO_HANDLERS failure type
+            raise TileError(-1, f"No handlers for address {address}")
+        try:
+            result = await asyncio.wait_for(
+                handler(payload), timeout=timeout_ms / 1000.0
+            )
+        except asyncio.TimeoutError:
+            raise TileError(
+                -1, f"Timed out after {timeout_ms:.0f} ms waiting for a reply"
+            ) from None
+        if isinstance(result, Message):
+            return result
+        body, headers = result
+        return Message(body, headers)

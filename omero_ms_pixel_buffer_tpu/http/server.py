@@ -1,0 +1,265 @@
+"""HTTP front — routes, session adoption, error mapping, headers.
+
+Replaces the reference's front verticle
+(PixelBufferMicroserviceVerticle.java):
+
+- ``GET /metrics`` — Prometheus text, registered before auth (order -2,
+  :238-240), unauthenticated;
+- ``OPTIONS *`` — microservice discovery JSON
+  {provider, version, features} (:315-327);
+- router-wide tracing span tagged ``omero.session_key`` (:242-251);
+- router-wide OMERO.web session adoption: ``sessionid`` cookie ->
+  session store -> ``omero.session_key`` or 403 (:275-276);
+- ``GET /tile/:imageId/:z/:c/:t`` -> TileCtx parse (400 with message on
+  failure, :340-348) -> event-bus request with send timeout (:352-354)
+  -> response assembly: Content-Type by format, Content-Length,
+  Content-Disposition attachment with the reply's filename header
+  (:372-392); failures map via failureCode (404 default, <1 -> 500,
+  :356-370).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+from .. import __version__
+from ..auth.omero_session import AllowListValidator, SessionValidator
+from ..auth.stores import OmeroWebSessionStore, make_session_store
+from ..dispatch.batcher import BatchingTileWorker
+from ..dispatch.bus import GET_TILE_EVENT, EventBus
+from ..errors import TileError, http_status_for_failure
+from ..io.pixels_service import ImageRegistry, PixelsService
+from ..models.tile_pipeline import TilePipeline
+from ..tile_ctx import TileCtx
+from ..utils.config import Config
+from ..utils.metrics import REGISTRY
+from ..utils.tracing import TRACER, configure as configure_tracing
+
+log = logging.getLogger("omero_ms_pixel_buffer_tpu.http")
+
+CONTENT_TYPES = {
+    None: "application/octet-stream",
+    "png": "image/png",
+    "tif": "image/tiff",
+}
+
+
+async def handle_metrics(request: web.Request) -> web.Response:
+    return web.Response(
+        text=REGISTRY.exposition(),
+        content_type="text/plain",
+        charset="utf-8",
+    )
+
+
+async def handle_options(request: web.Request) -> web.Response:
+    # getMicroserviceDetails (:315-327)
+    return web.json_response(
+        {
+            "provider": "PixelBufferMicroservice",
+            "version": __version__,
+            "features": [],
+        }
+    )
+
+
+@web.middleware
+async def tracing_middleware(request: web.Request, handler):
+    span = TRACER.start_span(f"http:{request.path}")
+    request["span"] = span
+    with span:
+        try:
+            return await handler(request)
+        finally:
+            # session middleware runs after us (the reference's order
+            # -1 tracing handler also precedes auth); tag at finish
+            key = request.get("omero.session_key")
+            if key:
+                span.tag("omero.session_key", key)
+
+
+def session_middleware(store: OmeroWebSessionStore):
+    """OmeroWebSessionRequestHandler analog: resolve the ``sessionid``
+    cookie to an OMERO session key; 403 when absent/unknown. /metrics
+    and OPTIONS are registered before auth in the reference and stay
+    open here."""
+
+    @web.middleware
+    async def middleware(request: web.Request, handler):
+        if request.path == "/metrics" or request.method == "OPTIONS":
+            return await handler(request)
+        session_id = request.cookies.get("sessionid")
+        if not session_id:
+            return web.Response(status=403, text="Permission denied")
+        key = await store.get_omero_session_key(session_id)
+        if not key:
+            return web.Response(status=403, text="Permission denied")
+        request["omero.session_key"] = key
+        return await handler(request)
+
+    return middleware
+
+
+class PixelBufferApp:
+    """Wires config -> session store -> pixels service -> pipeline ->
+    batching worker -> bus -> routes (the deploy() analog,
+    PixelBufferMicroserviceVerticle.java:145-292)."""
+
+    def __init__(
+        self,
+        config: Config,
+        pixels_service: Optional[PixelsService] = None,
+        session_store: Optional[OmeroWebSessionStore] = None,
+        session_validator: Optional[SessionValidator] = None,
+    ):
+        self.config = config
+        if config.zipkin_url:
+            # No Zipkin exporter is implemented yet; fall back to the
+            # log reporter rather than silently dropping spans
+            # (reference fallback: LogSpanReporter when no sender,
+            # PixelBufferMicroserviceVerticle.java:180-184).
+            log.warning(
+                "http-tracing.zipkin-url is set but Zipkin export is not "
+                "implemented; spans will be logged instead"
+            )
+        configure_tracing(
+            enabled=True,
+            log_spans=config.http_tracing_enabled,
+        )
+        self.session_store = session_store or make_session_store(
+            config.session_store.type, config.session_store.uri
+        )
+        if pixels_service is None:
+            registry = ImageRegistry(config.image_registry)
+            pixels_service = PixelsService(registry)
+        self.pixels_service = pixels_service
+        self.session_validator = session_validator or AllowListValidator()
+        batching = config.backend.batching
+        self.pipeline = TilePipeline(
+            pixels_service,
+            use_device=(
+                config.backend.engine == "jax" and batching.device_encode
+            ),
+            buckets=batching.buckets,
+        )
+        self.worker = BatchingTileWorker(
+            self.pipeline,
+            self.session_validator,
+            max_batch=batching.max_batch,
+            coalesce_window_ms=batching.coalesce_window_ms,
+        )
+        self.bus = EventBus()
+        self.bus.consumer(GET_TILE_EVENT, self.worker.handle)
+
+    def make_app(self) -> web.Application:
+        app = web.Application(
+            middlewares=[
+                tracing_middleware,
+                session_middleware(self.session_store),
+            ]
+        )
+        app.router.add_get("/metrics", handle_metrics)
+        app.router.add_route("OPTIONS", "/{tail:.*}", handle_options)
+        app.router.add_get(
+            "/tile/{imageId}/{z}/{c}/{t}", self.handle_get_tile
+        )
+        app.on_startup.append(self._on_startup)
+        app.on_cleanup.append(self._on_cleanup)
+        return app
+
+    async def _on_startup(self, app) -> None:
+        await self.worker.start()
+
+    async def _on_cleanup(self, app) -> None:
+        # stop() analog (:298-308)
+        await self.worker.close()
+        await self.session_store.close()
+        self.pixels_service.close()
+
+    async def handle_get_tile(self, request: web.Request) -> web.Response:
+        log.info("Get tile")
+        params = dict(request.match_info)
+        params.update(request.query)
+        try:
+            ctx = TileCtx.from_params(
+                params, request.get("omero.session_key")
+            )
+        except TileError as e:
+            return web.Response(status=400, text=e.message)
+        ctx.trace_context = TRACER.inject(request.get("span"))
+
+        try:
+            reply = await self.bus.request(
+                GET_TILE_EVENT,
+                ctx,
+                timeout_ms=self.config.event_bus_send_timeout_ms,
+            )
+        except Exception as e:
+            status = http_status_for_failure(e)
+            if status < 1:
+                status = 500
+            return web.Response(status=status)
+
+        tile: bytes = reply.body
+        headers = {
+            "Content-Type": CONTENT_TYPES.get(
+                ctx.format, "application/octet-stream"
+            ),
+            "Content-Length": str(len(tile)),
+            "Content-Disposition": (
+                f'attachment; filename="{reply.headers.get("filename", "")}"'
+            ),
+        }
+        return web.Response(body=tile, headers=headers)
+
+
+def create_app(
+    config: Config,
+    pixels_service: Optional[PixelsService] = None,
+    session_store: Optional[OmeroWebSessionStore] = None,
+    session_validator: Optional[SessionValidator] = None,
+) -> web.Application:
+    return PixelBufferApp(
+        config, pixels_service, session_store, session_validator
+    ).make_app()
+
+
+def main(argv: Optional[list] = None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="TPU pixel-buffer service")
+    parser.add_argument("--config", default="conf/config.yaml")
+    parser.add_argument("--port", type=int, default=None)
+    parser.add_argument(
+        "--dev", action="store_true",
+        help="accept any sessionid cookie (echo session store); "
+        "implies an in-memory store — never use in production",
+    )
+    parser.add_argument("--registry", default=None,
+                        help="image registry JSON (overrides config)")
+    args = parser.parse_args(argv)
+    config = Config.load(args.config, default_memory_store=args.dev)
+    if args.port is not None:
+        config.port = args.port
+    if args.registry is not None:
+        config.image_registry = args.registry
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s - %(message)s",
+    )
+    session_store = None
+    if args.dev:
+        from ..auth.stores import EchoSessionStore
+
+        session_store = EchoSessionStore()
+    app = create_app(config, session_store=session_store)
+    log.info("Starting HTTP server *:%d", config.port)
+    web.run_app(app, port=config.port, access_log=None)
+
+
+if __name__ == "__main__":
+    main()

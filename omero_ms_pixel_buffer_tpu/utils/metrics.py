@@ -1,0 +1,144 @@
+"""Prometheus metrics — self-contained registry + text exposition.
+
+Replaces the reference's Prometheus wiring
+(PixelBufferMicroserviceVerticle.java:202-218,238-240: MetricsHandler on
+``GET /metrics``, JVM/hotspot collectors, span-duration metrics via
+PrometheusSpanHandler). No prometheus_client in the environment; the
+text exposition format is a few lines of string assembly and the
+framework wants zero-dependency counters on the hot path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, Iterable, Optional, Tuple
+
+_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+    5.0, 10.0, float("inf"),
+)
+
+
+def _fmt_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str):
+        self.name, self.help = name, help_
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = defaultdict(float)
+        self._lock = threading.Lock()
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] += value
+
+    def collect(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} counter"
+        with self._lock:
+            items = list(self._values.items()) or [((), 0.0)]
+        for labels, v in items:
+            yield f"{self.name}{_fmt_labels(labels)} {v}"
+
+
+class Gauge(Counter):
+    def set(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = value
+
+    def collect(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} gauge"
+        with self._lock:
+            items = list(self._values.items()) or [((), 0.0)]
+        for labels, v in items:
+            yield f"{self.name}{_fmt_labels(labels)} {v}"
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str, buckets=_BUCKETS):
+        self.name, self.help = name, help_
+        self.buckets = buckets
+        self._counts: Dict[Tuple[Tuple[str, str], ...], list] = {}
+        self._sums: Dict[Tuple[Tuple[str, str], ...], float] = defaultdict(float)
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[key] += value
+
+    def time(self, **labels):
+        return _Timer(self, labels)
+
+    def collect(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} histogram"
+        with self._lock:
+            items = list(self._counts.items())
+            sums = dict(self._sums)
+        for labels, counts in items:
+            for b, c in zip(self.buckets, counts):
+                le = "+Inf" if b == float("inf") else repr(b)
+                lab = labels + (("le", le),)
+                yield f"{self.name}_bucket{_fmt_labels(lab)} {c}"
+            yield f"{self.name}_count{_fmt_labels(labels)} {counts[-1]}"
+            yield f"{self.name}_sum{_fmt_labels(labels)} {sums[labels]}"
+
+
+class _Timer:
+    def __init__(self, hist: Histogram, labels: dict):
+        self.hist, self.labels = hist, labels
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(time.perf_counter() - self.t0, **self.labels)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: list = []
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._register(Counter(name, help_))
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._register(Gauge(name, help_))
+
+    def histogram(self, name: str, help_: str = "", **kw) -> Histogram:
+        return self._register(Histogram(name, help_, **kw))
+
+    def _register(self, metric):
+        with self._lock:
+            self._metrics.append(metric)
+        return metric
+
+    def exposition(self) -> str:
+        """Prometheus text format (the GET /metrics body)."""
+        lines = []
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
+            lines.extend(m.collect())
+        return "\n".join(lines) + "\n"
+
+
+# Default process-wide registry (the reference's CollectorRegistry
+# .defaultRegistry analog).
+REGISTRY = Registry()

@@ -1,0 +1,147 @@
+"""Tracing with the reference's span taxonomy.
+
+Replaces the Brave/Zipkin stack (PixelBufferMicroserviceVerticle.java:
+169-200; omero-ms-core OmeroHttpTracingHandler/LogSpanReporter/
+PrometheusSpanHandler): per-request root span tagged with the session
+key, child spans naming every pipeline stage, trace context propagated
+across the dispatch boundary inside the ctx JSON
+(TileCtx/OmeroRequestCtx traceContext;
+PixelBufferVerticle.java:101-104), finished spans feeding span-duration
+metrics.
+
+Span taxonomy kept verbatim from the reference so dashboards translate
+1:1: ``handle_get_tile``, ``get_pixels``, ``get_pixel_buffer``,
+``get_tile_direct``, ``create_metadata``, ``write_image``
+(PixelBufferVerticle.java:101; TileRequestHandler.java:82,104-105,147,
+180,203,226) — plus TPU-side additions ``batch_stage``,
+``batch_device``, ``batch_encode``.
+
+Reporter model mirrors the reference's config gates: disabled -> noop;
+enabled without sink -> log reporter (LogSpanReporter analog). Span
+durations always land in the ``span_duration_seconds`` histogram
+(PrometheusSpanHandler analog).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import logging
+import threading
+import time
+import uuid
+from typing import Optional
+
+from .metrics import REGISTRY
+
+log = logging.getLogger("omero_ms_pixel_buffer_tpu.tracing")
+
+SPAN_SECONDS = REGISTRY.histogram(
+    "span_duration_seconds", "Duration of tracing spans by name"
+)
+
+_current_span: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "current_span", default=None
+)
+
+
+class Span:
+    __slots__ = ("tracer", "name", "trace_id", "span_id", "parent_id",
+                 "tags", "t0", "duration", "_token")
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str,
+                 parent_id: Optional[str]):
+        self.tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = uuid.uuid4().hex[:16]
+        self.parent_id = parent_id
+        self.tags: dict = {}
+        self.t0 = time.perf_counter()
+        self.duration: Optional[float] = None
+        self._token = None
+
+    def tag(self, key: str, value) -> "Span":
+        self.tags[key] = value
+        return self
+
+    def error(self, exc: BaseException) -> "Span":
+        self.tags["error"] = repr(exc)
+        return self
+
+    def finish(self) -> None:
+        self.duration = time.perf_counter() - self.t0
+        self.tracer._report(self)
+
+    # context-manager / scoped-span usage
+    def __enter__(self) -> "Span":
+        self._token = _current_span.set(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc is not None:
+            self.error(exc)
+        if self._token is not None:
+            _current_span.reset(self._token)
+        self.finish()
+
+
+class Tracer:
+    """ALWAYS_SAMPLE tracer (reference: Tracing.newBuilder()...
+    .sampler(ALWAYS_SAMPLE), PixelBufferMicroserviceVerticle.java:185-190)."""
+
+    def __init__(self, enabled: bool = True, log_spans: bool = False,
+                 service_name: str = "omero-ms-pixel-buffer-tpu"):
+        self.enabled = enabled
+        self.log_spans = log_spans
+        self.service_name = service_name
+        self._lock = threading.Lock()
+
+    def start_span(self, name: str, parent: Optional[Span] = None) -> Span:
+        if parent is None:
+            parent = _current_span.get()
+        if parent is not None:
+            return Span(self, name, parent.trace_id, parent.span_id)
+        return Span(self, name, uuid.uuid4().hex, None)
+
+    def start_span_with_context(self, name: str, ctx: dict) -> Span:
+        """Join a trace propagated across the dispatch boundary
+        (extractor().extract(traceContext) analog,
+        PixelBufferVerticle.java:101-104)."""
+        trace_id = ctx.get("traceId") or uuid.uuid4().hex
+        span = Span(self, name, trace_id, ctx.get("spanId"))
+        return span
+
+    @staticmethod
+    def inject(span: Optional[Span]) -> dict:
+        """Trace context for the ctx JSON
+        (injectCurrentTraceContext analog,
+        PixelBufferMicroserviceVerticle.java:349)."""
+        if span is None:
+            span = _current_span.get()
+        if span is None:
+            return {}
+        return {"traceId": span.trace_id, "spanId": span.span_id}
+
+    def _report(self, span: Span) -> None:
+        if not self.enabled:
+            return
+        SPAN_SECONDS.observe(span.duration or 0.0, name=span.name)
+        if self.log_spans:
+            log.info(
+                "span %s trace=%s id=%s parent=%s %.3fms tags=%s",
+                span.name, span.trace_id, span.span_id, span.parent_id,
+                (span.duration or 0) * 1e3, span.tags,
+            )
+
+
+# process default (reference: Tracing.currentTracer())
+TRACER = Tracer()
+
+
+def current_tracer() -> Tracer:
+    return TRACER
+
+
+def configure(enabled: bool, log_spans: bool) -> None:
+    TRACER.enabled = enabled
+    TRACER.log_spans = log_spans

@@ -2,7 +2,11 @@
 paths are exercised without TPU hardware (the driver separately dry-runs
 the multi-chip path; bench.py runs on the real chip)."""
 
+import asyncio
+import inspect
 import os
+
+import pytest
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
@@ -10,3 +14,32 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+
+# -- minimal async-test support (no pytest-asyncio in the image) -----------
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.run_until_complete(loop.shutdown_asyncgens())
+    loop.close()
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    fn = pyfuncitem.obj
+    if not inspect.iscoroutinefunction(fn):
+        return None
+    kwargs = {
+        name: pyfuncitem.funcargs[name]
+        for name in pyfuncitem._fixtureinfo.argnames
+        if name in pyfuncitem.funcargs
+    }
+    loop = pyfuncitem.funcargs.get("loop")
+    if loop is not None:
+        loop.run_until_complete(fn(**kwargs))
+    else:
+        asyncio.run(fn(**kwargs))
+    return True

@@ -1,0 +1,159 @@
+"""Auth (Django session decode, stores, validator), dispatch bus
+semantics (timeout -> code -1 -> 500), and metrics exposition."""
+
+import base64
+import pickle
+
+import pytest
+
+from omero_ms_pixel_buffer_tpu.auth.django import (
+    decode_session_payload,
+    extract_omero_session_key,
+)
+from omero_ms_pixel_buffer_tpu.auth.omero_session import AllowListValidator
+from omero_ms_pixel_buffer_tpu.auth.stores import (
+    MemorySessionStore,
+    PostgresSessionStore,
+    make_session_store,
+)
+from omero_ms_pixel_buffer_tpu.dispatch.bus import EventBus
+from omero_ms_pixel_buffer_tpu.errors import TileError, http_status_for_failure
+from omero_ms_pixel_buffer_tpu.utils.metrics import Registry
+
+
+class FakeConnector:
+    """Stands in for omeroweb.connector.Connector in pickles."""
+
+    def __init__(self, key):
+        self.omero_session_key = key
+        self.server_id = 1
+
+
+class TestDjangoDecode:
+    def test_raw_pickle_dict(self):
+        payload = pickle.dumps({"connector": FakeConnector("abc-123")})
+        session = decode_session_payload(payload)
+        assert extract_omero_session_key(session) == "abc-123"
+
+    def test_base64_hash_colon_pickle(self):
+        inner = pickle.dumps({"connector": FakeConnector("k-9")})
+        payload = base64.b64encode(b"fakehash:" + inner)
+        session = decode_session_payload(payload)
+        assert extract_omero_session_key(session) == "k-9"
+
+    def test_unknown_class_tolerated(self):
+        # pickle referencing a class that can't be imported at load time
+        # (the omeroweb.connector.Connector situation)
+        import sys
+        import types
+
+        mod = types.ModuleType("omeroweb_gone")
+        class Connector:  # noqa: E306
+            def __init__(self, key):
+                self.omero_session_key = key
+        Connector.__module__ = "omeroweb_gone"
+        Connector.__qualname__ = "Connector"
+        mod.Connector = Connector
+        sys.modules["omeroweb_gone"] = mod
+        try:
+            raw = pickle.dumps({"connector": Connector("z-1")})
+        finally:
+            del sys.modules["omeroweb_gone"]
+        session = decode_session_payload(raw)
+        assert extract_omero_session_key(session) == "z-1"
+
+    def test_garbage_returns_none(self):
+        assert decode_session_payload(b"\x00\x01garbage") is None
+
+    def test_missing_connector(self):
+        assert extract_omero_session_key({"other": 1}) is None
+
+
+class TestStores:
+    async def test_memory_store(self):
+        store = MemorySessionStore({"sid": "key"})
+        assert await store.get_omero_session_key("sid") == "key"
+        assert await store.get_omero_session_key("nope") is None
+
+    def test_factory(self):
+        assert isinstance(make_session_store("memory", None), MemorySessionStore)
+        with pytest.raises(NotImplementedError):
+            make_session_store("postgres", "jdbc:postgresql://x/db")
+        with pytest.raises(ValueError):
+            make_session_store("dynamo", None)
+
+    async def test_validator(self):
+        v = AllowListValidator()
+        assert await v.validate("any-key")
+        assert not await v.validate(None)
+        assert not await v.validate("")
+        v2 = AllowListValidator(allowed=["k1"])
+        assert await v2.validate("k1")
+        assert not await v2.validate("k2")
+
+
+class TestBus:
+    async def test_request_reply(self):
+        bus = EventBus()
+
+        async def handler(payload):
+            return b"data", {"filename": "f.bin"}
+
+        bus.consumer("addr", handler)
+        msg = await bus.request("addr", {"x": 1})
+        assert msg.body == b"data"
+        assert msg.headers["filename"] == "f.bin"
+
+    async def test_timeout_maps_to_500(self):
+        import asyncio
+
+        bus = EventBus()
+
+        async def slow(payload):
+            await asyncio.sleep(1.0)
+            return b"", {}
+
+        bus.consumer("slow", slow)
+        with pytest.raises(TileError) as ei:
+            await bus.request("slow", None, timeout_ms=30)
+        # Vert.x timeout failure code -1 -> HTTP 500
+        assert ei.value.code == -1
+        assert http_status_for_failure(ei.value) == 500
+
+    async def test_no_handlers(self):
+        bus = EventBus()
+        with pytest.raises(TileError) as ei:
+            await bus.request("nowhere", None)
+        assert ei.value.code == -1
+
+    async def test_typed_failure_propagates(self):
+        bus = EventBus()
+
+        async def failing(payload):
+            raise TileError(404, "Cannot find Image:5")
+
+        bus.consumer("f", failing)
+        with pytest.raises(TileError) as ei:
+            await bus.request("f", None)
+        assert ei.value.code == 404
+
+
+class TestMetrics:
+    def test_exposition_format(self):
+        reg = Registry()
+        c = reg.counter("requests_total", "Requests")
+        c.inc(format="png")
+        c.inc(format="png")
+        c.inc(format="raw")
+        h = reg.histogram("latency_seconds", "Latency", buckets=(0.1, 1.0, float("inf")))
+        h.observe(0.05)
+        h.observe(0.5)
+        text = reg.exposition()
+        assert 'requests_total{format="png"} 2' in text
+        assert 'requests_total{format="raw"} 1' in text
+        assert 'latency_seconds_bucket{le="0.1"} 1' in text
+        assert 'latency_seconds_bucket{le="+Inf"} 2' in text
+        assert "latency_seconds_count 2" in text
+        g = reg.gauge("up", "Up")
+        g.set(1.0)
+        assert "up 1.0" in reg.exposition()
