@@ -1,0 +1,143 @@
+"""North-star benchmark (BASELINE.json): tiles/sec for 512x512 uint16
+PNG tiles served from a large pyramidal OME-TIFF under concurrent load.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+- value: tiles/sec of the batched TPU pipeline (coalesced batches,
+  device byteswap+filter, threaded host deflate) over 1024 requests.
+- vs_baseline: speedup over the reference-architecture path measured
+  in-process — one request at a time, single-threaded, host-only
+  (read -> numpy filter -> zlib), i.e. the shape of the reference's
+  per-request Java worker (TileRequestHandler.java:80-139). The Java
+  service itself is not runnable in this environment (BASELINE.md:
+  baseline must be measured); this stand-in preserves its execution
+  structure on identical inputs.
+
+All progress chatter goes to stderr; stdout carries only the JSON line.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build_fixture(root: str, size: int = 8192):
+    from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
+
+    path = os.path.join(root, f"bench_{size}.ome.tiff")
+    if os.path.exists(path):
+        return path
+    log(f"writing {size}x{size} uint16 fixture...")
+    rng = np.random.default_rng(42)
+    # smooth-ish synthetic microscopy-like data (compresses realistically,
+    # unlike white noise)
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32)
+    base = (
+        2000
+        + 1500 * np.sin(xx / 97.0)
+        + 1500 * np.cos(yy / 131.0)
+    )
+    data = (base + rng.normal(0, 120, (size, size))).clip(0, 65535)
+    data = data.astype(np.uint16)[None, None, None]
+    write_ome_tiff(path, data, tile_size=(512, 512), compression="zlib")
+    return path
+
+
+def make_ctxs(n, size, tile=512, fmt="png", seed=7):
+    from omero_ms_pixel_buffer_tpu.tile_ctx import RegionDef, TileCtx
+
+    rng = np.random.default_rng(seed)
+    ctxs = []
+    for _ in range(n):
+        x = int(rng.integers(0, (size - tile) // 64)) * 64
+        y = int(rng.integers(0, (size - tile) // 64)) * 64
+        ctxs.append(
+            TileCtx(
+                image_id=1, z=0, c=0, t=0,
+                region=RegionDef(x, y, tile, tile),
+                format=fmt, omero_session_key="bench",
+            )
+        )
+    return ctxs
+
+
+def main():
+    t_setup = time.perf_counter()
+    from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+        ImageRegistry,
+        PixelsService,
+    )
+    from omero_ms_pixel_buffer_tpu.models.tile_pipeline import TilePipeline
+
+    cache_dir = os.environ.get(
+        "BENCH_CACHE", os.path.join(tempfile.gettempdir(), "ompb_bench")
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    size = int(os.environ.get("BENCH_IMAGE_SIZE", "8192"))
+    n_requests = int(os.environ.get("BENCH_REQUESTS", "1024"))
+    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    path = build_fixture(cache_dir, size)
+
+    registry = ImageRegistry()
+    registry.add(1, path)
+    service = PixelsService(registry)
+
+    # --- baseline: reference-architecture path (sequential, host) -----
+    base_pipe = TilePipeline(service, use_device=False, encode_workers=1)
+    base_ctxs = make_ctxs(64, size)
+    for ctx in base_ctxs[:4]:  # warm page cache + code paths
+        assert base_pipe.handle(ctx) is not None
+    t0 = time.perf_counter()
+    for ctx in base_ctxs:
+        out = base_pipe.handle(ctx)
+        assert out is not None
+    host_tps = len(base_ctxs) / (time.perf_counter() - t0)
+    log(f"baseline (sequential host path): {host_tps:.1f} tiles/s")
+
+    # --- TPU batched path ---------------------------------------------
+    import jax
+
+    log(f"jax backend: {jax.default_backend()} devices: {jax.devices()}")
+    pipe = TilePipeline(service, use_device=True, buckets=(512,))
+    ctxs = make_ctxs(n_requests, size, seed=9)
+    # warmup: trigger jit compile on the bucket shape
+    warm = pipe.handle_batch(ctxs[:batch])
+    assert all(w is not None for w in warm)
+    t0 = time.perf_counter()
+    done = 0
+    for i in range(0, len(ctxs), batch):
+        chunk = ctxs[i : i + batch]
+        results = pipe.handle_batch(chunk)
+        assert all(r is not None for r in results), "bench tile failed"
+        done += len(chunk)
+    elapsed = time.perf_counter() - t0
+    tpu_tps = done / elapsed
+    log(
+        f"tpu batched path: {tpu_tps:.1f} tiles/s over {done} tiles "
+        f"({elapsed:.2f}s; setup+warmup "
+        f"{time.perf_counter() - t_setup - elapsed:.1f}s)"
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": "tiles_per_sec_512x512_uint16_png",
+                "value": round(tpu_tps, 2),
+                "unit": "tiles/s",
+                "vs_baseline": round(tpu_tps / host_tps, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""Device meshes for multi-chip tile serving.
+
+The reference's only parallelism is a worker-thread pool
+(PixelBufferMicroserviceVerticle.java:117-118,224-233; SURVEY.md §2.3).
+The TPU equivalent is a ``jax.sharding.Mesh``:
+
+- ``data`` axis — request parallelism: coalesced tile batches shard
+  their batch dimension across chips (the worker-pool analog);
+- the same axis doubles as the **space** axis for single huge reads
+  (w/h=0 full-plane requests on whole-slide images): plane rows shard
+  across chips and PNG filtering runs distributed with a one-row halo
+  exchange over ICI (parallel/sharding.py).
+
+Multi-host: jax.devices() spans hosts under jax.distributed; the mesh
+builder just consumes it, so the same code scales DCN-wide.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    axes: Tuple[str, ...] = ("data",),
+    shape: Optional[Tuple[int, ...]] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a mesh over the available devices. Default: 1-D ``data``
+    mesh over every device."""
+    devs = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devs),) + (1,) * (len(axes) - 1)
+    arr = np.array(devs).reshape(shape)
+    return Mesh(arr, axes)
+
+
+def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Shard the leading (batch) dimension across the mesh axis."""
+    return NamedSharding(mesh, P(axis))
+
+
+def row_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Shard a (H, W)-like array's rows across the mesh axis — the
+    'sequence/space parallel' layout for full-plane operations."""
+    return NamedSharding(mesh, P(axis, None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

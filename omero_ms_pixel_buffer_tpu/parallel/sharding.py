@@ -1,0 +1,136 @@
+"""shard_map tile pipelines — the multi-chip execution plane.
+
+Two sharded programs cover the service's scaling axes (SURVEY.md §2.3,
+§5.7):
+
+- ``sharded_batch_filter`` — **data parallel**: a coalesced tile batch
+  (B, H, W) shards its batch axis across chips; each chip runs the
+  fused byteswap+filter kernel on its lanes. No collectives needed —
+  the embarrassing parallelism of independent tile requests, mapped
+  onto ICI instead of worker threads.
+
+- ``distributed_filter_plane`` — **space parallel**: one huge plane
+  (whole-slide full-plane request) shards its rows across chips. PNG's
+  Up filter makes row r depend on row r-1, so each shard needs the
+  last row of the previous shard: a single-row halo exchange via
+  ``lax.ppermute`` over ICI, then every shard filters locally. This is
+  the ring-attention-style neighbor exchange pattern applied to image
+  filtering — O(W) bytes over ICI per chip for O(H·W/n) compute.
+
+Both run under ``jit`` with explicit in/out shardings, so XLA inserts
+exactly the collectives written here and nothing else.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+try:  # stable location (jax >= 0.6)
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.convert import to_big_endian_bytes
+from ..ops.png import FILTER_UP, _filter_batch
+
+
+@partial(jax.jit, static_argnums=(0, 2, 3, 4))
+def _sharded_batch_filter(mesh, tiles, bpp, mode, axis):
+    def local(tiles_blk):
+        rows = to_big_endian_bytes(tiles_blk)
+        return _filter_batch(rows, bpp, mode)
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=P(axis),
+        out_specs=P(axis),
+    )
+    return fn(tiles)
+
+
+def sharded_batch_filter(
+    mesh: Mesh,
+    tiles: jax.Array,
+    bpp: int,
+    mode: str = "up",
+    axis: str = "data",
+) -> jax.Array:
+    """Batch-parallel PNG prep: (B, H, W) native-dtype tiles ->
+    (B, H, 1 + W*itemsize) filtered scanlines, batch sharded over
+    ``axis``. B must be divisible by the axis size — pad partial
+    batches with ``pad_batch`` first. Jit-cached per
+    (mesh, shape, bpp, mode)."""
+    return _sharded_batch_filter(mesh, tiles, bpp, mode, axis)
+
+
+def pad_batch(tiles, multiple: int):
+    """Pad the batch dimension up to a multiple with zero lanes;
+    returns (padded, real_count). Padded lanes are sliced away after
+    the sharded call."""
+    b = tiles.shape[0]
+    pad = (-b) % multiple
+    if pad == 0:
+        return tiles, b
+    widths = [(0, pad)] + [(0, 0)] * (tiles.ndim - 1)
+    return jnp.pad(tiles, widths), b
+
+
+@partial(jax.jit, static_argnums=(0, 2, 3))
+def _distributed_filter(mesh, plane, mode, axis):
+    if mode != "up":
+        raise ValueError("distributed filtering supports mode='up'")
+    n = mesh.shape[axis]
+
+    def local(plane_blk):
+        # byteswap fused with the filter inside the sharded program
+        rows_blk = to_big_endian_bytes(plane_blk)
+        # halo: receive the last row of the previous shard (ring
+        # neighbor exchange over ICI); shard 0 receives zeros since
+        # PNG defines the row above the image as zero
+        idx = jax.lax.axis_index(axis)
+        last_row = rows_blk[-1:, :]
+        prev_last = jax.lax.ppermute(
+            last_row, axis, [(i, (i + 1) % n) for i in range(n)]
+        )
+        prev_last = jnp.where(idx == 0, jnp.zeros_like(prev_last), prev_last)
+        # Up filter with the halo row prepended
+        above = jnp.concatenate([prev_last, rows_blk[:-1, :]], axis=0)
+        res = rows_blk - above
+        filt = jnp.full((rows_blk.shape[0], 1), FILTER_UP, dtype=jnp.uint8)
+        return jnp.concatenate([filt, res], axis=1)
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=P(axis, None),
+        out_specs=P(axis, None),
+    )
+    return fn(plane)
+
+
+def distributed_filter_plane(
+    mesh: Mesh,
+    plane: jax.Array,
+    mode: str = "up",
+    axis: str = "data",
+) -> jax.Array:
+    """Space-parallel PNG prep for one huge plane: (H, W) native dtype,
+    rows sharded over ``axis`` -> (H, 1 + W*itemsize) filtered
+    scanlines, same sharding. H must be divisible by the axis size.
+    One fused jitted program (byteswap + halo exchange + filter)."""
+    return _distributed_filter(mesh, plane, mode, axis)
+
+
+def shard_batch(mesh: Mesh, tiles, axis: str = "data"):
+    """Place a host batch onto the mesh with its batch dim sharded."""
+    return jax.device_put(tiles, NamedSharding(mesh, P(axis)))
+
+
+def shard_rows(mesh: Mesh, plane, axis: str = "data"):
+    """Place a host plane onto the mesh with rows sharded."""
+    return jax.device_put(plane, NamedSharding(mesh, P(axis, None)))

@@ -8,12 +8,19 @@ import os
 
 import pytest
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force an 8-virtual-device CPU backend regardless of the ambient
+# JAX_PLATFORMS (the axon TPU plugin ignores the env var; only the
+# config knob reliably overrides it).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 
 # -- minimal async-test support (no pytest-asyncio in the image) -----------
