@@ -230,6 +230,17 @@ def create_app(
 
 def main(argv: Optional[list] = None) -> None:
     import argparse
+    import os
+
+    # Some PJRT plugins only honor the platform selection made through
+    # jax.config, not the JAX_PLATFORMS env var alone — mirror the env
+    # var before anything touches a backend so `JAX_PLATFORMS=cpu
+    # python -m ...http.server` reliably runs CPU-only.
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
 
     parser = argparse.ArgumentParser(description="TPU pixel-buffer service")
     parser.add_argument("--config", default="conf/config.yaml")

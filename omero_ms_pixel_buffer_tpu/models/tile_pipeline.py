@@ -34,6 +34,10 @@ from ..io.pixel_buffer import PixelBuffer, PixelsMeta
 from ..io.pixels_service import PixelsService
 from ..ops.convert import to_big_endian_bytes, to_big_endian_bytes_np
 from ..ops.crop import resolve_region
+from ..ops.pallas import (
+    filter_tiles as pallas_filter_tiles,
+    supports as pallas_supports,
+)
 from ..ops.png import (
     PngEncodeError,
     _PNG_DTYPES,
@@ -69,12 +73,23 @@ class TilePipeline:
         png_level: int = 6,
         encode_workers: int = 8,
         use_device: bool = True,
+        use_pallas: Optional[bool] = None,
         buckets: Sequence[int] = (256, 512, 1024),
     ):
         self.pixels_service = pixels_service
         self.png_filter = png_filter
         self.png_level = png_level
         self.use_device = use_device
+        if use_pallas is None and use_device:
+            # Pallas is the default on real TPUs; interpret mode is far
+            # too slow for serving, so other backends take the
+            # XLA-fusion path. Only probe the backend when the device
+            # path is in play — resolving it would initialize PJRT,
+            # which host-only configurations must never pay for.
+            import jax
+
+            use_pallas = jax.default_backend() == "tpu"
+        self.use_pallas = bool(use_pallas)
         self.buckets = tuple(sorted(buckets))
         self._encode_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=encode_workers, thread_name_prefix="encode"
@@ -258,10 +273,17 @@ class TilePipeline:
             t = tiles[i]
             batch[j, : t.shape[0], : t.shape[1]] = t
         with TRACER.start_span("batch_device"):
-            rows = to_big_endian_bytes(jnp.asarray(batch))
-            filtered = np.asarray(
-                filter_batch(rows, itemsize, self.png_filter)
-            )  # (B, bh, 1 + bw*itemsize)
+            device_batch = jnp.asarray(batch)
+            if self.use_pallas and pallas_supports((bh, bw), dtype):
+                # fused Pallas kernel: byteswap + filter in one VMEM pass
+                filtered = np.asarray(
+                    pallas_filter_tiles(device_batch, self.png_filter)
+                )
+            else:
+                rows = to_big_endian_bytes(device_batch)
+                filtered = np.asarray(
+                    filter_batch(rows, itemsize, self.png_filter)
+                )  # (B, bh, 1 + bw*itemsize)
         with TRACER.start_span("batch_encode"):
             bit_depth = itemsize * 8
 
