@@ -154,6 +154,11 @@ class PixelBufferApp:
         )
         self.bus = EventBus()
         self.bus.consumer(GET_TILE_EVENT, self.worker.handle)
+        # warm the native engine at startup so a cold deploy never pays
+        # the build/load (up to ~2 min of g++) inside the first request
+        from ..runtime.native import get_engine
+
+        get_engine()
 
     def make_app(self) -> web.Application:
         app = web.Application(

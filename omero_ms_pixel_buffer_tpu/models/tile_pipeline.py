@@ -46,6 +46,7 @@ from ..ops.png import (
     filter_batch,
 )
 from ..ops.tiff import TiffEncodeError, encode_tiff
+from ..runtime.native import get_engine
 from ..tile_ctx import TileCtx
 from ..utils.tracing import TRACER
 
@@ -287,14 +288,44 @@ class TilePipeline:
         with TRACER.start_span("batch_encode"):
             bit_depth = itemsize * 8
 
+            def lane_bytes(j: int, i: int) -> bytes:
+                # slice away bucket padding: filters never look right or
+                # down, so the real region's bytes are identical
+                t = tiles[i]
+                h, w = t.shape
+                return filtered[j, :h, : 1 + w * itemsize].tobytes()
+
+            engine = get_engine()
+            if engine is not None:
+                # one native call: deflate + CRC + chunk framing for
+                # every lane on the C++ thread pool (GIL released)
+                payloads = [lane_bytes(j, i) for j, i in enumerate(lanes)]
+                pngs = engine.png_assemble_batch(
+                    payloads,
+                    widths=[tiles[i].shape[1] for i in lanes],
+                    heights=[tiles[i].shape[0] for i in lanes],
+                    bit_depths=[bit_depth] * len(lanes),
+                    color_types=[0] * len(lanes),
+                    level=self.png_level,
+                )
+                for (j, i), png in zip(enumerate(lanes), pngs):
+                    if png is None:
+                        # rare native lane failure (allocation): fall
+                        # back to the python assembler for that lane
+                        t = tiles[i]
+                        results[i] = assemble_png(
+                            payloads[j], t.shape[1], t.shape[0],
+                            bit_depth, 0, self.png_level,
+                        )
+                    else:
+                        results[i] = png
+                return
+
             def finish(j: int, i: int) -> Optional[bytes]:
                 t = tiles[i]
                 h, w = t.shape
-                # slice away bucket padding: filters never look right or
-                # down, so the real region's bytes are identical
-                lane = filtered[j, :h, : 1 + w * itemsize]
                 return assemble_png(
-                    lane.tobytes(), w, h, bit_depth, 0, self.png_level
+                    lane_bytes(j, i), w, h, bit_depth, 0, self.png_level
                 )
 
             futs = {

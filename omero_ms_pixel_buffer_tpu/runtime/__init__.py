@@ -1,0 +1,3 @@
+"""Native runtime bindings (C++ encode/IO engine)."""
+
+from .native import NativeEngine, get_engine  # noqa: F401

@@ -1,0 +1,225 @@
+"""ctypes bindings for the native C++ encode/IO engine.
+
+The reference's byte-level hot work (Bio-Formats in-memory encode,
+TileRequestHandler.java:176-199; per-block codec work inside
+ome.io.nio readers) runs on JVM threads. Here it runs in
+``native/libompb_native.so``: a C++ thread pool doing batched
+deflate / inflate / PNG assembly, entered via ctypes (which drops the
+GIL), so codec bytes never serialize behind the interpreter.
+
+The library is built on demand from ``native/`` with ``make`` (g++ +
+zlib only). Every caller must handle ``get_engine() is None`` and fall
+back to the pure-Python path — the service stays correct without a
+toolchain, just slower.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+log = logging.getLogger("omero_ms_pixel_buffer_tpu.native")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libompb_native.so")
+
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+
+
+def _build_library() -> bool:
+    """Compile the library if sources exist and a toolchain is around."""
+    if not os.path.exists(os.path.join(_NATIVE_DIR, "Makefile")):
+        return False
+    try:
+        proc = subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            capture_output=True,
+            timeout=120,
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        log.warning("native build unavailable: %s", e)
+        return False
+    if proc.returncode != 0:
+        log.warning(
+            "native build failed:\n%s", proc.stderr.decode(errors="replace")
+        )
+        return False
+    return os.path.exists(_LIB_PATH)
+
+
+class NativeEngine:
+    """Thin, typed wrapper over the C API. Thread-safe (the C side has
+    its own pool; per-call state is stack-local)."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        lib.ompb_version.restype = ctypes.c_int
+        lib.ompb_pool_size.restype = ctypes.c_int
+        lib.ompb_free_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
+        ]
+        lib.ompb_deflate_batch.restype = ctypes.c_int
+        lib.ompb_inflate_batch.restype = ctypes.c_int
+        lib.ompb_png_assemble_batch.restype = ctypes.c_int
+        self.version = lib.ompb_version()
+        self.pool_size = lib.ompb_pool_size()
+
+    # -- helpers -----------------------------------------------------------
+
+    @staticmethod
+    def _in_arrays(buffers: Sequence[bytes]):
+        n = len(buffers)
+        ins = (_U8P * n)()
+        lens = (ctypes.c_size_t * n)()
+        # zero-copy: point at the immutable bytes objects' own storage;
+        # `keep` pins them (and the c_char_p views) for the call
+        keep = []
+        for i, b in enumerate(buffers):
+            view = ctypes.c_char_p(b)
+            keep.append((b, view))
+            ins[i] = ctypes.cast(view, _U8P)
+            lens[i] = len(b)
+        return ins, lens, keep
+
+    def _collect(self, outs, out_lens, n: int) -> List[Optional[bytes]]:
+        results: List[Optional[bytes]] = []
+        try:
+            for i in range(n):
+                if outs[i]:
+                    results.append(
+                        ctypes.string_at(outs[i], out_lens[i])
+                    )
+                else:
+                    results.append(None)
+        finally:
+            self._lib.ompb_free_batch(
+                ctypes.cast(outs, ctypes.POINTER(ctypes.c_void_p)),
+                ctypes.c_int(n),
+            )
+        return results
+
+    # -- API ---------------------------------------------------------------
+
+    def deflate_batch(
+        self, buffers: Sequence[bytes], level: int = 6
+    ) -> List[Optional[bytes]]:
+        """zlib-compress N buffers on the native pool; None per failed
+        lane."""
+        n = len(buffers)
+        if n == 0:
+            return []
+        ins, lens, _keep = self._in_arrays(buffers)
+        outs = (_U8P * n)()
+        out_lens = (ctypes.c_size_t * n)()
+        self._lib.ompb_deflate_batch(
+            ctypes.c_int(n), ins, lens, ctypes.c_int(level), outs, out_lens
+        )
+        return self._collect(outs, out_lens, n)
+
+    def inflate_batch(
+        self,
+        buffers: Sequence[bytes],
+        out_sizes: Sequence[int],
+    ) -> List[Optional[np.ndarray]]:
+        """zlib-decompress N blocks into fresh numpy uint8 arrays of the
+        given capacities (decompressed tile sizes are known from the
+        storage layout). None per failed lane; arrays are trimmed to
+        the actual decompressed length."""
+        n = len(buffers)
+        if n == 0:
+            return []
+        ins, lens, _keep = self._in_arrays(buffers)
+        outs = (_U8P * n)()
+        out_lens = (ctypes.c_size_t * n)()
+        arrays = []
+        for i, size in enumerate(out_sizes):
+            arr = np.empty(int(size), dtype=np.uint8)
+            arrays.append(arr)
+            outs[i] = arr.ctypes.data_as(_U8P)
+            out_lens[i] = int(size)
+        rc = self._lib.ompb_inflate_batch(
+            ctypes.c_int(n), ins, lens, outs, out_lens
+        )
+        results: List[Optional[np.ndarray]] = []
+        for i, arr in enumerate(arrays):
+            if rc and out_lens[i] == 0:
+                results.append(None)
+            else:
+                results.append(arr[: out_lens[i]])
+        return results
+
+    def png_assemble_batch(
+        self,
+        filtered: Sequence[bytes],
+        widths: Sequence[int],
+        heights: Sequence[int],
+        bit_depths: Sequence[int],
+        color_types: Sequence[int],
+        level: int = 6,
+    ) -> List[Optional[bytes]]:
+        """N filtered scanline buffers -> N complete PNG streams."""
+        n = len(filtered)
+        if n == 0:
+            return []
+        ins, lens, _keep = self._in_arrays(filtered)
+        outs = (_U8P * n)()
+        out_lens = (ctypes.c_size_t * n)()
+        self._lib.ompb_png_assemble_batch(
+            ctypes.c_int(n), ins, lens,
+            (ctypes.c_uint32 * n)(*[int(w) for w in widths]),
+            (ctypes.c_uint32 * n)(*[int(h) for h in heights]),
+            (ctypes.c_uint8 * n)(*[int(b) for b in bit_depths]),
+            (ctypes.c_uint8 * n)(*[int(c) for c in color_types]),
+            ctypes.c_int(level), outs, out_lens,
+        )
+        return self._collect(outs, out_lens, n)
+
+
+_engine: Optional[NativeEngine] = None
+_engine_failed = False
+_engine_lock = threading.Lock()
+
+
+def get_engine() -> Optional[NativeEngine]:
+    """The process-wide native engine, building/loading it on first use;
+    None when the library can't be built (pure-Python fallback)."""
+    global _engine, _engine_failed
+    if _engine is not None or _engine_failed:
+        return _engine
+    with _engine_lock:
+        if _engine is not None or _engine_failed:
+            return _engine
+        if os.environ.get("OMPB_DISABLE_NATIVE"):
+            _engine_failed = True
+            return None
+        try:
+            if not os.path.exists(_LIB_PATH) and not _build_library():
+                _engine_failed = True
+                return None
+            # rebuild stale library (source newer than .so)
+            src = os.path.join(_NATIVE_DIR, "ompb_native.cc")
+            if (
+                os.path.exists(src)
+                and os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
+                and not _build_library()
+            ):
+                _engine_failed = True
+                return None
+            _engine = NativeEngine(ctypes.CDLL(_LIB_PATH))
+            log.info(
+                "native engine v%d loaded (%d threads)",
+                _engine.version, _engine.pool_size,
+            )
+        except OSError as e:
+            log.warning("native engine unavailable: %s", e)
+            _engine_failed = True
+    return _engine
