@@ -89,10 +89,17 @@ def main():
 
     registry = ImageRegistry()
     registry.add(1, path)
-    service = PixelsService(registry)
 
     # --- baseline: reference-architecture path (sequential, host) -----
-    base_pipe = TilePipeline(service, use_device=False, encode_workers=1)
+    # Separate service with the decoded-block cache OFF: the reference
+    # re-opens and re-decodes per request (TileRequestHandler.java:86),
+    # so its stand-in must too. Python (not native) encode, one at a
+    # time, single worker — the Java worker-thread shape.
+    base_service = PixelsService(registry, block_cache_bytes=0)
+    base_pipe = TilePipeline(
+        base_service, use_device=False, encode_workers=1,
+        png_level=6, png_strategy="default",  # Java Deflater defaults
+    )
     base_ctxs = make_ctxs(64, size)
     for ctx in base_ctxs[:4]:  # warm page cache + code paths
         assert base_pipe.handle(ctx) is not None
@@ -103,15 +110,18 @@ def main():
     host_tps = len(base_ctxs) / (time.perf_counter() - t0)
     log(f"baseline (sequential host path): {host_tps:.1f} tiles/s")
 
-    # --- TPU batched path ---------------------------------------------
+    # --- framework batched path (auto engine) -------------------------
     import jax
 
     log(f"jax backend: {jax.default_backend()} devices: {jax.devices()}")
-    pipe = TilePipeline(service, use_device=True, buckets=(512,))
+    service = PixelsService(registry)
+    engine = os.environ.get("BENCH_ENGINE", "auto")
+    pipe = TilePipeline(service, engine=engine, buckets=(512,))
     ctxs = make_ctxs(n_requests, size, seed=9)
-    # warmup: trigger jit compile on the bucket shape
+    # warmup: resolve auto engine, trigger jit/native build
     warm = pipe.handle_batch(ctxs[:batch])
     assert all(w is not None for w in warm)
+    log(f"engine: {pipe.engine}")
     t0 = time.perf_counter()
     done = 0
     for i in range(0, len(ctxs), batch):
@@ -122,8 +132,8 @@ def main():
     elapsed = time.perf_counter() - t0
     tpu_tps = done / elapsed
     log(
-        f"tpu batched path: {tpu_tps:.1f} tiles/s over {done} tiles "
-        f"({elapsed:.2f}s; setup+warmup "
+        f"batched path ({pipe.engine}): {tpu_tps:.1f} tiles/s over "
+        f"{done} tiles ({elapsed:.2f}s; setup+warmup "
         f"{time.perf_counter() - t_setup - elapsed:.1f}s)"
     )
 

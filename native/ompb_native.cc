@@ -129,19 +129,36 @@ void ParallelFor(size_t n, std::function<void(size_t)> fn) {
   st->cv.wait(lk, [&] { return st->done.load() == st->n; });
 }
 
-// One-shot zlib-format compress; returns malloc'd buffer.
+// One-shot zlib-format compress; returns malloc'd buffer. Strategy is
+// Z_DEFAULT_STRATEGY for generic payloads, Z_FILTERED for PNG-filtered
+// scanlines (small-residual data; skips the literal-heavy heuristics).
 bool DeflateOne(const uint8_t* in, size_t in_len, int level, uint8_t** out,
-                size_t* out_len) {
-  uLong bound = compressBound(in_len);
+                size_t* out_len, int strategy = Z_DEFAULT_STRATEGY) {
+  z_stream zs;
+  std::memset(&zs, 0, sizeof(zs));
+  if (deflateInit2(&zs, level, Z_DEFLATED, 15, 9, strategy) != Z_OK) {
+    return false;
+  }
+  // deflateBound, not compressBound: Z_FILTERED/memLevel-9 streams can
+  // exceed the generic bound on incompressible data.
+  uLong bound = deflateBound(&zs, in_len);
   uint8_t* buf = static_cast<uint8_t*>(std::malloc(bound));
-  if (!buf) return false;
-  uLongf dst_len = bound;
-  if (compress2(buf, &dst_len, in, in_len, level) != Z_OK) {
+  if (!buf) {
+    deflateEnd(&zs);
+    return false;
+  }
+  zs.next_in = const_cast<Bytef*>(in);
+  zs.avail_in = static_cast<uInt>(in_len);
+  zs.next_out = buf;
+  zs.avail_out = static_cast<uInt>(bound);
+  int rc = deflate(&zs, Z_FINISH);
+  deflateEnd(&zs);
+  if (rc != Z_STREAM_END) {
     std::free(buf);
     return false;
   }
   *out = buf;
-  *out_len = dst_len;
+  *out_len = zs.total_out;
   return true;
 }
 
@@ -166,11 +183,50 @@ size_t WriteChunk(uint8_t* dst, const char* tag, const uint8_t* data,
   return 12 + len;
 }
 
+// Assemble a complete PNG stream around a ready IDAT payload.
+uint8_t* AssemblePng(const uint8_t* idat, size_t idat_len, uint32_t width,
+                     uint32_t height, uint8_t bit_depth, uint8_t color_type,
+                     size_t* total_len) {
+  static const uint8_t kSig[8] = {0x89, 'P', 'N', 'G', '\r', '\n', 0x1A, '\n'};
+  size_t total = 8 + (12 + 13) + (12 + idat_len) + 12;
+  uint8_t* out = static_cast<uint8_t*>(std::malloc(total));
+  if (!out) return nullptr;
+  uint8_t* p = out;
+  std::memcpy(p, kSig, 8);
+  p += 8;
+  uint8_t ihdr[13];
+  PutU32BE(ihdr, width);
+  PutU32BE(ihdr + 4, height);
+  ihdr[8] = bit_depth;
+  ihdr[9] = color_type;
+  ihdr[10] = ihdr[11] = ihdr[12] = 0;  // deflate/adaptive/no-interlace
+  p += WriteChunk(p, "IHDR", ihdr, 13);
+  p += WriteChunk(p, "IDAT", idat, idat_len);
+  p += WriteChunk(p, "IEND", nullptr, 0);
+  *total_len = static_cast<size_t>(p - out);
+  return out;
+}
+
+// Byteswap one row of `width*channels` samples of `itemsize` bytes from
+// native little-endian to PNG big-endian (identity for itemsize 1).
+void SwapRowBE(const uint8_t* src, uint8_t* dst, size_t samples,
+               size_t itemsize) {
+  if (itemsize == 1) {
+    std::memcpy(dst, src, samples);
+    return;
+  }
+  for (size_t s = 0; s < samples; ++s) {
+    for (size_t b = 0; b < itemsize; ++b) {
+      dst[s * itemsize + b] = src[s * itemsize + (itemsize - 1 - b)];
+    }
+  }
+}
+
 }  // namespace
 
 extern "C" {
 
-int ompb_version() { return 1; }
+int ompb_version() { return 2; }
 
 int ompb_pool_size() { return static_cast<int>(Pool().size()); }
 
@@ -222,49 +278,129 @@ int ompb_inflate_batch(int n, const uint8_t** inputs, const size_t* in_lens,
 // + row bytes per row, the device kernel's output layout).
 // widths/heights/bit_depths/color_types are per-lane; outputs malloc'd.
 // Returns 0 on success, else first failing lane index + 1.
+// `strategy` is the zlib strategy code (0 default, 1 filtered,
+// 2 huffman-only, 3 RLE). On PNG-filtered scanlines of microscopy-like
+// data, RLE matches level-6/filtered's ratio at ~5x the speed.
 int ompb_png_assemble_batch(int n, const uint8_t** filtered,
                             const size_t* filtered_lens, const uint32_t* widths,
                             const uint32_t* heights, const uint8_t* bit_depths,
-                            const uint8_t* color_types, int level,
+                            const uint8_t* color_types, int level, int strategy,
                             uint8_t** outputs, size_t* out_lens) {
-  static const uint8_t kSig[8] = {0x89, 'P', 'N', 'G', '\r', '\n', 0x1A, '\n'};
   std::atomic<int> failed{0};
   ParallelFor(static_cast<size_t>(n), [&](size_t i) {
+    auto fail = [&] {
+      outputs[i] = nullptr;
+      out_lens[i] = 0;
+      int expected = 0;
+      failed.compare_exchange_strong(expected, static_cast<int>(i) + 1);
+    };
     uint8_t* idat = nullptr;
     size_t idat_len = 0;
-    if (!DeflateOne(filtered[i], filtered_lens[i], level, &idat, &idat_len)) {
-      outputs[i] = nullptr;
-      out_lens[i] = 0;
-      int expected = 0;
-      failed.compare_exchange_strong(expected, static_cast<int>(i) + 1);
+    if (!DeflateOne(filtered[i], filtered_lens[i], level, &idat, &idat_len,
+                    strategy)) {
+      fail();
       return;
     }
-    // signature + IHDR(13) + IDAT + IEND chunks
-    size_t total = 8 + (12 + 13) + (12 + idat_len) + 12;
-    uint8_t* out = static_cast<uint8_t*>(std::malloc(total));
-    if (!out) {
-      std::free(idat);
-      outputs[i] = nullptr;
-      out_lens[i] = 0;
-      int expected = 0;
-      failed.compare_exchange_strong(expected, static_cast<int>(i) + 1);
-      return;
-    }
-    uint8_t* p = out;
-    std::memcpy(p, kSig, 8);
-    p += 8;
-    uint8_t ihdr[13];
-    PutU32BE(ihdr, widths[i]);
-    PutU32BE(ihdr + 4, heights[i]);
-    ihdr[8] = bit_depths[i];
-    ihdr[9] = color_types[i];
-    ihdr[10] = ihdr[11] = ihdr[12] = 0;  // deflate/adaptive/no-interlace
-    p += WriteChunk(p, "IHDR", ihdr, 13);
-    p += WriteChunk(p, "IDAT", idat, idat_len);
-    p += WriteChunk(p, "IEND", nullptr, 0);
+    size_t total = 0;
+    uint8_t* out = AssemblePng(idat, idat_len, widths[i], heights[i],
+                               bit_depths[i], color_types[i], &total);
     std::free(idat);
+    if (!out) {
+      fail();
+      return;
+    }
     outputs[i] = out;
-    out_lens[i] = static_cast<size_t>(p - out);
+    out_lens[i] = total;
+  });
+  return failed.load();
+}
+
+// N raw tiles -> N complete PNG streams, fused: big-endian byteswap +
+// scanline filter (0=none, 1=sub, 2=up) + deflate (Z_FILTERED) + chunk
+// framing, one pass per lane on the pool. Tiles are native-endian
+// contiguous (height x width x channels) arrays of `itemsize`-byte
+// samples — the shape the pixel readers hand back — so the Python side
+// passes numpy pointers with zero staging copies.
+// Returns 0 on success, else first failing lane index + 1.
+int ompb_png_encode_batch(int n, const uint8_t** tiles,
+                          const uint32_t* widths, const uint32_t* heights,
+                          const uint8_t* channels, const uint8_t* itemsizes,
+                          int filter, int level, int strategy, int swap_to_be,
+                          uint8_t** outputs, size_t* out_lens) {
+  std::atomic<int> failed{0};
+  ParallelFor(static_cast<size_t>(n), [&](size_t i) {
+    auto fail = [&] {
+      outputs[i] = nullptr;
+      out_lens[i] = 0;
+      int expected = 0;
+      failed.compare_exchange_strong(expected, static_cast<int>(i) + 1);
+    };
+    const size_t w = widths[i], h = heights[i];
+    const size_t ch = channels[i], isz = itemsizes[i];
+    const size_t row_bytes = w * ch * isz;
+    const size_t bpp = ch * isz;  // PNG filter unit
+    uint8_t* filtered =
+        static_cast<uint8_t*>(std::malloc(h * (1 + row_bytes)));
+    // two scratch rows (current/previous, big-endian) for the filters
+    uint8_t* scratch = static_cast<uint8_t*>(std::malloc(2 * row_bytes));
+    if (!filtered || !scratch) {
+      std::free(filtered);
+      std::free(scratch);
+      fail();
+      return;
+    }
+    uint8_t* cur = scratch;
+    uint8_t* prev = scratch + row_bytes;
+    std::memset(prev, 0, row_bytes);
+    for (size_t r = 0; r < h; ++r) {
+      const uint8_t* src = tiles[i] + r * row_bytes;
+      if (swap_to_be) {
+        SwapRowBE(src, cur, w * ch, isz);
+      } else {
+        std::memcpy(cur, src, row_bytes);
+      }
+      uint8_t* dst = filtered + r * (1 + row_bytes);
+      dst[0] = static_cast<uint8_t>(filter);
+      switch (filter) {
+        case 0:  // none
+          std::memcpy(dst + 1, cur, row_bytes);
+          break;
+        case 1:  // sub
+          std::memcpy(dst + 1, cur, bpp);
+          for (size_t b = bpp; b < row_bytes; ++b) {
+            dst[1 + b] = static_cast<uint8_t>(cur[b] - cur[b - bpp]);
+          }
+          break;
+        default:  // 2 = up
+          for (size_t b = 0; b < row_bytes; ++b) {
+            dst[1 + b] = static_cast<uint8_t>(cur[b] - prev[b]);
+          }
+          break;
+      }
+      std::swap(cur, prev);
+    }
+    uint8_t* idat = nullptr;
+    size_t idat_len = 0;
+    bool ok = DeflateOne(filtered, h * (1 + row_bytes), level, &idat,
+                         &idat_len, strategy);
+    std::free(filtered);
+    std::free(scratch);
+    if (!ok) {
+      fail();
+      return;
+    }
+    size_t total = 0;
+    uint8_t* out =
+        AssemblePng(idat, idat_len, widths[i], heights[i],
+                    static_cast<uint8_t>(isz * 8),
+                    ch == 3 ? 2 : 0, &total);
+    std::free(idat);
+    if (!out) {
+      fail();
+      return;
+    }
+    outputs[i] = out;
+    out_lens[i] = total;
   });
   return failed.load();
 }

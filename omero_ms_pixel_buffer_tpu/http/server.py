@@ -139,12 +139,23 @@ class PixelBufferApp:
         self.pixels_service = pixels_service
         self.session_validator = session_validator or AllowListValidator()
         batching = config.backend.batching
+        # config `backend.engine`: jax/auto -> probe the device link and
+        # pick; device/tpu -> force the accelerator path; host -> force
+        # the native host engine. `device-encode: false` forces host.
+        engine = {
+            "jax": "auto", "auto": "auto",
+            "device": "device", "tpu": "device",
+            "host": "host",
+        }.get(config.backend.engine, "auto")
+        if not batching.device_encode:
+            engine = "host"
         self.pipeline = TilePipeline(
             pixels_service,
-            use_device=(
-                config.backend.engine == "jax" and batching.device_encode
-            ),
+            engine=engine,
             buckets=batching.buckets,
+            png_filter=config.backend.png.filter,
+            png_level=config.backend.png.level,
+            png_strategy=config.backend.png.strategy,
         )
         self.worker = BatchingTileWorker(
             self.pipeline,

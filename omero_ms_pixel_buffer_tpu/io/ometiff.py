@@ -32,7 +32,12 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .pixel_buffer import PixelBuffer, PixelsMeta, check_bounds
+from .pixel_buffer import (
+    BlockCache,
+    PixelBuffer,
+    PixelsMeta,
+    check_bounds,
+)
 from ..ops.convert import dtype_for, omero_type_for
 from ..ops.tiff import ome_xml_metadata  # single-plane variant
 
@@ -165,12 +170,17 @@ class _LevelReader:
     across every tile/plane in a coalesced request batch.
     """
 
-    def __init__(self, fh, bo: str, ifd: _Ifd, dtype: np.dtype, samples: int):
+    def __init__(
+        self, fh, bo: str, ifd: _Ifd, dtype: np.dtype, samples: int,
+        cache: Optional[BlockCache] = None, cache_ns: int = 0,
+    ):
         self.fh = fh
         self.bo = bo
         self.ifd = ifd
         self.dtype = dtype.newbyteorder(bo)
         self.samples = samples
+        self.cache = cache
+        self.cache_ns = cache_ns
         self.compression = ifd.first("COMPRESSION", 1)
         if self.compression not in (1, 8):
             raise TiffError(f"Unsupported compression: {self.compression}")
@@ -210,11 +220,22 @@ class _LevelReader:
             offs, cnts = ifd.values("STRIP_OFFSETS"), ifd.values("STRIP_COUNTS")
         return offs[i], cnts[i], cap
 
-    def _read_block(self, i: int) -> bytes:
+    def _read_block(self, i: int):
+        # decoded-block LRU: inflating a source chunk is the dominant
+        # read cost; pay it once per chunk, not once per overlapping
+        # tile request (uncompressed blocks are mmap slices — cheap)
+        key = (self.cache_ns, id(self.ifd), i)
+        if self.cache is not None and self.compression == 8:
+            cached = self.cache.get(key)
+            if cached is not None:
+                return cached
         offset, count, _ = self.block_span(i)
         raw = self.fh[offset : offset + count]
         if self.compression == 8:
-            raw = zlib.decompress(raw)
+            decoded = np.frombuffer(zlib.decompress(raw), dtype=np.uint8)
+            if self.cache is not None:
+                self.cache[key] = decoded
+            return decoded
         return raw
 
     # -- assembly ----------------------------------------------------------
@@ -272,8 +293,16 @@ class _LevelReader:
 class OmeTiffPixelBuffer(PixelBuffer):
     """OME-TIFF (optionally pyramidal) as a PixelBuffer."""
 
-    def __init__(self, path: str, image_id: int = 0, image_name: str = ""):
+    def __init__(
+        self, path: str, image_id: int = 0, image_name: str = "",
+        cache_bytes: Optional[int] = None,
+        block_cache: Optional[BlockCache] = None,
+    ):
         self.path = path
+        # shared (service-owned, process-bounded) or private cache
+        self.block_cache = (
+            block_cache if block_cache is not None else BlockCache(cache_bytes)
+        )
         self._file = open(path, "rb")
         try:
             # mmap: IFD parse and tile reads never copy the whole file
@@ -365,7 +394,8 @@ class OmeTiffPixelBuffer(PixelBuffer):
         plane = self._plane_index(z, c, t)
         ifd = self._level_ifd(plane, level)
         return _LevelReader(
-            self.mm, self.bo, ifd, self._base_dtype, self.samples
+            self.mm, self.bo, ifd, self._base_dtype, self.samples,
+            cache=self.block_cache, cache_ns=self.cache_ns,
         )
 
     def get_tile_at(self, level, z, c, t, x, y, w, h) -> np.ndarray:
@@ -392,15 +422,22 @@ class OmeTiffPixelBuffer(PixelBuffer):
                 for r, (_, _, _, x, y, w, h) in zip(readers, coords)
             ]
 
-        # plan: dedup compressed blocks across the whole batch
-        spans: Dict[Tuple[int, int], Tuple[int, int, int]] = {}
+        # plan: dedup compressed blocks across the whole batch, serving
+        # already-decoded blocks from the persistent LRU
+        cache = {}
+        spans: Dict[Tuple, Tuple[int, int, int]] = {}
         for r, (_, _, _, x, y, w, h) in zip(readers, coords):
             if r.compression != 8:
                 continue
             ifd_key = id(r.ifd)
             for bi in r.plan_region(x, y, w, h):
-                key = (ifd_key, bi)
-                if key not in spans:
+                key = (self.cache_ns, ifd_key, bi)
+                if key in cache or key in spans:
+                    continue
+                hit = self.block_cache.get(key)
+                if hit is not None:
+                    cache[key] = hit
+                else:
                     spans[key] = r.block_span(bi)
 
         keys = list(spans.keys())
@@ -410,18 +447,20 @@ class OmeTiffPixelBuffer(PixelBuffer):
         ]
         caps = [spans[k][2] for k in keys]
         decoded = engine.inflate_batch(raws, caps)
-        cache = {}
         for key, arr in zip(keys, decoded):
             if arr is None:  # corrupt block: fail only the lanes that
                 # touch it (per-lane degradation, not batch-wide)
                 continue
             cache[key] = arr
+            self.block_cache[key] = arr
 
         out: List[Optional[np.ndarray]] = []
         for r, (_, _, _, x, y, w, h) in zip(readers, coords):
             if r.compression == 8:
                 ifd_key = id(r.ifd)
-                get_block = lambda i, _k=ifd_key: cache[(_k, i)]  # noqa: E731
+                get_block = (  # noqa: E731
+                    lambda i, _k=ifd_key: cache[(self.cache_ns, _k, i)]
+                )
             else:
                 get_block = None
             try:

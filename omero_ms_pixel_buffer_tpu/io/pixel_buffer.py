@@ -19,11 +19,91 @@ Differences from the reference, by design:
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence, Tuple
+import itertools
+import os
+import threading
+from collections import OrderedDict
+from typing import Any, Hashable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..ops.convert import dtype_for
+
+
+_MISSING = object()
+
+# Monotonic namespace ids so buffers sharing one BlockCache can never
+# alias each other's keys (id() of an internal object can be reused
+# after a closed buffer is garbage-collected). itertools.count.__next__
+# is a single C call — atomic under the GIL.
+_cache_namespace = itertools.count(1).__next__
+
+
+def default_block_cache_bytes() -> int:
+    """Per-buffer decoded-block cache budget (OMPB_BLOCK_CACHE_MB,
+    default 256 MiB; 0 disables)."""
+    return int(os.environ.get("OMPB_BLOCK_CACHE_MB", "256")) << 20
+
+
+class BlockCache:
+    """Byte-bounded, thread-safe LRU of decoded storage blocks.
+
+    The persistent half of the reference's acceleration state
+    (Bio-Formats Memoizer / pyramid files, SURVEY.md §5.4): a source
+    chunk is inflated once and every later tile that overlaps it — in
+    this batch or any future request — assembles from the cached
+    bytes. Values are numpy arrays or None (a legitimately absent
+    chunk, e.g. Zarr fill_value); both count toward the budget
+    (None as 0 bytes).
+    """
+
+    def __init__(self, max_bytes: Optional[int] = None):
+        self.max_bytes = (
+            default_block_cache_bytes() if max_bytes is None else max_bytes
+        )
+        self._entries: "OrderedDict[Hashable, Any]" = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _size(value: Any) -> int:
+        return int(value.nbytes) if isinstance(value, np.ndarray) else 0
+
+    def get(self, key: Hashable, default: Any = None) -> Any:
+        with self._lock:
+            value = self._entries.get(key, _MISSING)
+            if value is _MISSING:
+                self.misses += 1
+                return default
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return value
+
+    def __setitem__(self, key: Hashable, value: Any) -> None:
+        if self.max_bytes <= 0:
+            return
+        size = self._size(value)
+        if size > self.max_bytes:
+            return  # a single oversized block would evict everything
+        with self._lock:
+            old = self._entries.pop(key, _MISSING)
+            if old is not _MISSING:
+                self._bytes -= self._size(old)
+            self._entries[key] = value
+            self._bytes += size
+            while self._bytes > self.max_bytes and self._entries:
+                _, evicted = self._entries.popitem(last=False)
+                self._bytes -= self._size(evicted)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def nbytes(self) -> int:
+        return self._bytes
 
 
 @dataclasses.dataclass(frozen=True)
@@ -55,6 +135,7 @@ class PixelBuffer:
 
     def __init__(self, meta: PixelsMeta):
         self.meta = meta
+        self.cache_ns = _cache_namespace()  # key prefix in shared caches
         self._resolution_level = 0  # 0 = full resolution
 
     # -- resolution pyramid (TileRequestHandler.java:89-91) ---------------

@@ -30,7 +30,7 @@ from collections import OrderedDict
 from typing import Optional
 
 from .ometiff import OmeTiffPixelBuffer
-from .pixel_buffer import PixelBuffer, PixelsMeta
+from .pixel_buffer import BlockCache, PixelBuffer, PixelsMeta
 from .romio import RomioPixelBuffer
 from .zarr import ZarrPixelBuffer
 
@@ -100,7 +100,8 @@ class ImageRegistry(MetadataResolver):
 
 
 def _open_buffer(
-    registry: ImageRegistry, entry: dict, image_id: int
+    registry: ImageRegistry, entry: dict, image_id: int,
+    block_cache: Optional[BlockCache] = None,
 ) -> PixelBuffer:
     path = registry.resolve_path(entry)
     name = entry.get("name", os.path.basename(path))
@@ -109,9 +110,15 @@ def _open_buffer(
         meta = registry.get_pixels(image_id)
         return RomioPixelBuffer(path, meta)
     if kind == "zarr" or (kind is None and os.path.isdir(path)):
-        return ZarrPixelBuffer(path, image_id=image_id, image_name=name)
+        return ZarrPixelBuffer(
+            path, image_id=image_id, image_name=name,
+            block_cache=block_cache,
+        )
     if kind in ("ometiff", "tiff") or kind is None:
-        return OmeTiffPixelBuffer(path, image_id=image_id, image_name=name)
+        return OmeTiffPixelBuffer(
+            path, image_id=image_id, image_name=name,
+            block_cache=block_cache,
+        )
     raise ValueError(f"Unknown image type: {kind}")
 
 
@@ -119,9 +126,18 @@ class PixelsService:
     """getPixelBuffer + buffer cache (the Spring-singleton
     ZarrPixelsService analog, beanRefContext.xml:51-57)."""
 
-    def __init__(self, registry: ImageRegistry, max_open: int = 128):
+    def __init__(
+        self, registry: ImageRegistry, max_open: int = 128,
+        block_cache_bytes: Optional[int] = None,
+    ):
         self.registry = registry
         self.max_open = max_open
+        # ONE decoded-block cache shared by every buffer this service
+        # opens — a process-wide bound, not per-buffer (None ->
+        # OMPB_BLOCK_CACHE_MB default; 0 disables, e.g. for baselines).
+        # Buffers namespace their keys via cache_ns so entries never
+        # alias across buffers.
+        self.block_cache = BlockCache(block_cache_bytes)
         self._cache: OrderedDict[int, PixelBuffer] = OrderedDict()
         self._lock = threading.Lock()
 
@@ -149,7 +165,10 @@ class PixelsService:
         entry = self.registry.entry(image_id)
         if entry is None:
             return None
-        buf = _open_buffer(self.registry, entry, image_id)
+        buf = _open_buffer(
+            self.registry, entry, image_id,
+            block_cache=self.block_cache,
+        )
         with self._lock:
             existing = self._cache.get(image_id)
             if existing is not None:

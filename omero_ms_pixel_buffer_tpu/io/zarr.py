@@ -23,8 +23,29 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .pixel_buffer import PixelBuffer, PixelsMeta, check_bounds
+from .pixel_buffer import (
+    BlockCache,
+    PixelBuffer,
+    PixelsMeta,
+    check_bounds,
+)
 from ..ops.convert import omero_type_for
+
+_MISSING = object()
+
+
+class _PrefixedCache:
+    """View of a shared BlockCache scoped to one (buffer, level), with
+    the dict-style surface ``ZarrArray.read_region`` consumes."""
+
+    def __init__(self, cache: BlockCache, prefix: tuple):
+        self._cache, self._prefix = cache, prefix
+
+    def get(self, key, default=None):
+        return self._cache.get(self._prefix + tuple(key), default)
+
+    def __setitem__(self, key, value) -> None:
+        self._cache[self._prefix + tuple(key)] = value
 
 
 class ZarrError(ValueError):
@@ -60,13 +81,17 @@ class ZarrArray:
         return os.path.join(self.path, self.separator.join(map(str, idx)))
 
     def _cached_chunk(
-        self, idx: Tuple[int, ...], cache: Optional[dict]
+        self, idx: Tuple[int, ...], cache
     ) -> Optional[np.ndarray]:
         if cache is None:
             return self.read_chunk(idx)
-        if idx not in cache:  # avoid setdefault's eager evaluation
-            cache[idx] = self.read_chunk(idx)
-        return cache[idx]
+        # sentinel, not `in`: None is a real value (absent chunk), and
+        # a bounded cache may evict between membership test and read
+        value = cache.get(idx, _MISSING)
+        if value is _MISSING:
+            value = self.read_chunk(idx)
+            cache[idx] = value
+        return value
 
     def read_chunk(self, idx: Tuple[int, ...]) -> Optional[np.ndarray]:
         """Decode one chunk (full chunk shape, padded at array edges) or
@@ -128,8 +153,15 @@ class ZarrPixelBuffer(PixelBuffer):
     """OME-NGFF multiscale image as a PixelBuffer. Axes are TCZYX
     (NGFF 0.4 canonical order)."""
 
-    def __init__(self, root: str, image_id: int = 0, image_name: str = ""):
+    def __init__(
+        self, root: str, image_id: int = 0, image_name: str = "",
+        cache_bytes: Optional[int] = None,
+        block_cache: Optional[BlockCache] = None,
+    ):
         self.root = root
+        self.block_cache = (
+            block_cache if block_cache is not None else BlockCache(cache_bytes)
+        )
         attrs_path = os.path.join(root, ".zattrs")
         with open(attrs_path) as f:
             attrs = json.load(f)
@@ -172,15 +204,25 @@ class ZarrPixelBuffer(PixelBuffer):
         arr = self.levels[level]
         st, sc, sz, sy, sx = arr.shape
         check_bounds(z, c, t, x, y, w, h, sx, sy, sz, sc, st)
+        if _chunk_cache is None:
+            _chunk_cache = self._level_cache(level)
         region = arr.read_region(
             (t, c, z, y, x), (1, 1, 1, h, w), chunk_cache=_chunk_cache
         )
         return region[0, 0, 0]
 
+    def _level_cache(self, level: int):
+        """Persistent LRU view for one level — or, with the cache
+        disabled (budget 0), a plain dict so batches still dedup chunk
+        decode within themselves."""
+        if self.block_cache.max_bytes <= 0:
+            return {}
+        return _PrefixedCache(self.block_cache, (self.cache_ns, level))
+
     def read_tiles(self, coords, level: int = 0):
-        # Chunk-dedup batched read: a per-call cache dict (no shared
-        # state) so each touched chunk is decoded once per batch.
-        cache: Dict[Tuple[int, ...], Optional[np.ndarray]] = {}
+        # Chunk-dedup batched read through the persistent LRU: each
+        # touched chunk decodes once — per batch AND across batches.
+        cache = self._level_cache(level)
         return [
             self.get_tile_at(level, *co, _chunk_cache=cache) for co in coords
         ]

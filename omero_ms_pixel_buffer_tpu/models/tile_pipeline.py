@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import logging
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -66,35 +67,117 @@ class ResolvedTile:
         self.level, self.x, self.y, self.w, self.h = level, x, y, w, h
 
 
+def _device_link_mbps() -> float:
+    """Measured host<->device roundtrip bandwidth (MB/s), probed once
+    per process with a 4 MB array. On a co-located TPU (PCIe) this is
+    GB/s; over a tunneled device it can be tens of MB/s — in which
+    case shipping tiles to the device costs more than it saves and the
+    host engine wins (the 'minimise host<->device transfers' rule)."""
+    global _LINK_MBPS
+    if _LINK_MBPS is None:
+        import time
+
+        import jax
+
+        sample = np.zeros((2 * 1024 * 1024,), np.uint16)  # 4 MB
+        jax.device_put(np.zeros(8, np.uint8)).block_until_ready()  # warm
+        t0 = time.perf_counter()
+        dev = jax.device_put(sample)
+        dev.block_until_ready()
+        np.asarray(dev)
+        dt = time.perf_counter() - t0
+        _LINK_MBPS = (2 * sample.nbytes) / dt / 1e6
+        log.info("device link probe: %.0f MB/s roundtrip", _LINK_MBPS)
+    return _LINK_MBPS
+
+
+_LINK_MBPS: Optional[float] = None
+
+
+def _png_native_eligible(tile: np.ndarray) -> bool:
+    return (
+        tile.dtype in _PNG_DTYPES
+        and (tile.ndim == 2 or (tile.ndim == 3 and tile.shape[2] == 3))
+    )
+
+
 class TilePipeline:
+    """Engines:
+
+    - ``auto`` — probe the device link at first batch; use ``device``
+      only on a TPU backend whose transfer bandwidth clears
+      ``OMPB_DEVICE_MIN_MBPS`` (default 1000 MB/s), else ``host``.
+    - ``device`` — coalesced tiles padded to shape buckets, filtered on
+      the accelerator (Pallas/XLA), deflate on host threads.
+    - ``host`` — one fused native call per batch (byteswap + filter +
+      deflate + PNG framing on the C++ pool, GIL released).
+
+    ``use_device`` is the legacy spelling: True -> ``device``,
+    False -> ``host``, None -> ``engine`` as given.
+    """
+
     def __init__(
         self,
         pixels_service: PixelsService,
         png_filter: str = "up",
         png_level: int = 6,
+        png_strategy: str = "rle",
         encode_workers: int = 8,
-        use_device: bool = True,
+        use_device: Optional[bool] = None,
         use_pallas: Optional[bool] = None,
         buckets: Sequence[int] = (256, 512, 1024),
+        engine: str = "auto",
     ):
         self.pixels_service = pixels_service
         self.png_filter = png_filter
         self.png_level = png_level
-        self.use_device = use_device
-        if use_pallas is None and use_device:
-            # Pallas is the default on real TPUs; interpret mode is far
-            # too slow for serving, so other backends take the
-            # XLA-fusion path. Only probe the backend when the device
-            # path is in play — resolving it would initialize PJRT,
-            # which host-only configurations must never pay for.
-            import jax
-
-            use_pallas = jax.default_backend() == "tpu"
-        self.use_pallas = bool(use_pallas)
+        self.png_strategy = png_strategy
+        if use_device is not None:
+            engine = "device" if use_device else "host"
+        if engine not in ("auto", "device", "host"):
+            raise ValueError(f"Unknown engine: {engine}")
+        self._engine = engine
+        self._use_pallas_arg = use_pallas
         self.buckets = tuple(sorted(buckets))
         self._encode_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=encode_workers, thread_name_prefix="encode"
         )
+
+    @property
+    def engine(self) -> str:
+        """The resolved engine ('auto' resolves lazily at first use)."""
+        if self._engine == "auto":
+            import jax
+
+            min_mbps = float(os.environ.get("OMPB_DEVICE_MIN_MBPS", "1000"))
+            if (
+                jax.default_backend() == "tpu"
+                and _device_link_mbps() >= min_mbps
+            ):
+                self._engine = "device"
+            else:
+                self._engine = "host"
+            log.info("engine auto-resolved to '%s'", self._engine)
+        return self._engine
+
+    @property
+    def use_device(self) -> bool:
+        return self.engine == "device"
+
+    @property
+    def use_pallas(self) -> bool:
+        if self._use_pallas_arg is not None:
+            return bool(self._use_pallas_arg)
+        if not self.use_device:
+            return False
+        # Pallas is the default on real TPUs; interpret mode is far
+        # too slow for serving, so other backends take the XLA-fusion
+        # path. Only probe the backend when the device path is in play
+        # — resolving it would initialize PJRT, which host-only
+        # configurations must never pay for.
+        import jax
+
+        return jax.default_backend() == "tpu"
 
     # ------------------------------------------------------------------
     # resolve / read — the metadata + I/O stages
@@ -163,7 +246,8 @@ class TilePipeline:
             with TRACER.start_span("write_image"):
                 try:
                     return encode_png(
-                        tile, filter_mode=self.png_filter, level=self.png_level
+                        tile, filter_mode=self.png_filter,
+                        level=self.png_level, strategy=self.png_strategy,
                     )
                 except PngEncodeError:
                     log.error("PNG encode failed for %s", tile.dtype)
@@ -235,14 +319,16 @@ class TilePipeline:
                 except Exception:
                     log.exception("batched read failed; lanes -> 404")
 
-        # split lanes: device-PNG vs host fallback
+        # split lanes: device-PNG buckets / host fused encode / python
+        use_device = self.use_device  # resolves 'auto' once per batch
         png_groups: Dict[Tuple, List[int]] = {}
+        host_lanes: List[int] = []
         for i, (ctx, tile) in enumerate(zip(ctxs, tiles)):
             if tile is None or resolved[i] is None:
                 continue
             bucket = (
                 self._bucket(tile.shape[1], tile.shape[0])
-                if self.use_device
+                if use_device
                 and ctx.format == "png"
                 and tile.ndim == 2
                 and tile.dtype in _PNG_DTYPES
@@ -253,8 +339,13 @@ class TilePipeline:
                 png_groups.setdefault(
                     ((bh, bw), tile.dtype.str), []
                 ).append(i)
+            elif ctx.format == "png" and _png_native_eligible(tile):
+                host_lanes.append(i)
             else:
                 results[i] = self.encode(ctx, tile)
+
+        if host_lanes:
+            self._host_png_lanes(host_lanes, tiles, ctxs, results)
 
         for ((bh, bw), dtype_str), lanes in png_groups.items():
             try:
@@ -266,6 +357,29 @@ class TilePipeline:
                 for i in lanes:
                     results[i] = self.encode(ctxs[i], tiles[i])
         return results
+
+    def _host_png_lanes(self, lanes, tiles, ctxs, results) -> None:
+        """Host engine: the whole batch in one fused native call
+        (byteswap + filter + deflate + framing on the C++ pool). Falls
+        back to per-lane python encode without the native engine."""
+        engine = get_engine()
+        encoded = None
+        if engine is not None:
+            with TRACER.start_span("batch_encode"):
+                encoded = engine.png_encode_batch(
+                    [tiles[i] for i in lanes],
+                    filter_mode=self.png_filter,
+                    level=self.png_level,
+                    strategy=self.png_strategy,
+                )
+        if encoded is None:
+            for i in lanes:
+                results[i] = self.encode(ctxs[i], tiles[i])
+            return
+        for i, png in zip(lanes, encoded):
+            results[i] = (
+                png if png is not None else self.encode(ctxs[i], tiles[i])
+            )
 
     def _device_png_lanes(self, lanes, tiles, ctxs, results, bh, bw, dtype):
         itemsize = dtype.itemsize
@@ -307,6 +421,7 @@ class TilePipeline:
                     bit_depths=[bit_depth] * len(lanes),
                     color_types=[0] * len(lanes),
                     level=self.png_level,
+                    strategy=self.png_strategy,
                 )
                 for (j, i), png in zip(enumerate(lanes), pngs):
                     if png is None:
@@ -315,7 +430,7 @@ class TilePipeline:
                         t = tiles[i]
                         results[i] = assemble_png(
                             payloads[j], t.shape[1], t.shape[0],
-                            bit_depth, 0, self.png_level,
+                            bit_depth, 0, self.png_level, self.png_strategy,
                         )
                     else:
                         results[i] = png
@@ -325,7 +440,8 @@ class TilePipeline:
                 t = tiles[i]
                 h, w = t.shape
                 return assemble_png(
-                    lane_bytes(j, i), w, h, bit_depth, 0, self.png_level
+                    lane_bytes(j, i), w, h, bit_depth, 0,
+                    self.png_level, self.png_strategy,
                 )
 
             futs = {

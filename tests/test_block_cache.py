@@ -1,0 +1,193 @@
+"""Decoded-block cache + engine selection (the perf layer added on top
+of the readers and the pipeline)."""
+
+import numpy as np
+import pytest
+
+from omero_ms_pixel_buffer_tpu.io.ometiff import (
+    OmeTiffPixelBuffer,
+    write_ome_tiff,
+)
+from omero_ms_pixel_buffer_tpu.io.pixel_buffer import BlockCache
+from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+    ImageRegistry,
+    PixelsService,
+)
+from omero_ms_pixel_buffer_tpu.io.zarr import ZarrPixelBuffer, write_ngff
+from omero_ms_pixel_buffer_tpu.models.tile_pipeline import TilePipeline
+from omero_ms_pixel_buffer_tpu.ops.png import decode_png
+from omero_ms_pixel_buffer_tpu.tile_ctx import RegionDef, TileCtx
+
+
+class TestBlockCache:
+    def test_lru_byte_bound(self):
+        cache = BlockCache(max_bytes=100)
+        for i in range(5):
+            cache[i] = np.zeros(40, np.uint8)
+        assert cache.nbytes <= 100
+        assert cache.get(0) is None  # evicted
+        assert cache.get(4) is not None
+
+    def test_get_refreshes_recency(self):
+        cache = BlockCache(max_bytes=100)
+        cache["a"] = np.zeros(40, np.uint8)
+        cache["b"] = np.zeros(40, np.uint8)
+        cache.get("a")  # now "b" is LRU
+        cache["c"] = np.zeros(40, np.uint8)
+        assert cache.get("a") is not None
+        assert cache.get("b") is None
+
+    def test_disabled_and_oversized(self):
+        cache = BlockCache(max_bytes=0)
+        cache["x"] = np.zeros(8, np.uint8)
+        assert cache.get("x") is None
+        cache = BlockCache(max_bytes=10)
+        cache["big"] = np.zeros(100, np.uint8)  # larger than budget
+        assert cache.get("big") is None
+        assert cache.nbytes == 0
+
+    def test_none_values_cached(self):
+        cache = BlockCache(max_bytes=100)
+        sentinel = object()
+        cache["absent-chunk"] = None
+        assert cache.get("absent-chunk", sentinel) is None
+        assert cache.get("other", sentinel) is sentinel
+
+
+@pytest.fixture
+def tiff_image(tmp_path):
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 60000, (1, 1, 1, 512, 512), dtype=np.uint16)
+    path = str(tmp_path / "img.ome.tiff")
+    write_ome_tiff(path, data, tile_size=(128, 128), compression="zlib")
+    return path, data[0, 0, 0]
+
+
+class TestReaderCaches:
+    def test_ometiff_cache_hits_and_correctness(self, tiff_image):
+        path, truth = tiff_image
+        buf = OmeTiffPixelBuffer(path)
+        t1 = buf.get_tile_at(0, 0, 0, 0, 32, 32, 200, 200)
+        misses = buf.block_cache.misses
+        assert len(buf.block_cache) > 0
+        t2 = buf.get_tile_at(0, 0, 0, 0, 32, 32, 200, 200)
+        assert buf.block_cache.misses == misses  # pure hits second time
+        np.testing.assert_array_equal(t1, truth[32:232, 32:232])
+        np.testing.assert_array_equal(t2, t1)
+        buf.close()
+
+    def test_ometiff_batched_reads_use_cache(self, tiff_image):
+        path, truth = tiff_image
+        buf = OmeTiffPixelBuffer(path)
+        coords = [(0, 0, 0, 0, 0, 256, 256), (0, 0, 0, 64, 64, 256, 256)]
+        first = buf.read_tiles(coords)
+        second = buf.read_tiles(coords)
+        for (z, c, t, x, y, w, h), a, b in zip(coords, first, second):
+            np.testing.assert_array_equal(a, truth[y : y + h, x : x + w])
+            np.testing.assert_array_equal(a, b)
+        buf.close()
+
+    def test_ometiff_disabled_cache_still_correct(self, tiff_image):
+        path, truth = tiff_image
+        buf = OmeTiffPixelBuffer(path, cache_bytes=0)
+        tile = buf.get_tile_at(0, 0, 0, 0, 0, 0, 128, 128)
+        np.testing.assert_array_equal(tile, truth[:128, :128])
+        assert len(buf.block_cache) == 0
+        buf.close()
+
+    def test_zarr_persistent_cache(self, tmp_path):
+        rng = np.random.default_rng(6)
+        data = rng.integers(0, 255, (1, 1, 1, 256, 256), dtype=np.uint8)
+        root = str(tmp_path / "img.zarr")
+        write_ngff(root, data, chunks=(64, 64), compressor="zlib")
+        buf = ZarrPixelBuffer(root)
+        t1 = buf.get_tile_at(0, 0, 0, 0, 10, 10, 100, 100)
+        assert len(buf.block_cache) > 0
+        misses = buf.block_cache.misses
+        t2 = buf.get_tile_at(0, 0, 0, 0, 10, 10, 100, 100)
+        assert buf.block_cache.misses == misses
+        np.testing.assert_array_equal(t1, data[0, 0, 0, 10:110, 10:110])
+        np.testing.assert_array_equal(t1, t2)
+
+    def test_zarr_disabled_cache_still_correct(self, tmp_path):
+        rng = np.random.default_rng(6)
+        data = rng.integers(0, 255, (1, 1, 1, 128, 128), dtype=np.uint8)
+        root = str(tmp_path / "img.zarr")
+        write_ngff(root, data, chunks=(64, 64), compressor="zlib")
+        buf = ZarrPixelBuffer(root, cache_bytes=0)
+        tiles = buf.read_tiles([(0, 0, 0, 0, 0, 128, 128)])
+        np.testing.assert_array_equal(tiles[0], data[0, 0, 0])
+        assert len(buf.block_cache) == 0
+
+    def test_shared_cache_no_cross_buffer_aliasing(self, tmp_path):
+        """Two buffers sharing one BlockCache must never serve each
+        other's blocks, even with identical block indices."""
+        shared = BlockCache(max_bytes=64 << 20)
+        rng = np.random.default_rng(7)
+        bufs, truths = [], []
+        for k in range(2):
+            data = rng.integers(0, 60000, (1, 1, 1, 256, 256), np.uint16)
+            path = str(tmp_path / f"img{k}.ome.tiff")
+            write_ome_tiff(path, data, tile_size=(128, 128), compression="zlib")
+            bufs.append(OmeTiffPixelBuffer(path, block_cache=shared))
+            truths.append(data[0, 0, 0])
+        for buf, truth in zip(bufs, truths):
+            tile = buf.get_tile_at(0, 0, 0, 0, 0, 0, 256, 256)
+            np.testing.assert_array_equal(tile, truth)
+        # again, now everything is cached — still the right image
+        for buf, truth in zip(bufs, truths):
+            tile = buf.get_tile_at(0, 0, 0, 0, 64, 64, 128, 128)
+            np.testing.assert_array_equal(tile, truth[64:192, 64:192])
+        for buf in bufs:
+            buf.close()
+
+    def test_pixels_service_shares_one_cache(self, tiff_image, tmp_path):
+        path, _ = tiff_image
+        registry = ImageRegistry()
+        registry.add(1, path)
+        service = PixelsService(registry, block_cache_bytes=32 << 20)
+        buf = service.get_pixel_buffer(1)
+        assert buf.block_cache is service.block_cache
+        service.close()
+
+
+class TestEngineSelection:
+    def _service(self, tiff_image):
+        path, truth = tiff_image
+        registry = ImageRegistry()
+        registry.add(1, path)
+        return PixelsService(registry), truth
+
+    def _ctx(self, fmt="png"):
+        return TileCtx(
+            image_id=1, z=0, c=0, t=0,
+            region=RegionDef(16, 48, 200, 160),
+            format=fmt, omero_session_key="k",
+        )
+
+    def test_auto_resolves_to_host_on_cpu(self, tiff_image):
+        service, _ = self._service(tiff_image)
+        pipe = TilePipeline(service, engine="auto")
+        # conftest pins JAX to the CPU backend -> auto must pick host
+        assert pipe.engine == "host"
+        assert not pipe.use_device
+
+    def test_invalid_engine_rejected(self, tiff_image):
+        service, _ = self._service(tiff_image)
+        with pytest.raises(ValueError):
+            TilePipeline(service, engine="gpu")
+
+    def test_host_and_device_agree(self, tiff_image):
+        service, truth = self._service(tiff_image)
+        expected = truth[48:208, 16:216]
+        host = TilePipeline(service, engine="host")
+        device = TilePipeline(service, engine="device", use_pallas=False)
+        out_h = host.handle_batch([self._ctx()])[0]
+        out_d = device.handle_batch([self._ctx()])[0]
+        np.testing.assert_array_equal(decode_png(out_h), expected)
+        np.testing.assert_array_equal(decode_png(out_d), expected)
+
+    def test_legacy_use_device_mapping(self, tiff_image):
+        service, _ = self._service(tiff_image)
+        assert TilePipeline(service, use_device=False).engine == "host"
+        assert TilePipeline(service, use_device=True).engine == "device"

@@ -52,3 +52,26 @@ def test_full_yaml_shape():
     assert cfg.http_tracing_enabled
     assert cfg.backend.batching.buckets == (128, 256)
     assert cfg.backend.batching.max_batch == 8
+
+
+def test_invalid_backend_engine_is_hard_error():
+    with pytest.raises(ConfigError):
+        Config.from_dict({
+            "session-store": {"type": "memory"},
+            "backend": {"engine": "hots"},
+        })
+
+
+def test_png_block_parsed():
+    cfg = Config.from_dict({
+        "session-store": {"type": "memory"},
+        "backend": {"png": {"filter": "sub", "level": 3,
+                            "strategy": "default"}},
+    })
+    assert cfg.backend.png.filter == "sub"
+    assert cfg.backend.png.level == 3
+    assert cfg.backend.png.strategy == "default"
+    # defaults: up/6/rle
+    cfg2 = Config.from_dict({"session-store": {"type": "memory"}})
+    assert (cfg2.backend.png.filter, cfg2.backend.png.level,
+            cfg2.backend.png.strategy) == ("up", 6, "rle")
