@@ -12,9 +12,9 @@ handler turns into a 403.
   environment); reads Django cache-backend keys
   ``:<version>:django.cache:<KEY_PREFIX>:<sessionid>`` patterns,
   configurable, and decodes the pickled session via auth.django.
-- ``PostgresSessionStore`` — the JDBC analog; requires an external
-  driver this environment doesn't ship, so constructing it raises
-  with a clear message (config type remains accepted for parity).
+- ``PostgresSessionStore`` — the ``OmeroWebJDBCSessionStore`` analog:
+  reads Django's ``django_session`` table over the in-tree Postgres
+  wire-protocol client (db/postgres.py; no external driver needed).
 """
 
 from __future__ import annotations
@@ -158,16 +158,36 @@ class EchoSessionStore(OmeroWebSessionStore):
 
 
 class PostgresSessionStore(OmeroWebSessionStore):
-    """OmeroWebJDBCSessionStore analog. The environment ships no
-    Postgres driver; fail at construction with a clear pointer rather
-    than at first request."""
+    """The ``OmeroWebJDBCSessionStore`` analog: look the Django session
+    row up in OMERO.web's Postgres session table over the in-tree wire
+    protocol client (db/postgres.py — no external driver exists in
+    this environment, mirroring the RESP2 approach above).
+
+    Django's ``django_session`` schema: ``session_key`` (PK),
+    ``session_data`` (base64 text payload), ``expire_date``. Expired
+    rows are treated as absent, like Django itself does."""
+
+    QUERY = (
+        "SELECT session_data FROM django_session "
+        "WHERE session_key = $1 AND expire_date > now()"
+    )
 
     def __init__(self, uri: str):
-        raise NotImplementedError(
-            "The postgres session store requires a PostgreSQL client "
-            "driver, which this build does not bundle. Use "
-            "session-store.type: redis (or memory), or install asyncpg."
-        )
+        from ..db.postgres import PostgresClient
+
+        self._client = PostgresClient.from_uri(uri)
+
+    async def get_omero_session_key(self, session_id: str) -> Optional[str]:
+        rows = await self._client.query(self.QUERY, [session_id])
+        if not rows or rows[0][0] is None:
+            return None
+        session = decode_session_payload(rows[0][0].encode())
+        if session is None:
+            return None
+        return extract_omero_session_key(session)
+
+    async def close(self) -> None:
+        await self._client.close()
 
 
 def make_session_store(store_type: str, uri: Optional[str]) -> OmeroWebSessionStore:
@@ -176,7 +196,9 @@ def make_session_store(store_type: str, uri: Optional[str]) -> OmeroWebSessionSt
     if store_type == "redis":
         return RedisSessionStore(uri or "redis://localhost:6379/0")
     if store_type == "postgres":
-        return PostgresSessionStore(uri or "")
+        return PostgresSessionStore(
+            uri or "postgresql://omero@localhost:5432/omero_web"
+        )
     if store_type == "memory":
         return MemorySessionStore()
     raise ValueError(

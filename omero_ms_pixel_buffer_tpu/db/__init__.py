@@ -1,0 +1,4 @@
+"""Database clients (no external drivers in this environment)."""
+
+from .metadata import OmeroPostgresMetadataResolver  # noqa: F401
+from .postgres import PostgresClient, PostgresError  # noqa: F401

@@ -1,0 +1,68 @@
+"""OMERO Postgres metadata resolver.
+
+The reference resolves tile metadata with an HQL query against the
+OMERO server — ``Pixels`` joined with its image and pixels type, with
+``omero.group = -1`` for a cross-group read, null when the image does
+not exist (TileRequestHandler.java:220-241). This resolver implements
+the same contract directly against the OMERO database over the in-tree
+wire client (db/postgres.py): one round trip, one row, `None` -> 404.
+
+Wiring: this covers the *metadata plane* only. The serving path also
+needs the *buffer plane* (imageId -> storage path/reader), which the
+filesystem ``ImageRegistry`` provides; a deployment against a live
+OMERO database combines the two — registry (or OMERO data-dir layout)
+for paths, this resolver for authoritative dimensions/type. Construct
+with the ``omero.server.*`` database DSN (config.yaml's
+``omero.server`` block carries the database settings in a real
+deployment).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ..io.pixel_buffer import PixelsMeta
+from .postgres import PostgresClient
+
+# The HQL join, flattened to SQL over the OMERO schema: pixels rows
+# carry dimensions + FK to pixelstype (enum value = "uint16" etc.) and
+# to their image (name). Mirrors TileRequestHandler.java:228-236.
+PIXELS_QUERY = (
+    "SELECT p.id, p.sizex, p.sizey, p.sizez, p.sizec, p.sizet, "
+    "pt.value, i.name "
+    "FROM pixels p "
+    "JOIN image i ON p.image = i.id "
+    "JOIN pixelstype pt ON p.pixelstype = pt.id "
+    "WHERE i.id = $1"
+)
+
+
+class OmeroPostgresMetadataResolver:
+    """MetadataResolver over the OMERO database (async core with a sync
+    adapter for the pipeline's synchronous resolve stage)."""
+
+    def __init__(self, uri: str):
+        self._client = PostgresClient.from_uri(uri)
+
+    async def get_pixels_async(self, image_id: int) -> Optional[PixelsMeta]:
+        rows = await self._client.query(PIXELS_QUERY, [str(int(image_id))])
+        if not rows:
+            return None  # -> 404 "Cannot find Image:<id>"
+        (_pid, sx, sy, sz, sc, st, ptype, name) = rows[0]
+        return PixelsMeta(
+            image_id=int(image_id),
+            size_x=int(sx), size_y=int(sy),
+            size_z=int(sz), size_c=int(sc), size_t=int(st),
+            pixels_type=ptype,
+            image_name=name or str(image_id),
+        )
+
+    def get_pixels(self, image_id: int) -> Optional[PixelsMeta]:
+        """Sync adapter (the MetadataResolver surface). Runs the async
+        query on a private loop; callers on an event loop should use
+        ``get_pixels_async`` directly."""
+        return asyncio.run(self.get_pixels_async(image_id))
+
+    async def close(self) -> None:
+        await self._client.close()

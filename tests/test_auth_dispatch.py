@@ -77,8 +77,13 @@ class TestStores:
 
     def test_factory(self):
         assert isinstance(make_session_store("memory", None), MemorySessionStore)
-        with pytest.raises(NotImplementedError):
-            make_session_store("postgres", "jdbc:postgresql://x/db")
+        from omero_ms_pixel_buffer_tpu.auth.stores import PostgresSessionStore
+
+        # accepts both postgresql:// and the reference's jdbc: spelling
+        pg = make_session_store("postgres", "jdbc:postgresql://x:5433/db")
+        assert isinstance(pg, PostgresSessionStore)
+        assert pg._client.host == "x" and pg._client.port == 5433
+        assert pg._client.database == "db"
         with pytest.raises(ValueError):
             make_session_store("dynamo", None)
 

@@ -1,0 +1,420 @@
+"""Postgres wire client + the two consumers built on it (session
+store, metadata resolver), against an in-process fake server."""
+
+import asyncio
+import base64
+import hashlib
+import hmac
+import pickle
+import struct
+
+import pytest
+
+from omero_ms_pixel_buffer_tpu.db.metadata import (
+    OmeroPostgresMetadataResolver,
+    PIXELS_QUERY,
+)
+from omero_ms_pixel_buffer_tpu.db.postgres import (
+    PostgresClient,
+    PostgresError,
+    md5_password,
+    parse_dsn,
+    scram_client_final,
+    scram_client_first,
+)
+from omero_ms_pixel_buffer_tpu.auth.stores import PostgresSessionStore
+
+
+class TestScram:
+    def test_rfc7677_vectors(self):
+        """RFC 7677 §3 SCRAM-SHA-256 example exchange."""
+        nonce = "rOprNGfwEbeRWgbNEkqO"
+        first, bare = scram_client_first(nonce)
+        assert first == "n,,n=,r=rOprNGfwEbeRWgbNEkqO"
+        # RFC vector uses n=user; our bare omits the name (Postgres
+        # ignores it), so recompute the vector with n= empty is not
+        # possible — instead check the math against the RFC's bare.
+        bare = "n=user,r=rOprNGfwEbeRWgbNEkqO"
+        server_first = (
+            "r=rOprNGfwEbeRWgbNEkqO%hvYDpWUa2RaTCAfuxFIlj)hNlF$k0,"
+            "s=W22ZaJ0SNY7soEsUEjb6gQ==,i=4096"
+        )
+        final, server_sig = scram_client_final("pencil", bare, server_first)
+        assert final == (
+            "c=biws,r=rOprNGfwEbeRWgbNEkqO%hvYDpWUa2RaTCAfuxFIlj)hNlF$k0,"
+            "p=dHzbZapWIk4jUhN+Ute9ytag9zjfMHgsqmmiz7AndVQ="
+        )
+        assert base64.b64encode(server_sig).decode() == (
+            "6rriTRBi23WpRR/wtup+mMhUZUn/dB5nLTJRsjl95G4="
+        )
+
+    def test_md5_password(self):
+        # md5(md5("secret" + "user") + salt) with a fixed salt
+        out = md5_password("user", "secret", b"\x01\x02\x03\x04")
+        inner = hashlib.md5(b"secretuser").hexdigest()
+        expect = "md5" + hashlib.md5(
+            inner.encode() + b"\x01\x02\x03\x04"
+        ).hexdigest()
+        assert out == expect
+
+
+class TestDsn:
+    def test_basic(self):
+        p = parse_dsn("postgresql://alice:pw@db.example:5433/omero_web")
+        assert p["host"] == "db.example"
+        assert p["port"] == "5433"
+        assert p["user"] == "alice"
+        assert p["password"] == "pw"
+        assert p["database"] == "omero_web"
+
+    def test_jdbc_spelling(self):
+        p = parse_dsn("jdbc:postgresql://db:5432/omero")
+        assert p["host"] == "db"
+        assert p["database"] == "omero"
+
+    def test_defaults_and_rejects(self):
+        p = parse_dsn("postgresql://localhost")
+        assert p["port"] == "5432"
+        assert p["database"] == "omero"
+        with pytest.raises(ValueError):
+            parse_dsn("mysql://db/x")
+
+
+# ---------------------------------------------------------------------------
+# Fake server: enough of protocol v3 for auth + extended query
+# ---------------------------------------------------------------------------
+
+
+class FakePg:
+    """Serves canned rows; supports trust / cleartext / md5 / SCRAM auth.
+    Records the SQL + params of every query it answers."""
+
+    def __init__(self, auth="trust", user="omero", password="pw",
+                 rows_for=None):
+        self.auth = auth
+        self.user = user
+        self.password = password
+        self.rows_for = rows_for or (lambda sql, params: [])
+        self.queries = []
+        self.server = None
+        self.port = None
+
+    async def __aenter__(self):
+        self.server = await asyncio.start_server(
+            self._handle, "127.0.0.1", 0
+        )
+        self.port = self.server.sockets[0].getsockname()[1]
+        return self
+
+    async def __aexit__(self, *exc):
+        self.server.close()
+        await self.server.wait_closed()
+
+    @staticmethod
+    def _msg(type_byte: bytes, payload: bytes) -> bytes:
+        return type_byte + struct.pack("!I", len(payload) + 4) + payload
+
+    async def _read_msg(self, r):
+        head = await r.readexactly(5)
+        (length,) = struct.unpack("!I", head[1:5])
+        return head[:1], await r.readexactly(length - 4)
+
+    async def _handle(self, r, w):
+        try:
+            await self._session(r, w)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            w.close()
+
+    async def _session(self, r, w):
+        head = await r.readexactly(4)
+        (length,) = struct.unpack("!I", head)
+        body = await r.readexactly(length - 4)
+        (proto,) = struct.unpack("!I", body[:4])
+        assert proto == 196608
+
+        ok = self._msg(b"R", struct.pack("!I", 0))
+        if self.auth == "trust":
+            w.write(ok)
+        elif self.auth == "cleartext":
+            w.write(self._msg(b"R", struct.pack("!I", 3)))
+            await w.drain()
+            t, payload = await self._read_msg(r)
+            assert t == b"p"
+            if payload.rstrip(b"\x00").decode() != self.password:
+                w.write(self._error("28P01", "password authentication failed"))
+                return
+            w.write(ok)
+        elif self.auth == "md5":
+            salt = b"\xde\xad\xbe\xef"
+            w.write(self._msg(b"R", struct.pack("!I", 5) + salt))
+            await w.drain()
+            t, payload = await self._read_msg(r)
+            expect = md5_password(self.user, self.password, salt)
+            if payload.rstrip(b"\x00").decode() != expect:
+                w.write(self._error("28P01", "password authentication failed"))
+                return
+            w.write(ok)
+        elif self.auth == "scram":
+            w.write(self._msg(
+                b"R", struct.pack("!I", 10) + b"SCRAM-SHA-256\x00\x00"
+            ))
+            await w.drain()
+            t, payload = await self._read_msg(r)
+            assert t == b"p"
+            mech_end = payload.index(b"\x00")
+            assert payload[:mech_end] == b"SCRAM-SHA-256"
+            (blen,) = struct.unpack(
+                "!I", payload[mech_end + 1 : mech_end + 5]
+            )
+            client_first = payload[mech_end + 5 : mech_end + 5 + blen].decode()
+            client_bare = client_first.split(",", 2)[2]
+            client_nonce = dict(
+                kv.split("=", 1) for kv in client_bare.split(",")
+            )["r"]
+            salt, iters = b"0123456789abcdef", 4096
+            server_nonce = client_nonce + "SRVNONCE"
+            server_first = (
+                f"r={server_nonce},s={base64.b64encode(salt).decode()},"
+                f"i={iters}"
+            )
+            w.write(self._msg(
+                b"R", struct.pack("!I", 11) + server_first.encode()
+            ))
+            await w.drain()
+            t, payload = await self._read_msg(r)
+            client_final = payload.decode()
+            attrs = dict(
+                kv.split("=", 1) for kv in client_final.split(",")
+            )
+            # verify the proof exactly as a real server does
+            salted = hashlib.pbkdf2_hmac(
+                "sha256", self.password.encode(), salt, iters
+            )
+            client_key = hmac.new(
+                salted, b"Client Key", hashlib.sha256
+            ).digest()
+            stored = hashlib.sha256(client_key).digest()
+            without_proof = client_final.rsplit(",p=", 1)[0]
+            auth_msg = ",".join(
+                (client_bare, server_first, without_proof)
+            ).encode()
+            sig = hmac.new(stored, auth_msg, hashlib.sha256).digest()
+            proof = base64.b64decode(attrs["p"])
+            recovered = bytes(a ^ b for a, b in zip(proof, sig))
+            if hashlib.sha256(recovered).digest() != stored:
+                w.write(self._error("28P01", "SCRAM proof mismatch"))
+                return
+            server_key = hmac.new(
+                salted, b"Server Key", hashlib.sha256
+            ).digest()
+            server_sig = hmac.new(
+                server_key, auth_msg, hashlib.sha256
+            ).digest()
+            final = "v=" + base64.b64encode(server_sig).decode()
+            w.write(self._msg(b"R", struct.pack("!I", 12) + final.encode()))
+            w.write(ok)
+        w.write(self._msg(b"Z", b"I"))
+        await w.drain()
+
+        # extended-query loop
+        sql, params = None, []
+        while True:
+            t, payload = await self._read_msg(r)
+            if t == b"P":
+                sql = payload.split(b"\x00")[1].decode()
+            elif t == b"B":
+                params = self._parse_bind(payload)
+            elif t == b"E":
+                pass
+            elif t == b"S":
+                self.queries.append((sql, params))
+                rows = self.rows_for(sql, params)
+                for row in rows:
+                    cols = b""
+                    for v in row:
+                        if v is None:
+                            cols += struct.pack("!i", -1)
+                        else:
+                            data = str(v).encode()
+                            cols += struct.pack("!I", len(data)) + data
+                    w.write(self._msg(
+                        b"D", struct.pack("!H", len(row)) + cols
+                    ))
+                w.write(self._msg(b"C", b"SELECT %d\x00" % len(rows)))
+                w.write(self._msg(b"Z", b"I"))
+                await w.drain()
+            elif t == b"X":
+                return
+
+    @staticmethod
+    def _parse_bind(payload):
+        off = 0
+        for _ in range(2):  # portal, statement names
+            off = payload.index(b"\x00", off) + 1
+        (nfmt,) = struct.unpack_from("!H", payload, off)
+        off += 2 + 2 * nfmt
+        (nparams,) = struct.unpack_from("!H", payload, off)
+        off += 2
+        params = []
+        for _ in range(nparams):
+            (n,) = struct.unpack_from("!i", payload, off)
+            off += 4
+            if n == -1:
+                params.append(None)
+            else:
+                params.append(payload[off : off + n].decode())
+                off += n
+        return params
+
+    def _error(self, code, message):
+        fields = b"SERROR\x00C" + code.encode() + b"\x00M" + \
+            message.encode() + b"\x00\x00"
+        return self._msg(b"E", fields)
+
+
+class TestPostgresClient:
+    @pytest.mark.parametrize("auth", ["trust", "cleartext", "md5", "scram"])
+    def test_auth_and_query(self, loop, auth):
+        async def run():
+            async with FakePg(
+                auth=auth, user="u1", password="sekret",
+                rows_for=lambda sql, params: [("1", "hello"), ("2", None)],
+            ) as pg:
+                client = PostgresClient(
+                    host="127.0.0.1", port=pg.port, user="u1",
+                    password="sekret", database="db",
+                )
+                rows = await client.query("SELECT a, b FROM t WHERE x=$1",
+                                          ["42"])
+                await client.close()
+                assert rows == [("1", "hello"), ("2", None)]
+                assert pg.queries[-1] == (
+                    "SELECT a, b FROM t WHERE x=$1", ["42"]
+                )
+
+        loop.run_until_complete(run())
+
+    def test_bad_password_raises(self, loop):
+        async def run():
+            async with FakePg(auth="cleartext", password="right") as pg:
+                client = PostgresClient(
+                    host="127.0.0.1", port=pg.port, password="wrong",
+                )
+                with pytest.raises(PostgresError):
+                    await client.query("SELECT 1")
+                await client.close_nowait()
+
+        loop.run_until_complete(run())
+
+    def test_empty_result(self, loop):
+        async def run():
+            async with FakePg() as pg:
+                client = PostgresClient(host="127.0.0.1", port=pg.port)
+                rows = await client.query("SELECT 1")
+                assert rows == []
+                await client.close()
+
+        loop.run_until_complete(run())
+
+
+DJANGO_SESSION = base64.b64encode(
+    b"hash:" + pickle.dumps(
+        {"connector": {"omero_session_key": "omero-key-123"}}
+    )
+).decode()
+
+
+class TestPostgresSessionStore:
+    def test_lookup(self, loop):
+        def rows_for(sql, params):
+            assert "django_session" in sql
+            if params == ["good-cookie"]:
+                return [(DJANGO_SESSION,)]
+            return []
+
+        async def run():
+            async with FakePg(rows_for=rows_for) as pg:
+                store = PostgresSessionStore(
+                    f"postgresql://omero:pw@127.0.0.1:{pg.port}/omero_web"
+                )
+                assert await store.get_omero_session_key(
+                    "good-cookie"
+                ) == "omero-key-123"
+                assert await store.get_omero_session_key("bad") is None
+                await store.close()
+
+        loop.run_until_complete(run())
+
+
+class TestMetadataResolver:
+    def test_pixels_contract(self, loop):
+        def rows_for(sql, params):
+            assert sql == PIXELS_QUERY
+            if params == ["7"]:
+                return [("99", "4096", "2048", "16", "3", "1",
+                         "uint16", "plate1")]
+            return []
+
+        async def run():
+            async with FakePg(rows_for=rows_for) as pg:
+                resolver = OmeroPostgresMetadataResolver(
+                    f"postgresql://omero:pw@127.0.0.1:{pg.port}/omero"
+                )
+                meta = await resolver.get_pixels_async(7)
+                assert meta.size_x == 4096 and meta.size_y == 2048
+                assert meta.size_z == 16 and meta.size_c == 3
+                assert meta.pixels_type == "uint16"
+                assert meta.image_name == "plate1"
+                assert await resolver.get_pixels_async(8) is None  # -> 404
+                await resolver.close()
+
+        loop.run_until_complete(run())
+
+
+class TestCrossLoopReuse:
+    def test_sync_adapter_survives_multiple_calls(self):
+        """get_pixels uses asyncio.run per call; the client must not
+        reuse streams or locks bound to the previous (closed) loop."""
+        import threading
+
+        def rows_for(sql, params):
+            return [("1", "64", "32", "1", "1", "1", "uint8", "img")]
+
+        results = {}
+        started = threading.Event()
+
+        # run the fake server on its own thread+loop so each
+        # asyncio.run() in get_pixels sees a live server
+        def server_thread():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+
+            async def run():
+                async with FakePg(rows_for=rows_for) as pg:
+                    results["port"] = pg.port
+                    started.set()
+                    await asyncio.sleep(5)
+
+            try:
+                loop.run_until_complete(run())
+            finally:
+                loop.close()
+
+        t = threading.Thread(target=server_thread, daemon=True)
+        t.start()
+        assert started.wait(5)
+        resolver = OmeroPostgresMetadataResolver(
+            f"postgresql://omero:pw@127.0.0.1:{results['port']}/omero"
+        )
+        m1 = resolver.get_pixels(1)  # first asyncio.run
+        m2 = resolver.get_pixels(2)  # second loop: must reconnect
+        assert m1.size_x == 64 and m2.size_x == 64
+
+
+def test_sslmode_require_rejected():
+    with pytest.raises(ValueError, match="sslmode"):
+        parse_dsn("postgresql://u:p@db/omero?sslmode=require")
+    # prefer/disable pass through
+    assert parse_dsn("postgresql://db/omero?sslmode=disable")["host"] == "db"
