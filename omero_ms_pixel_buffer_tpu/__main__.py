@@ -1,0 +1,23 @@
+"""Package launcher (the io.vertx.core.Launcher analog,
+build.gradle:9,74): ``python -m omero_ms_pixel_buffer_tpu`` starts the
+HTTP service; ``... debug-context`` is the ``Main.main`` diagnostic
+entry (Main.java:10-21) — build the full wiring standalone, print the
+resolved pixels service, and exit without serving."""
+
+import sys
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "debug-context":
+        from .debug import main as debug_main
+
+        return debug_main(argv[1:])
+    from .http.server import main as serve
+
+    serve(argv)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

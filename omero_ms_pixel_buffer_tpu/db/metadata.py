@@ -20,10 +20,34 @@ deployment).
 from __future__ import annotations
 
 import asyncio
+import threading
 from typing import Optional
 
 from ..io.pixel_buffer import PixelsMeta
 from .postgres import PostgresClient
+
+
+class _LoopThread:
+    """A persistent background event loop so the sync adapter reuses
+    one connection instead of paying TCP + SCRAM per call (and never
+    leaks sockets to closed throwaway loops)."""
+
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self.loop.run_forever, name="pg-metadata", daemon=True
+        )
+        self._thread.start()
+
+    def run(self, coro, timeout: float = 30.0):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop).result(
+            timeout
+        )
+
+    def close(self) -> None:
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join(timeout=5)
+        self.loop.close()
 
 # The HQL join, flattened to SQL over the OMERO schema: pixels rows
 # carry dimensions + FK to pixelstype (enum value = "uint16" etc.) and
@@ -44,6 +68,8 @@ class OmeroPostgresMetadataResolver:
 
     def __init__(self, uri: str):
         self._client = PostgresClient.from_uri(uri)
+        self._runner: Optional[_LoopThread] = None
+        self._runner_lock = threading.Lock()
 
     async def get_pixels_async(self, image_id: int) -> Optional[PixelsMeta]:
         rows = await self._client.query(PIXELS_QUERY, [str(int(image_id))])
@@ -58,11 +84,24 @@ class OmeroPostgresMetadataResolver:
             image_name=name or str(image_id),
         )
 
+    def _run(self, coro):
+        with self._runner_lock:
+            if self._runner is None:
+                self._runner = _LoopThread()
+        return self._runner.run(coro)
+
     def get_pixels(self, image_id: int) -> Optional[PixelsMeta]:
-        """Sync adapter (the MetadataResolver surface). Runs the async
-        query on a private loop; callers on an event loop should use
-        ``get_pixels_async`` directly."""
-        return asyncio.run(self.get_pixels_async(image_id))
+        """Sync adapter (the MetadataResolver surface): dispatches onto
+        a persistent background loop, so the connection — and its
+        SCRAM handshake — is reused across calls. Callers already on
+        an event loop should use ``get_pixels_async`` directly."""
+        return self._run(self.get_pixels_async(image_id))
 
     async def close(self) -> None:
         await self._client.close()
+
+    def close_sync(self) -> None:
+        if self._runner is not None:
+            self._runner.run(self._client.close())
+            self._runner.close()
+            self._runner = None

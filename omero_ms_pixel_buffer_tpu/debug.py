@@ -1,0 +1,56 @@
+"""Wiring smoke test — the ``Main.main`` analog (Main.java:10-21).
+
+The reference's debug entry builds the OMERO Spring context standalone
+and prints the resolved ``/OMERO/Pixels`` bean to prove the data layer
+wires up without serving traffic. This does the same for the TPU
+service: load config, construct the session store / pixels service /
+pipeline / batching worker exactly as ``deploy()`` would, print what
+got resolved, and exit.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+from typing import Optional
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Build the service wiring standalone and print it"
+    )
+    parser.add_argument("--config", default="conf/config.yaml")
+    parser.add_argument("--registry", default=None)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+
+    from .http.server import PixelBufferApp
+    from .utils.config import Config
+
+    config = Config.load(args.config, default_memory_store=True)
+    if args.registry is not None:
+        config.image_registry = args.registry
+    app = PixelBufferApp(config)
+    print(f"config: port={config.port} "
+          f"event-bus-send-timeout={config.event_bus_send_timeout_ms}ms "
+          f"engine={config.backend.engine}")
+    print(f"session store: {type(app.session_store).__name__}")
+    print(f"pixels service: {type(app.pixels_service).__name__} "
+          f"(images registered: {len(app.pixels_service.registry._images)})")
+    print(f"pipeline: engine={app.pipeline._engine!r} "
+          f"buckets={app.pipeline.buckets} "
+          f"png={app.pipeline.png_filter}/{app.pipeline.png_level}"
+          f"/{app.pipeline.png_strategy}")
+    from .runtime.native import get_engine
+
+    engine = get_engine()
+    print(
+        "native engine: "
+        + (f"v{engine.version} ({engine.pool_size} threads)"
+           if engine else "unavailable (pure-python fallback)")
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -117,25 +117,32 @@ class PixelBufferApp:
         session_validator: Optional[SessionValidator] = None,
     ):
         self.config = config
-        if config.zipkin_url:
-            # No Zipkin exporter is implemented yet; fall back to the
-            # log reporter rather than silently dropping spans
-            # (reference fallback: LogSpanReporter when no sender,
-            # PixelBufferMicroserviceVerticle.java:180-184).
-            log.warning(
-                "http-tracing.zipkin-url is set but Zipkin export is not "
-                "implemented; spans will be logged instead"
-            )
+        # Reporter selection mirrors the reference
+        # (PixelBufferMicroserviceVerticle.java:169-200): zipkin-url ->
+        # batched HTTP sender; enabled without URL -> log reporter.
         configure_tracing(
             enabled=True,
             log_spans=config.http_tracing_enabled,
+            zipkin_url=(
+                config.zipkin_url if config.http_tracing_enabled else None
+            ),
         )
         self.session_store = session_store or make_session_store(
             config.session_store.type, config.session_store.uri
         )
         if pixels_service is None:
             registry = ImageRegistry(config.image_registry)
-            pixels_service = PixelsService(registry)
+            resolver = None
+            db_uri = config.omero_server.get("omero.db.uri")
+            if db_uri:
+                # authoritative metadata from the OMERO database (the
+                # HQL plane); registry keeps providing storage paths
+                from ..db.metadata import OmeroPostgresMetadataResolver
+
+                resolver = OmeroPostgresMetadataResolver(db_uri)
+            pixels_service = PixelsService(
+                registry, metadata_resolver=resolver
+            )
         self.pixels_service = pixels_service
         self.session_validator = session_validator or AllowListValidator()
         batching = config.backend.batching
@@ -191,10 +198,17 @@ class PixelBufferApp:
         await self.worker.start()
 
     async def _on_cleanup(self, app) -> None:
-        # stop() analog (:298-308)
+        # stop() analog (:298-308): worker, session store, pixel
+        # buffers, then the span reporter/sender
         await self.worker.close()
         await self.session_store.close()
         self.pixels_service.close()
+        resolver = getattr(self.pixels_service, "metadata_resolver", None)
+        if resolver is not None and hasattr(resolver, "close_sync"):
+            resolver.close_sync()
+        if TRACER.reporter is not None:
+            TRACER.reporter.close()
+            TRACER.reporter = None
 
     async def handle_get_tile(self, request: web.Request) -> web.Response:
         log.info("Get tile")
@@ -274,10 +288,9 @@ def main(argv: Optional[list] = None) -> None:
         config.port = args.port
     if args.registry is not None:
         config.image_registry = args.registry
-    logging.basicConfig(
-        level=logging.INFO,
-        format="%(asctime)s %(levelname).1s %(name)s - %(message)s",
-    )
+    from ..utils.logging_setup import configure_logging
+
+    configure_logging(config.logging)
     session_store = None
     if args.dev:
         from ..auth.stores import EchoSessionStore

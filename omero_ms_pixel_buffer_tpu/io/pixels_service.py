@@ -129,9 +129,16 @@ class PixelsService:
     def __init__(
         self, registry: ImageRegistry, max_open: int = 128,
         block_cache_bytes: Optional[int] = None,
+        metadata_resolver: Optional[MetadataResolver] = None,
     ):
         self.registry = registry
         self.max_open = max_open
+        # Optional authoritative metadata plane (e.g. the OMERO
+        # Postgres resolver): when set, it answers get_pixels — the
+        # HQL contract — while the registry keeps providing the
+        # buffer plane (imageId -> storage path). A resolver miss is a
+        # 404 even if the registry knows a path.
+        self.metadata_resolver = metadata_resolver
         # ONE decoded-block cache shared by every buffer this service
         # opens — a process-wide bound, not per-buffer (None ->
         # OMPB_BLOCK_CACHE_MB default; 0 disables, e.g. for baselines).
@@ -145,6 +152,8 @@ class PixelsService:
         """Metadata lookup answered from the cached buffer when one is
         open (no per-request file open/parse — unlike the reference's
         per-request HQL + buffer open, TileRequestHandler.java:201-241)."""
+        if self.metadata_resolver is not None:
+            return self.metadata_resolver.get_pixels(image_id)
         entry = self.registry.entry(image_id)
         if entry is None:
             return None

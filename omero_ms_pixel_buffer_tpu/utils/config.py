@@ -80,6 +80,16 @@ class BackendConfig:
 
 
 @dataclasses.dataclass
+class LoggingConfig:
+    """Reference logging (src/dist/conf/logback.xml): stdout by
+    default; with a file, daily rolling with 7-day retention."""
+
+    file: Optional[str] = None
+    level: str = "INFO"
+    retention_days: int = 7
+
+
+@dataclasses.dataclass
 class Config:
     port: int = 8082
     event_bus_send_timeout_ms: int = 15000  # config.yaml:5
@@ -94,6 +104,7 @@ class Config:
     zipkin_url: Optional[str] = None
     jmx_metrics_enabled: bool = True  # config.yaml:43-44 analog
     backend: BackendConfig = dataclasses.field(default_factory=BackendConfig)
+    logging: LoggingConfig = dataclasses.field(default_factory=LoggingConfig)
     # Filesystem image registry (stands in for the OMERO Postgres
     # metadata plane when running without a server; see io.pixels_service).
     image_registry: Optional[str] = None
@@ -153,6 +164,7 @@ class Config:
                 strategy=png_raw.get("strategy", "rle"),
             ),
         )
+        log_raw = raw.get("logging") or {}
         return cls(
             port=int(raw.get("port", 8082)),
             event_bus_send_timeout_ms=int(
@@ -170,6 +182,11 @@ class Config:
             zipkin_url=tracing.get("zipkin-url"),
             jmx_metrics_enabled=bool(jmx.get("enabled", True)),
             backend=backend,
+            logging=LoggingConfig(
+                file=log_raw.get("file"),
+                level=str(log_raw.get("level", "INFO")),
+                retention_days=int(log_raw.get("retention-days", 7)),
+            ),
             image_registry=raw.get("image-registry"),
         )
 

@@ -46,7 +46,7 @@ _current_span: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar
 
 class Span:
     __slots__ = ("tracer", "name", "trace_id", "span_id", "parent_id",
-                 "tags", "t0", "duration", "_token")
+                 "tags", "t0", "ts", "duration", "_token")
 
     def __init__(self, tracer: "Tracer", name: str, trace_id: str,
                  parent_id: Optional[str]):
@@ -57,6 +57,7 @@ class Span:
         self.parent_id = parent_id
         self.tags: dict = {}
         self.t0 = time.perf_counter()
+        self.ts = time.time()  # epoch start, for exporters
         self.duration: Optional[float] = None
         self._token = None
 
@@ -85,6 +86,102 @@ class Span:
         self.finish()
 
 
+class ZipkinReporter:
+    """AsyncReporter/OkHttpSender analog
+    (PixelBufferMicroserviceVerticle.java:180-184): finished spans are
+    queued and a background thread POSTs them to the Zipkin v2 JSON
+    endpoint in batches. The queue is bounded; under backpressure spans
+    are dropped (counted), never blocking the serving path."""
+
+    def __init__(self, url: str, service_name: str,
+                 batch_size: int = 100, flush_interval_s: float = 1.0,
+                 max_queue: int = 10_000):
+        import queue
+
+        self.url = url
+        self.service_name = service_name
+        self.batch_size = batch_size
+        self.flush_interval_s = flush_interval_s
+        self.dropped = 0
+        self._queue: "queue.Queue[Optional[dict]]" = queue.Queue(max_queue)
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="zipkin-reporter", daemon=True
+        )
+        self._thread.start()
+
+    def report(self, span: "Span") -> None:
+        if self._closed:
+            return
+        doc = {
+            "traceId": span.trace_id,
+            "id": span.span_id,
+            "name": span.name,
+            "timestamp": int(span.ts * 1e6),
+            "duration": max(1, int((span.duration or 0.0) * 1e6)),
+            "localEndpoint": {"serviceName": self.service_name},
+            "tags": {k: str(v) for k, v in span.tags.items()},
+        }
+        if span.parent_id:
+            doc["parentId"] = span.parent_id
+        try:
+            self._queue.put_nowait(doc)
+        except Exception:
+            self.dropped += 1
+
+    def _post(self, batch: list) -> None:
+        import json
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.url, data=json.dumps(batch).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            urllib.request.urlopen(req, timeout=5).close()
+        except Exception as e:  # sink down: drop batch, keep going
+            self.dropped += len(batch)
+            log.debug("zipkin export failed: %s", e)
+
+    def _run(self) -> None:
+        import queue
+
+        pending: list = []
+        last_flush = time.monotonic()
+        while True:
+            try:
+                item = self._queue.get(timeout=self.flush_interval_s)
+                if item is None:  # close sentinel
+                    break
+                pending.append(item)
+            except queue.Empty:
+                pass
+            # accumulate: POST on a full batch or a due interval, not
+            # per span (the AsyncReporter batching contract)
+            if pending and (
+                len(pending) >= self.batch_size
+                or time.monotonic() - last_flush >= self.flush_interval_s
+            ):
+                batch, pending = pending, []
+                self._post(batch)
+                last_flush = time.monotonic()
+        if pending:  # final flush on close
+            self._post(pending)
+
+    def close(self) -> None:
+        """stop() analog: flush and stop the sender
+        (PixelBufferMicroserviceVerticle.java:298-308)."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._queue.put_nowait(None)
+        except Exception:
+            pass
+        self._thread.join(timeout=10)
+
+
 class Tracer:
     """ALWAYS_SAMPLE tracer (reference: Tracing.newBuilder()...
     .sampler(ALWAYS_SAMPLE), PixelBufferMicroserviceVerticle.java:185-190)."""
@@ -94,6 +191,7 @@ class Tracer:
         self.enabled = enabled
         self.log_spans = log_spans
         self.service_name = service_name
+        self.reporter: Optional[ZipkinReporter] = None
         self._lock = threading.Lock()
 
     def start_span(self, name: str, parent: Optional[Span] = None) -> Span:
@@ -126,6 +224,8 @@ class Tracer:
         if not self.enabled:
             return
         SPAN_SECONDS.observe(span.duration or 0.0, name=span.name)
+        if self.reporter is not None:
+            self.reporter.report(span)
         if self.log_spans:
             log.info(
                 "span %s trace=%s id=%s parent=%s %.3fms tags=%s",
@@ -142,6 +242,16 @@ def current_tracer() -> Tracer:
     return TRACER
 
 
-def configure(enabled: bool, log_spans: bool) -> None:
+def configure(
+    enabled: bool, log_spans: bool, zipkin_url: Optional[str] = None
+) -> None:
+    """Reference reporter selection (:169-200): zipkin-url -> HTTP
+    sender; enabled without URL -> log reporter; disabled -> spans
+    still time metrics but nothing is exported."""
     TRACER.enabled = enabled
-    TRACER.log_spans = log_spans
+    TRACER.log_spans = log_spans and zipkin_url is None
+    if TRACER.reporter is not None:
+        TRACER.reporter.close()
+        TRACER.reporter = None
+    if enabled and zipkin_url:
+        TRACER.reporter = ZipkinReporter(zipkin_url, TRACER.service_name)

@@ -75,3 +75,34 @@ def test_png_block_parsed():
     cfg2 = Config.from_dict({"session-store": {"type": "memory"}})
     assert (cfg2.backend.png.filter, cfg2.backend.png.level,
             cfg2.backend.png.strategy) == ("up", 6, "rle")
+
+
+def test_logging_block_and_shipped_config(tmp_path):
+    # the shipped sample must load cleanly
+    cfg = Config.load("conf/config.yaml")
+    assert cfg.session_store.type == "redis"
+    assert cfg.backend.png.strategy == "rle"
+    assert cfg.logging.file is None
+
+    cfg2 = Config.from_dict({
+        "session-store": {"type": "memory"},
+        "logging": {"file": str(tmp_path / "svc.log"), "level": "debug",
+                    "retention-days": 3},
+    })
+    assert cfg2.logging.level == "debug"
+    assert cfg2.logging.retention_days == 3
+
+    from omero_ms_pixel_buffer_tpu.utils.logging_setup import (
+        configure_logging,
+    )
+    import logging as _logging
+
+    configure_logging(cfg2.logging)
+    _logging.getLogger("t").info("hello rolling file")
+    root = _logging.getLogger()
+    handler = root.handlers[0]
+    handler.flush()
+    assert "hello rolling file" in (tmp_path / "svc.log").read_text()
+    assert handler.backupCount == 3
+    # restore stdout logging for the rest of the suite
+    configure_logging(type(cfg2.logging)())

@@ -418,3 +418,42 @@ def test_sslmode_require_rejected():
         parse_dsn("postgresql://u:p@db/omero?sslmode=require")
     # prefer/disable pass through
     assert parse_dsn("postgresql://db/omero?sslmode=disable")["host"] == "db"
+
+
+class TestResolverWiring:
+    def test_resolver_overrides_metadata_plane(self, loop, tmp_path):
+        """With a metadata resolver set, get_pixels answers from the DB
+        contract; a resolver miss is a 404 even when the registry knows
+        a path."""
+        import numpy as np
+
+        from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
+        from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+            ImageRegistry,
+            PixelsService,
+        )
+
+        data = np.zeros((1, 1, 1, 64, 64), np.uint16)
+        path = str(tmp_path / "img.ome.tiff")
+        write_ome_tiff(path, data, tile_size=(64, 64))
+
+        class FakeResolver:
+            def get_pixels(self, image_id):
+                if int(image_id) == 1:
+                    from omero_ms_pixel_buffer_tpu.io.pixel_buffer import (
+                        PixelsMeta,
+                    )
+
+                    return PixelsMeta(1, 64, 64, 1, 1, 1, "uint16", "db-img")
+                return None
+
+        registry = ImageRegistry()
+        registry.add(1, path)
+        registry.add(2, path)  # path known, but resolver says no
+        service = PixelsService(registry, metadata_resolver=FakeResolver())
+        meta = service.get_pixels(1)
+        assert meta.image_name == "db-img"  # resolver, not the file
+        assert service.get_pixels(2) is None  # -> 404
+        # buffer plane still resolves through the registry
+        assert service.get_pixel_buffer(1) is not None
+        service.close()
