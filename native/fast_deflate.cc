@@ -1,0 +1,378 @@
+// See fast_deflate.h. RFC 1951 (deflate) + RFC 1950 (zlib wrapper).
+//
+// Shape of the encoder:
+//   pass 1: scan input for distance-1 runs, histogram literal/length
+//           symbols (distance tree is trivial: only symbol 0 is used);
+//   build:  length-limited canonical Huffman codes for the literal
+//           tree and the code-length tree;
+//   pass 2: emit the dynamic-block header and the symbol stream.
+
+#include "fast_deflate.h"
+
+#include <algorithm>
+#include <cstring>
+#include <vector>
+
+#include <zlib.h>  // adler32
+
+namespace ompb {
+namespace {
+
+constexpr int kMinRun = 4;     // shortest run worth a length/dist pair
+constexpr int kMaxRun = 258;   // deflate max match length
+constexpr int kNumLit = 286;   // 0-255 literals, 256 EOB, 257-285 lengths
+
+// -- bit writer (LSB-first, as deflate wants) ---------------------------
+
+struct BitWriter {
+  uint8_t* out;
+  size_t cap;
+  size_t pos = 0;
+  uint64_t acc = 0;
+  int nbits = 0;
+  bool overflow = false;
+
+  BitWriter(uint8_t* o, size_t c) : out(o), cap(c) {}
+
+  // Bulk flush: store the whole 64-bit accumulator unaligned and
+  // advance by the 4 completed bytes (little-endian layout matches
+  // deflate's LSB-first bit order). Single Put must stay <= 32 bits.
+  inline void Put(uint32_t code, int n) {
+    acc |= static_cast<uint64_t>(code) << nbits;
+    nbits += n;
+    if (nbits >= 32) {
+      if (pos + 8 > cap) {
+        overflow = true;
+        nbits = 0;
+        return;
+      }
+      std::memcpy(out + pos, &acc, 8);
+      pos += 4;
+      acc >>= 32;
+      nbits -= 32;
+    }
+  }
+
+  void FlushByte() {
+    while (nbits > 0) {
+      if (pos >= cap) {
+        overflow = true;
+        return;
+      }
+      out[pos++] = static_cast<uint8_t>(acc);
+      acc >>= 8;
+      nbits -= 8;
+    }
+    nbits = 0;
+  }
+};
+
+// -- length -> (symbol, extra bits, extra value) ------------------------
+
+struct LenCode {
+  uint16_t sym;
+  uint8_t extra_bits;
+  uint16_t extra_val;
+};
+
+// Deflate length table (RFC 1951 §3.2.5), expanded per length 3..258.
+const LenCode* LengthTable() {
+  static LenCode table[kMaxRun + 1];
+  static bool init = [] {
+    struct Row {
+      int sym, extra, base;
+    };
+    static const Row rows[] = {
+        {257, 0, 3},   {258, 0, 4},   {259, 0, 5},   {260, 0, 6},
+        {261, 0, 7},   {262, 0, 8},   {263, 0, 9},   {264, 0, 10},
+        {265, 1, 11},  {266, 1, 13},  {267, 1, 15},  {268, 1, 17},
+        {269, 2, 19},  {270, 2, 23},  {271, 2, 27},  {272, 2, 31},
+        {273, 3, 35},  {274, 3, 43},  {275, 3, 51},  {276, 3, 59},
+        {277, 4, 67},  {278, 4, 83},  {279, 4, 99},  {280, 4, 115},
+        {281, 5, 131}, {282, 5, 163}, {283, 5, 195}, {284, 5, 227},
+        {285, 0, 258},
+    };
+    for (const Row& r : rows) {
+      int hi = (r.sym == 285) ? 258 : r.base + (1 << r.extra) - 1;
+      for (int len = r.base; len <= hi && len <= kMaxRun; ++len) {
+        table[len] = {static_cast<uint16_t>(r.sym),
+                      static_cast<uint8_t>(r.extra),
+                      static_cast<uint16_t>(len - r.base)};
+      }
+    }
+    return true;
+  }();
+  (void)init;
+  return table;
+}
+
+inline uint32_t Reverse(uint32_t code, int len) {
+  uint32_t r = 0;
+  for (int i = 0; i < len; ++i) {
+    r = (r << 1) | (code & 1);
+    code >>= 1;
+  }
+  return r;
+}
+
+// -- length-limited Huffman ---------------------------------------------
+
+// Build code lengths for `n` symbols with the given frequencies, no
+// code longer than `limit`. Frequency-damping: halve-and-rebuild until
+// the tree fits the limit (converges fast; ratio impact negligible).
+void BuildLengths(const uint32_t* freq_in, int n, int limit,
+                  uint8_t* lengths) {
+  std::vector<uint32_t> freq(freq_in, freq_in + n);
+  std::memset(lengths, 0, n);
+  for (;;) {
+    // collect used symbols
+    struct Node {
+      uint32_t f;
+      int left, right, sym;  // sym >= 0 for leaves
+    };
+    std::vector<Node> nodes;
+    std::vector<int> heap;  // indices into nodes, min-heap by freq
+    for (int i = 0; i < n; ++i) {
+      if (freq[i]) {
+        nodes.push_back({freq[i], -1, -1, i});
+        heap.push_back(static_cast<int>(nodes.size()) - 1);
+      }
+    }
+    if (nodes.empty()) return;
+    if (nodes.size() == 1) {
+      lengths[nodes[0].sym] = 1;
+      return;
+    }
+    auto cmp = [&](int a, int b) { return nodes[a].f > nodes[b].f; };
+    std::make_heap(heap.begin(), heap.end(), cmp);
+    while (heap.size() > 1) {
+      std::pop_heap(heap.begin(), heap.end(), cmp);
+      int a = heap.back();
+      heap.pop_back();
+      std::pop_heap(heap.begin(), heap.end(), cmp);
+      int b = heap.back();
+      heap.pop_back();
+      nodes.push_back({nodes[a].f + nodes[b].f, a, b, -1});
+      heap.push_back(static_cast<int>(nodes.size()) - 1);
+      std::push_heap(heap.begin(), heap.end(), cmp);
+    }
+    // depth-assign iteratively
+    int root = heap[0];
+    std::vector<std::pair<int, int>> stack = {{root, 0}};
+    int maxdepth = 0;
+    while (!stack.empty()) {
+      auto [idx, depth] = stack.back();
+      stack.pop_back();
+      const Node& nd = nodes[idx];
+      if (nd.sym >= 0) {
+        lengths[nd.sym] = static_cast<uint8_t>(depth == 0 ? 1 : depth);
+        maxdepth = std::max(maxdepth, std::max(depth, 1));
+      } else {
+        stack.push_back({nd.left, depth + 1});
+        stack.push_back({nd.right, depth + 1});
+      }
+    }
+    if (maxdepth <= limit) return;
+    for (int i = 0; i < n; ++i) {
+      if (freq[i]) freq[i] = (freq[i] + 1) >> 1;  // damp, keep nonzero
+    }
+  }
+}
+
+// Canonical codes from lengths (RFC 1951 §3.2.2), pre-bit-reversed for
+// LSB-first emission.
+void BuildCodes(const uint8_t* lengths, int n, int max_len,
+                uint32_t* codes) {
+  std::vector<int> bl_count(max_len + 1, 0);
+  for (int i = 0; i < n; ++i) bl_count[lengths[i]]++;
+  bl_count[0] = 0;
+  std::vector<uint32_t> next_code(max_len + 1, 0);
+  uint32_t code = 0;
+  for (int bits = 1; bits <= max_len; ++bits) {
+    code = (code + bl_count[bits - 1]) << 1;
+    next_code[bits] = code;
+  }
+  for (int i = 0; i < n; ++i) {
+    if (lengths[i]) {
+      codes[i] = Reverse(next_code[lengths[i]]++, lengths[i]);
+    }
+  }
+}
+
+// RLE-encode the code-length sequence with CL symbols 16/17/18
+// (RFC 1951 §3.2.7). Emits (symbol, extra_bits, extra_val) triples.
+struct ClOp {
+  uint8_t sym;
+  uint8_t extra_bits;
+  uint8_t extra_val;
+};
+
+void EncodeCodeLengths(const uint8_t* lens, int n, std::vector<ClOp>* ops,
+                       uint32_t* cl_freq) {
+  int i = 0;
+  while (i < n) {
+    uint8_t v = lens[i];
+    int run = 1;
+    while (i + run < n && lens[i + run] == v) run++;
+    if (v == 0) {
+      while (run >= 3) {
+        int take = std::min(run, 138);
+        if (take >= 11) {
+          ops->push_back({18, 7, static_cast<uint8_t>(take - 11)});
+        } else {
+          ops->push_back({17, 3, static_cast<uint8_t>(take - 3)});
+        }
+        cl_freq[take >= 11 ? 18 : 17]++;
+        run -= take;
+        i += take;
+      }
+      while (run-- > 0) {
+        ops->push_back({0, 0, 0});
+        cl_freq[0]++;
+        i++;
+      }
+    } else {
+      ops->push_back({v, 0, 0});
+      cl_freq[v]++;
+      i++;
+      run--;
+      while (run >= 3) {
+        int take = std::min(run, 6);
+        ops->push_back({16, 2, static_cast<uint8_t>(take - 3)});
+        cl_freq[16]++;
+        run -= take;
+        i += take;
+      }
+      while (run-- > 0) {
+        ops->push_back({v, 0, 0});
+        cl_freq[v]++;
+        i++;
+      }
+    }
+  }
+}
+
+const int kClOrder[19] = {16, 17, 18, 0, 8,  7, 9,  6, 10, 5,
+                          11, 4,  12, 3, 13, 2, 14, 1, 15};
+
+}  // namespace
+
+size_t FastDeflate(const uint8_t* in, size_t n, uint8_t* out, size_t cap) {
+  if (cap < 64) return 0;
+  const LenCode* len_table = LengthTable();
+
+  // ---- pass 1: histogram (runs at distance 1) ----
+  uint32_t lit_freq[kNumLit] = {0};
+  bool any_run = false;
+  {
+    size_t i = 0;
+    while (i < n) {
+      if (i > 0 && in[i] == in[i - 1]) {
+        size_t run = 1;
+        const uint8_t v = in[i - 1];
+        while (i + run < n && in[i + run] == v &&
+               run < static_cast<size_t>(kMaxRun)) {
+          run++;
+        }
+        if (run >= kMinRun) {
+          lit_freq[len_table[run].sym]++;
+          any_run = true;
+          i += run;
+          continue;
+        }
+      }
+      lit_freq[in[i]]++;
+      i++;
+    }
+  }
+  lit_freq[256] = 1;  // end-of-block
+
+  // ---- literal + distance trees ----
+  uint8_t lit_len[kNumLit] = {0};
+  BuildLengths(lit_freq, kNumLit, 15, lit_len);
+  uint32_t lit_code[kNumLit] = {0};
+  BuildCodes(lit_len, kNumLit, 15, lit_code);
+
+  // distance tree: only symbol 0 (distance 1), or none at all
+  uint8_t dist_len[1] = {static_cast<uint8_t>(any_run ? 1 : 0)};
+  // code for the single 1-bit distance symbol is 0
+
+  // trim trailing zero-length literal codes (HLIT >= 257)
+  int hlit = kNumLit;
+  while (hlit > 257 && lit_len[hlit - 1] == 0) hlit--;
+  const int hdist = 1;
+
+  // ---- code-length tree over (lit lengths ++ dist lengths) ----
+  std::vector<uint8_t> all_lens(lit_len, lit_len + hlit);
+  all_lens.push_back(dist_len[0]);
+  std::vector<ClOp> cl_ops;
+  uint32_t cl_freq[19] = {0};
+  EncodeCodeLengths(all_lens.data(), static_cast<int>(all_lens.size()),
+                    &cl_ops, cl_freq);
+  uint8_t cl_len[19] = {0};
+  BuildLengths(cl_freq, 19, 7, cl_len);
+  uint32_t cl_code[19] = {0};
+  BuildCodes(cl_len, 19, 7, cl_code);
+  int hclen = 19;
+  while (hclen > 4 && cl_len[kClOrder[hclen - 1]] == 0) hclen--;
+
+  // ---- emit ----
+  if (cap < 6) return 0;
+  out[0] = 0x78;  // CM=8 CINFO=7
+  out[1] = 0x01;  // FLEVEL=0, FCHECK makes the pair % 31 == 0
+  BitWriter bw(out + 2, cap - 6);  // reserve adler32 tail
+
+  bw.Put(1, 1);  // BFINAL
+  bw.Put(2, 2);  // BTYPE=10 dynamic
+  bw.Put(static_cast<uint32_t>(hlit - 257), 5);
+  bw.Put(static_cast<uint32_t>(hdist - 1), 5);
+  bw.Put(static_cast<uint32_t>(hclen - 4), 4);
+  for (int i = 0; i < hclen; ++i) bw.Put(cl_len[kClOrder[i]], 3);
+  for (const ClOp& op : cl_ops) {
+    bw.Put(cl_code[op.sym], cl_len[op.sym]);
+    if (op.extra_bits) bw.Put(op.extra_val, op.extra_bits);
+  }
+
+  // symbol stream (same scan as pass 1)
+  {
+    size_t i = 0;
+    while (i < n) {
+      if (i > 0 && in[i] == in[i - 1]) {
+        size_t run = 1;
+        const uint8_t v = in[i - 1];
+        while (i + run < n && in[i + run] == v &&
+               run < static_cast<size_t>(kMaxRun)) {
+          run++;
+        }
+        if (run >= kMinRun) {
+          // one fused write: length code + extra bits + the 1-bit
+          // distance-1 code (a zero bit) — <= 21 bits total
+          const LenCode& lc = len_table[run];
+          uint32_t bits = lit_code[lc.sym];
+          int nb = lit_len[lc.sym];
+          bits |= static_cast<uint32_t>(lc.extra_val) << nb;
+          nb += lc.extra_bits + 1;
+          bw.Put(bits, nb);
+          i += run;
+          continue;
+        }
+      }
+      bw.Put(lit_code[in[i]], lit_len[in[i]]);
+      i++;
+    }
+  }
+  bw.Put(lit_code[256], lit_len[256]);  // EOB
+  bw.FlushByte();
+  if (bw.overflow) return 0;
+
+  uLong adler = adler32(1L, in, static_cast<uInt>(n));
+  size_t pos = 2 + bw.pos;
+  if (pos + 4 > cap) return 0;
+  out[pos++] = static_cast<uint8_t>(adler >> 24);
+  out[pos++] = static_cast<uint8_t>(adler >> 16);
+  out[pos++] = static_cast<uint8_t>(adler >> 8);
+  out[pos++] = static_cast<uint8_t>(adler);
+  return pos;
+}
+
+}  // namespace ompb

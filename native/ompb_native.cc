@@ -31,7 +31,13 @@
 
 #include <zlib.h>
 
+#include "fast_deflate.h"
+
 namespace {
+
+// Strategy code for the in-house RLE+dynamic-Huffman encoder (zlib's
+// own strategies are 0..4).
+constexpr int kStrategyFast = 100;
 
 class ThreadPool {
  public:
@@ -134,6 +140,19 @@ void ParallelFor(size_t n, std::function<void(size_t)> fn) {
 // scanlines (small-residual data; skips the literal-heavy heuristics).
 bool DeflateOne(const uint8_t* in, size_t in_len, int level, uint8_t** out,
                 size_t* out_len, int strategy = Z_DEFAULT_STRATEGY) {
+  if (strategy == kStrategyFast) {
+    size_t bound = ompb::FastDeflateBound(in_len);
+    uint8_t* buf = static_cast<uint8_t*>(std::malloc(bound));
+    if (!buf) return false;
+    size_t written = ompb::FastDeflate(in, in_len, buf, bound);
+    if (written > 0) {
+      *out = buf;
+      *out_len = written;
+      return true;
+    }
+    std::free(buf);          // pathological input: fall back to zlib
+    strategy = Z_RLE;
+  }
   z_stream zs;
   std::memset(&zs, 0, sizeof(zs));
   if (deflateInit2(&zs, level, Z_DEFLATED, 15, 9, strategy) != Z_OK) {

@@ -66,42 +66,86 @@ class OmeroPostgresMetadataResolver:
     """MetadataResolver over the OMERO database (async core with a sync
     adapter for the pipeline's synchronous resolve stage)."""
 
-    def __init__(self, uri: str):
+    def __init__(self, uri: str, cache_ttl_s: float = 60.0,
+                 cache_max: int = 4096):
         self._client = PostgresClient.from_uri(uri)
         self._runner: Optional[_LoopThread] = None
         self._runner_lock = threading.Lock()
+        self._closed = False
+        # Per-image TTL cache: metadata is effectively immutable for a
+        # stored image, so the hot path must not pay one DB roundtrip
+        # per tile (the registry path it replaces answers from memory).
+        self._cache_ttl_s = cache_ttl_s
+        self._cache_max = cache_max
+        self._cache: dict = {}  # image_id -> (expires_at, meta|None)
+        self._cache_lock = threading.Lock()
+
+    def _cache_get(self, image_id: int):
+        import time
+
+        with self._cache_lock:
+            hit = self._cache.get(image_id)
+            if hit is not None and hit[0] > time.monotonic():
+                return True, hit[1]
+        return False, None
+
+    def _cache_put(self, image_id: int, meta) -> None:
+        import time
+
+        with self._cache_lock:
+            if len(self._cache) >= self._cache_max:
+                self._cache.clear()  # coarse but bounded
+            self._cache[image_id] = (
+                time.monotonic() + self._cache_ttl_s, meta
+            )
 
     async def get_pixels_async(self, image_id: int) -> Optional[PixelsMeta]:
-        rows = await self._client.query(PIXELS_QUERY, [str(int(image_id))])
+        image_id = int(image_id)
+        cached, meta = self._cache_get(image_id)
+        if cached:
+            return meta
+        rows = await self._client.query(PIXELS_QUERY, [str(image_id)])
         if not rows:
+            self._cache_put(image_id, None)
             return None  # -> 404 "Cannot find Image:<id>"
         (_pid, sx, sy, sz, sc, st, ptype, name) = rows[0]
-        return PixelsMeta(
-            image_id=int(image_id),
+        meta = PixelsMeta(
+            image_id=image_id,
             size_x=int(sx), size_y=int(sy),
             size_z=int(sz), size_c=int(sc), size_t=int(st),
             pixels_type=ptype,
             image_name=name or str(image_id),
         )
+        self._cache_put(image_id, meta)
+        return meta
 
     def _run(self, coro):
         with self._runner_lock:
+            if self._closed:
+                coro.close()
+                raise RuntimeError("metadata resolver is closed")
             if self._runner is None:
                 self._runner = _LoopThread()
-        return self._runner.run(coro)
+            runner = self._runner
+        return runner.run(coro)
 
     def get_pixels(self, image_id: int) -> Optional[PixelsMeta]:
         """Sync adapter (the MetadataResolver surface): dispatches onto
         a persistent background loop, so the connection — and its
         SCRAM handshake — is reused across calls. Callers already on
         an event loop should use ``get_pixels_async`` directly."""
+        cached, meta = self._cache_get(int(image_id))
+        if cached:
+            return meta
         return self._run(self.get_pixels_async(image_id))
 
     async def close(self) -> None:
         await self._client.close()
 
     def close_sync(self) -> None:
-        if self._runner is not None:
-            self._runner.run(self._client.close())
-            self._runner.close()
-            self._runner = None
+        with self._runner_lock:
+            self._closed = True
+            runner, self._runner = self._runner, None
+        if runner is not None:
+            runner.run(self._client.close())
+            runner.close()

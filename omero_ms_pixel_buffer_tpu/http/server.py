@@ -172,6 +172,11 @@ class PixelBufferApp:
         )
         self.bus = EventBus()
         self.bus.consumer(GET_TILE_EVENT, self.worker.handle)
+        if config.jmx_metrics_enabled:
+            # JMX/hotspot collectors analog (:202-218), config-gated
+            from ..utils.process_metrics import install as install_process
+
+            install_process()
         # warm the native engine at startup so a cold deploy never pays
         # the build/load (up to ~2 min of g++) inside the first request
         from ..runtime.native import get_engine

@@ -121,7 +121,7 @@ class TilePipeline:
         pixels_service: PixelsService,
         png_filter: str = "up",
         png_level: int = 6,
-        png_strategy: str = "rle",
+        png_strategy: str = "fast",
         encode_workers: int = 8,
         use_device: Optional[bool] = None,
         use_pallas: Optional[bool] = None,

@@ -68,6 +68,9 @@ ZLIB_STRATEGIES = {
     "huffman": zlib.Z_HUFFMAN_ONLY,
     "rle": zlib.Z_RLE,
     "fixed": zlib.Z_FIXED,
+    # "fast" is the native RLE+dynamic-Huffman encoder; the closest
+    # pure-python behavior (same match policy) is Z_RLE
+    "fast": zlib.Z_RLE,
 }
 
 

@@ -61,14 +61,16 @@ class BatchingConfig:
 
 @dataclasses.dataclass
 class PngConfig:
-    """PNG encode tuning. Strategy "rle" matches zlib level-6 ratios at
-    ~5x the speed on filtered microscopy data; every strategy emits a
-    compliant stream (the correctness contract is decoded-pixel
-    equality, not byte equality)."""
+    """PNG encode tuning. Strategy "fast" (the native RLE + dynamic-
+    Huffman encoder) matches zlib level-6 ratios on filtered microscopy
+    data at >10x the speed; every strategy emits a compliant stream
+    (the correctness contract is decoded-pixel equality, not byte
+    equality)."""
 
     filter: str = "up"  # none | sub | up | average | paeth | adaptive
     level: int = 6
-    strategy: str = "rle"  # default | filtered | huffman | rle | fixed
+    # fast | default | filtered | huffman | rle | fixed
+    strategy: str = "fast"
 
 
 @dataclasses.dataclass
@@ -161,7 +163,7 @@ class Config:
             png=PngConfig(
                 filter=png_raw.get("filter", "up"),
                 level=int(png_raw.get("level", 6)),
-                strategy=png_raw.get("strategy", "rle"),
+                strategy=png_raw.get("strategy", "fast"),
             ),
         )
         log_raw = raw.get("logging") or {}

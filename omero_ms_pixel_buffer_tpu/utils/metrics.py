@@ -124,6 +124,11 @@ class Registry:
     def histogram(self, name: str, help_: str = "", **kw) -> Histogram:
         return self._register(Histogram(name, help_, **kw))
 
+    def register(self, collector):
+        """Register any collector exposing ``collect() -> iterable of
+        exposition lines`` (custom collectors, e.g. process metrics)."""
+        return self._register(collector)
+
     def _register(self, metric):
         with self._lock:
             self._metrics.append(metric)

@@ -175,10 +175,18 @@ class ZipkinReporter:
         if self._closed:
             return
         self._closed = True
-        try:
-            self._queue.put_nowait(None)
-        except Exception:
-            pass
+        # a full queue must not swallow the shutdown sentinel: make
+        # room by dropping the oldest spans
+        for _ in range(8):
+            try:
+                self._queue.put_nowait(None)
+                break
+            except Exception:
+                try:
+                    self._queue.get_nowait()
+                    self.dropped += 1
+                except Exception:
+                    break
         self._thread.join(timeout=10)
 
 

@@ -74,14 +74,14 @@ def test_png_block_parsed():
     # defaults: up/6/rle
     cfg2 = Config.from_dict({"session-store": {"type": "memory"}})
     assert (cfg2.backend.png.filter, cfg2.backend.png.level,
-            cfg2.backend.png.strategy) == ("up", 6, "rle")
+            cfg2.backend.png.strategy) == ("up", 6, "fast")
 
 
 def test_logging_block_and_shipped_config(tmp_path):
     # the shipped sample must load cleanly
     cfg = Config.load("conf/config.yaml")
     assert cfg.session_store.type == "redis"
-    assert cfg.backend.png.strategy == "rle"
+    assert cfg.backend.png.strategy == "fast"
     assert cfg.logging.file is None
 
     cfg2 = Config.from_dict({

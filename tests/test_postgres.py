@@ -457,3 +457,37 @@ class TestResolverWiring:
         # buffer plane still resolves through the registry
         assert service.get_pixel_buffer(1) is not None
         service.close()
+
+
+class TestResolverCache:
+    def test_metadata_cached_per_image(self, loop):
+        calls = []
+
+        def rows_for(sql, params):
+            calls.append(params)
+            return [("9", "128", "64", "1", "1", "1", "uint8", "img")]
+
+        async def run():
+            async with FakePg(rows_for=rows_for) as pg:
+                resolver = OmeroPostgresMetadataResolver(
+                    f"postgresql://omero:pw@127.0.0.1:{pg.port}/omero"
+                )
+                m1 = await resolver.get_pixels_async(5)
+                m2 = await resolver.get_pixels_async(5)  # cache hit
+                assert m1 == m2
+                assert len(calls) == 1  # one DB roundtrip, not two
+                await resolver.close()
+
+        loop.run_until_complete(run())
+
+    def test_closed_resolver_rejects(self, loop):
+        async def run():
+            async with FakePg() as pg:
+                resolver = OmeroPostgresMetadataResolver(
+                    f"postgresql://omero:pw@127.0.0.1:{pg.port}/omero"
+                )
+                resolver.close_sync()
+                with pytest.raises(RuntimeError):
+                    resolver.get_pixels(1)
+
+        loop.run_until_complete(run())

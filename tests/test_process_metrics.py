@@ -1,0 +1,36 @@
+"""Process collector (the JMX/hotspot exports analog)."""
+
+from omero_ms_pixel_buffer_tpu.utils.metrics import Registry
+from omero_ms_pixel_buffer_tpu.utils.process_metrics import (
+    ProcessCollector,
+    install,
+)
+
+
+def test_collect_exposes_process_metrics():
+    text = "\n".join(ProcessCollector("1.2.3").collect())
+    for metric in (
+        "process_cpu_seconds_total",
+        "process_resident_memory_bytes",
+        "process_open_fds",
+        "process_max_fds",
+        "process_threads",
+        "python_gc_collections_total",
+    ):
+        assert metric in text, metric
+    assert 'build_info{version="1.2.3"} 1' in text
+    # numbers are sane
+    rss = float(
+        [l for l in text.splitlines()
+         if l.startswith("process_resident_memory_bytes")][0].split()[-1]
+    )
+    assert rss > 1e6  # a real python process uses > 1 MB
+
+
+def test_install_idempotent_and_scraped_via_registry():
+    registry = Registry()
+    a = install(registry)
+    b = install(registry)
+    assert a is b
+    text = registry.exposition()
+    assert "process_cpu_seconds_total" in text
