@@ -137,6 +137,9 @@ def main():
         f"{time.perf_counter() - t_setup - elapsed:.1f}s)"
     )
 
+    if os.environ.get("BENCH_SUBS", "1") != "0":
+        sub_benches(pipe, service, size, cache_dir)
+
     print(
         json.dumps(
             {
@@ -147,6 +150,85 @@ def main():
             }
         )
     )
+
+
+def sub_benches(pipe, service, size, cache_dir):
+    """The remaining BASELINE.md measurement-matrix configs, scaled to
+    bench-friendly sizes; stderr only (the driver consumes stdout)."""
+    import time as _t
+
+    from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
+    from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+        ImageRegistry,
+        PixelsService,
+    )
+    from omero_ms_pixel_buffer_tpu.models.tile_pipeline import TilePipeline
+    from omero_ms_pixel_buffer_tpu.runtime.native import get_engine
+
+    rng = np.random.default_rng(3)
+
+    # -- config 2: random 256x256 replay, format=raw -------------------
+    ctxs = make_ctxs(256, size, tile=256, fmt=None, seed=13)
+    pipe.handle_batch(ctxs[:32])
+    t0 = _t.perf_counter()
+    for i in range(0, len(ctxs), 32):
+        results = pipe.handle_batch(ctxs[i : i + 32])
+        assert all(r is not None for r in results)
+    log(f"[sub] raw 256x256 replay: "
+        f"{len(ctxs) / (_t.perf_counter() - t0):.1f} tiles/s")
+
+    # -- config 3: multi-Z stack, PNG coalesced across Z ---------------
+    zpath = os.path.join(cache_dir, "bench_z8.ome.tiff")
+    if not os.path.exists(zpath):
+        zdata = rng.integers(
+            0, 60000, (1, 1, 8, 1024, 1024), dtype=np.uint16
+        )
+        write_ome_tiff(zpath, zdata, tile_size=(512, 512),
+                       compression="zlib")
+    registry = ImageRegistry()
+    registry.add(2, zpath)
+    zservice = PixelsService(registry)
+    zpipe = TilePipeline(zservice, engine=pipe.engine)
+    from omero_ms_pixel_buffer_tpu.tile_ctx import RegionDef, TileCtx
+
+    zctxs = [
+        TileCtx(image_id=2, z=z, c=0, t=0,
+                region=RegionDef(256, 256, 512, 512), format="png",
+                omero_session_key="bench")
+        for z in range(8)
+    ] * 8  # 64 requests coalescing across the Z axis
+    zpipe.handle_batch(zctxs[:16])
+    t0 = _t.perf_counter()
+    for i in range(0, len(zctxs), 32):
+        results = zpipe.handle_batch(zctxs[i : i + 32])
+        assert all(r is not None for r in results)
+    log(f"[sub] multi-Z 512x512 png (coalesced): "
+        f"{len(zctxs) / (_t.perf_counter() - t0):.1f} tiles/s")
+    zservice.close()
+
+    # -- config 4 (scaled): RGB8 256x256 encode sweep ------------------
+    engine = get_engine()
+    if engine is not None:
+        rgb = [
+            rng.integers(0, 255, (256, 256, 3), dtype=np.uint8)
+            for _ in range(64)
+        ]
+        engine.png_encode_batch(rgb[:8], "up", 6, strategy="fast")
+        t0 = _t.perf_counter()
+        out = engine.png_encode_batch(rgb, "up", 6, strategy="fast")
+        assert all(o is not None for o in out)
+        log(f"[sub] rgb8 256x256 png encode: "
+            f"{len(rgb) / (_t.perf_counter() - t0):.1f} tiles/s")
+
+    # -- config 5 (scaled): concurrent format=tif fan-out --------------
+    tctxs = make_ctxs(128, size, tile=512, fmt="tif", seed=17)
+    pipe.handle_batch(tctxs[:16])
+    t0 = _t.perf_counter()
+    for i in range(0, len(tctxs), 32):
+        results = pipe.handle_batch(tctxs[i : i + 32])
+        assert all(r is not None for r in results)
+    log(f"[sub] tif 512x512 fan-out: "
+        f"{len(tctxs) / (_t.perf_counter() - t0):.1f} tiles/s")
 
 
 if __name__ == "__main__":

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import threading
+import time
 from typing import Optional
 
 from ..io.pixel_buffer import PixelsMeta
@@ -81,8 +82,6 @@ class OmeroPostgresMetadataResolver:
         self._cache_lock = threading.Lock()
 
     def _cache_get(self, image_id: int):
-        import time
-
         with self._cache_lock:
             hit = self._cache.get(image_id)
             if hit is not None and hit[0] > time.monotonic():
@@ -90,8 +89,6 @@ class OmeroPostgresMetadataResolver:
         return False, None
 
     def _cache_put(self, image_id: int, meta) -> None:
-        import time
-
         with self._cache_lock:
             if len(self._cache) >= self._cache_max:
                 self._cache.clear()  # coarse but bounded
@@ -106,7 +103,8 @@ class OmeroPostgresMetadataResolver:
             return meta
         rows = await self._client.query(PIXELS_QUERY, [str(image_id)])
         if not rows:
-            self._cache_put(image_id, None)
+            # no negative caching: an image mid-import must become
+            # visible on the next request, not after a TTL of 404s
             return None  # -> 404 "Cannot find Image:<id>"
         (_pid, sx, sy, sz, sc, st, ptype, name) = rows[0]
         meta = PixelsMeta(

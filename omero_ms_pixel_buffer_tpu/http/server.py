@@ -141,7 +141,13 @@ class PixelBufferApp:
 
                 resolver = OmeroPostgresMetadataResolver(db_uri)
             pixels_service = PixelsService(
-                registry, metadata_resolver=resolver
+                registry,
+                metadata_resolver=resolver,
+                # the Memoizer-dir analog (the reference's data layer
+                # memoizes Bio-Formats metadata under the data dir)
+                memo_dir=config.omero_server.get(
+                    "omero.pixeldata.memoizer.dir"
+                ),
             )
         self.pixels_service = pixels_service
         self.session_validator = session_validator or AllowListValidator()

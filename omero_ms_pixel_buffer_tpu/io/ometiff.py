@@ -23,8 +23,11 @@ so reads can be chunk-aligned and batched (SURVEY.md §7 step 3).
 
 from __future__ import annotations
 
+import hashlib
+import logging
 import mmap
 import os
+import pickle
 import re
 import struct
 import zlib
@@ -290,15 +293,83 @@ class _LevelReader:
         return out
 
 
+_memo_log = logging.getLogger("omero_ms_pixel_buffer_tpu.io.memoizer")
+
+
+def _memo_key(path: str) -> str:
+    st = os.stat(path)
+    raw = f"{os.path.abspath(path)}:{st.st_mtime_ns}:{st.st_size}"
+    return hashlib.sha256(raw.encode()).hexdigest()
+
+
+def _memo_load(path: str, memo_dir: str):
+    """(byteorder, ifds) from the memo cache, or None. The memo dir is
+    service-owned state (like the Bio-Formats Memoizer's .bfmemo
+    files); entries are keyed to path+mtime+size so a rewritten file
+    never matches a stale memo."""
+    memo = os.path.join(memo_dir, _memo_key(path) + ".ifd.pkl")
+    try:
+        with open(memo, "rb") as f:
+            bo, dumped = pickle.load(f)
+        ifds = []
+        for tags, sub_tags in dumped:
+            ifd = _Ifd(tags)
+            ifd.sub_ifds = [_Ifd(t) for t in sub_tags]
+            ifds.append(ifd)
+        return bo, ifds
+    except Exception:
+        # any malformed/foreign memo (shape drift across releases,
+        # torn writes) must degrade to a reparse, never an open error
+        return None
+
+
+def _memo_save(path: str, memo_dir: str, bo: str, ifds) -> None:
+    try:
+        os.makedirs(memo_dir, exist_ok=True)
+        dumped = [
+            (ifd.tags, [s.tags for s in getattr(ifd, "sub_ifds", [])])
+            for ifd in ifds
+        ]
+        memo = os.path.join(memo_dir, _memo_key(path) + ".ifd.pkl")
+        # unique tmp per writer (two threads can race the first open
+        # of one image); os.replace keeps publication atomic
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(dir=memo_dir, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump(
+                    (bo, dumped), f, protocol=pickle.HIGHEST_PROTOCOL
+                )
+            os.replace(tmp, memo)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+    except OSError as e:
+        _memo_log.debug("memo save failed for %s: %s", path, e)
+
+
 class OmeTiffPixelBuffer(PixelBuffer):
-    """OME-TIFF (optionally pyramidal) as a PixelBuffer."""
+    """OME-TIFF (optionally pyramidal) as a PixelBuffer.
+
+    ``memo_dir`` enables the Bio-Formats-Memoizer-style persistent
+    metadata cache (SURVEY.md §5.4): the parsed IFD chain is pickled
+    next to first use, so re-opening a large pyramid after a restart
+    skips the full-structure walk (the reference's memoizer wait bean,
+    beanRefContext.xml:20-22).
+    """
 
     def __init__(
         self, path: str, image_id: int = 0, image_name: str = "",
         cache_bytes: Optional[int] = None,
         block_cache: Optional[BlockCache] = None,
+        memo_dir: Optional[str] = None,
     ):
         self.path = path
+        self.memo_dir = memo_dir or os.environ.get("OMPB_MEMO_DIR")
         # shared (service-owned, process-bounded) or private cache
         self.block_cache = (
             block_cache if block_cache is not None else BlockCache(cache_bytes)
@@ -319,7 +390,15 @@ class OmeTiffPixelBuffer(PixelBuffer):
             raise
 
     def _init_from_mmap(self, image_id: int, image_name: str) -> None:
-        self.bo, self.ifds = _parse_ifds(self.mm)
+        loaded = (
+            _memo_load(self.path, self.memo_dir) if self.memo_dir else None
+        )
+        if loaded is not None:
+            self.bo, self.ifds = loaded
+        else:
+            self.bo, self.ifds = _parse_ifds(self.mm)
+            if self.memo_dir:
+                _memo_save(self.path, self.memo_dir, self.bo, self.ifds)
         if not self.ifds:
             raise TiffError(f"No IFDs in {self.path}")
         first = self.ifds[0]

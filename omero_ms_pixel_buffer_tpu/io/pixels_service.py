@@ -102,6 +102,7 @@ class ImageRegistry(MetadataResolver):
 def _open_buffer(
     registry: ImageRegistry, entry: dict, image_id: int,
     block_cache: Optional[BlockCache] = None,
+    memo_dir: Optional[str] = None,
 ) -> PixelBuffer:
     path = registry.resolve_path(entry)
     name = entry.get("name", os.path.basename(path))
@@ -117,7 +118,7 @@ def _open_buffer(
     if kind in ("ometiff", "tiff") or kind is None:
         return OmeTiffPixelBuffer(
             path, image_id=image_id, image_name=name,
-            block_cache=block_cache,
+            block_cache=block_cache, memo_dir=memo_dir,
         )
     raise ValueError(f"Unknown image type: {kind}")
 
@@ -130,7 +131,10 @@ class PixelsService:
         self, registry: ImageRegistry, max_open: int = 128,
         block_cache_bytes: Optional[int] = None,
         metadata_resolver: Optional[MetadataResolver] = None,
+        memo_dir: Optional[str] = None,
     ):
+        # persistent IFD-parse memo cache (Memoizer analog, §5.4)
+        self.memo_dir = memo_dir
         self.registry = registry
         self.max_open = max_open
         # Optional authoritative metadata plane (e.g. the OMERO
@@ -176,7 +180,7 @@ class PixelsService:
             return None
         buf = _open_buffer(
             self.registry, entry, image_id,
-            block_cache=self.block_cache,
+            block_cache=self.block_cache, memo_dir=self.memo_dir,
         )
         with self._lock:
             existing = self._cache.get(image_id)

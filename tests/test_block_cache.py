@@ -191,3 +191,56 @@ class TestEngineSelection:
         service, _ = self._service(tiff_image)
         assert TilePipeline(service, use_device=False).engine == "host"
         assert TilePipeline(service, use_device=True).engine == "device"
+
+
+class TestMemoizer:
+    """Persistent IFD-parse memo (the Bio-Formats Memoizer analog)."""
+
+    def test_memo_roundtrip_and_staleness(self, tiff_image, tmp_path,
+                                          monkeypatch):
+        path, truth = tiff_image
+        memo_dir = str(tmp_path / "memo")
+        buf = OmeTiffPixelBuffer(path, memo_dir=memo_dir)
+        first = buf.get_tile_at(0, 0, 0, 0, 0, 0, 128, 128)
+        buf.close()
+        import os
+
+        memos = os.listdir(memo_dir)
+        assert len(memos) == 1 and memos[0].endswith(".ifd.pkl")
+
+        # second open must come from the memo: break the parser to prove
+        from omero_ms_pixel_buffer_tpu.io import ometiff as mod
+
+        def boom(data):
+            raise AssertionError("memo not used")
+
+        monkeypatch.setattr(mod, "_parse_ifds", boom)
+        buf2 = OmeTiffPixelBuffer(path, memo_dir=memo_dir)
+        np.testing.assert_array_equal(
+            buf2.get_tile_at(0, 0, 0, 0, 0, 0, 128, 128), first
+        )
+        buf2.close()
+        monkeypatch.undo()
+
+        # rewriting the file invalidates the memo (key = mtime+size)
+        rng = np.random.default_rng(9)
+        data = rng.integers(0, 60000, (1, 1, 1, 256, 256), dtype=np.uint16)
+        write_ome_tiff(path, data, tile_size=(128, 128), compression="zlib")
+        os.utime(path, (1e9, 1e9))  # force distinct mtime
+        buf3 = OmeTiffPixelBuffer(path, memo_dir=memo_dir)
+        np.testing.assert_array_equal(
+            buf3.get_tile_at(0, 0, 0, 0, 0, 0, 256, 256), data[0, 0, 0]
+        )
+        buf3.close()
+
+    def test_corrupt_memo_falls_back(self, tiff_image, tmp_path):
+        path, truth = tiff_image
+        memo_dir = tmp_path / "memo"
+        memo_dir.mkdir()
+        from omero_ms_pixel_buffer_tpu.io.ometiff import _memo_key
+
+        (memo_dir / (_memo_key(path) + ".ifd.pkl")).write_bytes(b"garbage")
+        buf = OmeTiffPixelBuffer(path, memo_dir=str(memo_dir))
+        tile = buf.get_tile_at(0, 0, 0, 0, 0, 0, 64, 64)
+        np.testing.assert_array_equal(tile, truth[:64, :64])
+        buf.close()
