@@ -11,21 +11,17 @@ Implementations:
 - ``AllowListValidator`` — standalone/bench mode: a key is valid when
   the session store produced it (it came from an authenticated
   OMERO.web session) and matches the optional allow-set.
-- ``IceSessionValidator`` — placeholder for a real Glacier2 join; the
-  environment has no Ice runtime or OMERO server, so constructing it
-  raises with a clear message. The wire contract (join by key, fail
-  403) is what matters for parity; plugging a real client in later
-  touches only this module.
+- ``IceSessionValidator`` (auth/ice.py, re-exported here) — the real
+  Glacier2 join over the in-tree Ice-protocol client:
+  ``createSession(key, key)`` against the OMERO router; denial -> 403.
 """
 
 from __future__ import annotations
 
 from typing import Iterable, Optional, Set
 
-
-class SessionValidator:
-    async def validate(self, omero_session_key: Optional[str]) -> bool:
-        raise NotImplementedError
+from .ice import IceSessionValidator  # noqa: F401  (re-export)
+from .validator import SessionValidator  # noqa: F401  (re-export)
 
 
 class AllowListValidator(SessionValidator):
@@ -43,10 +39,3 @@ class AllowListValidator(SessionValidator):
         return True
 
 
-class IceSessionValidator(SessionValidator):
-    def __init__(self, host: str, port: int):
-        raise NotImplementedError(
-            "Glacier2 session join requires the Ice runtime (zeroc-ice), "
-            "which this build does not bundle. Use the allow-list "
-            "validator, or deploy alongside an Ice-enabled sidecar."
-        )

@@ -1,0 +1,12 @@
+"""The session-validator interface (the OmeroRequest join contract,
+PixelBufferVerticle.java:106-110): a key validates iff the OMERO
+session it names is alive; invalid -> 403 at the dispatch layer."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class SessionValidator:
+    async def validate(self, omero_session_key: Optional[str]) -> bool:
+        raise NotImplementedError

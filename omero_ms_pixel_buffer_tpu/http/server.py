@@ -150,7 +150,20 @@ class PixelBufferApp:
                 ),
             )
         self.pixels_service = pixels_service
-        self.session_validator = session_validator or AllowListValidator()
+        if session_validator is None:
+            if config.omero_validate_sessions:
+                # per-request Glacier2 join, the OmeroRequest analog
+                # (PixelBufferVerticle.java:106-110)
+                from ..auth.ice import IceSessionValidator
+
+                session_validator = IceSessionValidator(
+                    config.omero_host, config.omero_port,
+                    secure=config.omero_secure,
+                    verify_tls=config.omero_verify_tls,
+                )
+            else:
+                session_validator = AllowListValidator()
+        self.session_validator = session_validator
         batching = config.backend.batching
         # config `backend.engine`: jax/auto -> probe the device link and
         # pick; device/tpu -> force the accelerator path; host -> force

@@ -297,20 +297,27 @@ _memo_log = logging.getLogger("omero_ms_pixel_buffer_tpu.io.memoizer")
 
 
 def _memo_key(path: str) -> str:
+    # stable per-path name (rewrites overwrite rather than orphan);
+    # freshness is validated from the stamp saved inside the memo
+    return hashlib.sha256(os.path.abspath(path).encode()).hexdigest()
+
+
+def _memo_stamp(path: str):
     st = os.stat(path)
-    raw = f"{os.path.abspath(path)}:{st.st_mtime_ns}:{st.st_size}"
-    return hashlib.sha256(raw.encode()).hexdigest()
+    return (st.st_mtime_ns, st.st_size)
 
 
 def _memo_load(path: str, memo_dir: str):
     """(byteorder, ifds) from the memo cache, or None. The memo dir is
     service-owned state (like the Bio-Formats Memoizer's .bfmemo
-    files); entries are keyed to path+mtime+size so a rewritten file
-    never matches a stale memo."""
+    files); a memo whose recorded mtime/size don't match the file is
+    stale and ignored."""
     memo = os.path.join(memo_dir, _memo_key(path) + ".ifd.pkl")
     try:
         with open(memo, "rb") as f:
-            bo, dumped = pickle.load(f)
+            stamp, bo, dumped = pickle.load(f)
+        if tuple(stamp) != _memo_stamp(path):
+            return None  # image was rewritten
         ifds = []
         for tags, sub_tags in dumped:
             ifd = _Ifd(tags)
@@ -339,7 +346,8 @@ def _memo_save(path: str, memo_dir: str, bo: str, ifds) -> None:
         try:
             with os.fdopen(fd, "wb") as f:
                 pickle.dump(
-                    (bo, dumped), f, protocol=pickle.HIGHEST_PROTOCOL
+                    (_memo_stamp(path), bo, dumped), f,
+                    protocol=pickle.HIGHEST_PROTOCOL,
                 )
             os.replace(tmp, memo)
         except BaseException:

@@ -98,6 +98,16 @@ class Config:
     worker_pool_size: Optional[int] = None  # default 2 x CPUs at deploy
     omero_host: str = "localhost"
     omero_port: int = 4064
+    # Join the OMERO session per request over Glacier2 (the reference's
+    # OmeroRequest behavior). Off by default: standalone deployments
+    # have no OMERO server, and the session store already authenticated
+    # the browser session.
+    omero_validate_sessions: bool = False
+    omero_secure: bool = True  # Glacier2 over TLS (OMERO default)
+    # Verify the router's TLS certificate. Opt out only for
+    # self-signed deployments — without verification the join can be
+    # spoofed by an on-path attacker.
+    omero_verify_tls: bool = True
     omero_server: dict = dataclasses.field(default_factory=dict)
     session_store: SessionStoreConfig = dataclasses.field(
         default_factory=SessionStoreConfig
@@ -178,6 +188,11 @@ class Config:
             ),
             omero_host=omero.get("host", "localhost"),
             omero_port=int(omero.get("port", 4064)),
+            omero_validate_sessions=bool(
+                omero.get("validate-sessions", False)
+            ),
+            omero_secure=bool(omero.get("secure", True)),
+            omero_verify_tls=bool(omero.get("verify-tls", True)),
             omero_server=dict(raw.get("omero.server") or {}),
             session_store=ss,
             http_tracing_enabled=bool(tracing.get("enabled", False)),

@@ -244,3 +244,23 @@ class TestMemoizer:
         tile = buf.get_tile_at(0, 0, 0, 0, 0, 0, 64, 64)
         np.testing.assert_array_equal(tile, truth[:64, :64])
         buf.close()
+
+
+def test_memo_rewrite_overwrites_not_orphans(tmp_path):
+    """A rewritten image reuses its (path-keyed) memo file instead of
+    leaking one orphan per rewrite."""
+    import os
+
+    rng = np.random.default_rng(31)
+    path = str(tmp_path / "img.ome.tiff")
+    memo_dir = str(tmp_path / "memo")
+    for round_ in range(3):
+        data = rng.integers(0, 60000, (1, 1, 1, 128, 128), dtype=np.uint16)
+        write_ome_tiff(path, data, tile_size=(64, 64))
+        os.utime(path, (1e9 + round_, 1e9 + round_))
+        buf = OmeTiffPixelBuffer(path, memo_dir=memo_dir)
+        np.testing.assert_array_equal(
+            buf.get_tile_at(0, 0, 0, 0, 0, 0, 128, 128), data[0, 0, 0]
+        )
+        buf.close()
+    assert len(os.listdir(memo_dir)) == 1

@@ -1,0 +1,209 @@
+"""Ice/Glacier2 session-join client against a fake router that speaks
+the same wire format (protocol 1.0 framing, encoding 1.1
+encapsulations)."""
+
+import asyncio
+import struct
+
+import pytest
+
+from omero_ms_pixel_buffer_tpu.auth.ice import (
+    Glacier2Client,
+    IceProtocolError,
+    IceSessionValidator,
+    build_request,
+    marshal_two_strings,
+)
+
+HEADER = b"IceP" + bytes([1, 0, 1, 0])
+
+
+def _msg(msg_type: int, body: bytes = b"") -> bytes:
+    return (
+        b"IceP" + bytes([1, 0, 1, 0, msg_type, 0])
+        + struct.pack("<i", 14 + len(body)) + body
+    )
+
+
+def _read_size(buf, off):
+    if buf[off] != 255:
+        return buf[off], off + 1
+    return struct.unpack("<i", buf[off + 1 : off + 5])[0], off + 5
+
+
+def _read_string(buf, off):
+    n, off = _read_size(buf, off)
+    return buf[off : off + n].decode(), off + n
+
+
+class FakeGlacier2:
+    """Accepts one Ice connection: sends ValidateConnection, parses one
+    createSession Request, replies per the configured session table."""
+
+    def __init__(self, valid_keys=(), exception="PermissionDenied"):
+        self.valid_keys = set(valid_keys)
+        self.exception = exception
+        self.requests = []
+        self.server = None
+        self.port = None
+
+    async def __aenter__(self):
+        self.server = await asyncio.start_server(
+            self._handle, "127.0.0.1", 0
+        )
+        self.port = self.server.sockets[0].getsockname()[1]
+        return self
+
+    async def __aexit__(self, *exc):
+        self.server.close()
+        await self.server.wait_closed()
+
+    async def _handle(self, reader, writer):
+        try:
+            writer.write(_msg(3))  # ValidateConnection
+            await writer.drain()
+            header = await reader.readexactly(14)
+            assert header[:4] == b"IceP"
+            assert header[8] == 0  # Request
+            (total,) = struct.unpack("<i", header[10:14])
+            body = await reader.readexactly(total - 14)
+            (request_id,) = struct.unpack("<i", body[:4])
+            off = 4
+            name, off = _read_string(body, off)
+            category, off = _read_string(body, off)
+            nfacet, off = _read_size(body, off)
+            operation, off = _read_string(body, off)
+            mode = body[off]
+            off += 1
+            nctx, off = _read_size(body, off)
+            # params encapsulation: size(i32) major minor payload
+            (esize,) = struct.unpack("<i", body[off : off + 4])
+            payload = body[off + 6 : off + esize]
+            user, poff = _read_string(payload, 0)
+            password, _ = _read_string(payload, poff)
+            self.requests.append(
+                (request_id, category, name, operation, mode, user,
+                 password)
+            )
+            if operation != "createSession":
+                status_body = struct.pack("<i", request_id) + bytes([2])
+                writer.write(_msg(2, status_body))
+                await writer.drain()
+                return
+            if user in self.valid_keys:
+                # success: status 0 + encapsulated (null proxy) result
+                result = struct.pack("<iBB", 7, 1, 1) + b"\x00"
+                reply = struct.pack("<i", request_id) + bytes([0]) + result
+            else:
+                exc_blob = (
+                    b"\x2b::Glacier2::" + self.exception.encode()
+                    + b"Exception\x00reason"
+                )
+                reply = (
+                    struct.pack("<i", request_id) + bytes([1]) + exc_blob
+                )
+            writer.write(_msg(2, reply))
+            await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+
+class TestGlacier2Client:
+    def test_join_success(self, loop):
+        async def run():
+            async with FakeGlacier2(valid_keys={"good-key"}) as g:
+                client = Glacier2Client("127.0.0.1", g.port)
+                joined, reason = await client.create_session(
+                    "good-key", "good-key"
+                )
+                assert joined and reason is None
+                rid, category, name, op, mode, user, pw = g.requests[0]
+                assert (category, name) == ("Glacier2", "router")
+                assert op == "createSession"
+                assert mode == 0
+                assert user == pw == "good-key"
+
+        loop.run_until_complete(run())
+
+    @pytest.mark.parametrize(
+        "exc,reason",
+        [("PermissionDenied", "Permission denied"),
+         ("CannotCreateSession", "Cannot create session")],
+    )
+    def test_join_denied(self, loop, exc, reason):
+        async def run():
+            async with FakeGlacier2(exception=exc) as g:
+                client = Glacier2Client("127.0.0.1", g.port)
+                joined, why = await client.create_session("bad", "bad")
+                assert not joined
+                assert why == reason
+
+        loop.run_until_complete(run())
+
+    def test_validator_contract(self, loop):
+        async def run():
+            async with FakeGlacier2(valid_keys={"alive"}) as g:
+                v = IceSessionValidator("127.0.0.1", g.port)
+                assert await v.validate("alive")
+            async with FakeGlacier2() as g2:
+                v2 = IceSessionValidator("127.0.0.1", g2.port)
+                assert not await v2.validate("dead")
+                assert not await v2.validate(None)  # no join attempted
+
+        loop.run_until_complete(run())
+
+    def test_protocol_error_raises(self, loop):
+        async def run():
+            async def bad_server(reader, writer):
+                writer.write(b"NOPE" + bytes(10))
+                await writer.drain()
+                writer.close()
+
+            server = await asyncio.start_server(
+                bad_server, "127.0.0.1", 0
+            )
+            port = server.sockets[0].getsockname()[1]
+            client = Glacier2Client("127.0.0.1", port, timeout_s=2)
+            with pytest.raises(IceProtocolError):
+                await client.create_session("k", "k")
+            server.close()
+            await server.wait_closed()
+
+        loop.run_until_complete(run())
+
+
+def test_request_marshaling_shape():
+    req = build_request(
+        7, ("Glacier2", "router"), "createSession",
+        marshal_two_strings("u", "p"),
+    )
+    assert req[:4] == b"IceP"
+    assert req[8] == 0  # Request
+    (total,) = struct.unpack("<i", req[10:14])
+    assert total == len(req)
+    (request_id,) = struct.unpack("<i", req[14:18])
+    assert request_id == 7
+
+
+def test_validator_caches_valid_keys(loop):
+    async def run():
+        async with FakeGlacier2(valid_keys={"k"}) as g:
+            v = IceSessionValidator("127.0.0.1", g.port, cache_ttl_s=30)
+            assert await v.validate("k")
+            joins = len(g.requests)
+            assert await v.validate("k")  # cache hit, no new join
+            assert len(g.requests) == joins
+            # denials are never cached
+            assert not await v.validate("other")
+            assert not await v.validate("other")
+            assert len(g.requests) == joins + 2
+
+    loop.run_until_complete(run())
+
+
+def test_validator_is_a_session_validator():
+    from omero_ms_pixel_buffer_tpu.auth.validator import SessionValidator
+
+    assert issubclass(IceSessionValidator, SessionValidator)
