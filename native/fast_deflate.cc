@@ -261,7 +261,12 @@ size_t FastDeflate(const uint8_t* in, size_t n, uint8_t* out, size_t cap) {
   if (cap < 64) return 0;
   const LenCode* len_table = LengthTable();
 
-  // ---- pass 1: histogram (runs at distance 1) ----
+  // ---- pass 1: tokenize + histogram in one scan ----
+  // token < 256: literal byte; token >= 256: run of length token-256
+  // at distance 1. One uint16 per input byte worst-case.
+  std::vector<uint16_t> token_buf(n + 1);
+  uint16_t* tokens = token_buf.data();
+  size_t ntok = 0;
   uint32_t lit_freq[kNumLit] = {0};
   bool any_run = false;
   {
@@ -276,12 +281,14 @@ size_t FastDeflate(const uint8_t* in, size_t n, uint8_t* out, size_t cap) {
         }
         if (run >= kMinRun) {
           lit_freq[len_table[run].sym]++;
+          tokens[ntok++] = static_cast<uint16_t>(256 + run);
           any_run = true;
           i += run;
           continue;
         }
       }
       lit_freq[in[i]]++;
+      tokens[ntok++] = in[i];
       i++;
     }
   }
@@ -333,32 +340,35 @@ size_t FastDeflate(const uint8_t* in, size_t n, uint8_t* out, size_t cap) {
     if (op.extra_bits) bw.Put(op.extra_val, op.extra_bits);
   }
 
-  // symbol stream (same scan as pass 1)
+  // symbol stream from the token buffer; adjacent literals fuse into
+  // one bit-writer call (two codes are <= 30 bits)
   {
-    size_t i = 0;
-    while (i < n) {
-      if (i > 0 && in[i] == in[i - 1]) {
-        size_t run = 1;
-        const uint8_t v = in[i - 1];
-        while (i + run < n && in[i + run] == v &&
-               run < static_cast<size_t>(kMaxRun)) {
-          run++;
-        }
-        if (run >= kMinRun) {
-          // one fused write: length code + extra bits + the 1-bit
-          // distance-1 code (a zero bit) — <= 21 bits total
-          const LenCode& lc = len_table[run];
-          uint32_t bits = lit_code[lc.sym];
-          int nb = lit_len[lc.sym];
-          bits |= static_cast<uint32_t>(lc.extra_val) << nb;
-          nb += lc.extra_bits + 1;
-          bw.Put(bits, nb);
-          i += run;
+    size_t t = 0;
+    while (t < ntok) {
+      uint16_t tok = tokens[t];
+      if (tok < 256) {
+        if (t + 1 < ntok && tokens[t + 1] < 256) {
+          const uint16_t tok2 = tokens[t + 1];
+          uint32_t bits = lit_code[tok];
+          const int nb1 = lit_len[tok];
+          bits |= lit_code[tok2] << nb1;
+          bw.Put(bits, nb1 + lit_len[tok2]);
+          t += 2;
           continue;
         }
+        bw.Put(lit_code[tok], lit_len[tok]);
+        t++;
+        continue;
       }
-      bw.Put(lit_code[in[i]], lit_len[in[i]]);
-      i++;
+      // one fused write: length code + extra bits + the 1-bit
+      // distance-1 code (a zero bit) — <= 21 bits total
+      const LenCode& lc = len_table[tok - 256];
+      uint32_t bits = lit_code[lc.sym];
+      int nb = lit_len[lc.sym];
+      bits |= static_cast<uint32_t>(lc.extra_val) << nb;
+      nb += lc.extra_bits + 1;
+      bw.Put(bits, nb);
+      t++;
     }
   }
   bw.Put(lit_code[256], lit_len[256]);  // EOB

@@ -237,19 +237,36 @@ class IceSessionValidator(SessionValidator):
         self._cache_ttl_s = cache_ttl_s
         self._cache_max = cache_max
         self._valid_until: dict = {}  # key -> monotonic expiry
+        self._in_flight: dict = {}  # key -> Future[bool]
 
     async def validate(self, omero_session_key: Optional[str]) -> bool:
         if not omero_session_key:
             return False
-        now = time.monotonic()
         expiry = self._valid_until.get(omero_session_key)
-        if expiry is not None and expiry > now:
+        if expiry is not None and expiry > time.monotonic():
             return True
-        joined, _reason = await self._client.create_session(
-            omero_session_key, omero_session_key
-        )
-        if joined:
-            if len(self._valid_until) >= self._cache_max:
-                self._valid_until.clear()  # coarse but bounded
-            self._valid_until[omero_session_key] = now + self._cache_ttl_s
-        return joined
+        # single-flight: a cold-cache tile burst must cost ONE join per
+        # key, not one TLS handshake + router session per tile
+        pending = self._in_flight.get(omero_session_key)
+        if pending is not None:
+            return await asyncio.shield(pending)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._in_flight[omero_session_key] = fut
+        try:
+            joined, _reason = await self._client.create_session(
+                omero_session_key, omero_session_key
+            )
+            if joined:
+                if len(self._valid_until) >= self._cache_max:
+                    self._valid_until.clear()  # coarse but bounded
+                self._valid_until[omero_session_key] = (
+                    time.monotonic() + self._cache_ttl_s
+                )
+            fut.set_result(joined)
+            return joined
+        except BaseException as e:
+            fut.set_exception(e)
+            fut.exception()  # consumed; avoid 'never retrieved' warnings
+            raise
+        finally:
+            self._in_flight.pop(omero_session_key, None)

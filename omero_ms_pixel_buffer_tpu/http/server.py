@@ -27,7 +27,11 @@ from typing import Optional
 from aiohttp import web
 
 from .. import __version__
-from ..auth.omero_session import AllowListValidator, SessionValidator
+from ..auth.omero_session import (
+    AllowListValidator,
+    IceSessionValidator,
+    SessionValidator,
+)
 from ..auth.stores import OmeroWebSessionStore, make_session_store
 from ..dispatch.batcher import BatchingTileWorker
 from ..dispatch.bus import GET_TILE_EVENT, EventBus
@@ -154,8 +158,6 @@ class PixelBufferApp:
             if config.omero_validate_sessions:
                 # per-request Glacier2 join, the OmeroRequest analog
                 # (PixelBufferVerticle.java:106-110)
-                from ..auth.ice import IceSessionValidator
-
                 session_validator = IceSessionValidator(
                     config.omero_host, config.omero_port,
                     secure=config.omero_secure,

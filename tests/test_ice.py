@@ -207,3 +207,18 @@ def test_validator_is_a_session_validator():
     from omero_ms_pixel_buffer_tpu.auth.validator import SessionValidator
 
     assert issubclass(IceSessionValidator, SessionValidator)
+
+
+def test_validator_single_flight(loop):
+    """Concurrent cold-cache validations of one key perform ONE join."""
+
+    async def run():
+        async with FakeGlacier2(valid_keys={"k"}) as g:
+            v = IceSessionValidator("127.0.0.1", g.port)
+            results = await asyncio.gather(
+                *[v.validate("k") for _ in range(16)]
+            )
+            assert all(results)
+            assert len(g.requests) == 1
+
+    loop.run_until_complete(run())
