@@ -237,7 +237,20 @@ class IceSessionValidator(SessionValidator):
         self._cache_ttl_s = cache_ttl_s
         self._cache_max = cache_max
         self._valid_until: dict = {}  # key -> monotonic expiry
-        self._in_flight: dict = {}  # key -> Future[bool]
+        self._in_flight: dict = {}  # key -> Task[bool]
+
+    async def _join(self, key: str) -> bool:
+        try:
+            joined, _reason = await self._client.create_session(key, key)
+            if joined:
+                if len(self._valid_until) >= self._cache_max:
+                    self._valid_until.clear()  # coarse but bounded
+                self._valid_until[key] = (
+                    time.monotonic() + self._cache_ttl_s
+                )
+            return joined
+        finally:
+            self._in_flight.pop(key, None)
 
     async def validate(self, omero_session_key: Optional[str]) -> bool:
         if not omero_session_key:
@@ -246,27 +259,14 @@ class IceSessionValidator(SessionValidator):
         if expiry is not None and expiry > time.monotonic():
             return True
         # single-flight: a cold-cache tile burst must cost ONE join per
-        # key, not one TLS handshake + router session per tile
-        pending = self._in_flight.get(omero_session_key)
-        if pending is not None:
-            return await asyncio.shield(pending)
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._in_flight[omero_session_key] = fut
-        try:
-            joined, _reason = await self._client.create_session(
-                omero_session_key, omero_session_key
+        # key, not one TLS handshake + router session per tile. The
+        # join runs as its OWN task so one waiter's cancellation (a
+        # client hanging up) never aborts the others — shield keeps the
+        # task alive and the remaining waiters get its result.
+        task = self._in_flight.get(omero_session_key)
+        if task is None:
+            task = asyncio.get_running_loop().create_task(
+                self._join(omero_session_key)
             )
-            if joined:
-                if len(self._valid_until) >= self._cache_max:
-                    self._valid_until.clear()  # coarse but bounded
-                self._valid_until[omero_session_key] = (
-                    time.monotonic() + self._cache_ttl_s
-                )
-            fut.set_result(joined)
-            return joined
-        except BaseException as e:
-            fut.set_exception(e)
-            fut.exception()  # consumed; avoid 'never retrieved' warnings
-            raise
-        finally:
-            self._in_flight.pop(omero_session_key, None)
+            self._in_flight[omero_session_key] = task
+        return await asyncio.shield(task)

@@ -127,6 +127,7 @@ class TilePipeline:
         use_pallas: Optional[bool] = None,
         buckets: Sequence[int] = (256, 512, 1024),
         engine: str = "auto",
+        use_plane_cache: bool = True,
     ):
         self.pixels_service = pixels_service
         self.png_filter = png_filter
@@ -138,6 +139,8 @@ class TilePipeline:
             raise ValueError(f"Unknown engine: {engine}")
         self._engine = engine
         self._use_pallas_arg = use_pallas
+        self.use_plane_cache = use_plane_cache
+        self._plane_cache = None  # built lazily on first device batch
         self.buckets = tuple(sorted(buckets))
         self._encode_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=encode_workers, thread_name_prefix="encode"
@@ -296,11 +299,24 @@ class TilePipeline:
                 log.exception("resolve failed for lane %d", i)
                 resolved[i] = None
 
+        use_device = self.use_device  # resolves 'auto' once per batch
+
+        # HBM-resident path: lanes whose plane is (or becomes) device-
+        # resident skip the host read entirely — crop + filter happen
+        # on the accelerator and only filtered bytes come back.
+        plane_groups: Dict[Tuple, List[int]] = {}
+        plane_handles: Dict[Tuple, object] = {}
+        if use_device and self.use_plane_cache:
+            plane_groups, plane_handles = self._stage_plane_lanes(
+                ctxs, resolved
+            )
+        in_plane = {i for lanes in plane_groups.values() for i in lanes}
+
         # group reads by (image, level) to hit readers' batched path
         with TRACER.start_span("batch_stage"):
             by_image: Dict[Tuple[int, int], List[int]] = {}
             for i, rt in enumerate(resolved):
-                if rt is not None:
+                if rt is not None and i not in in_plane:
                     by_image.setdefault(
                         (rt.meta.image_id, rt.level), []
                     ).append(i)
@@ -320,7 +336,6 @@ class TilePipeline:
                     log.exception("batched read failed; lanes -> 404")
 
         # split lanes: device-PNG buckets / host fused encode / python
-        use_device = self.use_device  # resolves 'auto' once per batch
         png_groups: Dict[Tuple, List[int]] = {}
         host_lanes: List[int] = []
         for i, (ctx, tile) in enumerate(zip(ctxs, tiles)):
@@ -356,7 +371,138 @@ class TilePipeline:
                 log.exception("device PNG batch failed; host fallback")
                 for i in lanes:
                     results[i] = self.encode(ctxs[i], tiles[i])
+
+        for key, lanes in plane_groups.items():
+            (_, bh, bw, dtype_str) = key[-4:]
+            try:
+                self._device_plane_png_lanes(
+                    plane_handles[key], lanes, resolved, ctxs, results,
+                    bh, bw, np.dtype(dtype_str),
+                )
+            except Exception:
+                log.exception("plane-cache PNG batch failed; host fallback")
+                for i in lanes:
+                    try:
+                        results[i] = self.encode(
+                            ctxs[i], self.read(resolved[i])
+                        )
+                    except Exception:
+                        results[i] = None
         return results
+
+    def _stage_plane_lanes(self, ctxs, resolved):
+        """Group device-eligible PNG lanes by resident plane; stages
+        planes into HBM on first touch. Lanes whose crop would clamp at
+        the plane edge (region + bucket exceeding the plane) stay on
+        the host path — PNG filters require the region at crop origin."""
+        from .device_cache import DevicePlaneCache
+
+        if self._plane_cache is None:
+            self._plane_cache = DevicePlaneCache()
+        groups: Dict[Tuple, List[int]] = {}
+        handles: Dict[Tuple, object] = {}
+        for i, (ctx, rt) in enumerate(zip(ctxs, resolved)):
+            if rt is None or ctx.format != "png":
+                continue
+            meta_dtype = rt.meta.dtype
+            if (
+                meta_dtype not in _PNG_DTYPES
+                or getattr(rt.buffer, "samples", 1) != 1
+            ):
+                continue
+            bucket = self._bucket(rt.w, rt.h)
+            if bucket is None:
+                continue
+            bw, bh = bucket
+            size_x, size_y = rt.buffer.level_size(rt.level)
+            if rt.x + bw > size_x or rt.y + bh > size_y:
+                continue  # edge lane: host path keeps filter semantics
+            key = (
+                rt.meta.image_id, rt.level, ctx.z, ctx.c, ctx.t,
+                bh, bw, meta_dtype.str,
+            )
+            if key not in handles:
+                try:
+                    plane = self._plane_cache.get_plane(
+                        rt.buffer, rt.level, ctx.z, ctx.c, ctx.t
+                    )
+                except Exception:
+                    log.exception("plane staging failed; host path")
+                    plane = None
+                if plane is None:
+                    continue
+                handles[key] = plane
+            groups.setdefault(key, []).append(i)
+        return groups, handles
+
+    def _device_plane_png_lanes(
+        self, plane, lanes, resolved, ctxs, results, bh, bw, dtype
+    ):
+        """Crop + byteswap + filter on device from a resident plane;
+        only the filtered scanline bytes cross back to the host."""
+        itemsize = dtype.itemsize
+        coords = [(resolved[i].y, resolved[i].x) for i in lanes]
+        with TRACER.start_span("batch_device"):
+            device_batch = self._plane_cache.crop_batch(
+                plane, coords, bh, bw
+            )
+            if self.use_pallas and pallas_supports((bh, bw), dtype):
+                filtered = np.asarray(
+                    pallas_filter_tiles(device_batch, self.png_filter)
+                )
+            else:
+                rows = to_big_endian_bytes(device_batch)
+                filtered = np.asarray(
+                    filter_batch(rows, itemsize, self.png_filter)
+                )
+        sizes = [(resolved[i].w, resolved[i].h) for i in lanes]
+        self._finish_png_lanes(filtered, lanes, sizes, results, itemsize)
+
+    def _finish_png_lanes(self, filtered, lanes, sizes, results, itemsize):
+        """Deflate + frame filtered device output (shared tail of both
+        device paths). Padding slices away per lane: filters never look
+        right or down, so the real region's bytes are identical."""
+        bit_depth = itemsize * 8
+        payloads = [
+            filtered[j, :h, : 1 + w * itemsize].tobytes()
+            for j, (w, h) in enumerate(sizes)
+        ]
+        engine = get_engine()
+        if engine is not None:
+            with TRACER.start_span("batch_encode"):
+                pngs = engine.png_assemble_batch(
+                    payloads,
+                    widths=[w for w, _ in sizes],
+                    heights=[h for _, h in sizes],
+                    bit_depths=[bit_depth] * len(lanes),
+                    color_types=[0] * len(lanes),
+                    level=self.png_level,
+                    strategy=self.png_strategy,
+                )
+            for (j, i), png in zip(enumerate(lanes), pngs):
+                if png is None:
+                    w, h = sizes[j]
+                    results[i] = assemble_png(
+                        payloads[j], w, h, bit_depth, 0,
+                        self.png_level, self.png_strategy,
+                    )
+                else:
+                    results[i] = png
+            return
+        with TRACER.start_span("batch_encode"):
+            futs = {
+                i: self._encode_pool.submit(
+                    assemble_png, payloads[j], sizes[j][0], sizes[j][1],
+                    bit_depth, 0, self.png_level, self.png_strategy,
+                )
+                for j, i in enumerate(lanes)
+            }
+            for i, fut in futs.items():
+                try:
+                    results[i] = fut.result()
+                except Exception:
+                    log.exception("encode failed for lane %d", i)
+                    results[i] = None
 
     def _host_png_lanes(self, lanes, tiles, ctxs, results) -> None:
         """Host engine: the whole batch in one fused native call
@@ -382,6 +528,8 @@ class TilePipeline:
             )
 
     def _device_png_lanes(self, lanes, tiles, ctxs, results, bh, bw, dtype):
+        """Host-staged device path: tiles padded into one bucket batch,
+        transferred, filtered on device, then the shared deflate tail."""
         itemsize = dtype.itemsize
         batch = np.zeros((len(lanes), bh, bw), dtype=dtype)
         for j, i in enumerate(lanes):
@@ -399,58 +547,5 @@ class TilePipeline:
                 filtered = np.asarray(
                     filter_batch(rows, itemsize, self.png_filter)
                 )  # (B, bh, 1 + bw*itemsize)
-        with TRACER.start_span("batch_encode"):
-            bit_depth = itemsize * 8
-
-            def lane_bytes(j: int, i: int) -> bytes:
-                # slice away bucket padding: filters never look right or
-                # down, so the real region's bytes are identical
-                t = tiles[i]
-                h, w = t.shape
-                return filtered[j, :h, : 1 + w * itemsize].tobytes()
-
-            engine = get_engine()
-            if engine is not None:
-                # one native call: deflate + CRC + chunk framing for
-                # every lane on the C++ thread pool (GIL released)
-                payloads = [lane_bytes(j, i) for j, i in enumerate(lanes)]
-                pngs = engine.png_assemble_batch(
-                    payloads,
-                    widths=[tiles[i].shape[1] for i in lanes],
-                    heights=[tiles[i].shape[0] for i in lanes],
-                    bit_depths=[bit_depth] * len(lanes),
-                    color_types=[0] * len(lanes),
-                    level=self.png_level,
-                    strategy=self.png_strategy,
-                )
-                for (j, i), png in zip(enumerate(lanes), pngs):
-                    if png is None:
-                        # rare native lane failure (allocation): fall
-                        # back to the python assembler for that lane
-                        t = tiles[i]
-                        results[i] = assemble_png(
-                            payloads[j], t.shape[1], t.shape[0],
-                            bit_depth, 0, self.png_level, self.png_strategy,
-                        )
-                    else:
-                        results[i] = png
-                return
-
-            def finish(j: int, i: int) -> Optional[bytes]:
-                t = tiles[i]
-                h, w = t.shape
-                return assemble_png(
-                    lane_bytes(j, i), w, h, bit_depth, 0,
-                    self.png_level, self.png_strategy,
-                )
-
-            futs = {
-                i: self._encode_pool.submit(finish, j, i)
-                for j, i in enumerate(lanes)
-            }
-            for i, fut in futs.items():
-                try:
-                    results[i] = fut.result()
-                except Exception:
-                    log.exception("encode failed for lane %d", i)
-                    results[i] = None
+        sizes = [(tiles[i].shape[1], tiles[i].shape[0]) for i in lanes]
+        self._finish_png_lanes(filtered, lanes, sizes, results, itemsize)

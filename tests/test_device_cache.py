@@ -1,0 +1,102 @@
+"""HBM-resident plane cache: device crops must encode identically to
+the host path, planes stage once, and edge lanes fall back."""
+
+import numpy as np
+import pytest
+
+from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
+from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+    ImageRegistry,
+    PixelsService,
+)
+from omero_ms_pixel_buffer_tpu.models.device_cache import DevicePlaneCache
+from omero_ms_pixel_buffer_tpu.models.tile_pipeline import TilePipeline
+from omero_ms_pixel_buffer_tpu.ops.png import decode_png
+from omero_ms_pixel_buffer_tpu.tile_ctx import RegionDef, TileCtx
+
+
+@pytest.fixture
+def image(tmp_path):
+    rng = np.random.default_rng(41)
+    data = rng.integers(0, 60000, (1, 1, 2, 640, 640), dtype=np.uint16)
+    path = str(tmp_path / "img.ome.tiff")
+    write_ome_tiff(path, data, tile_size=(256, 256), compression="zlib")
+    registry = ImageRegistry()
+    registry.add(1, path)
+    return PixelsService(registry), data[0, 0]
+
+
+def _ctx(x, y, w, h, z=0):
+    return TileCtx(
+        image_id=1, z=z, c=0, t=0, region=RegionDef(x, y, w, h),
+        format="png", omero_session_key="k",
+    )
+
+
+class TestPlaneCache:
+    def test_device_plane_path_matches_host(self, image):
+        service, truth = image
+        dev = TilePipeline(
+            service, engine="device", use_pallas=False, buckets=(256,),
+        )
+        host = TilePipeline(service, engine="host")
+        ctxs = [
+            _ctx(0, 0, 256, 256),
+            _ctx(128, 64, 256, 256),
+            _ctx(37, 51, 100, 200),     # sub-bucket
+            _ctx(500, 500, 140, 140),   # edge: crop would clamp -> host
+            _ctx(0, 0, 256, 256, z=1),  # second plane
+        ]
+        out_dev = dev.handle_batch(list(ctxs))
+        out_host = host.handle_batch(list(ctxs))
+        for ctx, d, h in zip(ctxs, out_dev, out_host):
+            assert d is not None and h is not None
+            r = ctx.region
+            z = ctx.z
+            np.testing.assert_array_equal(
+                decode_png(d), truth[z, r.y : r.y + r.height,
+                                     r.x : r.x + r.width],
+            )
+            np.testing.assert_array_equal(decode_png(d), decode_png(h))
+        # two planes staged (z=0, z=1), reused on a second batch
+        cache = dev._plane_cache
+        assert cache is not None and len(cache) == 2
+        misses = cache.misses
+        out2 = dev.handle_batch([_ctx(64, 64, 256, 256)])
+        assert out2[0] is not None
+        assert cache.misses == misses  # pure hit
+
+    def test_budget_zero_falls_back(self, image):
+        service, truth = image
+        pipe = TilePipeline(
+            service, engine="device", use_pallas=False, buckets=(256,),
+        )
+        pipe._plane_cache = DevicePlaneCache(max_bytes=0)
+        out = pipe.handle_batch([_ctx(0, 0, 256, 256)])
+        np.testing.assert_array_equal(
+            decode_png(out[0]), truth[0, :256, :256]
+        )
+        assert len(pipe._plane_cache) == 0
+
+    def test_plane_cache_lru_evicts(self, image):
+        service, _ = image
+        plane_bytes = 640 * 640 * 2
+        cache = DevicePlaneCache(max_bytes=plane_bytes + 16)
+        buf = service.get_pixel_buffer(1)
+        p0 = cache.get_plane(buf, 0, 0, 0, 0)
+        p1 = cache.get_plane(buf, 0, 1, 0, 0)
+        assert p0 is not None and p1 is not None
+        assert len(cache) == 1  # first plane evicted
+        assert cache.nbytes <= plane_bytes + 16
+
+    def test_disabled_plane_cache(self, image):
+        service, truth = image
+        pipe = TilePipeline(
+            service, engine="device", use_pallas=False, buckets=(256,),
+            use_plane_cache=False,
+        )
+        out = pipe.handle_batch([_ctx(32, 32, 128, 128)])
+        np.testing.assert_array_equal(
+            decode_png(out[0]), truth[0, 32:160, 32:160]
+        )
+        assert pipe._plane_cache is None

@@ -222,3 +222,24 @@ def test_validator_single_flight(loop):
             assert len(g.requests) == 1
 
     loop.run_until_complete(run())
+
+
+def test_single_flight_survives_waiter_cancellation(loop):
+    """A waiter (or the first caller) being cancelled must not poison
+    the shared join for the others."""
+
+    async def run():
+        async with FakeGlacier2(valid_keys={"k"}) as g:
+            v = IceSessionValidator("127.0.0.1", g.port)
+            first = asyncio.ensure_future(v.validate("k"))
+            await asyncio.sleep(0)  # let the join task start
+            first.cancel()
+            try:
+                await first
+            except asyncio.CancelledError:
+                pass
+            # others still complete from the surviving join task
+            assert await v.validate("k")
+            assert len(g.requests) == 1
+
+    loop.run_until_complete(run())
