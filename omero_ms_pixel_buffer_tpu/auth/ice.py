@@ -268,5 +268,10 @@ class IceSessionValidator(SessionValidator):
             task = asyncio.get_running_loop().create_task(
                 self._join(omero_session_key)
             )
+            # consume the exception if every waiter cancelled before
+            # the join failed ("Task exception was never retrieved")
+            task.add_done_callback(
+                lambda t: t.cancelled() or t.exception()
+            )
             self._in_flight[omero_session_key] = task
         return await asyncio.shield(task)

@@ -574,26 +574,32 @@ def write_ome_tiff(
     compression: Optional[str] = None,  # None | "zlib"
     big_endian: bool = True,
 ) -> None:
-    """Write 5D TCZYX data as a (pyramidal) OME-TIFF: planes in XYCZT
-    page order, pyramid levels as SubIFDs, tiled storage."""
-    if data.ndim != 5:
-        raise TiffError("write_ome_tiff expects TCZYX data")
-    T, C, Z, Y, X = data.shape
+    """Write 5D TCZYX (or 6D TCZYXS for RGB, S=3) data as a (pyramidal)
+    OME-TIFF: planes in XYCZT page order, pyramid levels as SubIFDs,
+    tiled storage."""
+    if data.ndim == 6:
+        if data.shape[5] != 3:
+            raise TiffError("6D input must be TCZYXS with S=3 (RGB)")
+    elif data.ndim != 5:
+        raise TiffError("write_ome_tiff expects TCZYX(S) data")
+    T, C, Z, Y, X = data.shape[:5]
     bo = ">" if big_endian else "<"
     dtype = data.dtype
     comp_code = 8 if compression == "zlib" else 1
     kind_fmt = {"u": 1, "i": 2, "f": 3}[dtype.kind]
 
+    samples = 3 if data.ndim == 6 else 1
     ome = (
         '<?xml version="1.0" encoding="UTF-8"?>'
         '<OME xmlns="http://www.openmicroscopy.org/Schemas/OME/2016-06">'
         '<Image ID="Image:0">'
         f'<Pixels ID="Pixels:0" DimensionOrder="XYCZT" '
         f'Type="{omero_type_for(dtype)}" '
-        f'SizeX="{X}" SizeY="{Y}" SizeZ="{Z}" SizeC="{C}" SizeT="{T}" '
+        f'SizeX="{X}" SizeY="{Y}" SizeZ="{Z}" '
+        f'SizeC="{C * samples}" SizeT="{T}" '
         f'BigEndian="{"true" if big_endian else "false"}">'
         + "".join(
-            f'<Channel ID="Channel:0:{c}" SamplesPerPixel="1"/>'
+            f'<Channel ID="Channel:0:{c}" SamplesPerPixel="{samples}"/>'
             for c in range(C)
         )
         + "<TiffData/></Pixels></Image></OME>"

@@ -34,28 +34,48 @@ def default_hbm_cache_bytes() -> int:
     return int(os.environ.get("OMPB_HBM_CACHE_MB", "4096")) << 20
 
 
-@partial(__import__("jax").jit, static_argnums=(3, 4))
+_crop_batch_jit = None
+
+
 def _crop_batch(plane, ys, xs, bh: int, bw: int):
     """Gather N (bh, bw) crops from one resident plane. vmap over the
     per-lane start indices; slice sizes are static per bucket so XLA
-    compiles one gather kernel per (bucket, dtype)."""
-    import jax
-    from jax import lax
+    compiles one gather kernel per (bucket, dtype). The jitted callable
+    is built on first use so importing this module never imports jax."""
+    global _crop_batch_jit
+    if _crop_batch_jit is None:
+        import jax
+        from jax import lax
 
-    def one(y0, x0):
-        return lax.dynamic_slice(plane, (y0, x0), (bh, bw))
+        @partial(jax.jit, static_argnums=(3, 4))
+        def crop(plane, ys, xs, bh, bw):
+            def one(y0, x0):
+                return lax.dynamic_slice(plane, (y0, x0), (bh, bw))
 
-    return jax.vmap(one)(ys, xs)
+            return jax.vmap(one)(ys, xs)
+
+        _crop_batch_jit = crop
+    return _crop_batch_jit(plane, ys, xs, bh, bw)
 
 
 class DevicePlaneCache:
-    """LRU of device-resident (level, z, c, t) planes per buffer."""
+    """LRU of device-resident (level, z, c, t) planes per buffer.
 
-    def __init__(self, max_bytes: Optional[int] = None):
+    Admission: a plane is staged only on its ``admit_after``-th touch
+    (default 2) — one stray tile on a cold plane must not pay a
+    multi-hundred-MB read/decode/transfer, and a working set larger
+    than the budget degrades to the batched host-read path instead of
+    thrashing full-plane restages."""
+
+    def __init__(
+        self, max_bytes: Optional[int] = None, admit_after: int = 2
+    ):
         self.max_bytes = (
             default_hbm_cache_bytes() if max_bytes is None else max_bytes
         )
+        self.admit_after = admit_after
         self._planes: "OrderedDict[tuple, object]" = OrderedDict()
+        self._touches: OrderedDict = OrderedDict()  # key -> count
         self._bytes = 0
         self._lock = threading.Lock()
         self.hits = 0
@@ -65,9 +85,9 @@ class DevicePlaneCache:
         return (buffer.cache_ns, level, z, c, t)
 
     def get_plane(self, buffer, level: int, z: int, c: int, t: int):
-        """The device array for a whole plane, staging it on first use;
-        None when the plane exceeds the budget (caller falls back to
-        host staging)."""
+        """The device array for a whole plane, staging it once the
+        admission threshold is met; None when not (yet) resident
+        (caller falls back to host staging)."""
         import jax
 
         key = self._key(buffer, level, z, c, t)
@@ -78,6 +98,12 @@ class DevicePlaneCache:
                 self.hits += 1
                 return plane
             self.misses += 1
+            touches = self._touches.get(key, 0) + 1
+            self._touches[key] = touches
+            while len(self._touches) > 4096:  # bounded bookkeeping
+                self._touches.popitem(last=False)
+            if touches < self.admit_after:
+                return None
         # budget check BEFORE materializing anything: a whole-slide
         # plane can be tens of GB, and rejecting it must cost nothing
         size_x, size_y = buffer.level_size(level)

@@ -47,18 +47,21 @@ class TestPlaneCache:
             _ctx(500, 500, 140, 140),   # edge: crop would clamp -> host
             _ctx(0, 0, 256, 256, z=1),  # second plane
         ]
-        out_dev = dev.handle_batch(list(ctxs))
-        out_host = host.handle_batch(list(ctxs))
-        for ctx, d, h in zip(ctxs, out_dev, out_host):
-            assert d is not None and h is not None
-            r = ctx.region
-            z = ctx.z
-            np.testing.assert_array_equal(
-                decode_png(d), truth[z, r.y : r.y + r.height,
-                                     r.x : r.x + r.width],
-            )
-            np.testing.assert_array_equal(decode_png(d), decode_png(h))
-        # two planes staged (z=0, z=1), reused on a second batch
+        # batch 1: admission threshold not met -> host staging, but
+        # outputs already correct; batch 2: planes resident
+        for round_ in range(2):
+            out_dev = dev.handle_batch(list(ctxs))
+            out_host = host.handle_batch(list(ctxs))
+            for ctx, d, h in zip(ctxs, out_dev, out_host):
+                assert d is not None and h is not None
+                r = ctx.region
+                z = ctx.z
+                np.testing.assert_array_equal(
+                    decode_png(d), truth[z, r.y : r.y + r.height,
+                                         r.x : r.x + r.width],
+                )
+                np.testing.assert_array_equal(decode_png(d), decode_png(h))
+        # two planes staged (z=0, z=1) on the second touch
         cache = dev._plane_cache
         assert cache is not None and len(cache) == 2
         misses = cache.misses
@@ -81,13 +84,22 @@ class TestPlaneCache:
     def test_plane_cache_lru_evicts(self, image):
         service, _ = image
         plane_bytes = 640 * 640 * 2
-        cache = DevicePlaneCache(max_bytes=plane_bytes + 16)
+        cache = DevicePlaneCache(
+            max_bytes=plane_bytes + 16, admit_after=1
+        )
         buf = service.get_pixel_buffer(1)
         p0 = cache.get_plane(buf, 0, 0, 0, 0)
         p1 = cache.get_plane(buf, 0, 1, 0, 0)
         assert p0 is not None and p1 is not None
         assert len(cache) == 1  # first plane evicted
         assert cache.nbytes <= plane_bytes + 16
+
+    def test_admission_defers_first_touch(self, image):
+        service, _ = image
+        cache = DevicePlaneCache(max_bytes=1 << 30)  # admit_after=2
+        buf = service.get_pixel_buffer(1)
+        assert cache.get_plane(buf, 0, 0, 0, 0) is None  # touch 1
+        assert cache.get_plane(buf, 0, 0, 0, 0) is not None  # touch 2
 
     def test_disabled_plane_cache(self, image):
         service, truth = image

@@ -211,3 +211,47 @@ class TestBatching:
         )
         for status, _ in results:
             assert status == 200
+
+
+class TestRgbImage:
+    """RGB (SamplesPerPixel=3) images through the full HTTP surface."""
+
+    @pytest.fixture
+    def rgb_client(self, tmp_path, loop):
+        rgb = rng.integers(0, 255, (1, 1, 1, 48, 56, 3), dtype=np.uint8)
+        write_ome_tiff(
+            str(tmp_path / "rgb.ome.tiff"), rgb, tile_size=(32, 32)
+        )
+        registry = ImageRegistry()
+        registry.add(1, str(tmp_path / "rgb.ome.tiff"))
+        store = MemorySessionStore({"cookie-1": "omero-key-1"})
+        config = Config.from_dict({"session-store": {"type": "memory"}})
+        app_obj = PixelBufferApp(
+            config, pixels_service=PixelsService(registry),
+            session_store=store,
+        )
+        client = TestClient(TestServer(app_obj.make_app()), loop=loop)
+        loop.run_until_complete(client.start_server())
+        yield client, rgb[0, 0, 0]
+        loop.run_until_complete(client.close())
+
+    def test_rgb_png_and_tif(self, rgb_client, loop):
+        client, truth = rgb_client
+
+        async def run():
+            r = await client.get(
+                "/tile/1/0/0/0?x=8&y=4&w=32&h=24&format=png",
+                headers=AUTH,
+            )
+            assert r.status == 200
+            png = np.array(Image.open(io.BytesIO(await r.read())))
+            np.testing.assert_array_equal(png, truth[4:28, 8:40])
+            r2 = await client.get(
+                "/tile/1/0/0/0?x=0&y=0&w=56&h=48&format=tif",
+                headers=AUTH,
+            )
+            assert r2.status == 200
+            tif = np.array(Image.open(io.BytesIO(await r2.read())))
+            np.testing.assert_array_equal(tif, truth)
+
+        loop.run_until_complete(run())
