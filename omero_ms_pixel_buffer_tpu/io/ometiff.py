@@ -426,12 +426,23 @@ class OmeTiffPixelBuffer(PixelBuffer):
         sc = int(ome["SizeC"]) if ome and "SizeC" in ome else 1
         st = int(ome["SizeT"]) if ome and "SizeT" in ome else 1
         self.dim_order = (ome or {}).get("DimensionOrder", "XYCZT")
-        n_planes = sz * sc * st
-        if n_planes > len(self.ifds):
-            # RGB interleaved counts C inside samples; or metadata lies —
-            # fall back to page count as plane count.
+        # OMERO models RGB as SizeC=3 with per-channel reads; an
+        # interleaved TIFF stores those channels inside the samples of
+        # one page. When the page count reconciles that way, requests
+        # for channel c slice sample c out of the shared page.
+        self._channels_per_plane = 1
+        if (
+            samples > 1 and sc % samples == 0
+            and sz * (sc // samples) * st == len(self.ifds)
+        ):
+            self._channels_per_plane = samples
+            n_planes = len(self.ifds)
+        elif sz * sc * st > len(self.ifds):
+            # metadata lies — fall back to page count as plane count
             n_planes = len(self.ifds)
             sz, sc, st = 1, 1, n_planes
+        else:
+            n_planes = sz * sc * st
         self.n_planes = n_planes
 
         meta = PixelsMeta(
@@ -447,8 +458,13 @@ class OmeTiffPixelBuffer(PixelBuffer):
     # plane index for XYCZT-family orders (X/Y always first two)
     def _plane_index(self, z: int, c: int, t: int) -> int:
         m = self.meta
+        s = self._channels_per_plane
         order = self.dim_order[2:]  # e.g. "CZT"
-        dims = {"Z": (z, m.size_z), "C": (c, m.size_c), "T": (t, m.size_t)}
+        dims = {
+            "Z": (z, m.size_z),
+            "C": (c // s, max(1, m.size_c // s)),
+            "T": (t, m.size_t),
+        }
         idx, stride = 0, 1
         for d in order:
             val, size = dims[d]
@@ -485,9 +501,16 @@ class OmeTiffPixelBuffer(PixelBuffer):
             cache=self.block_cache, cache_ns=self.cache_ns,
         )
 
+    def _extract_channel(self, region: np.ndarray, c: int) -> np.ndarray:
+        if self._channels_per_plane > 1 and region.ndim == 3:
+            return np.ascontiguousarray(
+                region[:, :, c % self._channels_per_plane]
+            )
+        return region
+
     def get_tile_at(self, level, z, c, t, x, y, w, h) -> np.ndarray:
         reader = self._reader_for(z, c, t, x, y, w, h, level)
-        return reader.read_region(x, y, w, h)
+        return self._extract_channel(reader.read_region(x, y, w, h), c)
 
     def read_tiles(self, coords, level: int = 0):
         """Batched read: every compressed block any requested tile
@@ -503,10 +526,23 @@ class OmeTiffPixelBuffer(PixelBuffer):
             self._reader_for(z, c, t, x, y, w, h, level)
             for (z, c, t, x, y, w, h) in coords
         ]
+        # regions assembled once per (page, rect) and shared across the
+        # channel lanes of one composite request (tiles are read-only
+        # downstream); channels slice out of the shared region
+        regions: Dict[Tuple, np.ndarray] = {}
+
+        def assemble(r, c, x, y, w, h, get_block=None):
+            rk = (id(r.ifd), x, y, w, h)
+            region = regions.get(rk)
+            if region is None:
+                region = r.read_region(x, y, w, h, get_block=get_block)
+                regions[rk] = region
+            return self._extract_channel(region, c)
+
         if engine is None or not any(r.compression == 8 for r in readers):
             return [
-                r.read_region(x, y, w, h)
-                for r, (_, _, _, x, y, w, h) in zip(readers, coords)
+                assemble(r, c, x, y, w, h)
+                for r, (_, c, _, x, y, w, h) in zip(readers, coords)
             ]
 
         # plan: dedup compressed blocks across the whole batch, serving
@@ -542,7 +578,7 @@ class OmeTiffPixelBuffer(PixelBuffer):
             self.block_cache[key] = arr
 
         out: List[Optional[np.ndarray]] = []
-        for r, (_, _, _, x, y, w, h) in zip(readers, coords):
+        for r, (_, c, _, x, y, w, h) in zip(readers, coords):
             if r.compression == 8:
                 ifd_key = id(r.ifd)
                 get_block = (  # noqa: E731
@@ -551,7 +587,7 @@ class OmeTiffPixelBuffer(PixelBuffer):
             else:
                 get_block = None
             try:
-                out.append(r.read_region(x, y, w, h, get_block=get_block))
+                out.append(assemble(r, c, x, y, w, h, get_block=get_block))
             except KeyError:  # a needed block failed to inflate
                 out.append(None)
         return out

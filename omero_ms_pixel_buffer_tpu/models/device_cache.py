@@ -98,11 +98,15 @@ class DevicePlaneCache:
                 self.hits += 1
                 return plane
             self.misses += 1
-            touches = self._touches.get(key, 0) + 1
-            self._touches[key] = touches
-            while len(self._touches) > 4096:  # bounded bookkeeping
-                self._touches.popitem(last=False)
+            touches = self._touches.pop(key, 0) + 1
             if touches < self.admit_after:
+                # re-insert at the recent end so active warmers survive
+                # the bounded trim; admitted keys leave the dict (their
+                # count must restart after an eviction, or a working
+                # set above the budget thrashes full-plane restages)
+                self._touches[key] = touches
+                while len(self._touches) > 4096:
+                    self._touches.popitem(last=False)
                 return None
         # budget check BEFORE materializing anything: a whole-slide
         # plane can be tens of GB, and rejecting it must cost nothing

@@ -401,6 +401,7 @@ class TilePipeline:
             self._plane_cache = DevicePlaneCache()
         groups: Dict[Tuple, List[int]] = {}
         handles: Dict[Tuple, object] = {}
+        attempted: set = set()  # one admission touch per key per batch
         for i, (ctx, rt) in enumerate(zip(ctxs, resolved)):
             if rt is None or ctx.format != "png":
                 continue
@@ -422,6 +423,9 @@ class TilePipeline:
                 bh, bw, meta_dtype.str,
             )
             if key not in handles:
+                if key in attempted:
+                    continue  # cold this batch; later lanes stay host
+                attempted.add(key)
                 try:
                     plane = self._plane_cache.get_plane(
                         rt.buffer, rt.level, ctx.z, ctx.c, ctx.t

@@ -112,3 +112,33 @@ class TestPlaneCache:
             decode_png(out[0]), truth[0, 32:160, 32:160]
         )
         assert pipe._plane_cache is None
+
+
+def test_admission_single_touch_per_batch(image):
+    """Multiple cold lanes on one plane in one batch count ONE
+    admission touch (get_plane called once), so admit_after=2 really
+    defers staging to the second batch."""
+    service, truth = image
+    pipe = TilePipeline(
+        service, engine="device", use_pallas=False, buckets=(256,),
+    )
+    batch = [_ctx(0, 0, 256, 256), _ctx(128, 128, 256, 256)]
+    out1 = pipe.handle_batch(list(batch))
+    assert all(o is not None for o in out1)
+    assert len(pipe._plane_cache) == 0  # still cold after batch 1
+    out2 = pipe.handle_batch(list(batch))
+    assert all(o is not None for o in out2)
+    assert len(pipe._plane_cache) == 1  # staged on batch 2
+
+
+def test_admission_counter_resets_after_staging(image):
+    service, _ = image
+    cache = DevicePlaneCache(max_bytes=1 << 30)
+    buf = service.get_pixel_buffer(1)
+    assert cache.get_plane(buf, 0, 0, 0, 0) is None
+    assert cache.get_plane(buf, 0, 0, 0, 0) is not None  # staged
+    # evict by replacing the cache contents, then the counter must
+    # restart (no immediate restage on the first post-eviction touch)
+    cache._planes.clear()
+    cache._bytes = 0
+    assert cache.get_plane(buf, 0, 0, 0, 0) is None  # touch 1 again

@@ -235,23 +235,31 @@ class TestRgbImage:
         yield client, rgb[0, 0, 0]
         loop.run_until_complete(client.close())
 
-    def test_rgb_png_and_tif(self, rgb_client, loop):
+    def test_rgb_channels_served_separately(self, rgb_client, loop):
+        """OMERO semantics: an RGB image is SizeC=3; channel c serves
+        that sample as a grayscale tile (viewers compose client-side)."""
         client, truth = rgb_client
 
         async def run():
-            r = await client.get(
-                "/tile/1/0/0/0?x=8&y=4&w=32&h=24&format=png",
-                headers=AUTH,
-            )
-            assert r.status == 200
-            png = np.array(Image.open(io.BytesIO(await r.read())))
-            np.testing.assert_array_equal(png, truth[4:28, 8:40])
+            for c in range(3):
+                r = await client.get(
+                    f"/tile/1/0/{c}/0?x=8&y=4&w=32&h=24&format=png",
+                    headers=AUTH,
+                )
+                assert r.status == 200
+                png = np.array(Image.open(io.BytesIO(await r.read())))
+                np.testing.assert_array_equal(png, truth[4:28, 8:40, c])
             r2 = await client.get(
-                "/tile/1/0/0/0?x=0&y=0&w=56&h=48&format=tif",
+                "/tile/1/0/2/0?x=0&y=0&w=56&h=48&format=tif",
                 headers=AUTH,
             )
             assert r2.status == 200
             tif = np.array(Image.open(io.BytesIO(await r2.read())))
-            np.testing.assert_array_equal(tif, truth)
+            np.testing.assert_array_equal(tif, truth[:, :, 2])
+            # channel out of range -> 404, like any bad coordinate
+            r3 = await client.get(
+                "/tile/1/0/3/0?w=8&h=8", headers=AUTH
+            )
+            assert r3.status == 404
 
         loop.run_until_complete(run())
