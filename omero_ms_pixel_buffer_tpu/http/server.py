@@ -184,6 +184,7 @@ class PixelBufferApp:
             png_filter=config.backend.png.filter,
             png_level=config.backend.png.level,
             png_strategy=config.backend.png.strategy,
+            max_tile_bytes=config.backend.max_tile_mb << 20,
         )
         self.worker = BatchingTileWorker(
             self.pipeline,

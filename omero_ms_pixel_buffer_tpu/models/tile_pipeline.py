@@ -128,6 +128,7 @@ class TilePipeline:
         buckets: Sequence[int] = (256, 512, 1024),
         engine: str = "auto",
         use_plane_cache: bool = True,
+        max_tile_bytes: int = 256 << 20,
     ):
         self.pixels_service = pixels_service
         self.png_filter = png_filter
@@ -141,6 +142,11 @@ class TilePipeline:
         self._use_pallas_arg = use_pallas
         self.use_plane_cache = use_plane_cache
         self._plane_cache = None  # built lazily on first device batch
+        # Allocation guard the reference lacks (its tile-size policy
+        # beans only steer pyramid writing; a full-plane request still
+        # allocates w*h*bpp unchecked, TileRequestHandler.java:98-103).
+        # 0 disables.
+        self.max_tile_bytes = max_tile_bytes
         self.buckets = tuple(sorted(buckets))
         self._encode_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=encode_workers, thread_name_prefix="encode"
@@ -209,6 +215,18 @@ class TilePipeline:
             level = ctx.resolution
         size_x, size_y = buffer.level_size(level)
         x, y, w, h = resolve_region(ctx.region, size_x, size_y)
+        # guard the true allocation: interleaved multi-sample pages
+        # materialize w*h*samples before channel extraction
+        samples = getattr(buffer, "samples", 1)
+        if (
+            self.max_tile_bytes
+            and w * h * samples * meta.bytes_per_pixel
+            > self.max_tile_bytes
+        ):
+            raise ValueError(
+                f"Tile {w}x{h} exceeds max-tile-bytes "
+                f"({self.max_tile_bytes})"
+            )
         # reflect defaulting back into the ctx (the reference mutates
         # region in place, TileRequestHandler.java:92-97, and the
         # filename header carries the resolved w/h)
@@ -401,7 +419,10 @@ class TilePipeline:
             self._plane_cache = DevicePlaneCache()
         groups: Dict[Tuple, List[int]] = {}
         handles: Dict[Tuple, object] = {}
-        attempted: set = set()  # one admission touch per key per batch
+        # one admission touch per PLANE per batch (a plane serves every
+        # bucket group; keying attempts on the group would double-touch)
+        planes: Dict[Tuple, object] = {}
+        attempted: set = set()
         for i, (ctx, rt) in enumerate(zip(ctxs, resolved)):
             if rt is None or ctx.format != "png":
                 continue
@@ -418,14 +439,12 @@ class TilePipeline:
             size_x, size_y = rt.buffer.level_size(rt.level)
             if rt.x + bw > size_x or rt.y + bh > size_y:
                 continue  # edge lane: host path keeps filter semantics
-            key = (
-                rt.meta.image_id, rt.level, ctx.z, ctx.c, ctx.t,
-                bh, bw, meta_dtype.str,
-            )
-            if key not in handles:
-                if key in attempted:
+            plane_key = (rt.meta.image_id, rt.level, ctx.z, ctx.c, ctx.t)
+            key = plane_key + (bh, bw, meta_dtype.str)
+            if plane_key not in planes:
+                if plane_key in attempted:
                     continue  # cold this batch; later lanes stay host
-                attempted.add(key)
+                attempted.add(plane_key)
                 try:
                     plane = self._plane_cache.get_plane(
                         rt.buffer, rt.level, ctx.z, ctx.c, ctx.t
@@ -435,7 +454,8 @@ class TilePipeline:
                     plane = None
                 if plane is None:
                     continue
-                handles[key] = plane
+                planes[plane_key] = plane
+            handles[key] = planes[plane_key]
             groups.setdefault(key, []).append(i)
         return groups, handles
 

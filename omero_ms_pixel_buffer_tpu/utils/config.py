@@ -79,6 +79,9 @@ class BackendConfig:
     mesh_axes: tuple = ("data",)
     batching: BatchingConfig = dataclasses.field(default_factory=BatchingConfig)
     png: PngConfig = dataclasses.field(default_factory=PngConfig)
+    # Per-request allocation guard (MiB); 0 disables. The reference
+    # allocates w*h*bpp unchecked (TileRequestHandler.java:98-103).
+    max_tile_mb: int = 256
 
 
 @dataclasses.dataclass
@@ -175,6 +178,7 @@ class Config:
                 level=int(png_raw.get("level", 6)),
                 strategy=png_raw.get("strategy", "fast"),
             ),
+            max_tile_mb=int(be_raw.get("max-tile-mb", 256)),
         )
         log_raw = raw.get("logging") or {}
         return cls(

@@ -142,3 +142,16 @@ def test_admission_counter_resets_after_staging(image):
     cache._planes.clear()
     cache._bytes = 0
     assert cache.get_plane(buf, 0, 0, 0, 0) is None  # touch 1 again
+
+
+def test_admission_one_touch_across_buckets(image):
+    """Two buckets of one cold plane in one batch still count a single
+    admission touch."""
+    service, _ = image
+    pipe = TilePipeline(
+        service, engine="device", use_pallas=False, buckets=(256, 512),
+    )
+    batch = [_ctx(0, 0, 256, 256), _ctx(0, 0, 400, 400)]  # two buckets
+    out1 = pipe.handle_batch(list(batch))
+    assert all(o is not None for o in out1)
+    assert len(pipe._plane_cache) == 0  # one touch -> still cold

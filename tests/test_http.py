@@ -263,3 +263,101 @@ class TestRgbImage:
             assert r3.status == 404
 
         loop.run_until_complete(run())
+
+
+class TestFloatImage:
+    """float32 pixels: raw and TIFF serve; PNG has no float -> 404
+    (the reference's encode-failure -> null -> 404 path)."""
+
+    @pytest.fixture
+    def float_client(self, tmp_path, loop):
+        data = rng.normal(0, 1, (1, 1, 1, 32, 40)).astype(np.float32)
+        write_ome_tiff(str(tmp_path / "f.ome.tiff"), data)
+        registry = ImageRegistry()
+        registry.add(1, str(tmp_path / "f.ome.tiff"))
+        store = MemorySessionStore({"cookie-1": "omero-key-1"})
+        config = Config.from_dict({"session-store": {"type": "memory"}})
+        app_obj = PixelBufferApp(
+            config, pixels_service=PixelsService(registry),
+            session_store=store,
+        )
+        client = TestClient(TestServer(app_obj.make_app()), loop=loop)
+        loop.run_until_complete(client.start_server())
+        yield client, data[0, 0, 0]
+        loop.run_until_complete(client.close())
+
+    def test_float_formats(self, float_client, loop):
+        client, truth = float_client
+
+        async def run():
+            r = await client.get("/tile/1/0/0/0?w=0&h=0", headers=AUTH)
+            assert r.status == 200
+            raw = np.frombuffer(await r.read(), dtype=">f4").reshape(32, 40)
+            np.testing.assert_array_equal(
+                raw.astype(np.float32), truth
+            )
+            r2 = await client.get(
+                "/tile/1/0/0/0?w=0&h=0&format=tif", headers=AUTH
+            )
+            assert r2.status == 200
+            tif = np.array(Image.open(io.BytesIO(await r2.read())))
+            np.testing.assert_array_equal(tif, truth)
+            r3 = await client.get(
+                "/tile/1/0/0/0?w=8&h=8&format=png", headers=AUTH
+            )
+            assert r3.status == 404  # no float PNG
+
+        loop.run_until_complete(run())
+
+
+class TestGuardsAndFuzz:
+    def test_oversized_tile_404(self, tmp_path, loop):
+        data = np.zeros((1, 1, 1, 64, 64), np.uint16)
+        write_ome_tiff(str(tmp_path / "g.ome.tiff"), data)
+        registry = ImageRegistry()
+        registry.add(1, str(tmp_path / "g.ome.tiff"))
+        store = MemorySessionStore({"cookie-1": "omero-key-1"})
+        config = Config.from_dict(
+            {"session-store": {"type": "memory"},
+             "backend": {"max-tile-mb": 0}}  # disabled -> full plane OK
+        )
+        assert config.backend.max_tile_mb == 0
+        from omero_ms_pixel_buffer_tpu.models.tile_pipeline import (
+            TilePipeline,
+        )
+
+        pipe = TilePipeline(
+            PixelsService(registry), engine="host", max_tile_bytes=1024
+        )
+        from omero_ms_pixel_buffer_tpu.tile_ctx import RegionDef, TileCtx
+
+        big = TileCtx(
+            image_id=1, z=0, c=0, t=0, region=RegionDef(0, 0, 0, 0),
+            format=None, omero_session_key="k",
+        )  # full plane = 8 KiB > 1 KiB guard
+        assert pipe.handle(big) is None  # -> 404 via broad catch
+        small = TileCtx(
+            image_id=1, z=0, c=0, t=0, region=RegionDef(0, 0, 16, 16),
+            format=None, omero_session_key="k",
+        )
+        assert pipe.handle(small) is not None
+
+    def test_param_fuzz_never_500(self, client, loop):
+        """Garbage params must map to 4xx/404, never 500."""
+        cases = [
+            "/tile/1/0/0/0?x=-5&y=0&w=8&h=8",
+            "/tile/1/0/0/0?w=1e9&h=2",
+            "/tile/1/0/0/0?resolution=-1&w=8&h=8",
+            "/tile/1/0/0/0?resolution=99&w=8&h=8",
+            "/tile/1/zz/0/0?w=8&h=8",
+            "/tile/1/0/0/0?w=8&h=8&format=bmp",
+            "/tile/99999999999999999999/0/0/0?w=8&h=8",
+            "/tile/1/0/0/0?x=999999&y=999999&w=8&h=8",
+        ]
+
+        async def run():
+            for path in cases:
+                r = await client.get(path, headers=AUTH)
+                assert 400 <= r.status < 500, (path, r.status)
+
+        loop.run_until_complete(run())
