@@ -54,6 +54,19 @@ _TYPE_SIZES = {1: 1, 2: 1, 3: 2, 4: 4, 5: 8, 6: 1, 7: 1, 8: 2, 9: 4,
                10: 8, 11: 4, 12: 8, 16: 8, 17: 8, 18: 8}
 _TYPE_FMT = {1: "B", 3: "H", 4: "I", 16: "Q"}
 
+import collections  # noqa: E402
+
+# Classic vs BigTIFF structural layout, shared by reader and writer:
+# entry-count field format/width, IFD entry width, inline-value width,
+# offset format, and the TIFF type used for offset/count arrays.
+_Flavor = collections.namedtuple(
+    "_Flavor", "cnt_fmt cnt_len entry_len inline off_fmt off_typ"
+)
+_TIFF_FLAVORS = {
+    False: _Flavor("H", 2, 12, 4, "I", 4),    # classic, magic 42
+    True: _Flavor("Q", 8, 20, 8, "Q", 16),    # BigTIFF, magic 43
+}
+
 
 class TiffError(ValueError):
     pass
@@ -96,40 +109,67 @@ def _parse_ifds(data: bytes) -> Tuple[str, List[_Ifd]]:
         raise TiffError("Not a TIFF file")
     try:
         return _parse_ifds_inner(data, bo)
-    except (struct.error, IndexError) as e:
+    except (struct.error, IndexError, MemoryError, OverflowError) as e:
         raise TiffError(f"Corrupt TIFF structure: {e}") from None
 
 
 def _parse_ifds_inner(data, bo: str) -> Tuple[str, List[_Ifd]]:
+    """Classic TIFF (magic 42, 32-bit offsets, 12-byte entries) and
+    BigTIFF (magic 43, 64-bit offsets, 20-byte entries — whole-slide
+    pyramids routinely exceed classic TIFF's 4 GB address space)."""
     (magic,) = struct.unpack(bo + "H", data[2:4])
-    if magic != 42:
-        raise TiffError("Only classic (non-Big) TIFF supported")
+    if magic == 42:
+        big = False
+        (first_off,) = struct.unpack(bo + "I", data[4:8])
+    elif magic == 43:
+        big = True
+        offsize, reserved = struct.unpack(bo + "HH", data[4:8])
+        if offsize != 8 or reserved != 0:
+            raise TiffError("Malformed BigTIFF header")
+        (first_off,) = struct.unpack(bo + "Q", data[8:16])
+    else:
+        raise TiffError(f"Unknown TIFF magic: {magic}")
+
+    fl = _TIFF_FLAVORS[big]
 
     def parse_one(off: int) -> Tuple[_Ifd, int]:
-        (n,) = struct.unpack(bo + "H", data[off : off + 2])
+        (n,) = struct.unpack(bo + fl.cnt_fmt, data[off : off + fl.cnt_len])
+        if n > 65536:  # corrupt 64-bit entry count must not spin
+            raise TiffError(f"IFD claims {n} entries")
         tags: Dict[int, list] = {}
         for i in range(n):
-            eo = off + 2 + 12 * i
-            tag, typ, count = struct.unpack(bo + "HHI", data[eo : eo + 8])
+            eo = off + fl.cnt_len + fl.entry_len * i
+            tag, typ = struct.unpack(bo + "HH", data[eo : eo + 4])
+            (count,) = struct.unpack(
+                bo + fl.off_fmt, data[eo + 4 : eo + 4 + fl.inline]
+            )
             size = _TYPE_SIZES.get(typ, 1) * count
-            raw = data[eo + 8 : eo + 12]
-            if size > 4:
-                (ptr,) = struct.unpack(bo + "I", raw)
+            if size > len(data):
+                # a (corrupt) 64-bit count must never drive allocation
+                raise TiffError(
+                    f"Tag {tag} claims {size} value bytes in a "
+                    f"{len(data)}-byte file"
+                )
+            val_off = eo + 4 + fl.inline
+            raw = data[val_off : val_off + fl.inline]
+            if size > fl.inline:
+                (ptr,) = struct.unpack(bo + fl.off_fmt, raw)
                 raw = data[ptr : ptr + size]
             else:
                 raw = raw[:size]
             if typ in _TYPE_FMT:
+                # repeat-count form allocates O(1) and bounds-checks
                 tags[tag] = list(
-                    struct.unpack(bo + _TYPE_FMT[typ] * count, raw)
+                    struct.unpack(bo + f"{count}{_TYPE_FMT[typ]}", raw)
                 )
             elif typ == 2:  # ASCII
                 tags[tag] = [raw.rstrip(b"\x00").decode("utf-8", "replace")]
+        nxt_off = off + fl.cnt_len + fl.entry_len * n
         (nxt,) = struct.unpack(
-            bo + "I", data[off + 2 + 12 * n : off + 6 + 12 * n]
+            bo + fl.off_fmt, data[nxt_off : nxt_off + fl.inline]
         )
         return _Ifd(tags), nxt
 
-    (first_off,) = struct.unpack(bo + "I", data[4:8])
     ifds: List[_Ifd] = []
     off = first_off
     while off:
@@ -609,10 +649,17 @@ def write_ome_tiff(
     pyramid_levels: int = 1,
     compression: Optional[str] = None,  # None | "zlib"
     big_endian: bool = True,
+    bigtiff: bool = False,
 ) -> None:
     """Write 5D TCZYX (or 6D TCZYXS for RGB, S=3) data as a (pyramidal)
     OME-TIFF: planes in XYCZT page order, pyramid levels as SubIFDs,
-    tiled storage."""
+    tiled storage. ``bigtiff`` emits the 64-bit-offset layout
+    (magic 43) used by whole-slide pyramids past 4 GB.
+
+    The writer assembles the file in memory (it exists for fixtures
+    and exports); writing an actual multi-GB slide needs RAM to match.
+    The READER is the production surface and mmaps files of any size.
+    """
     if data.ndim == 6:
         if data.shape[5] != 3:
             raise TiffError("6D input must be TCZYXS with S=3 (RGB)")
@@ -641,8 +688,16 @@ def write_ome_tiff(
         + "<TiffData/></Pixels></Image></OME>"
     )
 
+    fl = _TIFF_FLAVORS[bigtiff]
+    cnt_fmt, cnt_len, entry_len = fl.cnt_fmt, fl.cnt_len, fl.entry_len
+    inline, off_fmt, off_typ = fl.inline, fl.off_fmt, fl.off_typ
+
     buf = bytearray()
-    buf += (b"MM\x00*" if big_endian else b"II*\x00") + b"\x00" * 4
+    if bigtiff:
+        buf += b"MM\x00+" if big_endian else b"II+\x00"
+        buf += struct.pack(bo + "HH", 8, 0) + b"\x00" * 8  # ifd0 ptr @8
+    else:
+        buf += (b"MM\x00*" if big_endian else b"II*\x00") + b"\x00" * 4
 
     def pack(fmt, *vals):
         return struct.pack(bo + fmt, *vals)
@@ -700,17 +755,26 @@ def write_ome_tiff(
         if tile_size:
             entries.append((_T["TILE_WIDTH"], 3, 1, [tile_size[0]]))
             entries.append((_T["TILE_LENGTH"], 3, 1, [tile_size[1]]))
-            entries.append((_T["TILE_OFFSETS"], 4, len(offsets), offsets))
-            entries.append((_T["TILE_COUNTS"], 4, len(counts), counts))
+            entries.append(
+                (_T["TILE_OFFSETS"], off_typ, len(offsets), offsets)
+            )
+            entries.append(
+                (_T["TILE_COUNTS"], off_typ, len(counts), counts)
+            )
         else:
-            entries.append((_T["STRIP_OFFSETS"], 4, len(offsets), offsets))
+            entries.append(
+                (_T["STRIP_OFFSETS"], off_typ, len(offsets), offsets)
+            )
             entries.append((_T["ROWS_PER_STRIP"], 4, 1, [h]))
-            entries.append((_T["STRIP_COUNTS"], 4, len(counts), counts))
+            entries.append(
+                (_T["STRIP_COUNTS"], off_typ, len(counts), counts)
+            )
         entries.append((_T["SAMPLES"], 3, 1, [samples]))
         entries.append((_T["SAMPLE_FORMAT"], 3, samples, [kind_fmt] * samples))
         if sub_ifd_offsets:
             entries.append(
-                (_T["SUB_IFDS"], 4, len(sub_ifd_offsets), sub_ifd_offsets)
+                (_T["SUB_IFDS"], off_typ, len(sub_ifd_offsets),
+                 sub_ifd_offsets)
             )
         entries.sort(key=lambda e: e[0])
 
@@ -722,20 +786,20 @@ def write_ome_tiff(
             else:
                 fmt = _TYPE_FMT[typ]
                 raw = b"".join(pack(fmt, v) for v in values)
-            if len(raw) <= 4:
-                fields.append(raw + b"\x00" * (4 - len(raw)))
+            if len(raw) <= inline:
+                fields.append(raw + b"\x00" * (inline - len(raw)))
             else:
                 if len(buf) % 2:
                     buf.extend(b"\x00")
-                fields.append(pack("I", len(buf)))
+                fields.append(pack(off_fmt, len(buf)))
                 buf.extend(raw)
         if len(buf) % 2:
             buf.extend(b"\x00")
         ifd_off = len(buf)
-        buf.extend(pack("H", len(entries)))
+        buf.extend(pack(cnt_fmt, len(entries)))
         for (tag, typ, count, _), field in zip(entries, fields):
-            buf.extend(pack("HHI", tag, typ, count) + field)
-        buf.extend(pack("I", 0))  # next pointer (patched when chaining)
+            buf.extend(pack("HH", tag, typ) + pack(off_fmt, count) + field)
+        buf.extend(pack(off_fmt, 0))  # next pointer (patched at chaining)
         return ifd_off
 
     main_offsets = []
@@ -759,11 +823,13 @@ def write_ome_tiff(
                 first = False
 
     # chain main IFDs
-    struct.pack_into(bo + "I", buf, 4, main_offsets[0])
+    struct.pack_into(bo + off_fmt, buf, 8 if bigtiff else 4, main_offsets[0])
     for prev, nxt in zip(main_offsets, main_offsets[1:]):
         # next-pointer sits after the entry table of prev
-        (n,) = struct.unpack_from(bo + "H", buf, prev)
-        struct.pack_into(bo + "I", buf, prev + 2 + 12 * n, nxt)
+        (n,) = struct.unpack_from(bo + cnt_fmt, buf, prev)
+        struct.pack_into(
+            bo + off_fmt, buf, prev + cnt_len + entry_len * n, nxt
+        )
 
     with open(path, "wb") as f:
         f.write(buf)
