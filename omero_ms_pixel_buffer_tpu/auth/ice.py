@@ -223,7 +223,11 @@ class IceSessionValidator(SessionValidator):
     Validated keys are cached for ``cache_ttl_s`` so a viewport pan
     issuing hundreds of tiles doesn't pay one TLS handshake + router
     session per tile; denials are NOT cached (a session created between
-    two requests must validate immediately)."""
+    two requests must validate immediately). ``cache_ttl_s=0`` disables
+    caching AND request merging entirely — every request performs its
+    own Glacier2 join, exactly the reference's per-request OmeroRequest
+    behavior (PixelBufferVerticle.java:106-110); config key
+    ``omero.session-validation-ttl``."""
 
     def __init__(
         self, host: str, port: int = 4064, secure: bool = False,
@@ -255,6 +259,12 @@ class IceSessionValidator(SessionValidator):
     async def validate(self, omero_session_key: Optional[str]) -> bool:
         if not omero_session_key:
             return False
+        if self._cache_ttl_s <= 0:
+            # strict per-request join parity: no cache, no merging
+            joined, _reason = await self._client.create_session(
+                omero_session_key, omero_session_key
+            )
+            return joined
         expiry = self._valid_until.get(omero_session_key)
         if expiry is not None and expiry > time.monotonic():
             return True

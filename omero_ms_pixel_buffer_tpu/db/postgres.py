@@ -19,6 +19,7 @@ import asyncio
 import base64
 import hashlib
 import hmac
+import logging
 import os
 import struct
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -115,6 +116,21 @@ def parse_dsn(uri: str) -> Dict[str, Optional[str]]:
     }
 
 
+_plaintext_warned: set = set()
+
+
+def _warn_plaintext_once(host: str) -> None:
+    if host in _plaintext_warned:
+        return
+    _plaintext_warned.add(host)
+    logging.getLogger("omero_ms_pixel_buffer_tpu.db.postgres").warning(
+        "connecting to postgres at %s WITHOUT TLS (this client is "
+        "plaintext-only); credentials and session keys are visible "
+        "on the wire — front it with a TLS-terminating proxy or "
+        "keep it on a trusted network", host,
+    )
+
+
 class PostgresClient:
     """One connection, extended-query only, text results.
 
@@ -161,6 +177,13 @@ class PostgresClient:
     # -- connect / auth ----------------------------------------------------
 
     async def connect(self) -> None:
+        if self.host not in ("localhost", "127.0.0.1", "::1"):
+            # libpq's default sslmode=prefer would negotiate TLS here;
+            # this client can't, so session keys and query results
+            # transit cleartext — say so once instead of degrading
+            # silently (sslmode=require already hard-errors in
+            # parse_dsn; sslmode=disable records operator intent).
+            _warn_plaintext_once(self.host)
         self._reader, self._writer = await asyncio.open_connection(
             self.host, self.port
         )

@@ -86,11 +86,18 @@ async def tracing_middleware(request: web.Request, handler):
                 span.tag("omero.session_key", key)
 
 
-def session_middleware(store: OmeroWebSessionStore):
+def session_middleware(store: OmeroWebSessionStore, synchronicity: str = "async"):
     """OmeroWebSessionRequestHandler analog: resolve the ``sessionid``
     cookie to an OMERO session key; 403 when absent/unknown. /metrics
     and OPTIONS are registered before auth in the reference and stay
-    open here."""
+    open here.
+
+    ``synchronicity`` honors the reference's
+    ``session-store.synchronicity`` key (config.yaml:25-26): ``sync``
+    serializes store lookups through one connection-at-a-time (the
+    blocking-client semantics of the reference's sync store variants),
+    ``async`` lets lookups run concurrently."""
+    lookup_lock = asyncio.Lock() if synchronicity == "sync" else None
 
     @web.middleware
     async def middleware(request: web.Request, handler):
@@ -99,7 +106,11 @@ def session_middleware(store: OmeroWebSessionStore):
         session_id = request.cookies.get("sessionid")
         if not session_id:
             return web.Response(status=403, text="Permission denied")
-        key = await store.get_omero_session_key(session_id)
+        if lookup_lock is not None:
+            async with lookup_lock:
+                key = await store.get_omero_session_key(session_id)
+        else:
+            key = await store.get_omero_session_key(session_id)
         if not key:
             return web.Response(status=403, text="Permission denied")
         request["omero.session_key"] = key
@@ -162,6 +173,7 @@ class PixelBufferApp:
                     config.omero_host, config.omero_port,
                     secure=config.omero_secure,
                     verify_tls=config.omero_verify_tls,
+                    cache_ttl_s=config.omero_session_validation_ttl_s,
                 )
             else:
                 session_validator = AllowListValidator()
@@ -209,7 +221,10 @@ class PixelBufferApp:
         app = web.Application(
             middlewares=[
                 tracing_middleware,
-                session_middleware(self.session_store),
+                session_middleware(
+                    self.session_store,
+                    self.config.session_store.synchronicity,
+                ),
             ]
         )
         app.router.add_get("/metrics", handle_metrics)

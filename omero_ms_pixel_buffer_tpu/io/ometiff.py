@@ -27,7 +27,7 @@ import hashlib
 import logging
 import mmap
 import os
-import pickle
+import json
 import re
 import struct
 import zlib
@@ -347,21 +347,45 @@ def _memo_stamp(path: str):
     return (st.st_mtime_ns, st.st_size)
 
 
+def _memo_tags_to_json(tags: Dict[int, list]) -> dict:
+    return {str(k): v for k, v in tags.items()}
+
+
+def _memo_tags_from_json(obj: dict) -> Dict[int, list]:
+    tags: Dict[int, list] = {}
+    for k, v in obj.items():
+        if not isinstance(v, list):
+            raise ValueError("tag values must be lists")
+        for item in v:
+            if not isinstance(item, (int, str)):
+                raise ValueError("tag values must be int/str")
+        tags[int(k)] = v
+    return tags
+
+
 def _memo_load(path: str, memo_dir: str):
     """(byteorder, ifds) from the memo cache, or None. The memo dir is
     service-owned state (like the Bio-Formats Memoizer's .bfmemo
     files); a memo whose recorded mtime/size don't match the file is
-    stale and ignored."""
-    memo = os.path.join(memo_dir, _memo_key(path) + ".ifd.pkl")
+    stale and ignored. The format is JSON, not pickle: loading a memo
+    must never execute code, even if the memo dir is writable by
+    others (same posture as auth/django.py's non-resolving unpickler).
+    """
+    memo = os.path.join(memo_dir, _memo_key(path) + ".ifd.json")
     try:
         with open(memo, "rb") as f:
-            stamp, bo, dumped = pickle.load(f)
-        if tuple(stamp) != _memo_stamp(path):
-            return None  # image was rewritten
+            doc = json.load(f)
+        if doc.get("v") != 1 or tuple(doc["stamp"]) != _memo_stamp(path):
+            return None  # image was rewritten (or format drifted)
+        bo = doc["bo"]
+        if bo not in ("<", ">"):
+            return None
         ifds = []
-        for tags, sub_tags in dumped:
-            ifd = _Ifd(tags)
-            ifd.sub_ifds = [_Ifd(t) for t in sub_tags]
+        for entry in doc["ifds"]:
+            ifd = _Ifd(_memo_tags_from_json(entry["tags"]))
+            ifd.sub_ifds = [
+                _Ifd(_memo_tags_from_json(t)) for t in entry["sub"]
+            ]
             ifds.append(ifd)
         return bo, ifds
     except Exception:
@@ -372,23 +396,31 @@ def _memo_load(path: str, memo_dir: str):
 
 def _memo_save(path: str, memo_dir: str, bo: str, ifds) -> None:
     try:
-        os.makedirs(memo_dir, exist_ok=True)
-        dumped = [
-            (ifd.tags, [s.tags for s in getattr(ifd, "sub_ifds", [])])
-            for ifd in ifds
-        ]
-        memo = os.path.join(memo_dir, _memo_key(path) + ".ifd.pkl")
+        os.makedirs(memo_dir, mode=0o700, exist_ok=True)
+        doc = {
+            "v": 1,
+            "stamp": list(_memo_stamp(path)),
+            "bo": bo,
+            "ifds": [
+                {
+                    "tags": _memo_tags_to_json(ifd.tags),
+                    "sub": [
+                        _memo_tags_to_json(s.tags)
+                        for s in getattr(ifd, "sub_ifds", [])
+                    ],
+                }
+                for ifd in ifds
+            ],
+        }
+        memo = os.path.join(memo_dir, _memo_key(path) + ".ifd.json")
         # unique tmp per writer (two threads can race the first open
         # of one image); os.replace keeps publication atomic
         import tempfile
 
         fd, tmp = tempfile.mkstemp(dir=memo_dir, suffix=".tmp")
         try:
-            with os.fdopen(fd, "wb") as f:
-                pickle.dump(
-                    (_memo_stamp(path), bo, dumped), f,
-                    protocol=pickle.HIGHEST_PROTOCOL,
-                )
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(doc, f, separators=(",", ":"))
             os.replace(tmp, memo)
         except BaseException:
             try:
@@ -404,7 +436,7 @@ class OmeTiffPixelBuffer(PixelBuffer):
     """OME-TIFF (optionally pyramidal) as a PixelBuffer.
 
     ``memo_dir`` enables the Bio-Formats-Memoizer-style persistent
-    metadata cache (SURVEY.md §5.4): the parsed IFD chain is pickled
+    metadata cache (SURVEY.md §5.4): the parsed IFD chain is saved as JSON
     next to first use, so re-opening a large pyramid after a restart
     skips the full-structure walk (the reference's memoizer wait bean,
     beanRefContext.xml:20-22).

@@ -76,6 +76,7 @@ class DevicePlaneCache:
         self.admit_after = admit_after
         self._planes: "OrderedDict[tuple, object]" = OrderedDict()
         self._touches: OrderedDict = OrderedDict()  # key -> count
+        self._staging: set = set()  # keys being read/transferred now
         self._bytes = 0
         self._lock = threading.Lock()
         self.hits = 0
@@ -108,28 +109,42 @@ class DevicePlaneCache:
                 while len(self._touches) > 4096:
                     self._touches.popitem(last=False)
                 return None
-        # budget check BEFORE materializing anything: a whole-slide
-        # plane can be tens of GB, and rejecting it must cost nothing
-        size_x, size_y = buffer.level_size(level)
-        nbytes = size_x * size_y * buffer.meta.bytes_per_pixel
-        if self.max_bytes <= 0 or nbytes > self.max_bytes:
-            return None
-        host = buffer.get_tile_at(level, z, c, t, 0, 0, size_x, size_y)
-        if host.dtype.byteorder == ">":
-            # device arrays are native-endian; byteswap once at staging
-            host = host.astype(host.dtype.newbyteorder("="))
-        nbytes = host.nbytes
-        plane = jax.device_put(np.ascontiguousarray(host))
-        with self._lock:
-            existing = self._planes.get(key)
-            if existing is not None:
-                self._planes.move_to_end(key)
-                return existing
-            self._planes[key] = plane
-            self._bytes += nbytes
-            while self._bytes > self.max_bytes and len(self._planes) > 1:
-                _, evicted = self._planes.popitem(last=False)
-                self._bytes -= evicted.nbytes
+            if key in self._staging:
+                # single-flight: another thread is mid-read/transfer of
+                # this multi-hundred-MB plane; duplicating the work
+                # doubles host+HBM pressure for nothing. Followers take
+                # the host path this once.
+                return None
+            self._staging.add(key)
+        plane = None
+        try:
+            # budget check BEFORE materializing anything: a whole-slide
+            # plane can be tens of GB, and rejecting it must cost nothing
+            size_x, size_y = buffer.level_size(level)
+            nbytes = size_x * size_y * buffer.meta.bytes_per_pixel
+            if self.max_bytes <= 0 or nbytes > self.max_bytes:
+                return None
+            host = buffer.get_tile_at(level, z, c, t, 0, 0, size_x, size_y)
+            if host.dtype.byteorder == ">":
+                # device arrays are native-endian; byteswap at staging
+                host = host.astype(host.dtype.newbyteorder("="))
+            nbytes = host.nbytes
+            plane = jax.device_put(np.ascontiguousarray(host))
+        finally:
+            # publish and release the staging claim under ONE lock
+            # acquisition: a gap between them would let a concurrent
+            # thread re-stage the plane this guard exists to dedupe
+            with self._lock:
+                self._staging.discard(key)
+                if plane is not None and key not in self._planes:
+                    self._planes[key] = plane
+                    self._bytes += nbytes
+                    while (
+                        self._bytes > self.max_bytes
+                        and len(self._planes) > 1
+                    ):
+                        _, evicted = self._planes.popitem(last=False)
+                        self._bytes -= evicted.nbytes
         return plane
 
     def crop_batch(

@@ -111,6 +111,12 @@ class Config:
     # self-signed deployments — without verification the join can be
     # spoofed by an on-path attacker.
     omero_verify_tls: bool = True
+    # How long a successful Glacier2 join keeps authorizing a session
+    # key without re-joining. 0 restores the reference's strict
+    # per-request join (PixelBufferVerticle.java:106-110); the >0
+    # default trades up-to-TTL staleness after an OMERO logout for not
+    # paying one TLS handshake + router session per tile of a burst.
+    omero_session_validation_ttl_s: float = 30.0
     omero_server: dict = dataclasses.field(default_factory=dict)
     session_store: SessionStoreConfig = dataclasses.field(
         default_factory=SessionStoreConfig
@@ -145,6 +151,12 @@ class Config:
         if ss.type not in ("redis", "postgres", "memory"):
             raise ConfigError(
                 "Missing/invalid value for 'session-store.type' in config"
+            )
+        if ss.synchronicity not in ("sync", "async"):
+            # accepted-but-ignored config is worse than an error
+            raise ConfigError(
+                "Invalid value for 'session-store.synchronicity': "
+                f"{ss.synchronicity!r} (expected sync|async)"
             )
         tracing = raw.get("http-tracing") or {}
         jmx = raw.get("jmx-metrics") or {}
@@ -197,6 +209,9 @@ class Config:
             ),
             omero_secure=bool(omero.get("secure", True)),
             omero_verify_tls=bool(omero.get("verify-tls", True)),
+            omero_session_validation_ttl_s=float(
+                omero.get("session-validation-ttl", 30.0)
+            ),
             omero_server=dict(raw.get("omero.server") or {}),
             session_store=ss,
             http_tracing_enabled=bool(tracing.get("enabled", False)),

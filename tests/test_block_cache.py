@@ -206,7 +206,7 @@ class TestMemoizer:
         import os
 
         memos = os.listdir(memo_dir)
-        assert len(memos) == 1 and memos[0].endswith(".ifd.pkl")
+        assert len(memos) == 1 and memos[0].endswith(".ifd.json")
 
         # second open must come from the memo: break the parser to prove
         from omero_ms_pixel_buffer_tpu.io import ometiff as mod
@@ -239,7 +239,7 @@ class TestMemoizer:
         memo_dir.mkdir()
         from omero_ms_pixel_buffer_tpu.io.ometiff import _memo_key
 
-        (memo_dir / (_memo_key(path) + ".ifd.pkl")).write_bytes(b"garbage")
+        (memo_dir / (_memo_key(path) + ".ifd.json")).write_bytes(b"garbage")
         buf = OmeTiffPixelBuffer(path, memo_dir=str(memo_dir))
         tile = buf.get_tile_at(0, 0, 0, 0, 0, 0, 64, 64)
         np.testing.assert_array_equal(tile, truth[:64, :64])

@@ -106,3 +106,27 @@ def test_logging_block_and_shipped_config(tmp_path):
     assert handler.backupCount == 3
     # restore stdout logging for the rest of the suite
     configure_logging(type(cfg2.logging)())
+
+
+def test_invalid_synchronicity_is_hard_error():
+    import pytest
+
+    from omero_ms_pixel_buffer_tpu.utils.config import Config, ConfigError
+
+    with pytest.raises(ConfigError, match="synchronicity"):
+        Config.from_dict({
+            "session-store": {"type": "memory", "synchronicity": "later"}
+        })
+
+
+def test_session_validation_ttl_parsed():
+    from omero_ms_pixel_buffer_tpu.utils.config import Config
+
+    cfg = Config.from_dict({
+        "session-store": {"type": "memory"},
+        "omero": {"session-validation-ttl": 0},
+    })
+    assert cfg.omero_session_validation_ttl_s == 0.0
+    # default preserves the burst-friendly cache
+    cfg2 = Config.from_dict({"session-store": {"type": "memory"}})
+    assert cfg2.omero_session_validation_ttl_s == 30.0

@@ -155,3 +155,48 @@ def test_admission_one_touch_across_buckets(image):
     out1 = pipe.handle_batch(list(batch))
     assert all(o is not None for o in out1)
     assert len(pipe._plane_cache) == 0  # one touch -> still cold
+
+
+def test_staging_single_flight(image):
+    """Two threads passing admission concurrently stage the plane ONCE;
+    the follower falls back to the host path instead of duplicating a
+    full-plane read + transfer (ADVICE r1)."""
+    import threading
+
+    from omero_ms_pixel_buffer_tpu.models.device_cache import DevicePlaneCache
+
+    service, _ = image
+    buf = service.get_pixel_buffer(1)
+
+    started = threading.Event()
+    release = threading.Event()
+    reads = []
+    real_get = buf.get_tile_at
+
+    def slow_get(level, z, c, t, x, y, w, h):
+        reads.append((level, z, c, t))
+        started.set()
+        release.wait(5)
+        return real_get(level, z, c, t, x, y, w, h)
+
+    buf.get_tile_at = slow_get
+    try:
+        cache = DevicePlaneCache(admit_after=1)
+        results = {}
+
+        def leader():
+            results["leader"] = cache.get_plane(buf, 0, 0, 0, 0)
+
+        t1 = threading.Thread(target=leader)
+        t1.start()
+        assert started.wait(5)
+        # leader is mid-read; a follower must get None, not a 2nd read
+        assert cache.get_plane(buf, 0, 0, 0, 0) is None
+        release.set()
+        t1.join(10)
+        assert results["leader"] is not None
+        assert len([r for r in reads]) == 1
+        # once staged, followers hit the resident plane
+        assert cache.get_plane(buf, 0, 0, 0, 0) is not None
+    finally:
+        buf.get_tile_at = real_get

@@ -243,3 +243,17 @@ def test_single_flight_survives_waiter_cancellation(loop):
             assert len(g.requests) == 1
 
     loop.run_until_complete(run())
+
+
+def test_validator_ttl_zero_joins_per_request(loop):
+    """cache_ttl_s=0 restores the reference's per-request Glacier2 join
+    (PixelBufferVerticle.java:106-110): no caching, no merging."""
+
+    async def run():
+        async with FakeGlacier2(valid_keys={"k"}) as g:
+            v = IceSessionValidator("127.0.0.1", g.port, cache_ttl_s=0)
+            assert await v.validate("k")
+            assert await v.validate("k")
+            assert len(g.requests) == 2
+
+    loop.run_until_complete(run())
