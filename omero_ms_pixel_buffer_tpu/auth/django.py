@@ -12,12 +12,22 @@ The connector is an ``omeroweb.connector.Connector`` instance — a class
 this process doesn't have — so unpickling uses a tolerant Unpickler
 that materializes unknown classes as attribute bags, then pulls
 ``omero_session_key`` out of the connector.
+
+Django >= 3.1 defaults to the signed-JSON encoding instead
+(``django.core.signing.dumps``): ``[.]urlsafe-b64(json or
+zlib(json)) ":" base62-timestamp ":" hmac-signature``. A current
+OMERO.web deployment stores sessions in that layout, so it is decoded
+here too. The signature is NOT verified — this process has no Django
+``SECRET_KEY``, and the reference's stores likewise treat the session
+backend itself (Redis/Postgres reachable only by the deployment) as
+the trust boundary.
 """
 
 from __future__ import annotations
 
 import base64
 import io
+import json
 import pickle
 import zlib
 from typing import Any, Optional
@@ -54,10 +64,43 @@ def _loads(data: bytes) -> Any:
     return _TolerantUnpickler(io.BytesIO(data)).load()
 
 
+def _decode_signed_json(payload: bytes) -> Optional[dict]:
+    """django.core.signing.dumps layout (TimestampSigner.sign_object,
+    the Django >= 3.1 session default): exactly three ":"-separated
+    segments — ``[.]urlsafe-b64-payload : base62-timestamp :
+    signature`` (the base64 alphabet cannot contain ":"). A leading "."
+    on the payload marks zlib compression (sign_object's compress=True,
+    which SessionBase.encode always passes)."""
+    try:
+        text = payload.decode("ascii").strip()
+    except UnicodeDecodeError:
+        return None
+    parts = text.split(":")
+    if len(parts) != 3 or not parts[0]:
+        return None
+    data = parts[0]
+    is_compressed = data.startswith(".")
+    if is_compressed:
+        data = data[1:]
+    try:
+        raw = base64.urlsafe_b64decode(data + "=" * (-len(data) % 4))
+        if is_compressed:
+            raw = zlib.decompress(raw)
+        obj = json.loads(raw.decode("utf-8"))
+    except Exception:
+        return None
+    return obj if isinstance(obj, dict) else None
+
+
 def decode_session_payload(payload: bytes) -> Optional[dict]:
     """Decode a Django session payload into the session dict. Handles:
-    raw pickle, zlib pickle, and base64("hash:pickle") legacy layouts.
-    Returns None when nothing decodes."""
+    raw pickle, zlib pickle, base64("hash:pickle") legacy layouts, the
+    signed-JSON layout (Django >= 3.1 default), and bare JSON (cache
+    backends configured with the JSONSerializer). Returns None when
+    nothing decodes."""
+    signed = _decode_signed_json(payload)
+    if signed is not None:
+        return signed
     candidates = [payload]
     try:
         candidates.append(zlib.decompress(payload))
@@ -73,6 +116,12 @@ def decode_session_payload(payload: bytes) -> Optional[dict]:
     for cand in candidates:
         try:
             obj = _loads(cand)
+        except Exception:
+            obj = None
+        if isinstance(obj, dict):
+            return obj
+        try:
+            obj = json.loads(cand.decode("utf-8"))
         except Exception:
             continue
         if isinstance(obj, dict):

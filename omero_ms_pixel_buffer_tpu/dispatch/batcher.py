@@ -11,6 +11,12 @@ latency under load drops because the TPU amortizes; a lone request
 still flushes after the window (2 ms default), keeping p50 low at low
 concurrency.
 
+Batches themselves are pipelined: up to ``workers`` batches execute
+concurrently on the executor (default 2 x CPUs — the reference's
+worker_pool_size default, PixelBufferMicroserviceVerticle.java:117-118),
+so batch N's host deflate overlaps batch N+1's reads and device
+filtering instead of serializing behind it.
+
 Worker semantics preserved from PixelBufferVerticle.getTile:
 ctx decode failure -> 400 "Illegal tile context"; invalid session ->
 403 "Permission denied"; pipeline None -> 404 "Cannot find Image:<id>";
@@ -20,9 +26,11 @@ reply carries the filename header.
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import contextvars
 import logging
-from typing import Any, List, Optional, Tuple
+import os
+from typing import Any, List, Optional, Set, Tuple
 
 from ..auth.omero_session import SessionValidator
 from ..errors import (
@@ -56,13 +64,28 @@ class BatchingTileWorker:
         max_batch: int = 32,
         coalesce_window_ms: float = 2.0,
         max_queue: int = 4096,
+        workers: Optional[int] = None,
     ):
         self.pipeline = pipeline
         self.session_validator = session_validator
         self.max_batch = max_batch
         self.coalesce_window_ms = coalesce_window_ms
+        # worker_pool_size analog: how many coalesced batches may be in
+        # flight on the executor at once (2 x CPUs default, matching
+        # the reference's worker-verticle instance count)
+        self.workers = max(
+            1, workers if workers is not None else 2 * (os.cpu_count() or 1)
+        )
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_queue)
         self._runner: Optional[asyncio.Task] = None
+        self._inflight: Set[asyncio.Task] = set()
+        # dedicated pool sized to the worker count: the loop's default
+        # executor caps at min(32, cpus+4) threads, which would silently
+        # queue semaphore-admitted batches below the configured bound
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=self.workers,
+            thread_name_prefix="pixel-buffer-pool",  # the named pool
+        )
         self._closed = False
 
     async def start(self) -> None:
@@ -78,12 +101,16 @@ class BatchingTileWorker:
             except asyncio.CancelledError:
                 pass
             self._runner = None
-        # fail queued requests fast instead of letting their handle()
-        # coroutines hang until the bus timeout
+        # fail queued requests FIRST (they haven't started; nothing to
+        # wait for), then let in-flight executor batches finish so
+        # their futures resolve (blocking work can't be cancelled)
         while not self._queue.empty():
             _, fut = self._queue.get_nowait()
             if not fut.done():
                 fut.set_exception(InternalError("Service shutting down"))
+        if self._inflight:
+            await asyncio.gather(*self._inflight, return_exceptions=True)
+        self._executor.shutdown(wait=False)
 
     # -- event-bus handler --------------------------------------------------
 
@@ -139,61 +166,99 @@ class BatchingTileWorker:
 
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
+        sem = asyncio.Semaphore(self.workers)
         while not self._closed:
             ctx, fut = await self._queue.get()
             batch: List[Tuple[TileCtx, asyncio.Future]] = [(ctx, fut)]
-            if self.coalesce_window_ms > 0:
-                deadline = loop.time() + self.coalesce_window_ms / 1000.0
-                while len(batch) < self.max_batch:
-                    remaining = deadline - loop.time()
-                    if remaining <= 0:
-                        break
-                    try:
-                        item = await asyncio.wait_for(
-                            self._queue.get(), timeout=remaining
-                        )
-                    except asyncio.TimeoutError:
-                        break
-                    batch.append(item)
-            else:
-                while len(batch) < self.max_batch and not self._queue.empty():
-                    batch.append(self._queue.get_nowait())
-
-            # drop lanes whose client already gave up (bus timeout
-            # cancelled the future) — no dead work under overload
-            batch = [(c, f) for c, f in batch if not f.done()]
-            if not batch:
-                continue
-            BATCH_SIZE.observe(len(batch))
-            ctxs = [b[0] for b in batch]
-            if len(batch) == 1:
-                work = lambda: [self.pipeline.handle(ctxs[0])]  # noqa: E731
-            else:
-                work = lambda: self.pipeline.handle_batch(ctxs)  # noqa: E731
-            # batch span joins the first lane's trace; entering it before
-            # copy_context() makes it the parent of the pipeline spans
-            # emitted inside the executor thread
-            bspan = TRACER.start_span_with_context(
-                "tile_batch", ctxs[0].trace_context
-            )
-            bspan.__enter__()
-            run_ctx = contextvars.copy_context()
             try:
-                # pipeline work is blocking (I/O + device); keep the
-                # event loop free (the reference's worker-pool move,
-                # PixelBufferMicroserviceVerticle.java:227-233)
-                results = await loop.run_in_executor(
-                    None, lambda: run_ctx.run(work)
-                )
-            except Exception as e:
-                bspan.error(e)
-                log.exception("batch execution failed")
+                await self._coalesce_and_dispatch(batch, loop, sem)
+            except asyncio.CancelledError:
+                # shutdown mid-coalesce: fail the popped-but-undispatched
+                # lanes instead of leaving their awaiters to the bus
+                # timeout
                 for _, f in batch:
                     if not f.done():
-                        f.set_exception(InternalError())
-                continue
-            finally:
-                bspan.__exit__(None, None, None)
-            for (_, f), result in zip(batch, results):
+                        f.set_exception(
+                            InternalError("Service shutting down")
+                        )
+                raise
+
+    async def _coalesce_and_dispatch(
+        self,
+        batch: List[Tuple[TileCtx, asyncio.Future]],
+        loop,
+        sem: asyncio.Semaphore,
+    ) -> None:
+        """Grow ``batch`` (in place, so a cancelled coalesce can fail
+        every popped lane) until the window closes, then hand it to an
+        executor task."""
+        if self.coalesce_window_ms > 0:
+            deadline = loop.time() + self.coalesce_window_ms / 1000.0
+            while len(batch) < self.max_batch:
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    break
+                try:
+                    item = await asyncio.wait_for(
+                        self._queue.get(), timeout=remaining
+                    )
+                except asyncio.TimeoutError:
+                    break
+                batch.append(item)
+        else:
+            while len(batch) < self.max_batch and not self._queue.empty():
+                batch.append(self._queue.get_nowait())
+
+        # drop lanes whose client already gave up (bus timeout
+        # cancelled the future) — no dead work under overload
+        live = [(c, f) for c, f in batch if not f.done()]
+        if not live:
+            return
+        # pipelining: dispatch this batch and immediately go back to
+        # coalescing the next one; the semaphore bounds how many
+        # batches run on the executor at once. Backpressure is the
+        # acquire below — when every worker is busy, coalescing pauses
+        # and the (bounded) queue absorbs the burst.
+        await sem.acquire()
+        task = asyncio.create_task(self._execute(live, loop))
+        self._inflight.add(task)
+        task.add_done_callback(
+            lambda t: (self._inflight.discard(t), sem.release())
+        )
+
+    async def _execute(
+        self, batch: List[Tuple[TileCtx, asyncio.Future]], loop
+    ) -> None:
+        BATCH_SIZE.observe(len(batch))
+        ctxs = [b[0] for b in batch]
+        if len(batch) == 1:
+            work = lambda: [self.pipeline.handle(ctxs[0])]  # noqa: E731
+        else:
+            work = lambda: self.pipeline.handle_batch(ctxs)  # noqa: E731
+        # batch span joins the first lane's trace; entering it before
+        # copy_context() makes it the parent of the pipeline spans
+        # emitted inside the executor thread
+        bspan = TRACER.start_span_with_context(
+            "tile_batch", ctxs[0].trace_context
+        )
+        bspan.__enter__()
+        run_ctx = contextvars.copy_context()
+        try:
+            # pipeline work is blocking (I/O + device); keep the
+            # event loop free (the reference's worker-pool move,
+            # PixelBufferMicroserviceVerticle.java:227-233)
+            results = await loop.run_in_executor(
+                self._executor, lambda: run_ctx.run(work)
+            )
+        except Exception as e:
+            bspan.error(e)
+            log.exception("batch execution failed")
+            for _, f in batch:
                 if not f.done():
-                    f.set_result(result)
+                    f.set_exception(InternalError())
+            return
+        finally:
+            bspan.__exit__(None, None, None)
+        for (_, f), result in zip(batch, results):
+            if not f.done():
+                f.set_result(result)

@@ -203,6 +203,7 @@ class PixelBufferApp:
             self.session_validator,
             max_batch=batching.max_batch,
             coalesce_window_ms=batching.coalesce_window_ms,
+            workers=config.effective_worker_pool_size,
         )
         self.bus = EventBus()
         self.bus.consumer(GET_TILE_EVENT, self.worker.handle)

@@ -136,6 +136,21 @@ class Config:
             return self.worker_pool_size
         return 2 * (os.cpu_count() or 1)
 
+    @staticmethod
+    def _parse_ttl_value(value) -> float:
+        try:
+            ttl = float(value)
+        except (TypeError, ValueError):
+            raise ConfigError(
+                "Invalid value for 'omero.session-validation-ttl': "
+                f"{value!r} (expected seconds; 0 = per-request join)"
+            ) from None
+        if ttl < 0:
+            raise ConfigError(
+                "'omero.session-validation-ttl' must be >= 0"
+            )
+        return ttl
+
     @classmethod
     def from_dict(cls, raw: dict) -> "Config":
         raw = dict(raw or {})
@@ -209,7 +224,7 @@ class Config:
             ),
             omero_secure=bool(omero.get("secure", True)),
             omero_verify_tls=bool(omero.get("verify-tls", True)),
-            omero_session_validation_ttl_s=float(
+            omero_session_validation_ttl_s=cls._parse_ttl_value(
                 omero.get("session-validation-ttl", 30.0)
             ),
             omero_server=dict(raw.get("omero.server") or {}),

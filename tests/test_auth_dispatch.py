@@ -69,6 +69,69 @@ class TestDjangoDecode:
         assert extract_omero_session_key({"other": 1}) is None
 
 
+def _django_signed(obj, compress=True):
+    """Build a payload byte-for-byte like django.core.signing.dumps
+    (TimestampSigner.sign_object): [.]urlsafe-b64(json|zlib(json)) :
+    base62(timestamp) : urlsafe-b64(hmac)."""
+    import hashlib
+    import hmac as hmac_mod
+    import json
+    import zlib as zlib_mod
+
+    data = json.dumps(obj, separators=(",", ":")).encode()
+    is_compressed = False
+    if compress:
+        compressed = zlib_mod.compress(data)
+        if len(compressed) < (len(data) - 1):
+            data = compressed
+            is_compressed = True
+    b64 = base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+    if is_compressed:
+        b64 = "." + b64
+    ts = "1tPqzV"  # base62 timestamp, opaque to the decoder
+    value = f"{b64}:{ts}"
+    sig = base64.urlsafe_b64encode(
+        hmac_mod.new(b"secret", value.encode(), hashlib.sha256).digest()
+    ).rstrip(b"=").decode()
+    return f"{value}:{sig}".encode()
+
+
+class TestDjangoSignedJson:
+    """Django >= 3.1 default session encoding (signing.dumps with the
+    JSONSerializer); a current OMERO.web stores sessions this way."""
+
+    SESSION = {
+        "connector": {
+            "omero_session_key": "sj-77",
+            "server_id": 1,
+            "is_secure": False,
+        },
+        "_auth_user_id": "2",
+    }
+
+    def test_signed_json_compressed(self):
+        payload = _django_signed(dict(self.SESSION, pad="x" * 200))
+        session = decode_session_payload(payload)
+        assert extract_omero_session_key(session) == "sj-77"
+
+    def test_signed_json_uncompressed(self):
+        payload = _django_signed(self.SESSION, compress=False)
+        assert b"." not in payload.split(b":")[0:1][0][:1]
+        session = decode_session_payload(payload)
+        assert extract_omero_session_key(session) == "sj-77"
+
+    def test_bare_json_cache_backend(self):
+        import json
+
+        payload = json.dumps(self.SESSION).encode()
+        session = decode_session_payload(payload)
+        assert extract_omero_session_key(session) == "sj-77"
+
+    def test_signed_garbage_returns_none(self):
+        assert decode_session_payload(b"abc:def:ghi") is None
+        assert decode_session_payload(b"::") is None
+
+
 class TestStores:
     async def test_memory_store(self):
         store = MemorySessionStore({"sid": "key"})

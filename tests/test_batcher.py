@@ -1,0 +1,169 @@
+"""Batch pipelining: up to ``workers`` coalesced batches execute
+concurrently on the executor (the reference's worker_pool_size knob,
+PixelBufferMicroserviceVerticle.java:117-118,224-233), while ordering
+of per-request results and failure isolation across batches hold."""
+
+import asyncio
+import threading
+
+from omero_ms_pixel_buffer_tpu.auth.omero_session import AllowListValidator
+from omero_ms_pixel_buffer_tpu.dispatch.batcher import BatchingTileWorker
+from omero_ms_pixel_buffer_tpu.errors import TileError
+from omero_ms_pixel_buffer_tpu.tile_ctx import RegionDef, TileCtx
+
+
+def _ctx(image_id=1, z=0):
+    return TileCtx(
+        image_id=image_id, z=z, c=0, t=0,
+        region=RegionDef(0, 0, 8, 8), format=None,
+        omero_session_key="k",
+    )
+
+
+class GatedPipeline:
+    """handle() blocks until ``release`` is set; records the maximum
+    number of threads inside handle() at once."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self._lock = threading.Lock()
+        self.active = 0
+        self.max_active = 0
+
+    def _enter(self):
+        with self._lock:
+            self.active += 1
+            self.max_active = max(self.max_active, self.active)
+
+    def _exit(self):
+        with self._lock:
+            self.active -= 1
+
+    def handle(self, ctx):
+        self._enter()
+        try:
+            assert self.release.wait(10)
+            return b"tile-%d-%d" % (ctx.image_id, ctx.z)
+        finally:
+            self._exit()
+
+    def handle_batch(self, ctxs):
+        return [self.handle(c) for c in ctxs]
+
+
+async def _submit(worker, ctxs):
+    await worker.start()
+    return await asyncio.gather(
+        *[worker.handle(c) for c in ctxs], return_exceptions=True
+    )
+
+
+def test_batches_overlap_with_two_workers(loop):
+    """Two single-lane batches must be in the executor simultaneously
+    when workers=2 (batch N+1 no longer serializes behind batch N)."""
+    pipe = GatedPipeline()
+    worker = BatchingTileWorker(
+        pipe, AllowListValidator(), max_batch=1,
+        coalesce_window_ms=0, workers=2,
+    )
+
+    async def run():
+        task = asyncio.ensure_future(
+            _submit(worker, [_ctx(z=0), _ctx(z=1)])
+        )
+        # wait (event-loop friendly) until both batches entered handle()
+        for _ in range(200):
+            if pipe.active >= 2:
+                break
+            await asyncio.sleep(0.02)
+        assert pipe.active == 2, "second batch did not overlap the first"
+        pipe.release.set()
+        results = await asyncio.wait_for(task, 10)
+        assert sorted(r[0] for r in results) == [b"tile-1-0", b"tile-1-1"]
+        await worker.close()
+
+    loop.run_until_complete(run())
+    assert pipe.max_active == 2
+
+
+def test_single_worker_serializes(loop):
+    """workers=1 preserves the strict one-batch-at-a-time behavior."""
+    pipe = GatedPipeline()
+    pipe.release.set()  # no gating; just count concurrency
+    worker = BatchingTileWorker(
+        pipe, AllowListValidator(), max_batch=1,
+        coalesce_window_ms=0, workers=1,
+    )
+
+    async def run():
+        results = await _submit(worker, [_ctx(z=i) for i in range(8)])
+        assert [r[0] for r in results] == [
+            b"tile-1-%d" % i for i in range(8)
+        ]
+        await worker.close()
+
+    loop.run_until_complete(run())
+    assert pipe.max_active == 1
+
+
+def test_failure_isolated_to_its_batch(loop):
+    """A batch whose pipeline call raises fails only its own lanes;
+    concurrent batches still serve."""
+
+    class HalfBroken(GatedPipeline):
+        def handle(self, ctx):
+            if ctx.image_id == 666:
+                raise RuntimeError("boom")
+            return super().handle(ctx)
+
+    pipe = HalfBroken()
+    pipe.release.set()
+    worker = BatchingTileWorker(
+        pipe, AllowListValidator(), max_batch=1,
+        coalesce_window_ms=0, workers=4,
+    )
+
+    async def run():
+        results = await _submit(worker, [_ctx(1), _ctx(666), _ctx(3)])
+        ok = [r for r in results if not isinstance(r, Exception)]
+        bad = [r for r in results if isinstance(r, Exception)]
+        assert len(ok) == 2 and len(bad) == 1
+        assert isinstance(bad[0], TileError) and bad[0].code == 500
+        await worker.close()
+
+    loop.run_until_complete(run())
+
+
+def test_close_fails_pending_cleanly(loop):
+    """close() mid-flight resolves every outstanding future (executor
+    batches finish; queued/coalescing lanes get 500s) — nothing hangs
+    to the bus timeout."""
+    pipe = GatedPipeline()
+    worker = BatchingTileWorker(
+        pipe, AllowListValidator(), max_batch=1,
+        coalesce_window_ms=0, workers=1,
+    )
+
+    async def run():
+        task = asyncio.ensure_future(
+            _submit(worker, [_ctx(z=i) for i in range(4)])
+        )
+        for _ in range(200):
+            if pipe.active >= 1:
+                break
+            await asyncio.sleep(0.02)
+        pipe.release.set()
+        await worker.close()
+        results = await asyncio.wait_for(task, 10)
+        assert all(
+            isinstance(r, (tuple, TileError)) for r in results
+        ), results
+
+    loop.run_until_complete(run())
+
+
+def test_default_workers_is_twice_cpus():
+    import os
+
+    w = BatchingTileWorker(GatedPipeline(), AllowListValidator())
+    assert w.workers == max(1, 2 * (os.cpu_count() or 1))

@@ -130,3 +130,20 @@ def test_session_validation_ttl_parsed():
     # default preserves the burst-friendly cache
     cfg2 = Config.from_dict({"session-store": {"type": "memory"}})
     assert cfg2.omero_session_validation_ttl_s == 30.0
+
+
+def test_invalid_session_validation_ttl_is_hard_error():
+    import pytest
+
+    from omero_ms_pixel_buffer_tpu.utils.config import Config, ConfigError
+
+    with pytest.raises(ConfigError, match="session-validation-ttl"):
+        Config.from_dict({
+            "session-store": {"type": "memory"},
+            "omero": {"session-validation-ttl": "30s"},
+        })
+    with pytest.raises(ConfigError, match="session-validation-ttl"):
+        Config.from_dict({
+            "session-store": {"type": "memory"},
+            "omero": {"session-validation-ttl": -1},
+        })
