@@ -241,11 +241,124 @@ void SwapRowBE(const uint8_t* src, uint8_t* dst, size_t samples,
   }
 }
 
+// ---- TIFF block codecs (LZW, PackBits) --------------------------------
+//
+// TIFF 6.0 §9 (PackBits) and §13 (LZW with the "early change" width
+// bump at 510/1022/2046 that libtiff/Bio-Formats writers use).
+
+bool PackBitsDecode(const uint8_t* in, size_t in_len, uint8_t* out,
+                    size_t cap, size_t* produced) {
+  size_t i = 0, o = 0;
+  while (i < in_len && o < cap) {
+    uint8_t b = in[i++];
+    if (b == 128) continue;  // -128: no-op
+    if (b < 128) {
+      size_t run = static_cast<size_t>(b) + 1;
+      if (i + run > in_len) return false;
+      if (run > cap - o) run = cap - o;
+      std::memcpy(out + o, in + i, run);
+      // advance the input by the full literal even when clamped
+      i += static_cast<size_t>(b) + 1;
+      o += run;
+    } else {
+      size_t run = 257 - static_cast<size_t>(b);
+      if (i >= in_len) return false;
+      if (run > cap - o) run = cap - o;
+      std::memset(out + o, in[i++], run);
+      o += run;
+    }
+  }
+  *produced = o;
+  return true;
+}
+
+// LZW dictionary as a prefix-linked table: entry = (prefix code,
+// suffix byte, depth). Strings materialize by walking the chain
+// backwards — no per-entry allocation, bounded memory (4096 entries).
+bool LzwDecode(const uint8_t* in, size_t in_len, uint8_t* out, size_t cap,
+               size_t* produced) {
+  constexpr int kClear = 256, kEoi = 257, kFirst = 258, kMax = 4096;
+  int16_t prefix[kMax];
+  uint8_t suffix[kMax];
+  uint8_t first_char[kMax];
+  for (int i = 0; i < 256; ++i) {
+    prefix[i] = -1;
+    suffix[i] = static_cast<uint8_t>(i);
+    first_char[i] = static_cast<uint8_t>(i);
+  }
+  int next_code = kFirst;
+  int width = 9;
+  uint32_t bitbuf = 0;
+  int nbits = 0;
+  size_t pos = 0, o = 0;
+  int old_code = -1;
+  uint8_t stack[kMax];
+
+  auto emit = [&](int code) -> bool {  // expand `code` into out
+    size_t depth = 0;
+    for (int c = code; c >= 0; c = prefix[c]) {
+      if (depth >= sizeof(stack)) return false;  // cycle guard
+      stack[depth++] = suffix[c];
+    }
+    while (depth && o < cap) out[o++] = stack[--depth];
+    return true;
+  };
+
+  while (true) {
+    while (nbits < width) {
+      if (pos >= in_len) {
+        // tolerate missing EOI only once output exists
+        *produced = o;
+        return o > 0;
+      }
+      bitbuf = (bitbuf << 8) | in[pos++];
+      nbits += 8;
+    }
+    int code = (bitbuf >> (nbits - width)) & ((1u << width) - 1);
+    nbits -= width;
+    if (code == kEoi) break;
+    if (code == kClear) {
+      next_code = kFirst;
+      width = 9;
+      old_code = -1;
+      continue;
+    }
+    if (old_code < 0) {
+      if (code >= 256) return false;  // must start with a literal
+      if (!emit(code)) return false;
+      old_code = code;
+    } else if (code < next_code && code != kClear && code != kEoi) {
+      if (!emit(code)) return false;
+      if (next_code < kMax) {
+        prefix[next_code] = static_cast<int16_t>(old_code);
+        suffix[next_code] = first_char[code];
+        first_char[next_code] = first_char[old_code];
+        ++next_code;
+      }
+      old_code = code;
+    } else if (code == next_code && next_code < kMax) {
+      prefix[next_code] = static_cast<int16_t>(old_code);
+      suffix[next_code] = first_char[old_code];
+      first_char[next_code] = first_char[old_code];
+      ++next_code;
+      if (!emit(code)) return false;
+      old_code = code;
+    } else {
+      return false;  // code beyond table: corrupt stream
+    }
+    if (o >= cap) break;
+    // early change (libtiff-calibrated): bump at 511/1023/2047
+    if (next_code == (1 << width) - 1 && width < 12) ++width;
+  }
+  *produced = o;
+  return true;
+}
+
 }  // namespace
 
 extern "C" {
 
-int ompb_version() { return 2; }
+int ompb_version() { return 3; }
 
 int ompb_pool_size() { return static_cast<int>(Pool().size()); }
 
@@ -288,6 +401,51 @@ int ompb_inflate_batch(int n, const uint8_t** inputs, const size_t* in_lens,
       failed.compare_exchange_strong(expected, static_cast<int>(i) + 1);
     } else {
       out_lens[i] = dst_len;
+    }
+  });
+  return failed.load();
+}
+
+// N compressed TIFF blocks -> caller-owned buffers, with a per-block
+// codec code: 8 = zlib/deflate, 5 = TIFF LZW (early change), 32773 =
+// PackBits. Mirrors the per-block codec dispatch Bio-Formats does
+// inside ome.io.nio readers (TileRequestHandler.java:104-112 is the
+// consumer). out_lens[i] carries capacity in, decoded length out;
+// a failed lane reports out_lens[i] = 0 (per-lane degradation).
+int ompb_decode_batch(int n, const uint8_t** inputs, const size_t* in_lens,
+                      const int* codecs, uint8_t** outputs,
+                      size_t* out_lens) {
+  std::atomic<int> failed{0};
+  ParallelFor(static_cast<size_t>(n), [&](size_t i) {
+    const uint8_t* in = inputs[i];
+    const size_t in_len = in_lens[i];
+    uint8_t* out = outputs[i];
+    const size_t cap = out_lens[i];
+    bool ok = false;
+    size_t produced = 0;
+    switch (codecs[i]) {
+      case 8: {
+        uLongf dst_len = cap;
+        ok = uncompress(out, &dst_len, in, static_cast<uLong>(in_len)) ==
+             Z_OK;
+        produced = dst_len;
+        break;
+      }
+      case 32773:
+        ok = PackBitsDecode(in, in_len, out, cap, &produced);
+        break;
+      case 5:
+        ok = LzwDecode(in, in_len, out, cap, &produced);
+        break;
+      default:
+        ok = false;
+    }
+    if (!ok) {
+      out_lens[i] = 0;
+      int expected = 0;
+      failed.compare_exchange_strong(expected, static_cast<int>(i) + 1);
+    } else {
+      out_lens[i] = produced;
     }
   });
   return failed.load();

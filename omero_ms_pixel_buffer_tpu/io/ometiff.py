@@ -41,14 +41,21 @@ from .pixel_buffer import (
     PixelsMeta,
     check_bounds,
 )
+from ..ops import codecs as _codecs
 from ..ops.convert import dtype_for, omero_type_for
 from ..ops.tiff import ome_xml_metadata  # single-plane variant
 
 _T = {"WIDTH": 256, "LENGTH": 257, "BITS": 258, "COMPRESSION": 259,
       "PHOTOMETRIC": 262, "DESCRIPTION": 270, "STRIP_OFFSETS": 273,
       "SAMPLES": 277, "ROWS_PER_STRIP": 278, "STRIP_COUNTS": 279,
-      "TILE_WIDTH": 322, "TILE_LENGTH": 323, "TILE_OFFSETS": 324,
-      "TILE_COUNTS": 325, "SUB_IFDS": 330, "SAMPLE_FORMAT": 339}
+      "PREDICTOR": 317, "TILE_WIDTH": 322, "TILE_LENGTH": 323,
+      "TILE_OFFSETS": 324, "TILE_COUNTS": 325, "SUB_IFDS": 330,
+      "SAMPLE_FORMAT": 339}
+
+# TIFF compression codes this reader serves (TileRequestHandler.java:
+# 104-112 reads them through Bio-Formats): 1 none, 5 LZW, 8 deflate,
+# 32773 PackBits. JPEG (7) remains out of scope.
+_SUPPORTED_COMPRESSIONS = (1, 5, 8, 32773)
 
 _TYPE_SIZES = {1: 1, 2: 1, 3: 2, 4: 4, 5: 8, 6: 1, 7: 1, 8: 2, 9: 4,
                10: 8, 11: 4, 12: 8, 16: 8, 17: 8, 18: 8}
@@ -225,8 +232,34 @@ class _LevelReader:
         self.cache = cache
         self.cache_ns = cache_ns
         self.compression = ifd.first("COMPRESSION", 1)
-        if self.compression not in (1, 8):
+        if self.compression not in _SUPPORTED_COMPRESSIONS:
             raise TiffError(f"Unsupported compression: {self.compression}")
+        self.predictor = ifd.first("PREDICTOR", 1)
+        if self.predictor not in (1, 2):
+            raise TiffError(f"Unsupported predictor: {self.predictor}")
+
+    @property
+    def compressed(self) -> bool:
+        return self.compression != 1
+
+    def row_samples(self) -> int:
+        """Samples per decoded-block row (tile width or image width)."""
+        ifd = self.ifd
+        width = ifd.first("TILE_WIDTH") if ifd.tiled else ifd.width
+        return width * self.samples
+
+    def postprocess(self, arr: np.ndarray) -> np.ndarray:
+        """Undo the horizontal-differencing predictor (tag 317 = 2) on
+        freshly decoded block bytes. Cached blocks are post-predictor."""
+        if self.predictor != 2 or not self.compressed:
+            return arr
+        rs = self.row_samples()
+        row_bytes = rs * self.dtype.itemsize
+        usable = (len(arr) // row_bytes) * row_bytes
+        return _codecs.undo_predictor2(
+            arr[:usable], rs, self.dtype.itemsize, self.samples,
+            self.bo,
+        )
 
     # -- block planning ----------------------------------------------------
 
@@ -268,18 +301,30 @@ class _LevelReader:
         # read cost; pay it once per chunk, not once per overlapping
         # tile request (uncompressed blocks are mmap slices — cheap)
         key = (self.cache_ns, id(self.ifd), i)
-        if self.cache is not None and self.compression == 8:
+        if self.cache is not None and self.compressed:
             cached = self.cache.get(key)
             if cached is not None:
                 return cached
-        offset, count, _ = self.block_span(i)
+        offset, count, cap = self.block_span(i)
         raw = self.fh[offset : offset + count]
+        if not self.compressed:
+            return raw
         if self.compression == 8:
-            decoded = np.frombuffer(zlib.decompress(raw), dtype=np.uint8)
-            if self.cache is not None:
-                self.cache[key] = decoded
-            return decoded
-        return raw
+            plain: Optional[bytes] = zlib.decompress(raw)
+        elif self.compression == 5:
+            plain = _codecs.lzw_decode(bytes(raw), cap)
+        else:  # 32773
+            plain = _codecs.packbits_decode(bytes(raw), cap)
+        if plain is None:
+            raise TiffError(
+                f"Corrupt block {i} (compression {self.compression})"
+            )
+        decoded = self.postprocess(
+            np.frombuffer(plain, dtype=np.uint8)
+        )
+        if self.cache is not None:
+            self.cache[key] = decoded
+        return decoded
 
     # -- assembly ----------------------------------------------------------
 
@@ -611,18 +656,19 @@ class OmeTiffPixelBuffer(PixelBuffer):
                 regions[rk] = region
             return self._extract_channel(region, c)
 
-        if engine is None or not any(r.compression == 8 for r in readers):
+        if engine is None or not any(r.compressed for r in readers):
             return [
                 assemble(r, c, x, y, w, h)
                 for r, (_, c, _, x, y, w, h) in zip(readers, coords)
             ]
 
         # plan: dedup compressed blocks across the whole batch, serving
-        # already-decoded blocks from the persistent LRU
+        # already-decoded blocks from the persistent LRU; each span
+        # remembers its codec and owning reader (for the predictor)
         cache = {}
-        spans: Dict[Tuple, Tuple[int, int, int]] = {}
+        spans: Dict[Tuple, Tuple[int, int, int, int, object]] = {}
         for r, (_, _, _, x, y, w, h) in zip(readers, coords):
-            if r.compression != 8:
+            if not r.compressed:
                 continue
             ifd_key = id(r.ifd)
             for bi in r.plan_region(x, y, w, h):
@@ -633,25 +679,28 @@ class OmeTiffPixelBuffer(PixelBuffer):
                 if hit is not None:
                     cache[key] = hit
                 else:
-                    spans[key] = r.block_span(bi)
+                    off, cnt, cap = r.block_span(bi)
+                    spans[key] = (off, cnt, cap, r.compression, r)
 
         keys = list(spans.keys())
         raws = [
             bytes(self.mm[off : off + cnt])
-            for (off, cnt, _) in (spans[k] for k in keys)
+            for (off, cnt, _, _, _) in (spans[k] for k in keys)
         ]
         caps = [spans[k][2] for k in keys]
-        decoded = engine.inflate_batch(raws, caps)
+        codecs = [spans[k][3] for k in keys]
+        decoded = engine.decode_batch(raws, caps, codecs)
         for key, arr in zip(keys, decoded):
             if arr is None:  # corrupt block: fail only the lanes that
                 # touch it (per-lane degradation, not batch-wide)
                 continue
+            arr = spans[key][4].postprocess(arr)
             cache[key] = arr
             self.block_cache[key] = arr
 
         out: List[Optional[np.ndarray]] = []
         for r, (_, c, _, x, y, w, h) in zip(readers, coords):
-            if r.compression == 8:
+            if r.compressed:
                 ifd_key = id(r.ifd)
                 get_block = (  # noqa: E731
                     lambda i, _k=ifd_key: cache[(self.cache_ns, _k, i)]
@@ -679,9 +728,10 @@ def write_ome_tiff(
     data: np.ndarray,
     tile_size: Optional[Tuple[int, int]] = (256, 256),
     pyramid_levels: int = 1,
-    compression: Optional[str] = None,  # None | "zlib"
+    compression: Optional[str] = None,  # None | "zlib" | "lzw" | "packbits"
     big_endian: bool = True,
     bigtiff: bool = False,
+    predictor: int = 1,  # 2 = horizontal differencing (zlib/lzw only)
 ) -> None:
     """Write 5D TCZYX (or 6D TCZYXS for RGB, S=3) data as a (pyramidal)
     OME-TIFF: planes in XYCZT page order, pyramid levels as SubIFDs,
@@ -700,7 +750,11 @@ def write_ome_tiff(
     T, C, Z, Y, X = data.shape[:5]
     bo = ">" if big_endian else "<"
     dtype = data.dtype
-    comp_code = 8 if compression == "zlib" else 1
+    comp_code = {None: 1, "zlib": 8, "lzw": 5, "packbits": 32773}[compression]
+    if predictor not in (1, 2):
+        raise TiffError(f"Unsupported predictor: {predictor}")
+    if predictor == 2 and comp_code in (1, 32773):
+        raise TiffError("predictor 2 requires zlib or lzw compression")
     kind_fmt = {"u": 1, "i": 2, "f": 3}[dtype.kind]
 
     samples = 3 if data.ndim == 6 else 1
@@ -734,10 +788,27 @@ def write_ome_tiff(
     def pack(fmt, *vals):
         return struct.pack(bo + fmt, *vals)
 
+    def encode_block(raw: bytes, row_samples: int, nsamples: int) -> bytes:
+        if predictor == 2:
+            arr = np.frombuffer(raw, dtype=np.uint8)
+            raw = _codecs.apply_predictor2(
+                arr, row_samples, dtype.itemsize, nsamples, bo
+            ).tobytes()
+        if comp_code == 8:
+            return zlib.compress(raw, 1)
+        if comp_code == 5:
+            return _codecs.lzw_encode(raw)
+        if comp_code == 32773:
+            return _codecs.packbits_encode(
+                raw, row_samples * dtype.itemsize
+            )
+        return raw
+
     def write_blocks(plane2d: np.ndarray):
         """Write tiles (or one strip) for a 2D/3D plane; returns
         (offsets, counts, tile_meta)."""
         be = np.ascontiguousarray(plane2d.astype(dtype.newbyteorder(bo), copy=False))
+        nsamples = plane2d.shape[2] if plane2d.ndim == 3 else 1
         offsets, counts = [], []
         if tile_size:
             tw, th = tile_size
@@ -749,18 +820,18 @@ def write_ome_tiff(
                     )
                     sub = be[ty : ty + th, tx : tx + tw]
                     block[: sub.shape[0], : sub.shape[1]] = sub
-                    raw = block.tobytes()
-                    if comp_code == 8:
-                        raw = zlib.compress(raw, 1)
+                    raw = encode_block(
+                        block.tobytes(), tw * nsamples, nsamples
+                    )
                     offsets.append(len(buf))
                     counts.append(len(raw))
                     buf.extend(raw)
                     if len(raw) % 2:
                         buf.extend(b"\x00")
         else:
-            raw = be.tobytes()
-            if comp_code == 8:
-                raw = zlib.compress(raw, 1)
+            raw = encode_block(
+                be.tobytes(), plane2d.shape[1] * nsamples, nsamples
+            )
             offsets.append(len(buf))
             counts.append(len(raw))
             buf.extend(raw)
@@ -778,6 +849,8 @@ def write_ome_tiff(
         entries.append((_T["LENGTH"], 4, 1, [h]))
         entries.append((_T["BITS"], 3, samples, [bits] * samples))
         entries.append((_T["COMPRESSION"], 3, 1, [comp_code]))
+        if predictor == 2:
+            entries.append((_T["PREDICTOR"], 3, 1, [2]))
         entries.append((_T["PHOTOMETRIC"], 3, 1, [2 if samples == 3 else 1]))
         if description:
             entries.append(

@@ -20,6 +20,7 @@ import logging
 import os
 import subprocess
 import threading
+import zlib
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -90,6 +91,12 @@ class NativeEngine:
         )
         if self._has_fused_encode:
             lib.ompb_png_encode_batch.restype = ctypes.c_int
+        # ABI v3 added the per-block codec dispatch (zlib/LZW/PackBits)
+        self._has_decode_batch = self.version >= 3 and hasattr(
+            lib, "ompb_decode_batch"
+        )
+        if self._has_decode_batch:
+            lib.ompb_decode_batch.restype = ctypes.c_int
         self.pool_size = lib.ompb_pool_size()
 
     # -- helpers -----------------------------------------------------------
@@ -169,6 +176,63 @@ class NativeEngine:
             ctypes.c_int(n), ins, lens, outs, out_lens
         )
         results: List[Optional[np.ndarray]] = []
+        for i, arr in enumerate(arrays):
+            if rc and out_lens[i] == 0:
+                results.append(None)
+            else:
+                results.append(arr[: out_lens[i]])
+        return results
+
+    def decode_batch(
+        self,
+        buffers: Sequence[bytes],
+        out_sizes: Sequence[int],
+        codecs: Sequence[int],
+    ) -> List[Optional[np.ndarray]]:
+        """Decode N TIFF blocks with per-block codec dispatch (8 =
+        zlib, 5 = LZW, 32773 = PackBits) into fresh uint8 arrays of the
+        given capacities. None per failed lane. Falls back to the
+        pure-Python codecs on an ABI-v2 library."""
+        n = len(buffers)
+        if n == 0:
+            return []
+        if not self._has_decode_batch:
+            if all(c == 8 for c in codecs):
+                return self.inflate_batch(buffers, out_sizes)
+            from ..ops import codecs as py
+
+            results: List[Optional[np.ndarray]] = []
+            for buf, size, codec in zip(buffers, out_sizes, codecs):
+                try:
+                    if codec == 8:
+                        raw: Optional[bytes] = zlib.decompress(buf)
+                    elif codec == py.LZW:
+                        raw = py.lzw_decode(buf, int(size))
+                    elif codec == py.PACKBITS:
+                        raw = py.packbits_decode(buf, int(size))
+                    else:
+                        raw = None
+                except Exception:
+                    raw = None
+                results.append(
+                    None if raw is None
+                    else np.frombuffer(raw, dtype=np.uint8)
+                )
+            return results
+        ins, lens, _keep = self._in_arrays(buffers)
+        outs = (_U8P * n)()
+        out_lens = (ctypes.c_size_t * n)()
+        codec_arr = (ctypes.c_int * n)(*[int(c) for c in codecs])
+        arrays = []
+        for i, size in enumerate(out_sizes):
+            arr = np.empty(int(size), dtype=np.uint8)
+            arrays.append(arr)
+            outs[i] = arr.ctypes.data_as(_U8P)
+            out_lens[i] = int(size)
+        rc = self._lib.ompb_decode_batch(
+            ctypes.c_int(n), ins, lens, codec_arr, outs, out_lens
+        )
+        results = []
         for i, arr in enumerate(arrays):
             if rc and out_lens[i] == 0:
                 results.append(None)
