@@ -2,7 +2,7 @@
 PNG tiles served from a large pyramidal OME-TIFF under concurrent load.
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
 - value: tiles/sec of the batched TPU pipeline (coalesced batches,
   device byteswap+filter, threaded host deflate) over 1024 requests.
@@ -13,10 +13,22 @@ Prints ONE JSON line:
   service itself is not runnable in this environment (BASELINE.md:
   baseline must be measured); this stand-in preserves its execution
   structure on identical inputs.
+- extra keys: http_tiles_per_sec + p50_ms/p99_ms measured through the
+  FULL stack (aiohttp client over a real socket -> session middleware
+  -> event bus -> batcher -> pipeline), and a `device` object with the
+  accelerator-engine sub-run (recorded even when the tunneled link
+  makes it slower; `engine: auto` rightly picks host then).
+
+Robustness contract: this script must NEVER exit non-zero because a
+TPU runtime failed to initialize — every jax touchpoint is guarded and
+degrades to the host engine, which needs no jax at all
+(VERDICT r2 item 1: BENCH_r02 died at an unguarded
+jax.default_backend()).
 
 All progress chatter goes to stderr; stdout carries only the JSON line.
 """
 
+import asyncio
 import json
 import os
 import sys
@@ -28,6 +40,14 @@ import numpy as np
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
+
+
+def jax_backend_info() -> dict:
+    """Bounded backend probe (a wedged TPU tunnel HANGS PJRT init, so
+    this must not touch jax in-process); never raises."""
+    from omero_ms_pixel_buffer_tpu.runtime.device_probe import probe
+
+    return dict(probe())
 
 
 def build_fixture(root: str, size: int = 8192):
@@ -68,6 +88,164 @@ def make_ctxs(n, size, tile=512, fmt="png", seed=7):
             )
         )
     return ctxs
+
+
+def run_batched(pipe, ctxs, batch):
+    """Drive handle_batch over all ctxs; returns tiles/s."""
+    t0 = time.perf_counter()
+    done = 0
+    for i in range(0, len(ctxs), batch):
+        chunk = ctxs[i : i + batch]
+        results = pipe.handle_batch(chunk)
+        assert all(r is not None for r in results), "bench tile failed"
+        done += len(chunk)
+    return done / (time.perf_counter() - t0)
+
+
+def bench_http(path: str, n_requests: int, concurrency: int) -> dict:
+    """Full-stack latency: aiohttp client over a real localhost socket
+    -> tracing middleware -> session middleware -> bus.request ->
+    BatchingTileWorker -> TilePipeline. The reference's hot path
+    (TileRequestHandler.java:80-139) ran per-request on a worker
+    thread behind Vert.x; this measures our complete analog."""
+    import aiohttp
+    from aiohttp import web
+
+    from omero_ms_pixel_buffer_tpu.auth.stores import MemorySessionStore
+    from omero_ms_pixel_buffer_tpu.http.server import PixelBufferApp
+    from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+        ImageRegistry,
+        PixelsService,
+    )
+    from omero_ms_pixel_buffer_tpu.utils.config import Config
+
+    registry = ImageRegistry()
+    registry.add(1, path)
+    config = Config.from_dict(
+        {
+            "session-store": {"type": "memory"},
+            "backend": {"engine": os.environ.get("BENCH_ENGINE", "auto")},
+        }
+    )
+    app_obj = PixelBufferApp(
+        config,
+        pixels_service=PixelsService(registry),
+        session_store=MemorySessionStore({"bench-cookie": "bench-key"}),
+    )
+    size = int(os.environ.get("BENCH_IMAGE_SIZE", "8192"))
+    rng = np.random.default_rng(11)
+    urls = []
+    for _ in range(n_requests):
+        x = int(rng.integers(0, (size - 512) // 64)) * 64
+        y = int(rng.integers(0, (size - 512) // 64)) * 64
+        urls.append(
+            f"/tile/1/0/0/0?x={x}&y={y}&w=512&h=512&format=png"
+        )
+
+    async def run() -> dict:
+        runner = web.AppRunner(app_obj.make_app(), access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = runner.addresses[0][1]
+        base = f"http://127.0.0.1:{port}"
+        latencies = []
+        sem = asyncio.Semaphore(concurrency)
+
+        async def one(session, url):
+            async with sem:
+                t0 = time.perf_counter()
+                async with session.get(
+                    base + url, cookies={"sessionid": "bench-cookie"}
+                ) as resp:
+                    body = await resp.read()
+                    assert resp.status == 200, (resp.status, body[:200])
+                latencies.append(time.perf_counter() - t0)
+
+        try:
+            conn = aiohttp.TCPConnector(limit=concurrency)
+            async with aiohttp.ClientSession(connector=conn) as session:
+                # warmup: engine resolution, jit, native build
+                await asyncio.gather(
+                    *(one(session, u) for u in urls[:concurrency])
+                )
+                latencies.clear()
+                t0 = time.perf_counter()
+                await asyncio.gather(*(one(session, u) for u in urls))
+                elapsed = time.perf_counter() - t0
+        finally:
+            await runner.cleanup()
+        lat_ms = np.array(latencies) * 1000.0
+        return {
+            "http_tiles_per_sec": round(len(urls) / elapsed, 2),
+            "p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+            "p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
+            "concurrency": concurrency,
+            "engine": app_obj.pipeline.engine,
+        }
+
+    return asyncio.run(run())
+
+
+def bench_device(path: str, size: int, probe_info: dict) -> dict:
+    """Accelerator-engine sub-run, recorded even when slower than host
+    (over a tunneled chip the link dominates; BENCH tail carries the
+    probed MB/s so the co-located-chip story is quantified separately
+    for the HBM plane-cache path and the host-staged bucket path).
+
+    Runs in a bounded CHILD process: the tunnel can wedge mid-transfer
+    and hang jax calls, and the headline record must survive that."""
+    from omero_ms_pixel_buffer_tpu.runtime.device_probe import run_bounded
+
+    out = dict(probe_info)
+    if out.get("backend") != "tpu":
+        # no accelerator (probe error, or CPU-only jax): record why,
+        # skip the sub-run — engine='device' on the CPU backend would
+        # mislabel CPU-JAX numbers as the accelerator story
+        return out
+    env = dict(os.environ)
+    env["BENCH_FIXTURE"] = path
+    env["BENCH_IMAGE_SIZE"] = str(size)
+    timeout_s = float(os.environ.get("BENCH_DEVICE_TIMEOUT_S", "600"))
+    child = run_bounded(
+        [sys.executable, os.path.abspath(__file__), "--device-sub"],
+        timeout_s, env=env,
+    )
+    out.update(child)
+    return out
+
+
+def device_sub_main():
+    """Child-process entry for the device sub-run (see bench_device)."""
+    from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+        ImageRegistry,
+        PixelsService,
+    )
+    from omero_ms_pixel_buffer_tpu.models.tile_pipeline import TilePipeline
+
+    path = os.environ["BENCH_FIXTURE"]
+    size = int(os.environ["BENCH_IMAGE_SIZE"])
+    n = int(os.environ.get("BENCH_DEVICE_REQUESTS", "64"))
+    registry = ImageRegistry()
+    registry.add(1, path)
+    service = PixelsService(registry)
+    out = {}
+    for label, plane_cache in (("plane_cache", True), ("bucket", False)):
+        try:
+            pipe = TilePipeline(
+                service, engine="device", buckets=(512,),
+                use_plane_cache=plane_cache,
+            )
+            ctxs = make_ctxs(n, size, seed=23)
+            pipe.handle_batch(ctxs[:16])  # warm: jit + staging
+            tps = run_batched(pipe, ctxs, 32)
+            out[f"tiles_per_sec_{label}"] = round(tps, 2)
+            log(f"[device] {label} path: {tps:.1f} tiles/s")
+        except Exception as e:
+            out[f"error_{label}"] = f"{type(e).__name__}: {e}"
+            log(f"[device] {label} path failed: {e!r}")
+    service.close()
+    print(json.dumps(out))
 
 
 def main():
@@ -111,45 +289,86 @@ def main():
     log(f"baseline (sequential host path): {host_tps:.1f} tiles/s")
 
     # --- framework batched path (auto engine) -------------------------
-    import jax
-
-    log(f"jax backend: {jax.default_backend()} devices: {jax.devices()}")
+    probe_info = jax_backend_info()
+    log(f"jax: {probe_info}")
     service = PixelsService(registry)
     engine = os.environ.get("BENCH_ENGINE", "auto")
-    pipe = TilePipeline(service, engine=engine, buckets=(512,))
+    if engine in ("device", "tpu") and probe_info.get("backend") != "tpu":
+        # an explicit device request on a wedged/absent TPU would HANG
+        # at in-process PJRT init (not raise), so gate on the bounded
+        # probe before any jax touchpoint
+        log(
+            f"engine '{engine}' requested but probe says "
+            f"{probe_info}; falling back to host"
+        )
+        engine = "host"
     ctxs = make_ctxs(n_requests, size, seed=9)
-    # warmup: resolve auto engine, trigger jit/native build
-    warm = pipe.handle_batch(ctxs[:batch])
-    assert all(w is not None for w in warm)
+    try:
+        pipe = TilePipeline(service, engine=engine, buckets=(512,))
+        # warmup: resolve auto engine, trigger jit/native build
+        warm = pipe.handle_batch(ctxs[:batch])
+        assert all(w is not None for w in warm)
+    except Exception as e:
+        # an explicitly-requested device engine on a wedged TPU must
+        # still produce a headline number — re-run on the host engine
+        log(f"engine '{engine}' failed ({e!r}); falling back to host")
+        engine = "host"
+        pipe = TilePipeline(service, engine="host", buckets=(512,))
+        warm = pipe.handle_batch(ctxs[:batch])
+        assert all(w is not None for w in warm)
     log(f"engine: {pipe.engine}")
-    t0 = time.perf_counter()
-    done = 0
-    for i in range(0, len(ctxs), batch):
-        chunk = ctxs[i : i + batch]
-        results = pipe.handle_batch(chunk)
-        assert all(r is not None for r in results), "bench tile failed"
-        done += len(chunk)
-    elapsed = time.perf_counter() - t0
-    tpu_tps = done / elapsed
+    tpu_tps = run_batched(pipe, ctxs, batch)
     log(
         f"batched path ({pipe.engine}): {tpu_tps:.1f} tiles/s over "
-        f"{done} tiles ({elapsed:.2f}s; setup+warmup "
-        f"{time.perf_counter() - t_setup - elapsed:.1f}s)"
+        f"{len(ctxs)} tiles (setup+warmup "
+        f"{time.perf_counter() - t_setup:.1f}s total elapsed)"
     )
+
+    # --- full-stack HTTP latency --------------------------------------
+    http_stats: dict = {}
+    if os.environ.get("BENCH_HTTP", "1") != "0":
+        try:
+            http_stats = bench_http(
+                path,
+                int(os.environ.get("BENCH_HTTP_REQUESTS", "512")),
+                int(os.environ.get("BENCH_HTTP_CONCURRENCY", "64")),
+            )
+            log(f"full-stack http: {http_stats}")
+        except Exception as e:
+            # namespaced: a top-level "error" key means total failure
+            http_stats = {"http_error": f"{type(e).__name__}: {e}"}
+            log(f"http bench failed: {e!r}")
 
     if os.environ.get("BENCH_SUBS", "1") != "0":
-        sub_benches(pipe, service, size, cache_dir)
+        try:
+            sub_benches(pipe, service, size, cache_dir)
+        except Exception as e:
+            log(f"sub-benches failed: {e!r}")
 
-    print(
-        json.dumps(
-            {
-                "metric": "tiles_per_sec_512x512_uint16_png",
-                "value": round(tpu_tps, 2),
-                "unit": "tiles/s",
-                "vs_baseline": round(tpu_tps / host_tps, 3),
-            }
-        )
+    # --- accelerator-engine sub-run (bounded child; last so a wedged
+    # tunnel can't cost anything already measured) ---------------------
+    device_stats: dict = {}
+    if os.environ.get("BENCH_DEVICE", "1") != "0":
+        try:
+            device_stats = bench_device(path, size, probe_info)
+        except Exception as e:
+            device_stats = {"error": f"{type(e).__name__}: {e}"}
+            log(f"device bench failed: {e!r}")
+
+    record = {
+        "metric": "tiles_per_sec_512x512_uint16_png",
+        "value": round(tpu_tps, 2),
+        "unit": "tiles/s",
+        "vs_baseline": round(tpu_tps / host_tps, 3),
+        "engine": pipe.engine,
+        "baseline_tiles_per_sec": round(host_tps, 2),
+    }
+    record.update(
+        {k: v for k, v in http_stats.items() if k != "engine"}
     )
+    if device_stats:
+        record["device"] = device_stats
+    print(json.dumps(record))
 
 
 def sub_benches(pipe, service, size, cache_dir):
@@ -232,4 +451,26 @@ def sub_benches(pipe, service, size, cache_dir):
 
 
 if __name__ == "__main__":
-    main()
+    if "--device-sub" in sys.argv:
+        device_sub_main()
+        sys.exit(0)
+    try:
+        main()
+    except Exception as e:
+        # last-resort: the driver must always get a parseable record
+        log(f"FATAL: {e!r}")
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print(
+            json.dumps(
+                {
+                    "metric": "tiles_per_sec_512x512_uint16_png",
+                    "value": 0.0,
+                    "unit": "tiles/s",
+                    "vs_baseline": 0.0,
+                    "error": f"{type(e).__name__}: {e}",
+                }
+            )
+        )
+        sys.exit(0)

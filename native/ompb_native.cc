@@ -307,9 +307,10 @@ bool LzwDecode(const uint8_t* in, size_t in_len, uint8_t* out, size_t cap,
   while (true) {
     while (nbits < width) {
       if (pos >= in_len) {
-        // tolerate missing EOI only once output exists
+        // tolerate missing EOI only when the block is complete; a
+        // truncated stream must fail the lane, not serve partial pixels
         *produced = o;
-        return o > 0;
+        return o >= cap;
       }
       bitbuf = (bitbuf << 8) | in[pos++];
       nbits += 8;

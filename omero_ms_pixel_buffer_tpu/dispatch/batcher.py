@@ -141,6 +141,10 @@ class BatchingTileWorker:
             if not ok:
                 raise PermissionDeniedError()
 
+            if self._closed:
+                # after close() drains the queue there is no runner;
+                # enqueueing would hang the caller until the bus timeout
+                raise InternalError("Service shutting down")
             fut: asyncio.Future = asyncio.get_running_loop().create_future()
             try:
                 self._queue.put_nowait((ctx, fut))

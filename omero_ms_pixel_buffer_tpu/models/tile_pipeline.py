@@ -67,31 +67,6 @@ class ResolvedTile:
         self.level, self.x, self.y, self.w, self.h = level, x, y, w, h
 
 
-def _device_link_mbps() -> float:
-    """Measured host<->device roundtrip bandwidth (MB/s), probed once
-    per process with a 4 MB array. On a co-located TPU (PCIe) this is
-    GB/s; over a tunneled device it can be tens of MB/s — in which
-    case shipping tiles to the device costs more than it saves and the
-    host engine wins (the 'minimise host<->device transfers' rule)."""
-    global _LINK_MBPS
-    if _LINK_MBPS is None:
-        import time
-
-        import jax
-
-        sample = np.zeros((2 * 1024 * 1024,), np.uint16)  # 4 MB
-        jax.device_put(np.zeros(8, np.uint8)).block_until_ready()  # warm
-        t0 = time.perf_counter()
-        dev = jax.device_put(sample)
-        dev.block_until_ready()
-        np.asarray(dev)
-        dt = time.perf_counter() - t0
-        _LINK_MBPS = (2 * sample.nbytes) / dt / 1e6
-        log.info("device link probe: %.0f MB/s roundtrip", _LINK_MBPS)
-    return _LINK_MBPS
-
-
-_LINK_MBPS: Optional[float] = None
 
 
 def _png_native_eligible(tile: np.ndarray) -> bool:
@@ -156,16 +131,26 @@ class TilePipeline:
     def engine(self) -> str:
         """The resolved engine ('auto' resolves lazily at first use)."""
         if self._engine == "auto":
-            import jax
+            # Bounded out-of-process probe: a wedged TPU runtime can
+            # HANG PJRT init, not just raise — resolving the engine
+            # in-process would stall the first batch forever instead
+            # of degrading to the host engine (which needs no jax).
+            from ..runtime.device_probe import probe
 
             min_mbps = float(os.environ.get("OMPB_DEVICE_MIN_MBPS", "1000"))
+            info = probe()
             if (
-                jax.default_backend() == "tpu"
-                and _device_link_mbps() >= min_mbps
+                info.get("backend") == "tpu"
+                and info.get("link_mbps", 0.0) >= min_mbps
             ):
                 self._engine = "device"
             else:
                 self._engine = "host"
+                if "error" in info:
+                    log.warning(
+                        "accelerator unavailable (%s); engine 'auto' "
+                        "-> 'host'", info["error"],
+                    )
             log.info("engine auto-resolved to '%s'", self._engine)
         return self._engine
 
@@ -184,9 +169,12 @@ class TilePipeline:
         # path. Only probe the backend when the device path is in play
         # — resolving it would initialize PJRT, which host-only
         # configurations must never pay for.
-        import jax
+        try:
+            import jax
 
-        return jax.default_backend() == "tpu"
+            return jax.default_backend() == "tpu"
+        except Exception:
+            return False
 
     # ------------------------------------------------------------------
     # resolve / read — the metadata + I/O stages

@@ -55,8 +55,11 @@ def lzw_decode(data: bytes, cap: int) -> Optional[bytes]:
         while nbits < width:
             if pos >= n:
                 # stream may simply end without EOI (some writers);
-                # tolerate only when output is complete
-                return bytes(out) if out else None
+                # tolerate only when output is complete — a full block
+                # returns at the cap check below, so reaching here
+                # means the block is truncated (serve None, not a
+                # partially-decoded tile)
+                return bytes(out) if len(out) >= cap else None
             bitbuf = (bitbuf << 8) | data[pos]
             pos += 1
             nbits += 8

@@ -47,6 +47,13 @@ class TestPythonCodecs:
         # first code after Clear must be a literal; 0xFFFF... gives 511
         assert codecs.lzw_decode(b"\xff\xff\xff\xff", 100) is None
 
+    def test_lzw_truncated_returns_none(self):
+        # a stream cut mid-codeword must fail the lane, not serve a
+        # partially-decoded block
+        data = _smooth(4000)
+        enc = codecs.lzw_encode(data)
+        assert codecs.lzw_decode(enc[: len(enc) // 2], len(data)) is None
+
     def test_packbits_fuzz(self):
         rng = np.random.default_rng(3)
         for trial in range(100):
@@ -117,8 +124,51 @@ class TestNativeDecodeBatch:
             [1000, 1000],
             [5, 5],
         )
-        assert outs[0] is None or outs[0].tobytes() != good
+        assert outs[0] is None  # corrupt lane must degrade to None
         assert outs[1] is not None and outs[1].tobytes() == good
+
+    def test_truncated_lzw_lane_degrades_native(self):
+        from omero_ms_pixel_buffer_tpu.runtime.native import get_engine
+
+        engine = get_engine()
+        if engine is None:
+            pytest.skip("no native engine")
+        good = _smooth(1000)
+        enc = codecs.lzw_encode(good)
+        outs = engine.decode_batch(
+            [enc[: len(enc) // 2], enc], [1000, 1000], [5, 5]
+        )
+        assert outs[0] is None
+        assert outs[1] is not None and outs[1].tobytes() == good
+
+    def test_abi_v2_fallback_caps_zlib_output(self):
+        """The pure-Python decode fallback must bound zlib output at
+        the lane capacity (a hostile stream can't balloon memory) and
+        fail truncated streams like native uncompress does."""
+        import zlib
+
+        from omero_ms_pixel_buffer_tpu.runtime.native import get_engine
+
+        engine = get_engine()
+        if engine is None:
+            pytest.skip("no native engine")
+        saved = engine._has_decode_batch
+        engine._has_decode_batch = False
+        try:
+            good = _smooth(1000)
+            bomb = zlib.compress(b"\x00" * 50_000_000)  # 50 MB from ~48 KB
+            trunc = zlib.compress(good)[:-8]
+            outs = engine.decode_batch(
+                [bomb, trunc, zlib.compress(good), codecs.lzw_encode(good)],
+                [1000, 1000, 1000, 1000],
+                [8, 8, 8, 5],  # mixed codecs forces the generic fallback
+            )
+            assert outs[0] is None  # overflow past cap -> failed lane
+            assert outs[1] is None  # truncated stream -> failed lane
+            assert outs[2] is not None and outs[2].tobytes() == good
+            assert outs[3] is not None and outs[3].tobytes() == good
+        finally:
+            engine._has_decode_batch = saved
 
 
 def _plane(shape=(160, 200), dtype=np.uint16, seed=2):
