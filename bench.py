@@ -236,6 +236,11 @@ def device_sub_main():
                 service, engine="device", buckets=(512,),
                 use_plane_cache=plane_cache,
             )
+            if plane_cache:
+                # the plane cache is the single-device HBM path; with
+                # >1 chip the auto-mesh would supersede it and this
+                # label would silently duplicate the bucket number
+                pipe.mesh = None
             ctxs = make_ctxs(n, size, seed=23)
             pipe.handle_batch(ctxs[:16])  # warm: jit + staging
             tps = run_batched(pipe, ctxs, 32)

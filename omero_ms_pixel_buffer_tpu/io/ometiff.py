@@ -310,12 +310,10 @@ class _LevelReader:
         if not self.compressed:
             return raw
         if self.compression == 8:
-            # bound output at the block capacity — an unbounded
-            # decompress of a hostile stream could balloon far past cap
-            d = zlib.decompressobj()
-            plain: Optional[bytes] = d.decompress(bytes(raw), cap)
-            if d.unconsumed_tail or not d.eof:
-                plain = None  # overflow or truncated stream
+            # bounded at the block capacity (hostile-stream defence)
+            plain: Optional[bytes] = _codecs.bounded_inflate(
+                bytes(raw), cap
+            )
         elif self.compression == 5:
             plain = _codecs.lzw_decode(bytes(raw), cap)
         else:  # 32773

@@ -23,6 +23,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..ops import codecs as _codecs
+
 from .pixel_buffer import (
     BlockCache,
     PixelBuffer,
@@ -102,10 +104,14 @@ class ZarrArray:
         with open(p, "rb") as f:
             raw = f.read()
         if self.compressor:
-            if self.compressor["id"] == "zlib":
-                raw = zlib.decompress(raw)
-            else:
-                raw = gzip.decompress(raw)
+            # bounded at the chunk capacity (hostile-stream defence,
+            # shared with the TIFF block path)
+            cap = int(np.prod(self.chunks)) * self.dtype.itemsize
+            wbits = 15 if self.compressor["id"] == "zlib" else 31
+            inflated = _codecs.bounded_inflate(raw, cap, wbits)
+            if inflated is None:
+                raise ZarrError(f"Corrupt chunk {idx}")
+            raw = inflated
         return np.frombuffer(raw, dtype=self.dtype).reshape(self.chunks)
 
     def read_region(

@@ -117,6 +117,10 @@ class TilePipeline:
         self._use_pallas_arg = use_pallas
         self.use_plane_cache = use_plane_cache
         self._plane_cache = None  # built lazily on first device batch
+        # serving mesh: "auto" -> built on first device batch when >1
+        # accelerator is visible (tests inject one via `pipeline.mesh =
+        # make_mesh(...)`, or force single-device with `= None`)
+        self.mesh = "auto"
         # Allocation guard the reference lacks (its tile-size policy
         # beans only steer pyramid writing; a full-plane request still
         # allocates w*h*bpp unchecked, TileRequestHandler.java:98-103).
@@ -175,6 +179,30 @@ class TilePipeline:
             return jax.default_backend() == "tpu"
         except Exception:
             return False
+
+    def _get_mesh(self):
+        """The serving mesh — the multi-chip worker pool
+        (PixelBufferMicroserviceVerticle.java:224-233's analog over
+        ICI instead of threads). Built once, only when the device
+        engine is active and more than one accelerator is visible;
+        None keeps every device stage single-chip."""
+        if self.mesh == "auto":
+            self.mesh = None
+            if self.use_device:
+                try:
+                    import jax
+
+                    if len(jax.devices()) > 1:
+                        from ..parallel.mesh import make_mesh
+
+                        self.mesh = make_mesh(("data",))
+                        log.info(
+                            "serving mesh: %s over %d devices",
+                            dict(self.mesh.shape), len(jax.devices()),
+                        )
+                except Exception:
+                    log.exception("mesh init failed; single-device serving")
+        return self.mesh
 
     # ------------------------------------------------------------------
     # resolve / read — the metadata + I/O stages
@@ -306,13 +334,16 @@ class TilePipeline:
                 resolved[i] = None
 
         use_device = self.use_device  # resolves 'auto' once per batch
+        mesh = self._get_mesh() if use_device else None
 
         # HBM-resident path: lanes whose plane is (or becomes) device-
         # resident skip the host read entirely — crop + filter happen
-        # on the accelerator and only filtered bytes come back.
+        # on the accelerator and only filtered bytes come back. With a
+        # multi-chip mesh the DP-sharded bucket path supersedes it:
+        # single-chip HBM residency would idle the other n-1 chips.
         plane_groups: Dict[Tuple, List[int]] = {}
         plane_handles: Dict[Tuple, object] = {}
-        if use_device and self.use_plane_cache:
+        if use_device and self.use_plane_cache and mesh is None:
             plane_groups, plane_handles = self._stage_plane_lanes(
                 ctxs, resolved
             )
@@ -341,25 +372,37 @@ class TilePipeline:
                 except Exception:
                     log.exception("batched read failed; lanes -> 404")
 
-        # split lanes: device-PNG buckets / host fused encode / python
+        # split lanes: device-PNG buckets / distributed full-plane /
+        # host fused encode / python
         png_groups: Dict[Tuple, List[int]] = {}
         host_lanes: List[int] = []
+        sp_lanes: List[int] = []
         for i, (ctx, tile) in enumerate(zip(ctxs, tiles)):
             if tile is None or resolved[i] is None:
                 continue
-            bucket = (
-                self._bucket(tile.shape[1], tile.shape[0])
-                if use_device
+            device_png = (
+                use_device
                 and ctx.format == "png"
                 and tile.ndim == 2
                 and tile.dtype in _PNG_DTYPES
-                else None
+            )
+            bucket = (
+                self._bucket(tile.shape[1], tile.shape[0])
+                if device_png else None
             )
             if bucket is not None:
                 bw, bh = bucket
                 png_groups.setdefault(
                     ((bh, bw), tile.dtype.str), []
                 ).append(i)
+            elif (
+                device_png
+                and mesh is not None
+                and self.png_filter == "up"
+            ):
+                # bigger than every bucket: shard the plane's rows
+                # across the mesh (space parallel, halo over ICI)
+                sp_lanes.append(i)
             elif ctx.format == "png" and _png_native_eligible(tile):
                 host_lanes.append(i)
             else:
@@ -367,6 +410,13 @@ class TilePipeline:
 
         if host_lanes:
             self._host_png_lanes(host_lanes, tiles, ctxs, results)
+
+        for i in sp_lanes:
+            try:
+                self._distributed_plane_lane(mesh, i, tiles[i], results)
+            except Exception:
+                log.exception("distributed plane lane failed; host fallback")
+                results[i] = self.encode(ctxs[i], tiles[i])
 
         for ((bh, bw), dtype_str), lanes in png_groups.items():
             try:
@@ -541,23 +591,66 @@ class TilePipeline:
 
     def _device_png_lanes(self, lanes, tiles, ctxs, results, bh, bw, dtype):
         """Host-staged device path: tiles padded into one bucket batch,
-        transferred, filtered on device, then the shared deflate tail."""
+        transferred, filtered on device, then the shared deflate tail.
+        With a serving mesh the batch axis shards across chips (data
+        parallel — the reference's worker pool over ICI)."""
         itemsize = dtype.itemsize
         batch = np.zeros((len(lanes), bh, bw), dtype=dtype)
         for j, i in enumerate(lanes):
             t = tiles[i]
             batch[j, : t.shape[0], : t.shape[1]] = t
+        mesh = self._get_mesh()
         with TRACER.start_span("batch_device"):
-            device_batch = jnp.asarray(batch)
-            if self.use_pallas and pallas_supports((bh, bw), dtype):
+            if mesh is not None:
+                from ..parallel.sharding import (
+                    pad_batch,
+                    shard_batch,
+                    sharded_batch_filter,
+                )
+
+                n = mesh.shape["data"]
+                padded, real = pad_batch(jnp.asarray(batch), n)
+                sharded = shard_batch(mesh, padded)
+                filtered = np.asarray(
+                    sharded_batch_filter(
+                        mesh, sharded, itemsize, self.png_filter
+                    )
+                )[:real]
+            elif self.use_pallas and pallas_supports((bh, bw), dtype):
                 # fused Pallas kernel: byteswap + filter in one VMEM pass
                 filtered = np.asarray(
-                    pallas_filter_tiles(device_batch, self.png_filter)
+                    pallas_filter_tiles(jnp.asarray(batch), self.png_filter)
                 )
             else:
-                rows = to_big_endian_bytes(device_batch)
+                rows = to_big_endian_bytes(jnp.asarray(batch))
                 filtered = np.asarray(
                     filter_batch(rows, itemsize, self.png_filter)
                 )  # (B, bh, 1 + bw*itemsize)
         sizes = [(tiles[i].shape[1], tiles[i].shape[0]) for i in lanes]
         self._finish_png_lanes(filtered, lanes, sizes, results, itemsize)
+
+    def _distributed_plane_lane(self, mesh, i, tile, results) -> None:
+        """Space-parallel path for one plane-sized PNG lane: rows shard
+        across the mesh, the Up filter's one-row dependency rides a
+        ppermute halo exchange over ICI, and only filtered scanlines
+        return to the host (SURVEY.md §5.7's long-context analog).
+        Rows pad up to the mesh size; padding sits BELOW the real rows
+        (Up only looks upward) and slices away before assembly."""
+        from ..parallel.sharding import (
+            distributed_filter_plane,
+            shard_rows,
+        )
+
+        itemsize = tile.dtype.itemsize
+        h, w = tile.shape
+        n = mesh.shape["data"]
+        pad = (-h) % n
+        arr = np.pad(tile, ((0, pad), (0, 0))) if pad else tile
+        with TRACER.start_span("batch_device"):
+            rows_sharded = shard_rows(mesh, jnp.asarray(arr))
+            filtered = np.asarray(
+                distributed_filter_plane(mesh, rows_sharded, mode="up")
+            )[:h]
+        self._finish_png_lanes(
+            filtered[None], [i], [(w, h)], results, itemsize
+        )

@@ -30,6 +30,27 @@ _CLEAR = 256
 _EOI = 257
 
 
+def bounded_inflate(
+    data: bytes, cap: int, wbits: int = 15
+) -> Optional[bytes]:
+    """zlib-family decompress with output bounded at ``cap`` — the
+    shared defence against hostile streams that balloon far past the
+    expected block size. ``wbits``: 15 = zlib wrapper, 31 = gzip.
+    Returns None on overflow or a truncated stream (callers degrade
+    per-lane / per-block), matching native uncompress-with-cap
+    semantics."""
+    import zlib
+
+    try:
+        d = zlib.decompressobj(wbits)
+        out = d.decompress(data, cap)
+        if d.unconsumed_tail or not d.eof:
+            return None  # overflow past cap, or truncated stream
+        return out
+    except zlib.error:
+        return None
+
+
 def lzw_decode(data: bytes, cap: int) -> Optional[bytes]:
     """Decode a TIFF-flavor LZW stream to at most ``cap`` bytes.
     Returns None on a corrupt stream (callers degrade per-lane)."""

@@ -205,16 +205,11 @@ class NativeEngine:
             for buf, size, codec in zip(buffers, out_sizes, codecs):
                 try:
                     if codec == 8:
-                        # cap output at the lane capacity like the
-                        # native uncompress path — an unbounded
-                        # decompress of a hostile stream could balloon
-                        # far past `size`
-                        d = zlib.decompressobj()
-                        raw: Optional[bytes] = d.decompress(
+                        # bounded like the native uncompress path — a
+                        # hostile stream must not balloon past `size`
+                        raw: Optional[bytes] = py.bounded_inflate(
                             buf, int(size)
                         )
-                        if d.unconsumed_tail or not d.eof:
-                            raw = None  # overflow or truncated stream
                     elif codec == py.LZW:
                         raw = py.lzw_decode(buf, int(size))
                     elif codec == py.PACKBITS:

@@ -39,6 +39,7 @@ class TestPlaneCache:
         dev = TilePipeline(
             service, engine="device", use_pallas=False, buckets=(256,),
         )
+        dev.mesh = None  # plane cache is the single-device path
         host = TilePipeline(service, engine="host")
         ctxs = [
             _ctx(0, 0, 256, 256),
@@ -74,6 +75,7 @@ class TestPlaneCache:
         pipe = TilePipeline(
             service, engine="device", use_pallas=False, buckets=(256,),
         )
+        pipe.mesh = None  # plane cache is the single-device path
         pipe._plane_cache = DevicePlaneCache(max_bytes=0)
         out = pipe.handle_batch([_ctx(0, 0, 256, 256)])
         np.testing.assert_array_equal(
@@ -122,6 +124,7 @@ def test_admission_single_touch_per_batch(image):
     pipe = TilePipeline(
         service, engine="device", use_pallas=False, buckets=(256,),
     )
+    pipe.mesh = None  # plane cache is the single-device path
     batch = [_ctx(0, 0, 256, 256), _ctx(128, 128, 256, 256)]
     out1 = pipe.handle_batch(list(batch))
     assert all(o is not None for o in out1)
@@ -151,6 +154,7 @@ def test_admission_one_touch_across_buckets(image):
     pipe = TilePipeline(
         service, engine="device", use_pallas=False, buckets=(256, 512),
     )
+    pipe.mesh = None  # plane cache is the single-device path
     batch = [_ctx(0, 0, 256, 256), _ctx(0, 0, 400, 400)]  # two buckets
     out1 = pipe.handle_batch(list(batch))
     assert all(o is not None for o in out1)

@@ -1,0 +1,122 @@
+"""The serving mesh: TilePipeline.handle_batch end-to-end on the
+8-virtual-device CPU mesh (conftest), byte-identical to single-device.
+
+VERDICT r2 item 3: the mesh must actually serve tiles — device-PNG
+bucket groups ride ``sharded_batch_filter`` (data parallel over the
+mesh) and plane-sized PNG lanes ride ``distributed_filter_plane``
+(rows sharded, one-row halo exchange), replacing the reference's
+worker-pool parallelism (PixelBufferMicroserviceVerticle.java:224-233)
+with ICI-resident parallelism."""
+
+import io
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
+from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+    ImageRegistry,
+    PixelsService,
+)
+from omero_ms_pixel_buffer_tpu.models.tile_pipeline import TilePipeline
+from omero_ms_pixel_buffer_tpu.tile_ctx import RegionDef, TileCtx
+
+rng = np.random.default_rng(29)
+
+# 1200 wide: wider than the largest default bucket (1024), so a
+# full-plane PNG request cannot take the bucket path and must go
+# space-parallel when a mesh is present
+IMG = rng.integers(0, 60000, (1, 1, 2, 160, 1200), dtype=np.uint16)
+
+
+def _ctx(z=0, x=0, y=0, w=64, h=64, fmt="png"):
+    return TileCtx(
+        image_id=1, z=z, c=0, t=0, region=RegionDef(x, y, w, h),
+        format=fmt, omero_session_key="k",
+    )
+
+
+@pytest.fixture(scope="module")
+def service(tmp_path_factory):
+    root = tmp_path_factory.mktemp("mesh-serving")
+    path = str(root / "img.ome.tiff")
+    write_ome_tiff(path, IMG, tile_size=(64, 64))
+    registry = ImageRegistry()
+    registry.add(1, path)
+    svc = PixelsService(registry)
+    yield svc
+    svc.close()
+
+
+@pytest.fixture
+def pipes(service):
+    import jax
+
+    assert len(jax.devices()) == 8, "conftest should provide 8 devices"
+    multi = TilePipeline(service, engine="device")
+    single = TilePipeline(service, engine="device")
+    single.mesh = None  # force the single-device stages
+    return multi, single
+
+
+BATCH = [
+    _ctx(x=0, y=0, w=64, h=64),
+    _ctx(x=128, y=32, w=100, h=80),   # non-bucket-aligned
+    _ctx(z=1, x=1150, y=110, w=50, h=50),  # edge tile
+    _ctx(x=0, y=0, w=256, h=128),     # larger bucket
+    _ctx(x=64, y=0, w=64, h=64, fmt=None),   # raw lane
+    _ctx(x=64, y=64, w=64, h=64, fmt="tif"),  # tif lane
+    _ctx(w=0, h=0),                   # full plane -> space parallel
+]
+
+
+class TestMeshServing:
+    def test_mesh_auto_builds(self, pipes):
+        multi, single = pipes
+        assert multi._get_mesh() is not None
+        assert dict(multi._get_mesh().shape) == {"data": 8}
+        assert single._get_mesh() is None
+
+    def test_batch_byte_identical_to_single_device(self, pipes):
+        multi, single = pipes
+        out_multi = multi.handle_batch([_c for _c in BATCH])
+        out_single = single.handle_batch([_c for _c in BATCH])
+        assert all(o is not None for o in out_multi)
+        # bucketed/raw/tif lanes: identical stages -> identical bytes
+        for i in range(6):
+            assert out_multi[i] == out_single[i], f"lane {i} differs"
+
+    def test_full_plane_pixels_exact(self, pipes):
+        multi, _ = pipes
+        out = multi.handle_batch([_ctx(w=0, h=0)])
+        png = np.array(Image.open(io.BytesIO(out[0])))
+        np.testing.assert_array_equal(png, IMG[0, 0, 0])
+
+    def test_bucketed_pixels_exact(self, pipes):
+        multi, _ = pipes
+        out = multi.handle_batch([_ctx(x=128, y=32, w=100, h=80)])
+        png = np.array(Image.open(io.BytesIO(out[0])))
+        np.testing.assert_array_equal(
+            png, IMG[0, 0, 0, 32:112, 128:228]
+        )
+
+    def test_plane_cache_superseded_by_mesh(self, service):
+        """With a mesh the DP bucket path must serve lanes the plane
+        cache would otherwise claim (single-chip residency would idle
+        the other chips)."""
+        multi = TilePipeline(service, engine="device", use_plane_cache=True)
+        assert multi._get_mesh() is not None
+        out = multi.handle_batch([_ctx(x=0, y=0, w=64, h=64)])
+        assert out[0] is not None
+        assert multi._plane_cache is None  # never built
+
+    def test_odd_batch_padding(self, pipes):
+        """Lane counts not divisible by the mesh size pad and slice."""
+        multi, single = pipes
+        ctxs = [
+            _ctx(x=64 * i, y=0, w=64, h=64) for i in range(13)
+        ]
+        out_multi = multi.handle_batch(list(ctxs))
+        out_single = single.handle_batch(list(ctxs))
+        assert out_multi == out_single
