@@ -94,6 +94,20 @@ def assemble_png(
     )
 
 
+def frame_png(
+    idat: bytes, width: int, height: int, bit_depth: int, color_type: int
+) -> bytes:
+    """Wrap an already-complete zlib stream (e.g. built on device by
+    ops/device_deflate) into a PNG container — the host's remaining
+    role is chunk framing and CRC over opaque bytes."""
+    return (
+        PNG_SIGNATURE
+        + _ihdr(width, height, bit_depth, color_type)
+        + _chunk(b"IDAT", idat)
+        + _chunk(b"IEND", b"")
+    )
+
+
 # ---------------------------------------------------------------------------
 # Host (numpy) filtering — reference-parity fallback path
 # ---------------------------------------------------------------------------

@@ -71,6 +71,11 @@ class PngConfig:
     level: int = 6
     # fast | default | filtered | huffman | rle | fixed
     strategy: str = "fast"
+    # Build the zlib stream on the accelerator (stored blocks,
+    # ops/device_deflate) for bucket-exact device lanes instead of
+    # host deflate. Spec-valid but uncompressed — a co-located-chip
+    # option that removes the host CPU from the encode path.
+    device_deflate: bool = False
 
 
 @dataclasses.dataclass
@@ -204,6 +209,9 @@ class Config:
                 filter=png_raw.get("filter", "up"),
                 level=int(png_raw.get("level", 6)),
                 strategy=png_raw.get("strategy", "fast"),
+                device_deflate=bool(
+                    png_raw.get("device-deflate", False)
+                ),
             ),
             max_tile_mb=int(be_raw.get("max-tile-mb", 256)),
         )
