@@ -230,11 +230,16 @@ def device_sub_main():
     registry.add(1, path)
     service = PixelsService(registry)
     out = {}
-    for label, plane_cache in (("plane_cache", True), ("bucket", False)):
+    for label, plane_cache, dev_deflate in (
+        ("plane_cache", True, False),
+        ("bucket", False, False),
+        # on-device deflate: only compressed bytes cross the link back
+        ("bucket_devdeflate", False, True),
+    ):
         try:
             pipe = TilePipeline(
                 service, engine="device", buckets=(512,),
-                use_plane_cache=plane_cache,
+                use_plane_cache=plane_cache, device_deflate=dev_deflate,
             )
             if plane_cache:
                 # the plane cache is the single-device HBM path; with

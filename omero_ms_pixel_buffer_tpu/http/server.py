@@ -197,6 +197,7 @@ class PixelBufferApp:
             png_level=config.backend.png.level,
             png_strategy=config.backend.png.strategy,
             max_tile_bytes=config.backend.max_tile_mb << 20,
+            device_deflate=config.backend.png.device_deflate,
         )
         self.worker = BatchingTileWorker(
             self.pipeline,

@@ -104,6 +104,7 @@ class TilePipeline:
         engine: str = "auto",
         use_plane_cache: bool = True,
         max_tile_bytes: int = 256 << 20,
+        device_deflate: bool = False,
     ):
         self.pixels_service = pixels_service
         self.png_filter = png_filter
@@ -115,6 +116,12 @@ class TilePipeline:
             raise ValueError(f"Unknown engine: {engine}")
         self._engine = engine
         self._use_pallas_arg = use_pallas
+        # Build the zlib stream on the accelerator (ops/device_deflate)
+        # for device PNG lanes: filtered scanlines never come back raw —
+        # only the (compressed) stream crosses the link, and the host's
+        # role shrinks to PNG chunk framing. Replaces the host half of
+        # the reference's encode hot loop (TileRequestHandler.java:176-199).
+        self.device_deflate = device_deflate
         self.use_plane_cache = use_plane_cache
         self._plane_cache = None  # built lazily on first device batch
         # serving mesh: "auto" -> built on first device batch when >1
@@ -509,16 +516,19 @@ class TilePipeline:
                 plane, coords, bh, bw
             )
             if self.use_pallas and pallas_supports((bh, bw), dtype):
-                filtered = np.asarray(
-                    pallas_filter_tiles(device_batch, self.png_filter)
-                )
+                filtered = pallas_filter_tiles(device_batch, self.png_filter)
             else:
                 rows = to_big_endian_bytes(device_batch)
-                filtered = np.asarray(
-                    filter_batch(rows, itemsize, self.png_filter)
-                )
+                filtered = filter_batch(rows, itemsize, self.png_filter)
         sizes = [(resolved[i].w, resolved[i].h) for i in lanes]
-        self._finish_png_lanes(filtered, lanes, sizes, results, itemsize)
+        if self.device_deflate:
+            self._finish_png_lanes_device(
+                filtered, lanes, sizes, results, itemsize
+            )
+        else:
+            self._finish_png_lanes(
+                np.asarray(filtered), lanes, sizes, results, itemsize
+            )
 
     def _finish_png_lanes(self, filtered, lanes, sizes, results, itemsize):
         """Deflate + frame filtered device output (shared tail of both
@@ -566,6 +576,47 @@ class TilePipeline:
                     log.exception("encode failed for lane %d", i)
                     results[i] = None
 
+    def _finish_png_lanes_device(
+        self, filtered, lanes, sizes, results, itemsize
+    ):
+        """On-device encode tail: the zlib stream itself is built on the
+        accelerator (ops/device_deflate — lane-parallel RLE match scan +
+        fixed-Huffman bit packing), so only compressed bytes cross the
+        link and the host's role shrinks to PNG chunk framing (CRC over
+        opaque bytes). Lanes group by real (w, h): stream layout is
+        static per payload length, one jit specialization per size.
+        Falls back to the host deflate tail on any device failure."""
+        from ..ops.device_deflate import deflate_filtered_batch
+        from ..ops.png import frame_png
+
+        bit_depth = itemsize * 8
+        groups: Dict[Tuple[int, int], List[int]] = {}
+        for j, wh in enumerate(sizes):
+            groups.setdefault(wh, []).append(j)
+        try:
+            with TRACER.start_span("batch_encode"):
+                for (w, h), js in groups.items():
+                    sub = (
+                        filtered
+                        if len(js) == filtered.shape[0]
+                        else filtered[jnp.asarray(js)]
+                    )
+                    streams, lengths = deflate_filtered_batch(
+                        sub, h, 1 + w * itemsize
+                    )
+                    streams = np.asarray(streams)
+                    lengths = np.asarray(lengths)
+                    for j, stream, length in zip(js, streams, lengths):
+                        results[lanes[j]] = frame_png(
+                            stream[: int(length)].tobytes(),
+                            w, h, bit_depth, 0,
+                        )
+        except Exception:
+            log.exception("device deflate failed; host deflate tail")
+            self._finish_png_lanes(
+                np.asarray(filtered), lanes, sizes, results, itemsize
+            )
+
     def _host_png_lanes(self, lanes, tiles, ctxs, results) -> None:
         """Host engine: the whole batch in one fused native call
         (byteswap + filter + deflate + framing on the C++ pool). Falls
@@ -611,23 +662,28 @@ class TilePipeline:
                 n = mesh.shape["data"]
                 padded, real = pad_batch(jnp.asarray(batch), n)
                 sharded = shard_batch(mesh, padded)
-                filtered = np.asarray(
-                    sharded_batch_filter(
-                        mesh, sharded, itemsize, self.png_filter
-                    )
+                filtered = sharded_batch_filter(
+                    mesh, sharded, itemsize, self.png_filter
                 )[:real]
             elif self.use_pallas and pallas_supports((bh, bw), dtype):
                 # fused Pallas kernel: byteswap + filter in one VMEM pass
-                filtered = np.asarray(
-                    pallas_filter_tiles(jnp.asarray(batch), self.png_filter)
+                filtered = pallas_filter_tiles(
+                    jnp.asarray(batch), self.png_filter
                 )
             else:
                 rows = to_big_endian_bytes(jnp.asarray(batch))
-                filtered = np.asarray(
-                    filter_batch(rows, itemsize, self.png_filter)
+                filtered = filter_batch(
+                    rows, itemsize, self.png_filter
                 )  # (B, bh, 1 + bw*itemsize)
         sizes = [(tiles[i].shape[1], tiles[i].shape[0]) for i in lanes]
-        self._finish_png_lanes(filtered, lanes, sizes, results, itemsize)
+        if self.device_deflate:
+            self._finish_png_lanes_device(
+                filtered, lanes, sizes, results, itemsize
+            )
+        else:
+            self._finish_png_lanes(
+                np.asarray(filtered), lanes, sizes, results, itemsize
+            )
 
     def _distributed_plane_lane(self, mesh, i, tile, results) -> None:
         """Space-parallel path for one plane-sized PNG lane: rows shard

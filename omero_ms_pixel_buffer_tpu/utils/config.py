@@ -71,11 +71,12 @@ class PngConfig:
     level: int = 6
     # fast | default | filtered | huffman | rle | fixed
     strategy: str = "fast"
-    # Build the zlib stream on the accelerator (stored blocks,
-    # ops/device_deflate) for bucket-exact device lanes instead of
-    # host deflate. Spec-valid but uncompressed — a co-located-chip
-    # option that removes the host CPU from the encode path.
-    device_deflate: bool = False
+    # Build the zlib stream on the accelerator (lane-parallel RLE +
+    # fixed-Huffman, ops/device_deflate) for device PNG lanes instead
+    # of host deflate: only compressed bytes cross the link and the
+    # host's role shrinks to PNG chunk framing. On by default — it
+    # only engages when the device engine serves the lane.
+    device_deflate: bool = True
 
 
 @dataclasses.dataclass
@@ -210,7 +211,7 @@ class Config:
                 level=int(png_raw.get("level", 6)),
                 strategy=png_raw.get("strategy", "fast"),
                 device_deflate=bool(
-                    png_raw.get("device-deflate", False)
+                    png_raw.get("device-deflate", True)
                 ),
             ),
             max_tile_mb=int(be_raw.get("max-tile-mb", 256)),
