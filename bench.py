@@ -102,12 +102,19 @@ def run_batched(pipe, ctxs, batch):
     return done / (time.perf_counter() - t0)
 
 
-def bench_http(path: str, n_requests: int, concurrency: int) -> dict:
+def bench_http(
+    path: str, n_requests: int, concurrency: int, engine: str = "auto"
+) -> dict:
     """Full-stack latency: aiohttp client over a real localhost socket
     -> tracing middleware -> session middleware -> bus.request ->
     BatchingTileWorker -> TilePipeline. The reference's hot path
     (TileRequestHandler.java:80-139) ran per-request on a worker
-    thread behind Vert.x; this measures our complete analog."""
+    thread behind Vert.x; this measures our complete analog.
+
+    ``engine`` must be the probe-gated value computed in main(), NOT
+    re-read from the environment: BENCH_ENGINE=device on a wedged TPU
+    would otherwise hang this section at in-process PJRT init before
+    the bounded device child ever runs."""
     import aiohttp
     from aiohttp import web
 
@@ -124,12 +131,13 @@ def bench_http(path: str, n_requests: int, concurrency: int) -> dict:
     config = Config.from_dict(
         {
             "session-store": {"type": "memory"},
-            "backend": {"engine": os.environ.get("BENCH_ENGINE", "auto")},
+            "backend": {"engine": engine},
         }
     )
+    service = PixelsService(registry)
     app_obj = PixelBufferApp(
         config,
-        pixels_service=PixelsService(registry),
+        pixels_service=service,
         session_store=MemorySessionStore({"bench-cookie": "bench-key"}),
     )
     size = int(os.environ.get("BENCH_IMAGE_SIZE", "8192"))
@@ -175,6 +183,7 @@ def bench_http(path: str, n_requests: int, concurrency: int) -> dict:
                 elapsed = time.perf_counter() - t0
         finally:
             await runner.cleanup()
+            service.close()  # idempotent (app cleanup also closes it)
         lat_ms = np.array(latencies) * 1000.0
         return {
             "http_tiles_per_sec": round(len(urls) / elapsed, 2),
@@ -342,6 +351,7 @@ def main():
                 path,
                 int(os.environ.get("BENCH_HTTP_REQUESTS", "512")),
                 int(os.environ.get("BENCH_HTTP_CONCURRENCY", "64")),
+                engine=pipe.engine,  # probe-gated, never re-read from env
             )
             log(f"full-stack http: {http_stats}")
         except Exception as e:

@@ -218,6 +218,14 @@ class PixelBufferApp:
         from ..runtime.native import get_engine
 
         get_engine()
+        # likewise kick the accelerator probe in the background NOW:
+        # a wedged TPU tunnel costs the deploy (daemon thread), never
+        # a user's first request — serving starts on the host engine
+        # and upgrades when the probe lands
+        if self.pipeline._engine == "auto":
+            from ..runtime.device_probe import probe_nonblocking
+
+            probe_nonblocking()
 
     def make_app(self) -> web.Application:
         app = web.Application(

@@ -122,6 +122,8 @@ class TilePipeline:
         # role shrinks to PNG chunk framing. Replaces the host half of
         # the reference's encode hot loop (TileRequestHandler.java:176-199).
         self.device_deflate = device_deflate
+        self._device_deflate_logged = False
+        self._probe_error_logged: Optional[str] = None
         self.use_plane_cache = use_plane_cache
         self._plane_cache = None  # built lazily on first device batch
         # serving mesh: "auto" -> built on first device batch when >1
@@ -140,16 +142,31 @@ class TilePipeline:
 
     @property
     def engine(self) -> str:
-        """The resolved engine ('auto' resolves lazily at first use)."""
-        if self._engine == "auto":
-            # Bounded out-of-process probe: a wedged TPU runtime can
-            # HANG PJRT init, not just raise — resolving the engine
-            # in-process would stall the first batch forever instead
-            # of degrading to the host engine (which needs no jax).
-            from ..runtime.device_probe import probe
+        """The resolved engine.
 
+        'auto' resolves through the bounded out-of-process probe
+        (a wedged TPU runtime can HANG PJRT init, not just raise) and
+        NEVER waits for it: while the probe is pending — or while a
+        probe *error* is cached (errors expire after a TTL so a healed
+        tunnel upgrades a long-running server without a restart) — the
+        batch at hand serves from the host engine, which needs no jax,
+        and 'auto' stays unresolved. Only a definitive probe result
+        (a reachable backend, fast or slow) pins the engine."""
+        if self._engine == "auto":
+            from ..runtime.device_probe import probe_nonblocking
+
+            info = probe_nonblocking()
+            if info is None:
+                return "host"  # probe pending: serve host, stay auto
+            if "error" in info:
+                if info.get("error") != self._probe_error_logged:
+                    self._probe_error_logged = info["error"]
+                    log.warning(
+                        "accelerator unavailable (%s); serving host "
+                        "until the probe error expires", info["error"],
+                    )
+                return "host"  # transient: stay auto for recovery
             min_mbps = float(os.environ.get("OMPB_DEVICE_MIN_MBPS", "1000"))
-            info = probe()
             if (
                 info.get("backend") == "tpu"
                 and info.get("link_mbps", 0.0) >= min_mbps
@@ -157,11 +174,6 @@ class TilePipeline:
                 self._engine = "device"
             else:
                 self._engine = "host"
-                if "error" in info:
-                    log.warning(
-                        "accelerator unavailable (%s); engine 'auto' "
-                        "-> 'host'", info["error"],
-                    )
             log.info("engine auto-resolved to '%s'", self._engine)
         return self._engine
 
@@ -390,8 +402,11 @@ class TilePipeline:
             device_png = (
                 use_device
                 and ctx.format == "png"
-                and tile.ndim == 2
                 and tile.dtype in _PNG_DTYPES
+                and (
+                    tile.ndim == 2
+                    or (tile.ndim == 3 and tile.shape[2] == 3)
+                )
             )
             bucket = (
                 self._bucket(tile.shape[1], tile.shape[0])
@@ -399,11 +414,13 @@ class TilePipeline:
             )
             if bucket is not None:
                 bw, bh = bucket
+                samples = 1 if tile.ndim == 2 else 3
                 png_groups.setdefault(
-                    ((bh, bw), tile.dtype.str), []
+                    ((bh, bw), tile.dtype.str, samples), []
                 ).append(i)
             elif (
                 device_png
+                and tile.ndim == 2
                 and mesh is not None
                 and self.png_filter == "up"
             ):
@@ -425,10 +442,11 @@ class TilePipeline:
                 log.exception("distributed plane lane failed; host fallback")
                 results[i] = self.encode(ctxs[i], tiles[i])
 
-        for ((bh, bw), dtype_str), lanes in png_groups.items():
+        for ((bh, bw), dtype_str, samples), lanes in png_groups.items():
             try:
                 self._device_png_lanes(
-                    lanes, tiles, ctxs, results, bh, bw, np.dtype(dtype_str)
+                    lanes, tiles, ctxs, results, bh, bw,
+                    np.dtype(dtype_str), samples,
                 )
             except Exception:
                 log.exception("device PNG batch failed; host fallback")
@@ -530,13 +548,17 @@ class TilePipeline:
                 np.asarray(filtered), lanes, sizes, results, itemsize
             )
 
-    def _finish_png_lanes(self, filtered, lanes, sizes, results, itemsize):
+    def _finish_png_lanes(
+        self, filtered, lanes, sizes, results, itemsize, samples=1
+    ):
         """Deflate + frame filtered device output (shared tail of both
         device paths). Padding slices away per lane: filters never look
         right or down, so the real region's bytes are identical."""
         bit_depth = itemsize * 8
+        color_type = 0 if samples == 1 else 2
+        bpp = samples * itemsize
         payloads = [
-            filtered[j, :h, : 1 + w * itemsize].tobytes()
+            filtered[j, :h, : 1 + w * bpp].tobytes()
             for j, (w, h) in enumerate(sizes)
         ]
         engine = get_engine()
@@ -547,7 +569,7 @@ class TilePipeline:
                     widths=[w for w, _ in sizes],
                     heights=[h for _, h in sizes],
                     bit_depths=[bit_depth] * len(lanes),
-                    color_types=[0] * len(lanes),
+                    color_types=[color_type] * len(lanes),
                     level=self.png_level,
                     strategy=self.png_strategy,
                 )
@@ -555,7 +577,7 @@ class TilePipeline:
                 if png is None:
                     w, h = sizes[j]
                     results[i] = assemble_png(
-                        payloads[j], w, h, bit_depth, 0,
+                        payloads[j], w, h, bit_depth, color_type,
                         self.png_level, self.png_strategy,
                     )
                 else:
@@ -565,7 +587,8 @@ class TilePipeline:
             futs = {
                 i: self._encode_pool.submit(
                     assemble_png, payloads[j], sizes[j][0], sizes[j][1],
-                    bit_depth, 0, self.png_level, self.png_strategy,
+                    bit_depth, color_type, self.png_level,
+                    self.png_strategy,
                 )
                 for j, i in enumerate(lanes)
             }
@@ -577,7 +600,7 @@ class TilePipeline:
                     results[i] = None
 
     def _finish_png_lanes_device(
-        self, filtered, lanes, sizes, results, itemsize
+        self, filtered, lanes, sizes, results, itemsize, samples=1
     ):
         """On-device encode tail: the zlib stream itself is built on the
         accelerator (ops/device_deflate — lane-parallel RLE match scan +
@@ -589,7 +612,16 @@ class TilePipeline:
         from ..ops.device_deflate import deflate_filtered_batch
         from ..ops.png import frame_png
 
+        if not self._device_deflate_logged:
+            self._device_deflate_logged = True
+            log.info(
+                "device deflate active: PNG lanes compress on the "
+                "accelerator (RLE + fixed Huffman); backend.png.level/"
+                "strategy apply only to host-encoded lanes"
+            )
         bit_depth = itemsize * 8
+        color_type = 0 if samples == 1 else 2
+        bpp = samples * itemsize
         groups: Dict[Tuple[int, int], List[int]] = {}
         for j, wh in enumerate(sizes):
             groups.setdefault(wh, []).append(j)
@@ -602,19 +634,20 @@ class TilePipeline:
                         else filtered[jnp.asarray(js)]
                     )
                     streams, lengths = deflate_filtered_batch(
-                        sub, h, 1 + w * itemsize
+                        sub, h, 1 + w * bpp
                     )
                     streams = np.asarray(streams)
                     lengths = np.asarray(lengths)
                     for j, stream, length in zip(js, streams, lengths):
                         results[lanes[j]] = frame_png(
                             stream[: int(length)].tobytes(),
-                            w, h, bit_depth, 0,
+                            w, h, bit_depth, color_type,
                         )
         except Exception:
             log.exception("device deflate failed; host deflate tail")
             self._finish_png_lanes(
-                np.asarray(filtered), lanes, sizes, results, itemsize
+                np.asarray(filtered), lanes, sizes, results, itemsize,
+                samples,
             )
 
     def _host_png_lanes(self, lanes, tiles, ctxs, results) -> None:
@@ -640,13 +673,22 @@ class TilePipeline:
                 png if png is not None else self.encode(ctxs[i], tiles[i])
             )
 
-    def _device_png_lanes(self, lanes, tiles, ctxs, results, bh, bw, dtype):
+    def _device_png_lanes(
+        self, lanes, tiles, ctxs, results, bh, bw, dtype, samples=1
+    ):
         """Host-staged device path: tiles padded into one bucket batch,
         transferred, filtered on device, then the shared deflate tail.
-        With a serving mesh the batch axis shards across chips (data
-        parallel — the reference's worker pool over ICI)."""
+        Grayscale and RGB ride the same math — the filter unit (bpp) is
+        just samples*itemsize bytes. With a serving mesh the batch axis
+        shards across chips (data parallel — the reference's worker
+        pool over ICI)."""
         itemsize = dtype.itemsize
-        batch = np.zeros((len(lanes), bh, bw), dtype=dtype)
+        bpp = samples * itemsize
+        shape = (
+            (len(lanes), bh, bw) if samples == 1
+            else (len(lanes), bh, bw, samples)
+        )
+        batch = np.zeros(shape, dtype=dtype)
         for j, i in enumerate(lanes):
             t = tiles[i]
             batch[j, : t.shape[0], : t.shape[1]] = t
@@ -663,26 +705,34 @@ class TilePipeline:
                 padded, real = pad_batch(jnp.asarray(batch), n)
                 sharded = shard_batch(mesh, padded)
                 filtered = sharded_batch_filter(
-                    mesh, sharded, itemsize, self.png_filter
+                    mesh, sharded, bpp, self.png_filter
                 )[:real]
-            elif self.use_pallas and pallas_supports((bh, bw), dtype):
+            elif (
+                samples == 1
+                and self.use_pallas
+                and pallas_supports((bh, bw), dtype)
+            ):
                 # fused Pallas kernel: byteswap + filter in one VMEM pass
                 filtered = pallas_filter_tiles(
                     jnp.asarray(batch), self.png_filter
                 )
             else:
                 rows = to_big_endian_bytes(jnp.asarray(batch))
+                if samples > 1:
+                    # (B, bh, bw, S*itemsize) interleaved -> scanrows
+                    rows = rows.reshape(len(lanes), bh, bw * bpp)
                 filtered = filter_batch(
-                    rows, itemsize, self.png_filter
-                )  # (B, bh, 1 + bw*itemsize)
+                    rows, bpp, self.png_filter
+                )  # (B, bh, 1 + bw*bpp)
         sizes = [(tiles[i].shape[1], tiles[i].shape[0]) for i in lanes]
         if self.device_deflate:
             self._finish_png_lanes_device(
-                filtered, lanes, sizes, results, itemsize
+                filtered, lanes, sizes, results, itemsize, samples
             )
         else:
             self._finish_png_lanes(
-                np.asarray(filtered), lanes, sizes, results, itemsize
+                np.asarray(filtered), lanes, sizes, results, itemsize,
+                samples,
             )
 
     def _distributed_plane_lane(self, mesh, i, tile, results) -> None:

@@ -42,6 +42,9 @@ from ..ops.png import FILTER_UP, _filter_batch
 def _sharded_batch_filter(mesh, tiles, bpp, mode, axis):
     def local(tiles_blk):
         rows = to_big_endian_bytes(tiles_blk)
+        if rows.ndim == 4:
+            # (B, H, W, S*itemsize) interleaved sample bytes -> scanrows
+            rows = rows.reshape(*rows.shape[:2], -1)
         return _filter_batch(rows, bpp, mode)
 
     fn = shard_map(
@@ -60,10 +63,11 @@ def sharded_batch_filter(
     mode: str = "up",
     axis: str = "data",
 ) -> jax.Array:
-    """Batch-parallel PNG prep: (B, H, W) native-dtype tiles ->
-    (B, H, 1 + W*itemsize) filtered scanlines, batch sharded over
-    ``axis``. B must be divisible by the axis size — pad partial
-    batches with ``pad_batch`` first. Jit-cached per
+    """Batch-parallel PNG prep: (B, H, W) grayscale or (B, H, W, S)
+    interleaved-sample tiles -> (B, H, 1 + W*bpp) filtered scanlines,
+    batch sharded over ``axis``; ``bpp`` is the full filter unit
+    (samples * itemsize). B must be divisible by the axis size — pad
+    partial batches with ``pad_batch`` first. Jit-cached per
     (mesh, shape, bpp, mode)."""
     return _sharded_batch_filter(mesh, tiles, bpp, mode, axis)
 
