@@ -256,7 +256,10 @@ def device_sub_main():
                 # label would silently duplicate the bucket number
                 pipe.mesh = None
             ctxs = make_ctxs(n, size, seed=23)
-            pipe.handle_batch(ctxs[:16])  # warm: jit + staging
+            # warm with the RUN's batch size: device jit programs are
+            # per-(batch, shape), and a mismatched warmup would leave a
+            # tens-of-seconds compile inside the timed region
+            pipe.handle_batch(ctxs[:32])
             tps = run_batched(pipe, ctxs, 32)
             out[f"tiles_per_sec_{label}"] = round(tps, 2)
             log(f"[device] {label} path: {tps:.1f} tiles/s")

@@ -76,6 +76,8 @@ class ImageRegistry(MetadataResolver):
 
     def resolve_path(self, entry: dict) -> str:
         p = entry["path"]
+        if p.startswith(("s3://", "http://", "https://")):
+            return p  # remote store URI, never root-relative
         return p if os.path.isabs(p) else os.path.join(self._root, p)
 
     def get_pixels(self, image_id: int) -> Optional[PixelsMeta]:
@@ -110,7 +112,12 @@ def _open_buffer(
     if kind == "romio":
         meta = registry.get_pixels(image_id)
         return RomioPixelBuffer(path, meta)
-    if kind == "zarr" or (kind is None and os.path.isdir(path)):
+    is_remote = path.startswith(("s3://", "http://", "https://"))
+    if kind == "zarr" or (kind is None and os.path.isdir(path)) or (
+        # remote NGFF: s3://bucket/img.zarr or an HTTP-exposed hierarchy
+        # (the reference's ZarrPixelsService serves S3 or filesystem)
+        kind is None and is_remote
+    ):
         return ZarrPixelBuffer(
             path, image_id=image_id, image_name=name,
             block_cache=block_cache,

@@ -1,0 +1,269 @@
+"""Key-value chunk stores: filesystem, HTTP, and S3 (SigV4).
+
+The reference's ``ZarrPixelsService`` serves OME-NGFF from **S3 or
+filesystem** (omero-zarr-pixel-buffer, /root/reference/build.gradle:57);
+this module is that storage plane. A store maps relative keys
+(``0/.zarray``, ``0/0.0.1.2.3``) to bytes; ``None`` means the key does
+not exist (Zarr fill_value semantics — an absent chunk is legitimate).
+
+- ``FileStore`` — directory root.
+- ``HTTPStore`` — any static HTTP server exposing the hierarchy
+  (https://host/path/<key>); 404 -> None.
+- ``S3Store`` — ``s3://bucket/prefix`` with AWS Signature V4 over
+  stdlib (urllib + hmac/hashlib; no SDK in the image). Credentials
+  from the standard env (AWS_ACCESS_KEY_ID / AWS_SECRET_ACCESS_KEY /
+  AWS_SESSION_TOKEN, region AWS_REGION); ``OMPB_S3_ENDPOINT`` points
+  at a custom endpoint (MinIO, test fakes) using path-style addressing.
+  Anonymous (unsigned) access when no credentials are configured.
+
+``make_store(uri)`` picks by scheme.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import http.client
+import os
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Optional, Tuple
+
+_EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+
+
+class _KeepAlive:
+    """Thread-local persistent connections keyed by (scheme, netloc).
+
+    A tile overlapping k chunks issues k sequential GETs on the serving
+    hot path; per-request TCP+TLS handshakes (urllib has no keep-alive)
+    would dominate remote-NGFF latency. One retry on a stale
+    connection (server closed the idle socket)."""
+
+    def __init__(self):
+        self._local = threading.local()
+
+    def get(
+        self, url: str, headers: dict, timeout_s: float
+    ) -> Tuple[int, bytes]:
+        parsed = urllib.parse.urlsplit(url)
+        key = (parsed.scheme, parsed.netloc)
+        conns = getattr(self._local, "conns", None)
+        if conns is None:
+            conns = self._local.conns = {}
+        path = parsed.path or "/"
+        if parsed.query:
+            path += f"?{parsed.query}"
+        last_error: Optional[Exception] = None
+        for attempt in (0, 1):
+            conn = conns.get(key)
+            if conn is None:
+                cls = (
+                    http.client.HTTPSConnection
+                    if parsed.scheme == "https"
+                    else http.client.HTTPConnection
+                )
+                conn = cls(parsed.netloc, timeout=timeout_s)
+                conns[key] = conn
+            try:
+                conn.request("GET", path, headers=headers)
+                resp = conn.getresponse()
+                body = resp.read()  # drain so the socket is reusable
+                return resp.status, body
+            except (http.client.HTTPException, OSError) as e:
+                conn.close()
+                conns.pop(key, None)
+                last_error = e
+        raise StoreError(f"GET {url} failed: {last_error}")
+
+
+class StoreError(IOError):
+    """Store-level failure that is NOT a missing key (auth, transport,
+    5xx) — callers must not treat it as fill_value."""
+
+
+class FileStore:
+    def __init__(self, root: str):
+        self.root = root
+
+    def get(self, key: str) -> Optional[bytes]:
+        path = os.path.join(self.root, key)
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+        except IsADirectoryError:
+            return None
+
+    def describe(self) -> str:
+        return self.root
+
+
+class HTTPStore:
+    """Read-only store over HTTP(S) GETs with keep-alive."""
+
+    def __init__(self, base_url: str, timeout_s: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+        self._conns = _KeepAlive()
+
+    def get(self, key: str) -> Optional[bytes]:
+        url = f"{self.base_url}/{urllib.parse.quote(key)}"
+        status, body = self._conns.get(url, {}, self.timeout_s)
+        if status == 200:
+            return body
+        if status in (404, 410):
+            return None
+        raise StoreError(f"HTTP {status} for {url}")
+
+    def describe(self) -> str:
+        return self.base_url
+
+
+def _sign(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def sigv4_headers(
+    method: str,
+    host: str,
+    canonical_uri: str,
+    region: str,
+    access_key: str,
+    secret_key: str,
+    session_token: Optional[str] = None,
+    payload_sha256: str = _EMPTY_SHA256,
+    now: Optional[datetime.datetime] = None,
+    service: str = "s3",
+) -> dict:
+    """AWS Signature Version 4 headers for a request with no query
+    string. Exposed standalone so tests can verify signatures
+    server-side."""
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = now.strftime("%Y%m%d")
+    headers = {
+        "host": host,
+        "x-amz-content-sha256": payload_sha256,
+        "x-amz-date": amz_date,
+    }
+    if session_token:
+        headers["x-amz-security-token"] = session_token
+    signed = ";".join(sorted(headers))
+    canonical_headers = "".join(
+        f"{k}:{headers[k]}\n" for k in sorted(headers)
+    )
+    canonical_request = "\n".join(
+        [method, canonical_uri, "", canonical_headers, signed,
+         payload_sha256]
+    )
+    scope = f"{datestamp}/{region}/{service}/aws4_request"
+    string_to_sign = "\n".join(
+        [
+            "AWS4-HMAC-SHA256",
+            amz_date,
+            scope,
+            hashlib.sha256(canonical_request.encode()).hexdigest(),
+        ]
+    )
+    k = _sign(("AWS4" + secret_key).encode(), datestamp)
+    k = _sign(k, region)
+    k = _sign(k, service)
+    k = _sign(k, "aws4_request")
+    signature = hmac.new(
+        k, string_to_sign.encode(), hashlib.sha256
+    ).hexdigest()
+    headers["authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+        f"SignedHeaders={signed}, Signature={signature}"
+    )
+    return headers
+
+
+class S3Store:
+    """``s3://bucket/prefix`` chunk store over stdlib HTTP + SigV4.
+
+    Endpoint resolution: ``OMPB_S3_ENDPOINT`` (path-style, for MinIO
+    and tests) else ``https://<bucket>.s3.<region>.amazonaws.com``
+    (virtual-hosted)."""
+
+    def __init__(
+        self,
+        uri: str,
+        endpoint: Optional[str] = None,
+        region: Optional[str] = None,
+        timeout_s: float = 60.0,
+    ):
+        parsed = urllib.parse.urlparse(uri)
+        if parsed.scheme != "s3" or not parsed.netloc:
+            raise ValueError(f"not an s3 URI: {uri}")
+        self.bucket = parsed.netloc
+        self.prefix = parsed.path.strip("/")
+        self.region = region or os.environ.get("AWS_REGION") or os.environ.get(
+            "AWS_DEFAULT_REGION", "us-east-1"
+        )
+        self.timeout_s = timeout_s
+        endpoint = endpoint or os.environ.get("OMPB_S3_ENDPOINT")
+        if endpoint:
+            self._base = endpoint.rstrip("/")
+            self._path_style = True
+        else:
+            self._base = (
+                f"https://{self.bucket}.s3.{self.region}.amazonaws.com"
+            )
+            self._path_style = False
+        self.access_key = os.environ.get("AWS_ACCESS_KEY_ID")
+        self.secret_key = os.environ.get("AWS_SECRET_ACCESS_KEY")
+        self.session_token = os.environ.get("AWS_SESSION_TOKEN")
+        # Without s3:ListBucket, S3 answers 403 AccessDenied for keys
+        # that simply don't exist — indistinguishable from real auth
+        # failure. Default is the safe read (403 raises); deployments
+        # reading sparse images from such buckets opt into treating
+        # 403 as an absent chunk (fill_value).
+        self.treat_403_as_missing = (
+            os.environ.get("OMPB_S3_403_AS_MISSING", "0") == "1"
+        )
+        self._conns = _KeepAlive()
+
+    def _url_and_path(self, key: str) -> Tuple[str, str]:
+        rel = f"{self.prefix}/{key}" if self.prefix else key
+        quoted = urllib.parse.quote(rel)
+        if self._path_style:
+            path = f"/{self.bucket}/{quoted}"
+        else:
+            path = f"/{quoted}"
+        return self._base + path, path
+
+    def get(self, key: str) -> Optional[bytes]:
+        url, canonical_path = self._url_and_path(key)
+        headers: dict = {}
+        if self.access_key and self.secret_key:
+            host = urllib.parse.urlparse(url).netloc
+            headers = sigv4_headers(
+                "GET", host, canonical_path, self.region,
+                self.access_key, self.secret_key, self.session_token,
+            )
+        status, body = self._conns.get(url, headers, self.timeout_s)
+        if status == 200:
+            return body
+        if status == 404:
+            return None
+        if status == 403 and self.treat_403_as_missing:
+            return None
+        raise StoreError(f"S3 {status} for s3://{self.bucket}/{key}")
+
+    def describe(self) -> str:
+        return f"s3://{self.bucket}/{self.prefix}"
+
+
+def make_store(uri: str):
+    """Scheme-dispatched store factory: s3:// | http(s):// | path."""
+    if uri.startswith("s3://"):
+        return S3Store(uri)
+    if uri.startswith(("http://", "https://")):
+        return HTTPStore(uri)
+    return FileStore(uri)

@@ -1,0 +1,204 @@
+"""Blosc v1 container codec (decode + fixture-grade encode).
+
+OME-NGFF chunks in the wild are overwhelmingly Blosc frames (the
+numcodecs default is ``Blosc(cname='lz4', shuffle=SHUFFLE)``); the
+reference reads them through omero-zarr-pixel-buffer's JNI blosc
+(/root/reference/build.gradle:57). No ``blosc`` package ships here, so
+the container is parsed in-tree.
+
+Frame layout (c-blosc 1.x, BLOSC_VERSION_FORMAT 2):
+
+    byte 0   version            byte 1   versionlz
+    byte 2   flags: bit0 byte-shuffle, bit1 memcpyed, bit2 bit-shuffle,
+             bits 5-7 codec (0 blosclz, 1 lz4/lz4hc, 2 snappy,
+             3 zlib, 4 zstd)
+    byte 3   typesize
+    4-7      nbytes   (LE, uncompressed)
+    8-11     blocksize(LE)
+    12-15    cbytes   (LE, whole frame)
+    then, unless memcpyed: int32 LE bstarts[nblocks] (absolute offsets),
+    each block at its bstart: int32 LE csize + csize compressed bytes
+    (csize == block size means the block is stored raw).
+
+Shuffle is per block: the leading ``size - size % typesize`` bytes are
+a (typesize, n) byte transpose; the remainder is copied verbatim.
+
+Supported codecs: lz4 (in-tree, ops/lz4), zstd (the ``zstandard``
+wheel), zlib (stdlib), memcpy. blosclz/snappy raise a clear error —
+callers surface it as an unreadable chunk.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib as _zlib
+
+import numpy as np
+
+from .lz4 import lz4_block_compress, lz4_block_decompress
+
+try:
+    import zstandard as _zstd
+except ImportError:  # pragma: no cover - baked into the image
+    _zstd = None
+
+_HEADER = 16
+_MEMCPYED = 0x2
+_BYTE_SHUFFLE = 0x1
+_BIT_SHUFFLE = 0x4
+_CODECS = {0: "blosclz", 1: "lz4", 2: "snappy", 3: "zlib", 4: "zstd"}
+_CODEC_IDS = {v: k for k, v in _CODECS.items()}
+
+
+class BloscError(ValueError):
+    pass
+
+
+def _unshuffle(block: bytes, typesize: int) -> bytes:
+    if typesize <= 1 or len(block) < typesize:
+        return block
+    main = len(block) - len(block) % typesize
+    arr = np.frombuffer(block, np.uint8, count=main)
+    un = arr.reshape(typesize, main // typesize).T.reshape(-1)
+    return un.tobytes() + block[main:]
+
+
+def _shuffle(block: bytes, typesize: int) -> bytes:
+    if typesize <= 1 or len(block) < typesize:
+        return block
+    main = len(block) - len(block) % typesize
+    arr = np.frombuffer(block, np.uint8, count=main)
+    sh = arr.reshape(main // typesize, typesize).T.reshape(-1)
+    return sh.tobytes() + block[main:]
+
+
+def blosc_decompress(data: bytes, expected_nbytes: int = -1) -> bytes:
+    """Decode one Blosc frame. ``expected_nbytes`` (e.g. the Zarr chunk
+    capacity) bounds hostile headers; -1 trusts the frame."""
+    if len(data) < _HEADER:
+        raise BloscError("truncated blosc header")
+    version, _versionlz, flags, typesize = data[0], data[1], data[2], data[3]
+    nbytes, blocksize, cbytes = struct.unpack_from("<iii", data, 4)
+    if version < 1 or version > 2:
+        raise BloscError(f"unsupported blosc version {version}")
+    if nbytes < 0 or blocksize <= 0 or cbytes != len(data):
+        raise BloscError("inconsistent blosc header")
+    if expected_nbytes >= 0 and nbytes > expected_nbytes:
+        raise BloscError(
+            f"blosc frame declares {nbytes} bytes, expected "
+            f"<= {expected_nbytes}"
+        )
+    if flags & _BIT_SHUFFLE:
+        raise BloscError("blosc bit-shuffle is not supported")
+    if nbytes == 0:
+        return b""
+    if flags & _MEMCPYED:
+        out = data[_HEADER : _HEADER + nbytes]
+        if len(out) != nbytes:
+            raise BloscError("truncated memcpy frame")
+        return out
+    codec = _CODECS.get(flags >> 5)
+    nblocks = -(-nbytes // blocksize)
+    starts_end = _HEADER + 4 * nblocks
+    if starts_end > len(data):
+        raise BloscError("truncated bstarts")
+    bstarts = struct.unpack_from(f"<{nblocks}i", data, _HEADER)
+    out = bytearray()
+    for i, start in enumerate(bstarts):
+        bsize = min(blocksize, nbytes - i * blocksize)
+        if start < starts_end or start + 4 > len(data):
+            raise BloscError(f"bad bstart[{i}]")
+        (csize,) = struct.unpack_from("<i", data, start)
+        payload = data[start + 4 : start + 4 + csize]
+        if csize < 0 or len(payload) != csize:
+            raise BloscError(f"truncated block {i}")
+        if csize == bsize:
+            block = payload  # stored raw
+        elif codec == "lz4":
+            try:
+                block = lz4_block_decompress(payload, bsize)
+            except Exception as e:
+                raise BloscError(f"corrupt lz4 block {i}: {e}") from None
+        elif codec == "zstd":
+            if _zstd is None:  # pragma: no cover
+                raise BloscError("zstd unavailable")
+            try:
+                block = _zstd.ZstdDecompressor().decompress(
+                    payload, max_output_size=bsize
+                )
+            except _zstd.ZstdError as e:
+                raise BloscError(f"corrupt zstd block {i}: {e}") from None
+        elif codec == "zlib":
+            # bounded at the block size (decompression-bomb defence,
+            # same posture as the lz4/zstd paths)
+            from . import codecs as _codecs
+
+            block = _codecs.bounded_inflate(payload, bsize, 15)
+            if block is None:
+                raise BloscError(f"corrupt zlib block {i}")
+        else:
+            raise BloscError(f"unsupported blosc codec: {codec}")
+        if len(block) != bsize:
+            raise BloscError(
+                f"block {i} decoded {len(block)} of {bsize} bytes"
+            )
+        if flags & _BYTE_SHUFFLE:
+            block = _unshuffle(block, typesize)
+        out.extend(block)
+    return bytes(out)
+
+
+def blosc_compress(
+    data: bytes,
+    typesize: int = 1,
+    cname: str = "lz4",
+    shuffle: bool = True,
+    blocksize: int = 0,
+) -> bytes:
+    """Fixture/test-grade Blosc frame writer (valid frames, no tuning).
+    ``blocksize`` 0 picks one block for small inputs, 256 KiB blocks
+    otherwise (the c-blosc ballpark)."""
+    nbytes = len(data)
+    if cname not in ("lz4", "zstd", "zlib"):
+        raise BloscError(f"unsupported compressor: {cname}")
+    if blocksize <= 0:
+        blocksize = nbytes if nbytes <= (1 << 18) else (1 << 18)
+    blocksize = max(blocksize, typesize, 1)
+    flags = (_CODEC_IDS[cname] << 5) | (_BYTE_SHUFFLE if shuffle else 0)
+    if nbytes == 0:
+        header = struct.pack(
+            "<BBBBiii", 2, 1, flags, typesize, 0, blocksize, _HEADER
+        )
+        return header
+    nblocks = -(-nbytes // blocksize)
+    chunks = []
+    for i in range(nblocks):
+        block = data[i * blocksize : (i + 1) * blocksize]
+        if shuffle:
+            block = _shuffle(block, typesize)
+        if cname == "lz4":
+            comp = lz4_block_compress(block)
+        elif cname == "zstd":
+            comp = _zstd.ZstdCompressor().compress(block)
+        else:
+            comp = _zlib.compress(block)
+        if len(comp) >= len(block):
+            comp = block  # store raw (csize == bsize signals it)
+        chunks.append(comp)
+    starts_end = _HEADER + 4 * nblocks
+    bstarts = []
+    pos = starts_end
+    for comp in chunks:
+        bstarts.append(pos)
+        pos += 4 + len(comp)
+    cbytes = pos
+    frame = bytearray(
+        struct.pack(
+            "<BBBBiii", 2, 1, flags, typesize, nbytes, blocksize, cbytes
+        )
+    )
+    frame.extend(struct.pack(f"<{nblocks}i", *bstarts))
+    for comp in chunks:
+        frame.extend(struct.pack("<i", len(comp)))
+        frame.extend(comp)
+    return bytes(frame)

@@ -1,0 +1,45 @@
+"""Persistent XLA compilation cache.
+
+The device encode programs cost tens of seconds to compile per shape on
+TPU (the RLE deflate's dense packer alone is ~20 s). A serving process
+pays that once — but deploy restarts and bench child processes would
+pay it again, so compiled executables persist on disk and reload in
+milliseconds. ``OMPB_JAX_CACHE_DIR`` overrides the location; empty
+disables.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger("omero_ms_pixel_buffer_tpu.jax_cache")
+
+_enabled = False
+
+
+def enable_persistent_cache() -> None:
+    """Idempotent; call before the first device compile."""
+    global _enabled
+    if _enabled:
+        return
+    _enabled = True
+    path = os.environ.get(
+        "OMPB_JAX_CACHE_DIR",
+        os.path.join(
+            os.path.expanduser("~"), ".cache", "ompb-jax-cache"
+        ),
+    )
+    if not path:
+        return
+    try:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache every compile that took >1s — the probe-sized programs
+        # stay out, the encode/filter programs all qualify
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # pragma: no cover - best-effort acceleration
+        log.debug("persistent compilation cache unavailable", exc_info=True)

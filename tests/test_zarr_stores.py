@@ -1,0 +1,272 @@
+"""OME-NGFF over filesystem / HTTP / S3 stores with real-world codecs
+(VERDICT r3 item 4): blosc(lz4|zstd) + bare zstd/lz4 chunks served
+pixel-exact, s3:// URIs signed with SigV4 (verified server-side by the
+fake S3), http:// hierarchies read directly, and the full HTTP tile
+surface on top of a blosc NGFF image.
+"""
+
+import datetime
+import functools
+import io
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from omero_ms_pixel_buffer_tpu.io.stores import (
+    FileStore,
+    HTTPStore,
+    S3Store,
+    StoreError,
+    make_store,
+    sigv4_headers,
+)
+from omero_ms_pixel_buffer_tpu.io.zarr import (
+    ZarrPixelBuffer,
+    write_ngff,
+)
+
+rng = np.random.default_rng(67)
+IMG = rng.integers(0, 60000, (1, 2, 2, 100, 120), dtype=np.uint16)
+
+ACCESS_KEY = "AKIATEST12345"
+SECRET_KEY = "testsecretkey/abc"
+
+
+@pytest.fixture(scope="module")
+def ngff_root(tmp_path_factory):
+    root = tmp_path_factory.mktemp("ngff")
+    path = str(root / "img.zarr")
+    write_ngff(path, IMG, chunks=(32, 32), levels=2,
+               compressor="blosc-lz4")
+    return path
+
+
+def _serve_dir(root: str, handler_cls):
+    server = ThreadingHTTPServer(
+        ("127.0.0.1", 0), functools.partial(handler_cls, root)
+    )
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server
+
+
+class _DirHandler(BaseHTTPRequestHandler):
+    def __init__(self, root, *args, **kwargs):
+        self.root = root
+        super().__init__(*args, **kwargs)
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _reply(self, code, body=b""):
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        import os
+        import urllib.parse
+
+        rel = urllib.parse.unquote(self.path.lstrip("/"))
+        if ".." in rel:
+            return self._reply(400)
+        path = os.path.join(self.root, rel)
+        if not os.path.isfile(path):
+            return self._reply(404)
+        with open(path, "rb") as f:
+            return self._reply(200, f.read())
+
+
+class _FakeS3Handler(_DirHandler):
+    """Path-style S3: /bucket/key. Verifies the SigV4 signature with
+    the known secret — a wrong signature is a 403, proving the client
+    signs correctly rather than the server ignoring auth."""
+
+    bucket = "test-bucket"
+
+    def do_GET(self):
+        auth = self.headers.get("Authorization", "")
+        m = re.match(
+            r"AWS4-HMAC-SHA256 Credential=([^/]+)/(\d+)/([^/]+)/s3/"
+            r"aws4_request, SignedHeaders=([^,]+), Signature=([0-9a-f]+)",
+            auth,
+        )
+        if not m:
+            return self._reply(403, b"missing/invalid auth")
+        access, _datestamp, region, _signed, signature = m.groups()
+        if access != ACCESS_KEY:
+            return self._reply(403, b"unknown key")
+        amz_date = self.headers.get("x-amz-date", "")
+        now = datetime.datetime.strptime(
+            amz_date, "%Y%m%dT%H%M%SZ"
+        ).replace(tzinfo=datetime.timezone.utc)
+        expected = sigv4_headers(
+            "GET", self.headers["Host"], self.path.split("?")[0],
+            region, ACCESS_KEY, SECRET_KEY,
+            payload_sha256=self.headers.get(
+                "x-amz-content-sha256", ""
+            ),
+            now=now,
+        )["authorization"]
+        if expected.rsplit("Signature=", 1)[1] != signature:
+            return self._reply(403, b"bad signature")
+        # strip the bucket segment, serve from the dir
+        prefix = f"/{self.bucket}/"
+        if not self.path.startswith(prefix):
+            return self._reply(404)
+        self.path = self.path[len(prefix) - 1 :]
+        return super().do_GET()
+
+
+class TestCodecMatrix:
+    @pytest.mark.parametrize(
+        "compressor",
+        ["blosc-lz4", "blosc-zstd", "blosc-zlib", "zstd", "lz4", "zlib"],
+    )
+    def test_pixel_exact(self, tmp_path, compressor):
+        path = str(tmp_path / f"{compressor}.zarr")
+        write_ngff(path, IMG, chunks=(48, 48), compressor=compressor)
+        buf = ZarrPixelBuffer(path)
+        tile = buf.get_tile_at(0, 1, 1, 0, 8, 16, 64, 48)
+        np.testing.assert_array_equal(
+            tile, IMG[0, 1, 1, 16 : 16 + 48, 8 : 8 + 64]
+        )
+
+    def test_pyramid_level_with_blosc(self, ngff_root):
+        buf = ZarrPixelBuffer(ngff_root)
+        assert buf.resolution_levels == 2
+        tile = buf.get_tile_at(1, 0, 0, 0, 0, 0, 30, 20)
+        np.testing.assert_array_equal(
+            tile, IMG[0, 0, 0, ::2, ::2][:20, :30]
+        )
+
+
+class TestHttpStore:
+    def test_reads_hierarchy(self, ngff_root):
+        import os
+
+        server = _serve_dir(os.path.dirname(ngff_root), _DirHandler)
+        try:
+            port = server.server_address[1]
+            buf = ZarrPixelBuffer(
+                f"http://127.0.0.1:{port}/img.zarr"
+            )
+            tile = buf.get_tile_at(0, 0, 1, 0, 40, 30, 50, 60)
+            np.testing.assert_array_equal(
+                tile, IMG[0, 1, 0, 30:90, 40:90]
+            )
+        finally:
+            server.shutdown()
+
+    def test_missing_key_is_none_5xx_raises(self, tmp_path):
+        server = _serve_dir(str(tmp_path), _DirHandler)
+        try:
+            port = server.server_address[1]
+            store = HTTPStore(f"http://127.0.0.1:{port}")
+            assert store.get("nope") is None
+        finally:
+            server.shutdown()
+        with pytest.raises(StoreError):
+            HTTPStore("http://127.0.0.1:1/unreachable",
+                      timeout_s=0.5).get("x")
+
+
+class TestS3Store:
+    @pytest.fixture
+    def s3_env(self, ngff_root, monkeypatch):
+        import os
+
+        server = _serve_dir(os.path.dirname(ngff_root), _FakeS3Handler)
+        port = server.server_address[1]
+        monkeypatch.setenv("AWS_ACCESS_KEY_ID", ACCESS_KEY)
+        monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", SECRET_KEY)
+        monkeypatch.setenv("AWS_REGION", "us-east-1")
+        monkeypatch.setenv(
+            "OMPB_S3_ENDPOINT", f"http://127.0.0.1:{port}"
+        )
+        yield
+        server.shutdown()
+
+    def test_signed_reads_pixel_exact(self, s3_env):
+        buf = ZarrPixelBuffer("s3://test-bucket/img.zarr")
+        tile = buf.get_tile_at(0, 0, 0, 0, 0, 0, 64, 64)
+        np.testing.assert_array_equal(tile, IMG[0, 0, 0, :64, :64])
+
+    def test_wrong_secret_rejected(self, s3_env, monkeypatch):
+        monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "wrong")
+        store = S3Store("s3://test-bucket/img.zarr")
+        with pytest.raises(StoreError):
+            store.get(".zattrs")
+
+    def test_missing_chunk_fill_value(self, s3_env):
+        store = S3Store("s3://test-bucket/img.zarr")
+        assert store.get("0/9.9.9.9.9") is None
+
+    def test_403_as_missing_knob(self, s3_env, monkeypatch):
+        monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "wrong")
+        monkeypatch.setenv("OMPB_S3_403_AS_MISSING", "1")
+        store = S3Store("s3://test-bucket/img.zarr")
+        # opted in: a 403 reads as an absent chunk (fill_value)
+        assert store.get(".zgroup") is None
+
+    def test_uri_parse(self):
+        s = S3Store("s3://bkt/a/b/c.zarr", endpoint="http://e")
+        assert s.bucket == "bkt" and s.prefix == "a/b/c.zarr"
+        with pytest.raises(ValueError):
+            S3Store("http://not-s3")
+
+
+class TestMakeStore:
+    def test_dispatch(self, tmp_path):
+        assert isinstance(make_store(str(tmp_path)), FileStore)
+        assert isinstance(make_store("http://x/y"), HTTPStore)
+        assert isinstance(
+            make_store("s3://b/k"), S3Store
+        )
+
+
+class TestEndToEndHttpServing:
+    """A blosc-lz4 NGFF image through the complete tile surface
+    (registry URI -> ZarrPixelBuffer -> pipeline -> HTTP)."""
+
+    async def test_served_pixel_exact(self, ngff_root, loop):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from omero_ms_pixel_buffer_tpu.auth.stores import (
+            MemorySessionStore,
+        )
+        from omero_ms_pixel_buffer_tpu.http.server import PixelBufferApp
+        from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+            ImageRegistry,
+            PixelsService,
+        )
+        from omero_ms_pixel_buffer_tpu.utils.config import Config
+
+        registry = ImageRegistry()
+        registry.add(7, ngff_root, type="zarr")
+        app_obj = PixelBufferApp(
+            Config.from_dict({"session-store": {"type": "memory"}}),
+            pixels_service=PixelsService(registry),
+            session_store=MemorySessionStore({"ck": "key"}),
+        )
+        client = TestClient(TestServer(app_obj.make_app()), loop=loop)
+        await client.start_server()
+        try:
+            resp = await client.get(
+                "/tile/7/1/0/0?x=16&y=8&w=80&h=72&format=png",
+                headers={"Cookie": "sessionid=ck"},
+            )
+            assert resp.status == 200
+            png = await resp.read()
+            decoded = np.array(Image.open(io.BytesIO(png)))
+            np.testing.assert_array_equal(
+                decoded, IMG[0, 0, 1, 8 : 8 + 72, 16 : 16 + 80]
+            )
+        finally:
+            await client.close()
