@@ -462,6 +462,48 @@ def sub_benches(pipe, service, size, cache_dir):
         log(f"[sub] rgb8 256x256 png encode: "
             f"{len(rgb) / (_t.perf_counter() - t0):.1f} tiles/s")
 
+    # -- config 4b: JPEG whole-slide RGB pyramid, 256x256 png sweep ----
+    # (the actual config-4 storage: JPEG-compressed tiled RGB TIFF,
+    # read through the in-tree baseline decoder, served as PNG)
+    jpath = os.path.join(cache_dir, "bench_rgb_jpeg.ome.tiff")
+    if not os.path.exists(jpath):
+        yy, xx = np.mgrid[0:2048, 0:2048].astype(np.float32)
+        base = (
+            128 + 60 * np.sin(xx / 37) + 50 * np.cos(yy / 53)
+            + rng.normal(0, 8, (2048, 2048))
+        ).clip(0, 255).astype(np.uint8)
+        rgbdata = np.stack(
+            [base, np.roll(base, 11, 0), np.roll(base, 7, 1)], -1
+        )
+        write_ome_tiff(
+            jpath, rgbdata[None, None, None], tile_size=(256, 256),
+            compression="jpeg", pyramid_levels=2,
+        )
+    jreg = ImageRegistry()
+    jreg.add(3, jpath)
+    jsvc = PixelsService(jreg)
+    jpipe = TilePipeline(jsvc, engine=pipe.engine)
+    from omero_ms_pixel_buffer_tpu.tile_ctx import RegionDef as _RD
+    from omero_ms_pixel_buffer_tpu.tile_ctx import TileCtx as _TC
+
+    jctxs = []
+    for _ in range(128):
+        x = int(rng.integers(0, (2048 - 256) // 64)) * 64
+        y = int(rng.integers(0, (2048 - 256) // 64)) * 64
+        jctxs.append(
+            _TC(image_id=3, z=0, c=int(rng.integers(0, 3)), t=0,
+                region=_RD(x, y, 256, 256), format="png",
+                omero_session_key="bench")
+        )
+    jpipe.handle_batch(jctxs[:16])
+    t0 = _t.perf_counter()
+    for i in range(0, len(jctxs), 32):
+        results = jpipe.handle_batch(jctxs[i : i + 32])
+        assert all(r is not None for r in results)
+    log(f"[sub] jpeg-rgb 256x256 png sweep: "
+        f"{len(jctxs) / (_t.perf_counter() - t0):.1f} tiles/s")
+    jsvc.close()
+
     # -- config 5 (scaled): concurrent format=tif fan-out --------------
     tctxs = make_ctxs(128, size, tile=512, fmt="tif", seed=17)
     pipe.handle_batch(tctxs[:16])

@@ -23,6 +23,7 @@ so reads can be chunk-aligned and batched (SURVEY.md §7 step 3).
 
 from __future__ import annotations
 
+import base64
 import hashlib
 import logging
 import mmap
@@ -50,12 +51,13 @@ _T = {"WIDTH": 256, "LENGTH": 257, "BITS": 258, "COMPRESSION": 259,
       "SAMPLES": 277, "ROWS_PER_STRIP": 278, "STRIP_COUNTS": 279,
       "PREDICTOR": 317, "TILE_WIDTH": 322, "TILE_LENGTH": 323,
       "TILE_OFFSETS": 324, "TILE_COUNTS": 325, "SUB_IFDS": 330,
-      "SAMPLE_FORMAT": 339}
+      "SAMPLE_FORMAT": 339, "JPEG_TABLES": 347}
 
 # TIFF compression codes this reader serves (TileRequestHandler.java:
-# 104-112 reads them through Bio-Formats): 1 none, 5 LZW, 8 deflate,
-# 32773 PackBits. JPEG (7) remains out of scope.
-_SUPPORTED_COMPRESSIONS = (1, 5, 8, 32773)
+# 104-112 reads them through Bio-Formats): 1 none, 5 LZW,
+# 7 new-style JPEG (baseline, incl. abbreviated streams with tag 347),
+# 8 deflate, 32773 PackBits.
+_SUPPORTED_COMPRESSIONS = (1, 5, 7, 8, 32773)
 
 _TYPE_SIZES = {1: 1, 2: 1, 3: 2, 4: 4, 5: 8, 6: 1, 7: 1, 8: 2, 9: 4,
                10: 8, 11: 4, 12: 8, 16: 8, 17: 8, 18: 8}
@@ -171,6 +173,8 @@ def _parse_ifds_inner(data, bo: str) -> Tuple[str, List[_Ifd]]:
                 )
             elif typ == 2:  # ASCII
                 tags[tag] = [raw.rstrip(b"\x00").decode("utf-8", "replace")]
+            elif typ == 7:  # UNDEFINED: opaque bytes (e.g. JPEGTables)
+                tags[tag] = [bytes(raw)]
         nxt_off = off + fl.cnt_len + fl.entry_len * n
         (nxt,) = struct.unpack(
             bo + fl.off_fmt, data[nxt_off : nxt_off + fl.inline]
@@ -237,6 +241,60 @@ class _LevelReader:
         self.predictor = ifd.first("PREDICTOR", 1)
         if self.predictor not in (1, 2):
             raise TiffError(f"Unsupported predictor: {self.predictor}")
+        self._jpeg_tables = None  # parsed lazily from tag 347
+        if self.compression == 7:
+            if self.predictor == 2:
+                raise TiffError("predictor 2 is invalid with JPEG")
+            if dtype != np.dtype(np.uint8):
+                raise TiffError("JPEG-in-TIFF requires 8-bit samples")
+
+    def decode_jpeg_block(self, raw: bytes) -> Optional[np.ndarray]:
+        """One JPEG block (compression 7) -> flat uint8 pixel bytes at
+        the block's decoded capacity, or None when corrupt. Tables
+        from tag 347 (abbreviated streams) seed the decoder; tile
+        streams smaller than the block pad bottom/right."""
+        from .jpeg import JpegError, decode_jpeg, parse_tables
+
+        if self._jpeg_tables is None:
+            # cache the parsed tables on the long-lived _Ifd (readers
+            # are per-request; rebuilding the 16-bit Huffman LUTs per
+            # tile would waste the hot path)
+            cached = getattr(self.ifd, "_jpeg_tables_cache", None)
+            if cached is not None:
+                self._jpeg_tables = cached
+            else:
+                blobs = self.ifd.values("JPEG_TABLES")
+                if blobs and isinstance(blobs[0], (bytes, bytearray)):
+                    self._jpeg_tables = parse_tables(bytes(blobs[0]))
+                elif blobs:  # written as BYTE values (ints)
+                    self._jpeg_tables = parse_tables(bytes(blobs))
+                else:
+                    self._jpeg_tables = False  # standalone streams
+                self.ifd._jpeg_tables_cache = self._jpeg_tables
+        tables = self._jpeg_tables or None
+        # photometric 6 (YCbCr) converts; 2 means components are RGB
+        ycbcr = self.ifd.first("PHOTOMETRIC", 6) != 2
+        try:
+            pixels = decode_jpeg(bytes(raw), tables=tables, ycbcr=ycbcr)
+        except JpegError:
+            return None
+        if pixels.ndim == 2:
+            pixels = pixels[:, :, None]
+        if pixels.shape[2] != self.samples:
+            return None
+        ifd = self.ifd
+        if ifd.tiled:
+            bw, bh = ifd.first("TILE_WIDTH"), ifd.first("TILE_LENGTH")
+        else:
+            bw = ifd.width
+            bh = min(ifd.first("ROWS_PER_STRIP", ifd.height), ifd.height)
+        if pixels.shape[0] > bh or pixels.shape[1] > bw:
+            pixels = pixels[:bh, :bw]
+        if pixels.shape[:2] != (bh, bw):
+            padded = np.zeros((bh, bw, self.samples), np.uint8)
+            padded[: pixels.shape[0], : pixels.shape[1]] = pixels
+            pixels = padded
+        return np.ascontiguousarray(pixels).reshape(-1)
 
     @property
     def compressed(self) -> bool:
@@ -316,6 +374,13 @@ class _LevelReader:
             )
         elif self.compression == 5:
             plain = _codecs.lzw_decode(bytes(raw), cap)
+        elif self.compression == 7:
+            decoded_jpeg = self.decode_jpeg_block(raw)
+            if decoded_jpeg is None:
+                raise TiffError(f"Corrupt JPEG block {i}")
+            if self.cache is not None:
+                self.cache[key] = decoded_jpeg
+            return decoded_jpeg
         else:  # 32773
             plain = _codecs.packbits_decode(bytes(raw), cap)
         if plain is None:
@@ -395,8 +460,18 @@ def _memo_stamp(path: str):
     return (st.st_mtime_ns, st.st_size)
 
 
+_MEMO_BYTES_MARKER = "\x00b64:"  # NUL prefix: impossible in TIFF ASCII
+
+
 def _memo_tags_to_json(tags: Dict[int, list]) -> dict:
-    return {str(k): v for k, v in tags.items()}
+    out: dict = {}
+    for k, v in tags.items():
+        out[str(k)] = [
+            _MEMO_BYTES_MARKER + base64.b64encode(item).decode()
+            if isinstance(item, (bytes, bytearray)) else item
+            for item in v
+        ]
+    return out
 
 
 def _memo_tags_from_json(obj: dict) -> Dict[int, list]:
@@ -404,10 +479,19 @@ def _memo_tags_from_json(obj: dict) -> Dict[int, list]:
     for k, v in obj.items():
         if not isinstance(v, list):
             raise ValueError("tag values must be lists")
+        vals = []
         for item in v:
-            if not isinstance(item, (int, str)):
+            if isinstance(item, str) and item.startswith(
+                _MEMO_BYTES_MARKER
+            ):
+                vals.append(
+                    base64.b64decode(item[len(_MEMO_BYTES_MARKER):])
+                )
+            elif isinstance(item, (int, str)):
+                vals.append(item)
+            else:
                 raise ValueError("tag values must be int/str")
-        tags[int(k)] = v
+        tags[int(k)] = vals
     return tags
 
 
@@ -423,7 +507,10 @@ def _memo_load(path: str, memo_dir: str):
     try:
         with open(memo, "rb") as f:
             doc = json.load(f)
-        if doc.get("v") != 1 or tuple(doc["stamp"]) != _memo_stamp(path):
+        # v2: v1 memos were written by a parser that dropped type-7
+        # (UNDEFINED) tags, losing JPEGTables (347) — accepting one
+        # would permanently break JPEG decode for that file
+        if doc.get("v") != 2 or tuple(doc["stamp"]) != _memo_stamp(path):
             return None  # image was rewritten (or format drifted)
         bo = doc["bo"]
         if bo not in ("<", ">"):
@@ -446,7 +533,7 @@ def _memo_save(path: str, memo_dir: str, bo: str, ifds) -> None:
     try:
         os.makedirs(memo_dir, mode=0o700, exist_ok=True)
         doc = {
-            "v": 1,
+            "v": 2,
             "stamp": list(_memo_stamp(path)),
             "bo": bo,
             "ifds": [
@@ -685,7 +772,9 @@ class OmeTiffPixelBuffer(PixelBuffer):
                     off, cnt, cap = r.block_span(bi)
                     spans[key] = (off, cnt, cap, r.compression, r)
 
-        keys = list(spans.keys())
+        # JPEG blocks (7) decode in-tree (entropy decode + vectorized
+        # IDCT, io/jpeg); the other codecs batch onto the native pool
+        keys = [k for k in spans if spans[k][3] != 7]
         raws = [
             bytes(self.mm[off : off + cnt])
             for (off, cnt, _, _, _) in (spans[k] for k in keys)
@@ -698,6 +787,14 @@ class OmeTiffPixelBuffer(PixelBuffer):
                 # touch it (per-lane degradation, not batch-wide)
                 continue
             arr = spans[key][4].postprocess(arr)
+            cache[key] = arr
+            self.block_cache[key] = arr
+        for key, (off, cnt, _, codec, reader) in spans.items():
+            if codec != 7:
+                continue
+            arr = reader.decode_jpeg_block(self.mm[off : off + cnt])
+            if arr is None:
+                continue
             cache[key] = arr
             self.block_cache[key] = arr
 
@@ -731,10 +828,12 @@ def write_ome_tiff(
     data: np.ndarray,
     tile_size: Optional[Tuple[int, int]] = (256, 256),
     pyramid_levels: int = 1,
-    compression: Optional[str] = None,  # None | "zlib" | "lzw" | "packbits"
+    compression: Optional[str] = None,  # None|"zlib"|"lzw"|"packbits"|"jpeg"
     big_endian: bool = True,
     bigtiff: bool = False,
     predictor: int = 1,  # 2 = horizontal differencing (zlib/lzw only)
+    jpeg_quality: int = 90,
+    jpeg_subsampling: int = 0,  # 0=4:4:4, 1=4:2:2, 2=4:2:0
 ) -> None:
     """Write 5D TCZYX (or 6D TCZYXS for RGB, S=3) data as a (pyramidal)
     OME-TIFF: planes in XYCZT page order, pyramid levels as SubIFDs,
@@ -753,11 +852,19 @@ def write_ome_tiff(
     T, C, Z, Y, X = data.shape[:5]
     bo = ">" if big_endian else "<"
     dtype = data.dtype
-    comp_code = {None: 1, "zlib": 8, "lzw": 5, "packbits": 32773}[compression]
+    comp_code = {
+        None: 1, "zlib": 8, "lzw": 5, "packbits": 32773, "jpeg": 7,
+    }[compression]
     if predictor not in (1, 2):
         raise TiffError(f"Unsupported predictor: {predictor}")
-    if predictor == 2 and comp_code in (1, 32773):
+    if predictor == 2 and comp_code in (1, 7, 32773):
         raise TiffError("predictor 2 requires zlib or lzw compression")
+    if comp_code == 7 and dtype != np.dtype(np.uint8):
+        raise TiffError("JPEG compression requires uint8 samples")
+    # JPEG tile streams ship abbreviated: tables go once into tag 347
+    # (the reference reads this form through Bio-Formats); all tiles
+    # share one table set because quality/subsampling are constant
+    jpeg_state: Dict[str, Optional[bytes]] = {"tables": None}
     kind_fmt = {"u": 1, "i": 2, "f": 3}[dtype.kind]
 
     samples = 3 if data.ndim == 6 else 1
@@ -792,6 +899,30 @@ def write_ome_tiff(
         return struct.pack(bo + fmt, *vals)
 
     def encode_block(raw: bytes, row_samples: int, nsamples: int) -> bytes:
+        if comp_code == 7:
+            from io import BytesIO
+
+            from PIL import Image
+
+            from .jpeg import split_tables
+
+            width = row_samples // nsamples
+            pixels = np.frombuffer(raw, np.uint8).reshape(
+                -1, width, nsamples
+            )
+            img = Image.fromarray(
+                pixels if nsamples == 3 else pixels[:, :, 0],
+                "RGB" if nsamples == 3 else "L",
+            )
+            out = BytesIO()
+            img.save(
+                out, "JPEG", quality=jpeg_quality,
+                subsampling=jpeg_subsampling if nsamples == 3 else -1,
+            )
+            tables, stripped = split_tables(out.getvalue())
+            if jpeg_state["tables"] is None:
+                jpeg_state["tables"] = tables
+            return stripped
         if predictor == 2:
             arr = np.frombuffer(raw, dtype=np.uint8)
             raw = _codecs.apply_predictor2(
@@ -854,7 +985,18 @@ def write_ome_tiff(
         entries.append((_T["COMPRESSION"], 3, 1, [comp_code]))
         if predictor == 2:
             entries.append((_T["PREDICTOR"], 3, 1, [2]))
-        entries.append((_T["PHOTOMETRIC"], 3, 1, [2 if samples == 3 else 1]))
+        if comp_code == 7:
+            # JPEG: 6 = YCbCr (the encoder's colorspace) for RGB
+            entries.append(
+                (_T["PHOTOMETRIC"], 3, 1, [6 if samples == 3 else 1])
+            )
+            if jpeg_state["tables"]:
+                tbl = jpeg_state["tables"]
+                entries.append((_T["JPEG_TABLES"], 7, len(tbl), tbl))
+        else:
+            entries.append(
+                (_T["PHOTOMETRIC"], 3, 1, [2 if samples == 3 else 1])
+            )
         if description:
             entries.append(
                 (_T["DESCRIPTION"], 2, len(description) + 1,
@@ -889,7 +1031,7 @@ def write_ome_tiff(
         # out-of-line values first
         fields = []
         for tag, typ, count, values in entries:
-            if typ == 2:
+            if typ in (2, 7):  # ASCII / UNDEFINED: raw bytes
                 raw = values
             else:
                 fmt = _TYPE_FMT[typ]
