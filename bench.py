@@ -114,8 +114,12 @@ def bench_http(
     ``engine`` must be the probe-gated value computed in main(), NOT
     re-read from the environment: BENCH_ENGINE=device on a wedged TPU
     would otherwise hang this section at in-process PJRT init before
-    the bounded device child ever runs."""
-    import aiohttp
+    the bounded device child ever runs.
+
+    The client is hand-rolled over raw asyncio streams (keep-alive,
+    minimal HTTP/1.1 parsing): the client shares the server's core(s)
+    in this in-process measurement, so a heavyweight client library
+    would bill its own parsing against the server's throughput."""
     from aiohttp import web
 
     from omero_ms_pixel_buffer_tpu.auth.stores import MemorySessionStore
@@ -156,31 +160,58 @@ def bench_http(
         site = web.TCPSite(runner, "127.0.0.1", 0)
         await site.start()
         port = runner.addresses[0][1]
-        base = f"http://127.0.0.1:{port}"
         latencies = []
-        sem = asyncio.Semaphore(concurrency)
 
-        async def one(session, url):
-            async with sem:
-                t0 = time.perf_counter()
-                async with session.get(
-                    base + url, cookies={"sessionid": "bench-cookie"}
-                ) as resp:
-                    body = await resp.read()
-                    assert resp.status == 200, (resp.status, body[:200])
-                latencies.append(time.perf_counter() - t0)
+        async def drive(request_urls):
+            """``concurrency`` keep-alive connections, each a worker
+            draining the shared URL queue."""
+            queue: asyncio.Queue = asyncio.Queue()
+            for u in request_urls:
+                queue.put_nowait(u)
+            for _ in range(concurrency):
+                queue.put_nowait(None)
+
+            async def worker():
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", port
+                )
+                try:
+                    while True:
+                        url = await queue.get()
+                        if url is None:
+                            return
+                        t0 = time.perf_counter()
+                        writer.write(
+                            f"GET {url} HTTP/1.1\r\n"
+                            "Host: bench\r\n"
+                            "Cookie: sessionid=bench-cookie\r\n"
+                            "\r\n".encode()
+                        )
+                        await writer.drain()
+                        status_line = await reader.readline()
+                        status = int(status_line.split()[1])
+                        clen = 0
+                        while True:
+                            line = await reader.readline()
+                            if line in (b"\r\n", b""):
+                                break
+                            if line.lower().startswith(b"content-length:"):
+                                clen = int(line.split(b":", 1)[1])
+                        body = await reader.readexactly(clen)
+                        assert status == 200, (status, body[:200])
+                        latencies.append(time.perf_counter() - t0)
+                finally:
+                    writer.close()
+
+            await asyncio.gather(*(worker() for _ in range(concurrency)))
 
         try:
-            conn = aiohttp.TCPConnector(limit=concurrency)
-            async with aiohttp.ClientSession(connector=conn) as session:
-                # warmup: engine resolution, jit, native build
-                await asyncio.gather(
-                    *(one(session, u) for u in urls[:concurrency])
-                )
-                latencies.clear()
-                t0 = time.perf_counter()
-                await asyncio.gather(*(one(session, u) for u in urls))
-                elapsed = time.perf_counter() - t0
+            # warmup: engine resolution, jit, native build
+            await drive(urls[:concurrency])
+            latencies.clear()
+            t0 = time.perf_counter()
+            await drive(urls)
+            elapsed = time.perf_counter() - t0
         finally:
             await runner.cleanup()
             service.close()  # idempotent (app cleanup also closes it)

@@ -134,9 +134,12 @@ class PixelBufferApp:
         self.config = config
         # Reporter selection mirrors the reference
         # (PixelBufferMicroserviceVerticle.java:169-200): zipkin-url ->
-        # batched HTTP sender; enabled without URL -> log reporter.
+        # batched HTTP sender; enabled without URL -> log reporter;
+        # DISABLED -> noop spans (the reference's :196-198 — span
+        # objects cost uuid4 + contextvar churn per request, so off
+        # means off)
         configure_tracing(
-            enabled=True,
+            enabled=config.http_tracing_enabled,
             log_spans=config.http_tracing_enabled,
             zipkin_url=(
                 config.zipkin_url if config.http_tracing_enabled else None

@@ -27,6 +27,7 @@ arithmetic coding, 12-bit precision, hierarchical.
 
 from __future__ import annotations
 
+import logging
 import os
 import struct
 from typing import Dict, List, Optional, Tuple
@@ -251,6 +252,11 @@ def _parse_sof(body: bytes):
         if not (1 <= c.h <= 4 and 1 <= c.v <= 4):
             raise JpegError(f"bad sampling factors {c.h}x{c.v}")
         comps.append(c)
+    if ncomp == 1:
+        # T.81: a single-component scan is non-interleaved — one data
+        # unit per MCU, sampling factors ignored (jpegtran -grayscale
+        # keeps the color original's 2x2 factors in SOF)
+        comps[0].h = comps[0].v = 1
     return {"w": w, "h": h, "comps": comps}
 
 
@@ -492,11 +498,16 @@ def idct_blocks_device(coefs: np.ndarray, qtable: np.ndarray) -> np.ndarray:
 
 
 def _idct(coefs: np.ndarray, qtable: np.ndarray, mode: str) -> np.ndarray:
-    if mode == "device":
+    if mode == "device" and not _device_idct_cache.get("failed"):
         try:
             return idct_blocks_device(coefs, qtable)
-        except Exception:
-            return idct_blocks_host(coefs, qtable)
+        except (ImportError, RuntimeError, ValueError) as e:
+            # remember and say so once — a broken device path must not
+            # silently re-pay a failed import/dispatch per tile
+            _device_idct_cache["failed"] = True
+            logging.getLogger(
+                "omero_ms_pixel_buffer_tpu.io.jpeg"
+            ).warning("device IDCT unavailable (%s); host IDCT", e)
     return idct_blocks_host(coefs, qtable)
 
 
@@ -505,6 +516,7 @@ def decode_jpeg(
     tables: Optional[JpegTables] = None,
     idct_mode: Optional[str] = None,
     ycbcr: bool = True,
+    max_pixels: int = 1 << 26,
 ) -> np.ndarray:
     """Decode one baseline JPEG stream -> (H, W) or (H, W, 3) uint8.
 
@@ -512,7 +524,10 @@ def decode_jpeg(
     (JPEG-in-TIFF with tag 347). ``idct_mode``: 'host' | 'device'
     (default from OMPB_JPEG_DEVICE_IDCT, else host). ``ycbcr`` False
     skips the JFIF color transform (TIFF photometric 2: components
-    are already RGB)."""
+    are already RGB). ``max_pixels`` bounds the SOF-declared frame
+    area BEFORE any allocation (hostile-stream defence: a few hundred
+    bytes of stream must not drive gigabytes of coefficient buffers);
+    TIFF callers pass their block capacity."""
     if idct_mode is None:
         idct_mode = (
             "device"
@@ -542,6 +557,10 @@ def decode_jpeg(
     w, h = frame["w"], frame["h"]
     if w == 0 or h == 0:
         raise JpegError("empty frame")
+    if w * h > max_pixels:
+        raise JpegError(
+            f"frame {w}x{h} exceeds the caller's bound ({max_pixels} px)"
+        )
     hmax = max(c.h for c in comps)
     vmax = max(c.v for c in comps)
     mcux = -(-w // (8 * hmax))

@@ -214,6 +214,34 @@ def _parse_ome(desc: str) -> Optional[dict]:
     return out or None
 
 
+_reader_log = logging.getLogger("omero_ms_pixel_buffer_tpu.io.ometiff")
+_pure_lzw_warned = False
+
+
+def _warn_pure_python_lzw_once() -> None:
+    """The sequential read path inflates LZW in pure Python; without
+    the native engine that is a seconds-per-tile cliff an operator
+    should hear about exactly once (batched reads use the native pool
+    when it exists)."""
+    global _pure_lzw_warned
+    if _pure_lzw_warned:
+        return
+    from ..runtime.native import get_engine
+
+    if get_engine() is None:
+        _pure_lzw_warned = True
+        _reader_log.warning(
+            "serving LZW-compressed TIFF with the pure-Python decoder "
+            "(native engine unavailable) — expect seconds-per-tile "
+            "latency; check the native build (OMPB_DISABLE_NATIVE, "
+            "g++ availability)"
+        )
+    else:
+        # native exists: the batched path uses it; stay quiet but do
+        # not re-check per block
+        _pure_lzw_warned = True
+
+
 class _LevelReader:
     """Random tile access within one IFD (one plane at one level).
 
@@ -274,15 +302,26 @@ class _LevelReader:
         tables = self._jpeg_tables or None
         # photometric 6 (YCbCr) converts; 2 means components are RGB
         ycbcr = self.ifd.first("PHOTOMETRIC", 6) != 2
+        ifd = self.ifd
+        if ifd.tiled:
+            cap_px = ifd.first("TILE_WIDTH") * ifd.first("TILE_LENGTH")
+        else:
+            cap_px = ifd.width * min(
+                ifd.first("ROWS_PER_STRIP", ifd.height), ifd.height
+            )
         try:
-            pixels = decode_jpeg(bytes(raw), tables=tables, ycbcr=ycbcr)
+            pixels = decode_jpeg(
+                bytes(raw), tables=tables, ycbcr=ycbcr,
+                # SOF dims may not exceed the block: a hostile stream
+                # must not size the coefficient buffers
+                max_pixels=cap_px,
+            )
         except JpegError:
             return None
         if pixels.ndim == 2:
             pixels = pixels[:, :, None]
         if pixels.shape[2] != self.samples:
             return None
-        ifd = self.ifd
         if ifd.tiled:
             bw, bh = ifd.first("TILE_WIDTH"), ifd.first("TILE_LENGTH")
         else:
@@ -373,6 +412,7 @@ class _LevelReader:
                 bytes(raw), cap
             )
         elif self.compression == 5:
+            _warn_pure_python_lzw_once()
             plain = _codecs.lzw_decode(bytes(raw), cap)
         elif self.compression == 7:
             decoded_jpeg = self.decode_jpeg_block(raw)

@@ -203,6 +203,8 @@ class Tracer:
         self._lock = threading.Lock()
 
     def start_span(self, name: str, parent: Optional[Span] = None) -> Span:
+        if not self.enabled:
+            return _NOOP_SPAN  # disabled -> noop tracing (:196-198)
         if parent is None:
             parent = _current_span.get()
         if parent is not None:
@@ -213,6 +215,8 @@ class Tracer:
         """Join a trace propagated across the dispatch boundary
         (extractor().extract(traceContext) analog,
         PixelBufferVerticle.java:101-104)."""
+        if not self.enabled:
+            return _NOOP_SPAN
         trace_id = ctx.get("traceId") or uuid.uuid4().hex
         span = Span(self, name, trace_id, ctx.get("spanId"))
         return span
@@ -224,7 +228,7 @@ class Tracer:
         PixelBufferMicroserviceVerticle.java:349)."""
         if span is None:
             span = _current_span.get()
-        if span is None:
+        if span is None or span.trace_id is None:
             return {}
         return {"traceId": span.trace_id, "spanId": span.span_id}
 
@@ -240,6 +244,36 @@ class Tracer:
                 span.name, span.trace_id, span.span_id, span.parent_id,
                 (span.duration or 0) * 1e3, span.tags,
             )
+
+
+class _NoopSpan:
+    """Shared do-nothing span for disabled tracing: same surface as
+    Span, zero per-request allocation."""
+
+    __slots__ = ()
+    trace_id = None
+    span_id = None
+    parent_id = None
+    tags: dict = {}
+    duration = None
+
+    def tag(self, key, value):
+        return self
+
+    def error(self, exc):
+        return self
+
+    def finish(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+_NOOP_SPAN = _NoopSpan()
 
 
 # process default (reference: Tracing.currentTracer())

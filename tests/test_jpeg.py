@@ -95,6 +95,30 @@ class TestDecoderVsPil:
         with pytest.raises(JpegError):
             decode_jpeg(data[: len(data) // 2] )
 
+    def test_hostile_sof_dimensions_bounded(self):
+        # a tiny stream declaring a 65535x65535 frame must raise before
+        # any coefficient allocation (OOM defence)
+        data = bytearray(_jpeg(GRAY, "L", quality=90))
+        sof = data.find(b"\xff\xc0")
+        assert sof > 0
+        data[sof + 5 : sof + 9] = b"\xff\xff\xff\xff"  # height, width
+        with pytest.raises(JpegError, match="exceeds"):
+            decode_jpeg(bytes(data))
+
+    def test_grayscale_sampling_factors_ignored(self):
+        # jpegtran -grayscale keeps the color original's 2x2 sampling
+        # in SOF; T.81 says one-component scans ignore it
+        orig = _jpeg(GRAY, "L", quality=90)
+        patched = bytearray(orig)
+        sof = patched.find(b"\xff\xc0")
+        # FFC0 len(2) precision(1) h(2) w(2) ncomp(1) cid(1) -> hv
+        hv_off = sof + 11
+        assert patched[hv_off] == 0x11
+        patched[hv_off] = 0x22
+        np.testing.assert_array_equal(
+            decode_jpeg(bytes(patched)), decode_jpeg(orig)
+        )
+
     def test_malformed_segment_bodies_are_jpeg_errors(self):
         # length-consistent but too-short DHT body: the bare IndexError
         # inside the field parser must surface as JpegError
