@@ -501,9 +501,10 @@ def _idct(coefs: np.ndarray, qtable: np.ndarray, mode: str) -> np.ndarray:
     if mode == "device" and not _device_idct_cache.get("failed"):
         try:
             return idct_blocks_device(coefs, qtable)
-        except (ImportError, RuntimeError, ValueError) as e:
-            # remember and say so once — a broken device path must not
-            # silently re-pay a failed import/dispatch per tile
+        except Exception as e:  # jax raises Type/Runtime/XlaRuntimeError
+            # any device failure degrades to host IDCT (the per-lane
+            # degradation contract) — but remember and say so once, so
+            # a broken device path neither hides nor re-pays per tile
             _device_idct_cache["failed"] = True
             logging.getLogger(
                 "omero_ms_pixel_buffer_tpu.io.jpeg"

@@ -16,9 +16,10 @@ Span taxonomy kept verbatim from the reference so dashboards translate
 180,203,226) — plus TPU-side additions ``batch_stage``,
 ``batch_device``, ``batch_encode``.
 
-Reporter model mirrors the reference's config gates: disabled -> noop;
-enabled without sink -> log reporter (LogSpanReporter analog). Span
-durations always land in the ``span_duration_seconds`` histogram
+Reporter model mirrors the reference's config gates: disabled -> noop
+spans (zero per-request cost, no metrics); enabled without sink -> log
+reporter (LogSpanReporter analog). With tracing enabled, span
+durations land in the ``span_duration_seconds`` histogram
 (PrometheusSpanHandler analog).
 """
 
@@ -288,8 +289,8 @@ def configure(
     enabled: bool, log_spans: bool, zipkin_url: Optional[str] = None
 ) -> None:
     """Reference reporter selection (:169-200): zipkin-url -> HTTP
-    sender; enabled without URL -> log reporter; disabled -> spans
-    still time metrics but nothing is exported."""
+    sender; enabled without URL -> log reporter; disabled -> noop
+    spans (no metrics, no export — the reference's :196-198)."""
     TRACER.enabled = enabled
     TRACER.log_spans = log_spans and zipkin_url is None
     if TRACER.reporter is not None:
