@@ -1,0 +1,147 @@
+// Baseline JPEG entropy-scan decoder — the byte-serial half of
+// JPEG-in-TIFF decode (io/jpeg.py), moved off the interpreter.
+//
+// The Python decoder splits a tile's scan into restart segments and
+// destuffs them (C-speed bytes.replace); this function runs the per-
+// bit Huffman walk those segments need — the only part that cannot be
+// vectorized — and writes quantized coefficient blocks in natural
+// (de-zigzagged) order, exactly as io/jpeg.py's _decode_block does.
+// Dequant + IDCT + color stay in Python/numpy/XLA where they are
+// vectorized. Tables arrive as the same 16-bit-peek LUTs the Python
+// path builds (sym/nbits, 65536 entries each), so both decoders share
+// one table representation and one correctness contract.
+//
+// Error returns (mirroring io/jpeg.py's JpegError conditions):
+//   -1 invalid DC/AC code     -2 AC run overflows block
+//   -3 entropy data exhausted mid-scan    -4 bad arguments
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+const int kZigzag[64] = {
+    0,  1,  8,  16, 9,  2,  3,  10, 17, 24, 32, 25, 18, 11, 4,  5,
+    12, 19, 26, 33, 40, 48, 41, 34, 27, 20, 13, 6,  7,  14, 21, 28,
+    35, 42, 49, 56, 57, 50, 43, 36, 29, 22, 15, 23, 30, 37, 44, 51,
+    58, 59, 52, 45, 38, 31, 39, 46, 53, 60, 61, 54, 47, 55, 62, 63};
+
+struct BitReader {
+  const uint8_t* data;
+  size_t n;
+  size_t pos = 0;
+  uint32_t acc = 0;
+  int bits = 0;
+
+  BitReader(const uint8_t* d, size_t len) : data(d), n(len) {}
+
+  inline void Fill(int need) {
+    while (bits < need) {
+      uint8_t byte = pos < n ? data[pos] : 0;  // zero-pad past the end
+      ++pos;
+      acc = (acc << 8) | byte;
+      bits += 8;
+    }
+  }
+  inline uint32_t Peek16() {
+    Fill(16);
+    return (acc >> (bits - 16)) & 0xFFFF;
+  }
+  inline void Skip(int k) { bits -= k; }
+  inline int32_t Receive(int k) {
+    if (k == 0) return 0;
+    Fill(k);
+    int32_t v = (acc >> (bits - k)) & ((1u << k) - 1);
+    bits -= k;
+    return v;
+  }
+  inline bool ExhaustedPast() const {
+    return pos - static_cast<size_t>((bits + 7) / 8) > n;
+  }
+};
+
+inline int32_t Extend(int32_t v, int t) {
+  return (t == 0 || v >= (1 << (t - 1))) ? v : v - (1 << t) + 1;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode one tile's entropy scan into per-component coefficient blocks.
+//   scan/scan_len:     destuffed restart segments, concatenated
+//   seg_offsets[s]:    byte offset of segment s (s < n_segs)
+//   seg_mcu_start/end: MCU index range [start, end) per segment
+//   comp_h/v:          sampling factors; comp_bw: blocks across per comp
+//   dc_sym/dc_nbits/ac_sym/ac_nbits: per comp 65536-entry peek LUTs
+//   out[c]:            int32 blocks, (bh*bw, 64) natural order, ZEROED
+int ompb_jpeg_scan(const uint8_t* scan, size_t scan_len,
+                   const int64_t* seg_offsets, int n_segs,
+                   const int32_t* seg_mcu_start, const int32_t* seg_mcu_end,
+                   int mcux, int ncomp, const int32_t* comp_h,
+                   const int32_t* comp_v, const int32_t* comp_bw,
+                   const uint8_t** dc_sym, const uint8_t** dc_nbits,
+                   const uint8_t** ac_sym, const uint8_t** ac_nbits,
+                   int32_t** out) {
+  if (ncomp < 1 || ncomp > 4 || mcux <= 0 || n_segs <= 0) return -4;
+  for (int s = 0; s < n_segs; ++s) {
+    size_t off = static_cast<size_t>(seg_offsets[s]);
+    size_t end = s + 1 < n_segs ? static_cast<size_t>(seg_offsets[s + 1])
+                                : scan_len;
+    if (off > end || end > scan_len) return -4;
+    BitReader reader(scan + off, end - off);
+    int32_t preds[4] = {0, 0, 0, 0};
+    for (int m = seg_mcu_start[s]; m < seg_mcu_end[s]; ++m) {
+      int my = m / mcux, mx = m % mcux;
+      for (int c = 0; c < ncomp; ++c) {
+        const uint8_t* dsym = dc_sym[c];
+        const uint8_t* dnb = dc_nbits[c];
+        const uint8_t* asym = ac_sym[c];
+        const uint8_t* anb = ac_nbits[c];
+        for (int by = 0; by < comp_v[c]; ++by) {
+          for (int bx = 0; bx < comp_h[c]; ++bx) {
+            int row = my * comp_v[c] + by;
+            int col = mx * comp_h[c] + bx;
+            int32_t* block = out[c] +
+                             (static_cast<int64_t>(row) * comp_bw[c] + col) *
+                                 64;
+            // DC
+            uint32_t peek = reader.Peek16();
+            int nb = dnb[peek];
+            if (nb == 0) return -1;
+            reader.Skip(nb);
+            int t = dsym[peek];
+            preds[c] += Extend(reader.Receive(t), t);
+            block[0] = preds[c];
+            // AC
+            int k = 1;
+            while (k < 64) {
+              peek = reader.Peek16();
+              nb = anb[peek];
+              if (nb == 0) return -1;
+              reader.Skip(nb);
+              int rs = asym[peek];
+              int r = rs >> 4, sz = rs & 0xF;
+              if (sz == 0) {
+                if (r == 15) {
+                  k += 16;
+                  continue;
+                }
+                break;  // EOB
+              }
+              k += r;
+              if (k > 63) return -2;
+              block[kZigzag[k]] = Extend(reader.Receive(sz), sz);
+              ++k;
+            }
+          }
+        }
+      }
+      if (reader.ExhaustedPast()) return -3;
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

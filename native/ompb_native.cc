@@ -359,7 +359,9 @@ bool LzwDecode(const uint8_t* in, size_t in_len, uint8_t* out, size_t cap,
 
 extern "C" {
 
-int ompb_version() { return 3; }
+// ABI history: v2 zlib-strategy arg + fused PNG encode; v3 per-block
+// codec dispatch; v4 JPEG entropy-scan decoder (jpeg_scan.cc)
+int ompb_version() { return 4; }
 
 int ompb_pool_size() { return static_cast<int>(Pool().size()); }
 

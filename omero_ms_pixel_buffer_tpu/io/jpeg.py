@@ -125,6 +125,12 @@ def _parse_dht(body: bytes, tables: JpegTables) -> None:
         i += n
         if tc > 1:
             raise JpegError(f"bad DHT class {tc}")
+        if tc == 0 and any(s > 15 for s in symbols):
+            # DC symbols are magnitude categories; baseline caps at 11
+            # and anything > 15 would drive undefined shifts in both
+            # decoders — reject at table build so the native and
+            # Python walkers share one validation point
+            raise JpegError("DC magnitude category > 15 in DHT")
         tables.huff[(tc, th)] = _HuffTable(counts, symbols)
 
 
@@ -305,6 +311,17 @@ class _BitReader:
 
 
 _RST_MARKERS = tuple(bytes([0xFF, 0xD0 + k]) for k in range(8))
+
+
+def _native_engine():
+    """The native engine when it carries the JPEG scan walker (ABI v4);
+    None -> pure-Python reference loop."""
+    from ..runtime.native import get_engine
+
+    engine = get_engine()
+    if engine is not None and getattr(engine, "has_jpeg_scan", False):
+        return engine
+    return None
 
 
 def _split_restarts(scan: bytes) -> List[bytes]:
@@ -594,26 +611,58 @@ def decode_jpeg(
             raise JpegError("unexpected restart marker (DRI=0)")
         ranges = [(0, n_mcu)]
 
-    block = np.zeros(64, np.int32)
-    for segment, (m0, m1) in zip(segments, ranges):
-        reader = _BitReader(segment)
-        preds = {c.cid: 0 for c in comps}
-        for m in range(m0, m1):
-            my, mx = divmod(m, mcux)
-            for c in comps:
-                dc_t = state.huff[(0, c.td)]
-                ac_t = state.huff[(1, c.ta)]
-                for by in range(c.v):
-                    for bx in range(c.h):
-                        block[:] = 0
-                        diff = _decode_block(reader, dc_t, ac_t, block)
-                        preds[c.cid] += diff
-                        block[0] = preds[c.cid]
-                        row = my * c.v + by
-                        col = mx * c.h + bx
-                        c.blocks[row * c.bw + col] = block
-            if reader.exhausted_past():
-                raise JpegError("entropy data exhausted mid-scan")
+    engine = _native_engine()
+    if engine is not None:
+        # native entropy walk (native/jpeg_scan.cc): same LUTs, same
+        # error taxonomy, GIL released — the Python loop below is the
+        # reference implementation and the no-toolchain fallback
+        scan_concat = b"".join(segments)
+        offsets = []
+        pos = 0
+        for segment in segments:
+            offsets.append(pos)
+            pos += len(segment)
+        rc = engine.jpeg_scan(
+            scan_concat, offsets, ranges, mcux,
+            [c.h for c in comps], [c.v for c in comps],
+            [c.bw for c in comps],
+            [(state.huff[(0, c.td)].sym, state.huff[(0, c.td)].nbits)
+             for c in comps],
+            [(state.huff[(1, c.ta)].sym, state.huff[(1, c.ta)].nbits)
+             for c in comps],
+            [c.blocks for c in comps],
+        )
+        if rc != 0:
+            raise JpegError(
+                {-1: "invalid Huffman code",
+                 -2: "AC run overflows block",
+                 -3: "entropy data exhausted mid-scan"}.get(
+                    rc, f"native scan failed ({rc})"
+                )
+            )
+    else:
+        block = np.zeros(64, np.int32)
+        for segment, (m0, m1) in zip(segments, ranges):
+            reader = _BitReader(segment)
+            preds = {c.cid: 0 for c in comps}
+            for m in range(m0, m1):
+                my, mx = divmod(m, mcux)
+                for c in comps:
+                    dc_t = state.huff[(0, c.td)]
+                    ac_t = state.huff[(1, c.ta)]
+                    for by in range(c.v):
+                        for bx in range(c.h):
+                            block[:] = 0
+                            diff = _decode_block(
+                                reader, dc_t, ac_t, block
+                            )
+                            preds[c.cid] += diff
+                            block[0] = preds[c.cid]
+                            row = my * c.v + by
+                            col = mx * c.h + bx
+                            c.blocks[row * c.bw + col] = block
+                if reader.exhausted_past():
+                    raise JpegError("entropy data exhausted mid-scan")
 
     planes = []
     for c in comps:

@@ -97,6 +97,12 @@ class NativeEngine:
         )
         if self._has_decode_batch:
             lib.ompb_decode_batch.restype = ctypes.c_int
+        # ABI v4 added the JPEG entropy-scan decoder
+        self.has_jpeg_scan = self.version >= 4 and hasattr(
+            lib, "ompb_jpeg_scan"
+        )
+        if self.has_jpeg_scan:
+            lib.ompb_jpeg_scan.restype = ctypes.c_int
         self.pool_size = lib.ompb_pool_size()
 
     # -- helpers -----------------------------------------------------------
@@ -244,6 +250,66 @@ class NativeEngine:
                 results.append(arr[: out_lens[i]])
         return results
 
+    def jpeg_scan(
+        self,
+        scan: bytes,
+        seg_offsets: Sequence[int],
+        seg_mcu_ranges: Sequence[tuple],
+        mcux: int,
+        comp_h: Sequence[int],
+        comp_v: Sequence[int],
+        comp_bw: Sequence[int],
+        dc_luts: Sequence[tuple],
+        ac_luts: Sequence[tuple],
+        out_blocks: Sequence[np.ndarray],
+    ) -> int:
+        """Baseline JPEG entropy scan (io/jpeg's byte-serial half) over
+        destuffed restart segments; fills the caller's zeroed int32
+        (nblocks, 64) coefficient arrays in natural order. LUTs are
+        the 16-bit-peek (sym, nbits) pairs io/jpeg builds. Returns the
+        C error code (0 = ok); the GIL is released for the walk."""
+        if not self.has_jpeg_scan:
+            return -100
+        ncomp = len(comp_h)
+        n_segs = len(seg_offsets)
+        offs = (ctypes.c_int64 * n_segs)(*seg_offsets)
+        m0 = (ctypes.c_int32 * n_segs)(
+            *[a for a, _ in seg_mcu_ranges]
+        )
+        m1 = (ctypes.c_int32 * n_segs)(
+            *[b for _, b in seg_mcu_ranges]
+        )
+        ch = (ctypes.c_int32 * ncomp)(*comp_h)
+        cv = (ctypes.c_int32 * ncomp)(*comp_v)
+        cbw = (ctypes.c_int32 * ncomp)(*comp_bw)
+
+        def lut_ptrs(luts, idx):
+            arr = (_U8P * ncomp)()
+            for i, pair in enumerate(luts):
+                arr[i] = pair[idx].ctypes.data_as(_U8P)
+            return arr
+
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        outs = (i32p * ncomp)()
+        for i, blocks in enumerate(out_blocks):
+            if (
+                blocks.dtype != np.int32
+                or not blocks.flags["C_CONTIGUOUS"]
+            ):
+                # a bad array here means C writes through wrong strides
+                # (heap corruption) — hard error, never an assert
+                raise ValueError(
+                    "jpeg_scan out_blocks must be C-contiguous int32"
+                )
+            outs[i] = blocks.ctypes.data_as(i32p)
+        return self._lib.ompb_jpeg_scan(
+            scan, ctypes.c_size_t(len(scan)), offs,
+            ctypes.c_int(n_segs), m0, m1, ctypes.c_int(mcux),
+            ctypes.c_int(ncomp), ch, cv, cbw,
+            lut_ptrs(dc_luts, 0), lut_ptrs(dc_luts, 1),
+            lut_ptrs(ac_luts, 0), lut_ptrs(ac_luts, 1), outs,
+        )
+
     def png_assemble_batch(
         self,
         filtered: Sequence[bytes],
@@ -358,7 +424,7 @@ def get_engine() -> Optional[NativeEngine]:
             sources = [
                 os.path.join(_NATIVE_DIR, f)
                 for f in ("ompb_native.cc", "fast_deflate.cc",
-                          "fast_deflate.h")
+                          "jpeg_scan.cc", "fast_deflate.h")
             ]
             stale = any(
                 os.path.exists(src)

@@ -129,6 +129,55 @@ class TestDecoderVsPil:
             decode_jpeg(b"\xff\xd8\xff\xc0\x00\x04\x08\x00\xff\xd9")
 
 
+class TestNativeScan:
+    """The C entropy walker (native/jpeg_scan.cc) against the pure-
+    Python reference loop: same tables, same coefficients, same
+    errors."""
+
+    def test_python_fallback_bit_exact(self, monkeypatch):
+        from omero_ms_pixel_buffer_tpu.io import jpeg as jpeg_mod
+
+        data = _jpeg(RGB, "RGB", quality=90, subsampling=0)
+        native = decode_jpeg(data)
+        monkeypatch.setattr(jpeg_mod, "_native_engine", lambda: None)
+        pure = decode_jpeg(data)
+        np.testing.assert_array_equal(native, pure)
+        # and both equal PIL
+        np.testing.assert_array_equal(
+            pure, np.array(Image.open(io.BytesIO(data)))
+        )
+
+    def test_python_fallback_restarts(self, monkeypatch):
+        from omero_ms_pixel_buffer_tpu.io import jpeg as jpeg_mod
+
+        data = _jpeg(GRAY, "L", quality=85, restart_marker_blocks=3)
+        native = decode_jpeg(data)
+        monkeypatch.setattr(jpeg_mod, "_native_engine", lambda: None)
+        np.testing.assert_array_equal(native, decode_jpeg(data))
+
+    @pytest.mark.parametrize("native", [True, False])
+    def test_hostile_dc_category_rejected(self, monkeypatch, native):
+        # DHT mapping a code to DC magnitude category 63: undefined
+        # shifts in either walker — must be a JpegError at table build
+        from omero_ms_pixel_buffer_tpu.io import jpeg as jpeg_mod
+
+        if not native:
+            monkeypatch.setattr(jpeg_mod, "_native_engine", lambda: None)
+        data = bytearray(_jpeg(GRAY, "L", quality=90))
+        dht = data.find(b"\xff\xc4")
+        assert data[dht + 4] == 0x00  # DC table 0
+        sym_off = dht + 5 + 16  # after tc/th + 16 counts
+        data[sym_off] = 63
+        with pytest.raises(JpegError, match="category"):
+            decode_jpeg(bytes(data))
+
+    def test_native_rejects_truncated_scan(self):
+        data = _jpeg(GRAY, "L", quality=90)
+        sos = data.find(b"\xff\xda")
+        with pytest.raises(JpegError):
+            decode_jpeg(data[: sos + 40])  # scan cut mid-entropy
+
+
 class TestAbbreviatedStreams:
     def test_split_and_seed_roundtrip(self):
         data = _jpeg(RGB, "RGB", quality=88, subsampling=0)
