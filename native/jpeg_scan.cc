@@ -69,6 +69,27 @@ inline int32_t Extend(int32_t v, int t) {
 
 extern "C" {
 
+// CRC-32C (Castagnoli) — the zarr v3 "crc32c" codec's checksum. Lives
+// here (not zlib) because zlib's crc32 is the wrong polynomial; the
+// Python fallback is a table loop, this is the hot-path form.
+uint32_t ompb_crc32c(const uint8_t* data, size_t n) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t crc = i;
+      for (int k = 0; k < 8; ++k)
+        crc = (crc >> 1) ^ ((crc & 1) ? 0x82F63B78u : 0);
+      table[i] = crc;
+    }
+    init = true;
+  }
+  uint32_t crc = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i)
+    crc = (crc >> 8) ^ table[(crc ^ data[i]) & 0xFF];
+  return crc ^ 0xFFFFFFFFu;
+}
+
 // Decode one tile's entropy scan into per-component coefficient blocks.
 //   scan/scan_len:     destuffed restart segments, concatenated
 //   seg_offsets[s]:    byte offset of segment s (s < n_segs)

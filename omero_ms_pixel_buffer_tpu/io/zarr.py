@@ -69,20 +69,123 @@ class ZarrError(ValueError):
     pass
 
 
+# per-codec decode helpers shared by the v2 `compressor` path and the
+# v3 codec pipeline — one place per codec for bounds and error wrapping
+
+
+def _inflate_bounded(raw: bytes, cap: int, wbits: int) -> bytes:
+    inflated = _codecs.bounded_inflate(raw, cap, wbits)
+    if inflated is None:
+        raise ZarrError("Corrupt deflate chunk")
+    return inflated
+
+
+def _zstd_decode(raw: bytes, cap: int) -> bytes:
+    if _zstd is None:  # pragma: no cover
+        raise ZarrError("zstd unavailable")
+    try:
+        return _zstd.ZstdDecompressor().decompress(
+            raw, max_output_size=cap
+        )
+    except _zstd.ZstdError as e:
+        raise ZarrError(f"Corrupt zstd chunk: {e}") from None
+
+
+def _blosc_decode(raw: bytes, cap: int) -> bytes:
+    try:
+        return blosc_decompress(raw, cap)
+    except BloscError as e:
+        raise ZarrError(f"Corrupt blosc chunk: {e}") from None
+
+
+_CRC32C_POLY = 0x82F63B78  # Castagnoli, reflected
+
+
+def _crc32c_tables(n: int):
+    """Slicing-by-n lookup tables as plain int lists (python-int table
+    walks beat numpy scalar indexing ~5x)."""
+    base = []
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ (_CRC32C_POLY if crc & 1 else 0)
+        base.append(crc)
+    tables = [base]
+    for _ in range(1, n):
+        prev = tables[-1]
+        tables.append(
+            [(prev[i] >> 8) ^ base[prev[i] & 0xFF] for i in range(256)]
+        )
+    return tables
+
+
+_T0, _T1, _T2, _T3 = _crc32c_tables(4)
+
+
+def crc32c(data: bytes) -> int:
+    """CRC-32C (the zarr v3 ``crc32c`` codec; zlib.crc32 is the wrong
+    polynomial). Chunk reads are a hot path, so the native engine's C
+    implementation is preferred; the fallback is a slicing-by-4 table
+    walk."""
+    from ..runtime.native import get_engine
+
+    engine = get_engine()
+    if engine is not None and getattr(engine, "has_crc32c", False):
+        return engine.crc32c(data)
+    crc = 0xFFFFFFFF
+    n4 = len(data) // 4 * 4
+    for i in range(0, n4, 4):
+        crc ^= data[i] | (data[i + 1] << 8) | (data[i + 2] << 16) | (
+            data[i + 3] << 24
+        )
+        crc = (
+            _T3[crc & 0xFF] ^ _T2[(crc >> 8) & 0xFF]
+            ^ _T1[(crc >> 16) & 0xFF] ^ _T0[(crc >> 24) & 0xFF]
+        )
+    for b in data[n4:]:
+        crc = (crc >> 8) ^ _T0[(crc ^ b) & 0xFF]
+    return crc ^ 0xFFFFFFFF
+
+
+# zarr v3 data_type names (the v3 spec drops numpy's <//> spellings)
+_V3_DTYPES = {
+    "bool": "|b1", "int8": "|i1", "uint8": "|u1",
+    "int16": "<i2", "uint16": "<u2", "int32": "<i4", "uint32": "<u4",
+    "int64": "<i8", "uint64": "<u8",
+    "float32": "<f4", "float64": "<f8",
+}
+
+
 class ZarrArray:
-    """One Zarr v2 array (one resolution level) over a chunk store."""
+    """One Zarr array (one resolution level) over a chunk store.
+
+    Both metadata generations are served: v2 (``.zarray``,
+    ``compressor`` dict, dot/slash chunk keys) and v3 (``zarr.json``,
+    ``codecs`` pipeline — ``bytes`` endian + gzip/zstd/blosc/crc32c —
+    and ``c/``-prefixed chunk keys). Out of scope with clear errors:
+    sharding_indexed, transpose, bit-shuffle, non-regular chunk grids.
+    """
 
     def __init__(self, store, prefix: str = ""):
         if isinstance(store, str):  # path convenience (fixtures, tests)
             store = FileStore(store)
         self.store = store
         self.prefix = prefix.strip("/")
+        self.codecs: Optional[list] = None  # v3 pipeline when set
         raw_meta = store.get(self._key(".zarray"))
+        if raw_meta is not None:
+            self._init_v2(json.loads(raw_meta))
+            return
+        raw_meta = store.get(self._key("zarr.json"))
         if raw_meta is None:
             raise ZarrError(
-                f"No .zarray at {store.describe()}/{self.prefix}"
+                f"No .zarray or zarr.json at "
+                f"{store.describe()}/{self.prefix}"
             )
-        meta = json.loads(raw_meta)
+        self._init_v3(json.loads(raw_meta))
+
+    def _init_v2(self, meta: dict) -> None:
+        self.zarr_format = 2
         if meta.get("zarr_format") != 2:
             raise ZarrError(f"Unsupported zarr_format in {self.prefix}")
         self.shape: Tuple[int, ...] = tuple(meta["shape"])
@@ -102,7 +205,78 @@ class ZarrArray:
             raise ZarrError(
                 f"Unsupported compressor: {self.compressor.get('id')}"
             )
-        self.separator = meta.get("dimension_separator", ".")
+        sep = meta.get("dimension_separator", ".")
+        self._chunk_key = lambda idx: self._key(sep.join(map(str, idx)))
+
+    def _init_v3(self, meta: dict) -> None:
+        self.zarr_format = 3
+        if meta.get("zarr_format") != 3 or meta.get("node_type") != "array":
+            raise ZarrError(f"Not a zarr v3 array: {self.prefix}")
+        self.shape = tuple(meta["shape"])
+        dt = meta["data_type"]
+        if dt not in _V3_DTYPES:
+            raise ZarrError(f"Unsupported v3 data_type: {dt}")
+        grid = meta.get("chunk_grid") or {}
+        if grid.get("name") != "regular":
+            raise ZarrError(
+                f"Unsupported chunk grid: {grid.get('name')}"
+            )
+        self.chunks = tuple(grid["configuration"]["chunk_shape"])
+        self.compressor = None
+        codecs = meta.get("codecs") or []
+        endian = "little"
+        chain: list = []
+        for codec in codecs:
+            name = codec.get("name")
+            conf = codec.get("configuration") or {}
+            if name == "bytes":
+                endian = conf.get("endian", "little")
+            elif name in ("gzip", "zstd", "blosc", "crc32c"):
+                chain.append((name, conf))
+            elif name == "sharding_indexed":
+                raise ZarrError(
+                    "sharded zarr v3 arrays are not supported"
+                )
+            else:
+                raise ZarrError(f"Unsupported v3 codec: {name}")
+        self.codecs = chain
+        self.dtype = np.dtype(_V3_DTYPES[dt]).newbyteorder(
+            "<" if endian == "little" else ">"
+        )
+        fill = meta.get("fill_value", 0)
+        if isinstance(fill, str):
+            # v3 float specials as strings, or raw bits as "0x..."
+            specials = {"NaN": np.nan, "Infinity": np.inf,
+                        "-Infinity": -np.inf}
+            if fill in specials:
+                fill = specials[fill]
+            elif fill.startswith("0x"):
+                bits = int(fill, 16)
+                fill = np.frombuffer(
+                    bits.to_bytes(self.dtype.itemsize, "little"),
+                    dtype=self.dtype.newbyteorder("<"),
+                )[0]
+            else:
+                raise ZarrError(f"Unsupported fill_value: {fill!r}")
+        self.fill_value = 0 if fill is None else fill
+        cke = meta.get("chunk_key_encoding") or {"name": "default"}
+        conf = cke.get("configuration") or {}
+        if cke.get("name") == "v2":
+            sep = conf.get("separator", ".")  # v2 encoding defaults "."
+            self._chunk_key = (
+                lambda idx: self._key(sep.join(map(str, idx)))
+            )
+        elif cke.get("name") == "default":
+            sep = conf.get("separator", "/")
+            self._chunk_key = (
+                lambda idx: self._key(
+                    "c" + sep + sep.join(map(str, idx))
+                )
+            )
+        else:
+            raise ZarrError(
+                f"Unsupported chunk_key_encoding: {cke.get('name')}"
+            )
 
     def _key(self, name: str) -> str:
         return f"{self.prefix}/{name}" if self.prefix else name
@@ -112,25 +286,11 @@ class ZarrArray:
         capacity (hostile-stream defence shared with the TIFF path)."""
         cid = self.compressor["id"]
         if cid in ("zlib", "gzip"):
-            wbits = 15 if cid == "zlib" else 31
-            inflated = _codecs.bounded_inflate(raw, cap, wbits)
-            if inflated is None:
-                raise ZarrError("Corrupt deflate chunk")
-            return inflated
+            return _inflate_bounded(raw, cap, 15 if cid == "zlib" else 31)
         if cid == "blosc":
-            try:
-                return blosc_decompress(raw, cap)
-            except BloscError as e:
-                raise ZarrError(f"Corrupt blosc chunk: {e}") from None
+            return _blosc_decode(raw, cap)
         if cid == "zstd":
-            if _zstd is None:  # pragma: no cover
-                raise ZarrError("zstd unavailable")
-            try:
-                return _zstd.ZstdDecompressor().decompress(
-                    raw, max_output_size=cap
-                )
-            except _zstd.ZstdError as e:
-                raise ZarrError(f"Corrupt zstd chunk: {e}") from None
+            return _zstd_decode(raw, cap)
         if cid == "lz4":
             # numcodecs LZ4: 4-byte little-endian size prefix
             if len(raw) < 4:
@@ -157,20 +317,44 @@ class ZarrArray:
             cache[idx] = value
         return value
 
+    def _decode_v3(self, raw: bytes, cap: int) -> bytes:
+        """Apply the v3 bytes->bytes codec chain in reverse."""
+        for name, conf in reversed(self.codecs):
+            if name == "crc32c":
+                if len(raw) < 4:
+                    raise ZarrError("Truncated crc32c chunk")
+                (want,) = struct.unpack("<I", raw[-4:])
+                raw = raw[:-4]
+                if crc32c(raw) != want:
+                    raise ZarrError("crc32c mismatch")
+            elif name == "gzip":
+                raw = _inflate_bounded(raw, cap, 31)
+            elif name == "zstd":
+                raw = _zstd_decode(raw, cap)
+            elif name == "blosc":
+                raw = _blosc_decode(raw, cap)
+            else:  # unreachable (validated at init)
+                raise ZarrError(f"Unsupported v3 codec: {name}")
+        return raw
+
     def read_chunk(self, idx: Tuple[int, ...]) -> Optional[np.ndarray]:
         """Decode one chunk (full chunk shape, padded at array edges) or
         None when the chunk key is absent (fill_value)."""
-        raw = self.store.get(
-            self._key(self.separator.join(map(str, idx)))
-        )
+        raw = self.store.get(self._chunk_key(idx))
         if raw is None:
             return None
-        if self.compressor:
-            cap = int(np.prod(self.chunks)) * self.dtype.itemsize
-            try:
+        cap = int(np.prod(self.chunks)) * self.dtype.itemsize
+        try:
+            if self.codecs is not None:
+                raw = self._decode_v3(raw, cap)
+            elif self.compressor:
                 raw = self._decompress(raw, cap)
-            except ZarrError as e:
-                raise ZarrError(f"Chunk {idx}: {e}") from None
+        except ZarrError as e:
+            raise ZarrError(f"Chunk {idx}: {e}") from None
+        if len(raw) != cap:
+            raise ZarrError(
+                f"Chunk {idx} decoded {len(raw)} of {cap} bytes"
+            )
         return np.frombuffer(raw, dtype=self.dtype).reshape(self.chunks)
 
     def read_region(
@@ -231,9 +415,20 @@ class ZarrPixelBuffer(PixelBuffer):
             block_cache if block_cache is not None else BlockCache(cache_bytes)
         )
         raw_attrs = self.store.get(".zattrs")
-        if raw_attrs is None:
-            raise ZarrError(f"No .zattrs under {self.store.describe()}")
-        attrs = json.loads(raw_attrs)
+        if raw_attrs is not None:
+            attrs = json.loads(raw_attrs)
+        else:
+            # zarr v3 group: attributes live in zarr.json; NGFF 0.5
+            # nests them under attributes["ome"]
+            raw_group = self.store.get("zarr.json")
+            if raw_group is None:
+                raise ZarrError(
+                    f"No .zattrs or zarr.json under "
+                    f"{self.store.describe()}"
+                )
+            group = json.loads(raw_group)
+            attrs = group.get("attributes") or {}
+            attrs = attrs.get("ome", attrs)
         try:
             ms = attrs["multiscales"][0]
             dataset_paths = [d["path"] for d in ms["datasets"]]
@@ -309,20 +504,26 @@ def write_ngff(
     levels: int = 1,
     compressor: Optional[str] = "zlib",
     level_arg: int = 1,
+    zarr_format: int = 2,
 ) -> None:
-    """Write a 5D TCZYX array as an OME-NGFF 0.4 multiscale hierarchy.
-    Pyramid levels are 2x downsamples (stride sampling, matching how
-    OMERO pyramids subsample). ``compressor``: None | zlib | gzip |
-    zstd | lz4 | blosc-lz4 | blosc-zstd | blosc-zlib (the blosc-*
-    spellings emit numcodecs-style Blosc chunks with byte shuffle)."""
+    """Write a 5D TCZYX array as an OME-NGFF multiscale hierarchy —
+    Zarr v2 / NGFF 0.4 by default, or v3 / NGFF 0.5
+    (``zarr_format=3``: ``zarr.json`` metadata, ``c/``-keys, codec
+    pipeline). Pyramid levels are 2x downsamples (stride sampling,
+    matching how OMERO pyramids subsample). ``compressor``: None |
+    zlib | gzip | zstd | lz4 | blosc-lz4 | blosc-zstd | blosc-zlib
+    (v3 maps zlib/lz4 spellings onto its gzip/blosc codecs)."""
     if data.ndim != 5:
         raise ZarrError("write_ngff expects TCZYX data")
+    if zarr_format not in (2, 3):
+        raise ZarrError(f"Unsupported zarr_format: {zarr_format}")
     os.makedirs(root, exist_ok=True)
     datasets = []
     current = data
+    writer = _write_array if zarr_format == 2 else _write_array_v3
     for lv in range(levels):
         path = str(lv)
-        _write_array(
+        writer(
             os.path.join(root, path), current, chunks, compressor, level_arg
         )
         datasets.append({"path": path})
@@ -335,15 +536,134 @@ def write_ngff(
         {"name": "y", "type": "space"},
         {"name": "x", "type": "space"},
     ]
-    attrs = {
-        "multiscales": [
-            {"version": "0.4", "axes": axes, "datasets": datasets}
-        ]
+    if zarr_format == 2:
+        attrs = {
+            "multiscales": [
+                {"version": "0.4", "axes": axes, "datasets": datasets}
+            ]
+        }
+        with open(os.path.join(root, ".zattrs"), "w") as f:
+            json.dump(attrs, f)
+        with open(os.path.join(root, ".zgroup"), "w") as f:
+            json.dump({"zarr_format": 2}, f)
+    else:
+        group = {
+            "zarr_format": 3,
+            "node_type": "group",
+            "attributes": {
+                "ome": {
+                    "version": "0.5",
+                    "multiscales": [
+                        {"axes": axes, "datasets": datasets}
+                    ],
+                }
+            },
+        }
+        with open(os.path.join(root, "zarr.json"), "w") as f:
+            json.dump(group, f)
+
+
+_V3_DTYPE_NAMES = {np.dtype(v): k for k, v in _V3_DTYPES.items()}
+
+
+def _iter_chunks(data: np.ndarray, yx_chunks: Tuple[int, int]):
+    """Yield ((t, c, z, iy, ix), chunk_bytes) over a 5D TCZYX array —
+    the shared zero-padded, edge-clamped chunk walk of both writers.
+    ``data`` must already carry the on-disk byte order."""
+    T, C, Z, Y, X = data.shape
+    cy, cx = yx_chunks
+    for t in range(T):
+        for c in range(C):
+            for z in range(Z):
+                for iy in range((Y + cy - 1) // cy):
+                    for ix in range((X + cx - 1) // cx):
+                        chunk = np.zeros(
+                            (1, 1, 1, cy, cx), dtype=data.dtype
+                        )
+                        ys, xs = iy * cy, ix * cx
+                        ye, xe = min(ys + cy, Y), min(xs + cx, X)
+                        chunk[0, 0, 0, : ye - ys, : xe - xs] = data[
+                            t, c, z, ys:ye, xs:xe
+                        ]
+                        yield (t, c, z, iy, ix), chunk.tobytes()
+
+
+def _write_array_v3(
+    path: str,
+    data: np.ndarray,
+    yx_chunks: Tuple[int, int],
+    compressor: Optional[str],
+    comp_level: int,
+) -> None:
+    """Zarr v3 array writer (fixtures/export): little-endian bytes
+    codec + one bytes->bytes codec + crc32c."""
+    os.makedirs(path, exist_ok=True)
+    chunks = (1, 1, 1) + tuple(yx_chunks)
+    codecs: list = [
+        {"name": "bytes", "configuration": {"endian": "little"}}
+    ]
+    if compressor in ("zlib", "gzip"):
+        codecs.append(
+            {"name": "gzip", "configuration": {"level": comp_level}}
+        )
+        encode = lambda raw, its: gzip.compress(raw, comp_level)  # noqa: E731
+    elif compressor == "zstd":
+        codecs.append(
+            {"name": "zstd",
+             "configuration": {"level": comp_level, "checksum": False}}
+        )
+        encode = lambda raw, its: _zstd.ZstdCompressor(  # noqa: E731
+            level=comp_level
+        ).compress(raw)
+    elif compressor and compressor.startswith("blosc-") or compressor == "lz4":
+        cname = (
+            "lz4" if compressor == "lz4"
+            else compressor.split("-", 1)[1]
+        )
+        codecs.append(
+            {"name": "blosc",
+             "configuration": {"cname": cname, "clevel": comp_level,
+                               "shuffle": "shuffle", "typesize":
+                               data.dtype.itemsize, "blocksize": 0}}
+        )
+
+        def encode(raw, its):
+            from ..ops.blosc import blosc_compress
+
+            return blosc_compress(raw, typesize=its, cname=cname)
+    elif compressor is None:
+        encode = lambda raw, its: raw  # noqa: E731
+    else:
+        raise ZarrError(f"Unknown v3 writer compressor: {compressor}")
+    codecs.append({"name": "crc32c"})
+    dt = np.dtype(data.dtype.str[1:]) if data.dtype.byteorder in "<>=|" \
+        else data.dtype
+    meta = {
+        "zarr_format": 3,
+        "node_type": "array",
+        "shape": list(data.shape),
+        "data_type": _V3_DTYPE_NAMES[np.dtype(dt)],
+        "chunk_grid": {
+            "name": "regular",
+            "configuration": {"chunk_shape": list(chunks)},
+        },
+        "chunk_key_encoding": {
+            "name": "default", "configuration": {"separator": "/"}
+        },
+        "fill_value": 0,
+        "codecs": codecs,
+        "attributes": {},
     }
-    with open(os.path.join(root, ".zattrs"), "w") as f:
-        json.dump(attrs, f)
-    with open(os.path.join(root, ".zgroup"), "w") as f:
-        json.dump({"zarr_format": 2}, f)
+    with open(os.path.join(path, "zarr.json"), "w") as f:
+        json.dump(meta, f)
+    le = data.astype(data.dtype.newbyteorder("<"), copy=False)
+    for (t, c, z, iy, ix), raw in _iter_chunks(le, yx_chunks):
+        raw = encode(raw, data.dtype.itemsize)
+        raw += struct.pack("<I", crc32c(raw))
+        cdir = os.path.join(path, "c", str(t), str(c), str(z), str(iy))
+        os.makedirs(cdir, exist_ok=True)
+        with open(os.path.join(cdir, str(ix)), "wb") as f:
+            f.write(raw)
 
 
 def _compressor_meta(compressor: Optional[str], comp_level: int, itemsize: int):
@@ -414,23 +734,10 @@ def _write_array(
     }
     with open(os.path.join(path, ".zarray"), "w") as f:
         json.dump(meta, f)
-    T, C, Z, Y, X = data.shape
-    cy, cx = yx_chunks
-    for t in range(T):
-        for c in range(C):
-            for z in range(Z):
-                for iy in range((Y + cy - 1) // cy):
-                    for ix in range((X + cx - 1) // cx):
-                        chunk = np.zeros((1, 1, 1, cy, cx), dtype=data.dtype)
-                        ys, xs = iy * cy, ix * cx
-                        ye, xe = min(ys + cy, Y), min(xs + cx, X)
-                        chunk[0, 0, 0, : ye - ys, : xe - xs] = data[
-                            t, c, z, ys:ye, xs:xe
-                        ]
-                        raw = _compress_chunk(
-                            chunk.tobytes(), compressor, comp_level,
-                            data.dtype.itemsize,
-                        )
-                        name = ".".join(map(str, (t, c, z, iy, ix)))
-                        with open(os.path.join(path, name), "wb") as f:
-                            f.write(raw)
+    for idx, raw in _iter_chunks(data, yx_chunks):
+        raw = _compress_chunk(
+            raw, compressor, comp_level, data.dtype.itemsize
+        )
+        name = ".".join(map(str, idx))
+        with open(os.path.join(path, name), "wb") as f:
+            f.write(raw)

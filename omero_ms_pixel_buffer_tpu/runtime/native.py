@@ -97,12 +97,15 @@ class NativeEngine:
         )
         if self._has_decode_batch:
             lib.ompb_decode_batch.restype = ctypes.c_int
-        # ABI v4 added the JPEG entropy-scan decoder
+        # ABI v4 added the JPEG entropy-scan decoder + crc32c
         self.has_jpeg_scan = self.version >= 4 and hasattr(
             lib, "ompb_jpeg_scan"
         )
         if self.has_jpeg_scan:
             lib.ompb_jpeg_scan.restype = ctypes.c_int
+        self.has_crc32c = hasattr(lib, "ompb_crc32c")
+        if self.has_crc32c:
+            lib.ompb_crc32c.restype = ctypes.c_uint32
         self.pool_size = lib.ompb_pool_size()
 
     # -- helpers -----------------------------------------------------------
@@ -249,6 +252,12 @@ class NativeEngine:
             else:
                 results.append(arr[: out_lens[i]])
         return results
+
+    def crc32c(self, data: bytes) -> int:
+        """CRC-32C over ``data`` (zarr v3 checksum codec)."""
+        return int(
+            self._lib.ompb_crc32c(data, ctypes.c_size_t(len(data)))
+        )
 
     def jpeg_scan(
         self,

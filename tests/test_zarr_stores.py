@@ -147,6 +147,171 @@ class TestCodecMatrix:
         )
 
 
+class TestZarrV3:
+    """Zarr v3 / NGFF 0.5: zarr.json metadata, c/-prefixed chunk keys,
+    codec pipelines (bytes endian + gzip/zstd/blosc + crc32c)."""
+
+    @pytest.mark.parametrize(
+        "compressor", [None, "zlib", "zstd", "blosc-lz4", "blosc-zstd"]
+    )
+    def test_pixel_exact(self, tmp_path, compressor):
+        path = str(tmp_path / "v3.zarr")
+        write_ngff(path, IMG, chunks=(48, 48), levels=2,
+                   compressor=compressor, zarr_format=3)
+        buf = ZarrPixelBuffer(path)
+        tile = buf.get_tile_at(0, 1, 1, 0, 8, 16, 64, 48)
+        np.testing.assert_array_equal(
+            tile, IMG[0, 1, 1, 16 : 16 + 48, 8 : 8 + 64]
+        )
+        assert buf.resolution_levels == 2
+        lv = buf.get_tile_at(1, 0, 0, 0, 0, 0, 30, 20)
+        np.testing.assert_array_equal(
+            lv, IMG[0, 0, 0, ::2, ::2][:20, :30]
+        )
+
+    def test_crc32c_detects_corruption(self, tmp_path):
+        import os
+
+        path = str(tmp_path / "v3.zarr")
+        write_ngff(path, IMG, chunks=(48, 48), compressor="zstd",
+                   zarr_format=3)
+        chunk = os.path.join(path, "0", "c", "0", "0", "0", "0", "0")
+        data = bytearray(open(chunk, "rb").read())
+        data[len(data) // 2] ^= 0xFF
+        open(chunk, "wb").write(bytes(data))
+        buf = ZarrPixelBuffer(path)
+        from omero_ms_pixel_buffer_tpu.io.zarr import ZarrError
+
+        with pytest.raises(ZarrError):
+            buf.levels[0].read_chunk((0, 0, 0, 0, 0))
+
+    def test_missing_chunk_fill_value(self, tmp_path):
+        import os
+        import shutil
+
+        path = str(tmp_path / "v3.zarr")
+        write_ngff(path, IMG, chunks=(48, 48), zarr_format=3)
+        shutil.rmtree(os.path.join(path, "0", "c", "0", "1"))
+        buf = ZarrPixelBuffer(path)
+        tile = buf.get_tile_at(0, 0, 1, 0, 0, 0, 40, 40)
+        np.testing.assert_array_equal(tile, np.zeros((40, 40), IMG.dtype))
+
+    def test_v2_key_encoding_default_separator(self, tmp_path):
+        # the v2 chunk-key encoding's spec default separator is "."
+        # (the default encoding's is "/") — a mixup reads every chunk
+        # as absent and silently serves blank tiles
+        import json as _json
+        import os
+
+        from omero_ms_pixel_buffer_tpu.io.zarr import ZarrArray, crc32c
+        import struct as _struct
+
+        path = str(tmp_path / "v2keys")
+        os.makedirs(path)
+        meta = {
+            "zarr_format": 3, "node_type": "array", "shape": [4, 4],
+            "data_type": "uint8",
+            "chunk_grid": {"name": "regular",
+                           "configuration": {"chunk_shape": [4, 4]}},
+            "chunk_key_encoding": {"name": "v2"},  # no configuration
+            "fill_value": 0,
+            "codecs": [{"name": "bytes",
+                        "configuration": {"endian": "little"}}],
+        }
+        _json.dump(meta, open(os.path.join(path, "zarr.json"), "w"))
+        payload = bytes(range(16))
+        open(os.path.join(path, "0.0"), "wb").write(payload)
+        arr = ZarrArray(path)
+        chunk = arr.read_chunk((0, 0))
+        np.testing.assert_array_equal(
+            chunk, np.frombuffer(payload, np.uint8).reshape(4, 4)
+        )
+
+    def test_hex_fill_value(self, tmp_path):
+        import json as _json
+        import os
+
+        from omero_ms_pixel_buffer_tpu.io.zarr import ZarrArray
+
+        path = str(tmp_path / "hexfill")
+        os.makedirs(path)
+        meta = {
+            "zarr_format": 3, "node_type": "array", "shape": [4, 4],
+            "data_type": "float32",
+            "chunk_grid": {"name": "regular",
+                           "configuration": {"chunk_shape": [4, 4]}},
+            "chunk_key_encoding": {"name": "default"},
+            "fill_value": "0x7fc00000",  # raw-bits NaN
+            "codecs": [{"name": "bytes",
+                        "configuration": {"endian": "little"}}],
+        }
+        _json.dump(meta, open(os.path.join(path, "zarr.json"), "w"))
+        arr = ZarrArray(path)
+        assert np.isnan(arr.fill_value)
+        region = arr.read_region((0, 0), (4, 4))  # no chunk: all fill
+        assert np.isnan(region).all()
+
+    def test_sharding_rejected_clearly(self, tmp_path):
+        import json as _json
+        import os
+
+        from omero_ms_pixel_buffer_tpu.io.zarr import ZarrArray, ZarrError
+
+        path = str(tmp_path / "sharded")
+        os.makedirs(path)
+        meta = {
+            "zarr_format": 3, "node_type": "array", "shape": [8, 8],
+            "data_type": "uint8",
+            "chunk_grid": {"name": "regular",
+                           "configuration": {"chunk_shape": [8, 8]}},
+            "chunk_key_encoding": {"name": "default"},
+            "fill_value": 0,
+            "codecs": [{"name": "sharding_indexed",
+                        "configuration": {}}],
+        }
+        _json.dump(meta, open(os.path.join(path, "zarr.json"), "w"))
+        with pytest.raises(ZarrError, match="shard"):
+            ZarrArray(path)
+
+    async def test_v3_served_over_http(self, tmp_path, loop):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from omero_ms_pixel_buffer_tpu.auth.stores import (
+            MemorySessionStore,
+        )
+        from omero_ms_pixel_buffer_tpu.http.server import PixelBufferApp
+        from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+            ImageRegistry,
+            PixelsService,
+        )
+        from omero_ms_pixel_buffer_tpu.utils.config import Config
+
+        path = str(tmp_path / "v3.zarr")
+        write_ngff(path, IMG, chunks=(32, 32), compressor="blosc-lz4",
+                   zarr_format=3)
+        registry = ImageRegistry()
+        registry.add(11, path, type="zarr")
+        app_obj = PixelBufferApp(
+            Config.from_dict({"session-store": {"type": "memory"}}),
+            pixels_service=PixelsService(registry),
+            session_store=MemorySessionStore({"ck": "key"}),
+        )
+        client = TestClient(TestServer(app_obj.make_app()), loop=loop)
+        await client.start_server()
+        try:
+            resp = await client.get(
+                "/tile/11/0/1/0?x=10&y=20&w=80&h=60&format=png",
+                headers={"Cookie": "sessionid=ck"},
+            )
+            assert resp.status == 200
+            png = np.array(Image.open(io.BytesIO(await resp.read())))
+            np.testing.assert_array_equal(
+                png, IMG[0, 1, 0, 20:80, 10:90]
+            )
+        finally:
+            await client.close()
+
+
 class TestHttpStore:
     def test_reads_hierarchy(self, ngff_root):
         import os
