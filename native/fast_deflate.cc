@@ -15,6 +15,11 @@
 
 #include <zlib.h>  // adler32
 
+#if defined(__x86_64__) || defined(__i386__)
+#include <immintrin.h>
+#define OMPB_X86 1
+#endif
+
 namespace ompb {
 namespace {
 
@@ -50,6 +55,38 @@ struct BitWriter {
       pos += 4;
       acc >>= 32;
       nbits -= 32;
+    }
+  }
+
+  // Wide put for packed literal groups: up to 56 bits per call. The
+  // accumulator is kept byte-drained (nbits < 8 after every call), so
+  // 56 + 7 = 63 bits always fit.
+  inline void Put56(uint64_t code, int n) {
+    acc |= code << nbits;
+    nbits += n;
+    int bytes = nbits >> 3;
+    if (pos + 8 > cap) {
+      overflow = true;
+      nbits &= 7;
+      return;
+    }
+    std::memcpy(out + pos, &acc, 8);
+    pos += bytes;
+    acc >>= bytes * 8;  // bytes <= 7 here (nbits <= 63)
+    nbits &= 7;
+  }
+
+  // Drain to the byte boundary so Put and Put56 can interleave.
+  inline void Align() {
+    while (nbits >= 8) {
+      if (pos >= cap) {
+        overflow = true;
+        nbits = 0;
+        return;
+      }
+      out[pos++] = static_cast<uint8_t>(acc);
+      acc >>= 8;
+      nbits -= 8;
     }
   }
 
@@ -105,6 +142,58 @@ const LenCode* LengthTable() {
   (void)init;
   return table;
 }
+
+// -- run tokens + AVX2 literal sweep ------------------------------------
+
+struct RunTok {
+  uint32_t pos;
+  uint16_t len;
+};
+
+#if defined(OMPB_X86)
+inline bool HasAvx2() {
+  static const bool v = __builtin_cpu_supports("avx2");
+  return v;
+}
+
+// Advance through guaranteed-literal positions, histogramming as it
+// goes; stops at (or just before) any 4-equal byte group — every run
+// the scalar loop could trigger implies such a group at the trigger
+// or one before it, so stopping there is conservative and exact.
+__attribute__((target("avx2"))) static size_t LiteralSweepAvx2(
+    const uint8_t* in, size_t i, size_t n, uint32_t* h0, uint32_t* h1,
+    uint32_t* h2, uint32_t* h3) {
+  while (i + 35 <= n) {
+    const __m256i a =
+        _mm256_loadu_si256(reinterpret_cast<const __m256i*>(in + i));
+    const __m256i b =
+        _mm256_loadu_si256(reinterpret_cast<const __m256i*>(in + i + 1));
+    const __m256i c =
+        _mm256_loadu_si256(reinterpret_cast<const __m256i*>(in + i + 2));
+    const __m256i d =
+        _mm256_loadu_si256(reinterpret_cast<const __m256i*>(in + i + 3));
+    const __m256i eq = _mm256_and_si256(
+        _mm256_and_si256(_mm256_cmpeq_epi8(a, b), _mm256_cmpeq_epi8(a, c)),
+        _mm256_cmpeq_epi8(a, d));
+    const uint32_t mask =
+        static_cast<uint32_t>(_mm256_movemask_epi8(eq));
+    if (mask == 0) {
+      for (int k = 0; k < 32; k += 4) {
+        h0[in[i + k]]++;
+        h1[in[i + k + 1]]++;
+        h2[in[i + k + 2]]++;
+        h3[in[i + k + 3]]++;
+      }
+      i += 32;
+      continue;
+    }
+    const int first = __builtin_ctz(mask);
+    for (int k = 0; k < first; ++k) h0[in[i + k]]++;
+    return i + first;
+  }
+  return i;
+}
+#endif
 
 inline uint32_t Reverse(uint32_t code, int len) {
   uint32_t r = 0;
@@ -262,16 +351,30 @@ size_t FastDeflate(const uint8_t* in, size_t n, uint8_t* out, size_t cap) {
   const LenCode* len_table = LengthTable();
 
   // ---- pass 1: tokenize + histogram in one scan ----
-  // token < 256: literal byte; token >= 256: run of length token-256
-  // at distance 1. One uint16 per input byte worst-case.
-  std::vector<uint16_t> token_buf(n + 1);
-  uint16_t* tokens = token_buf.data();
-  size_t ntok = 0;
+  // Representation: a list of (pos, len) distance-1 runs; the bytes
+  // between runs are literal spans read straight from the input in
+  // pass 2 (no per-byte token buffer). The AVX2 sweep skips 32
+  // literal bytes at a time when no 4-equal group is present — the
+  // dominant case for PNG-filtered noisy samples — with four
+  // interleaved histograms to break the increment dependency chain.
+  std::vector<RunTok> runs;
+  runs.reserve(64);
   uint32_t lit_freq[kNumLit] = {0};
+  uint32_t h1[256] = {0}, h2[256] = {0}, h3[256] = {0};
   bool any_run = false;
   {
+#if defined(OMPB_X86)
+    const bool use_avx2 = HasAvx2();
+#endif
     size_t i = 0;
+    size_t scalar_until = 0;  // backoff after a failed run candidate
     while (i < n) {
+#if defined(OMPB_X86)
+      if (use_avx2 && i >= scalar_until) {
+        i = LiteralSweepAvx2(in, i, n, lit_freq, h1, h2, h3);
+        if (i >= n) break;
+      }
+#endif
       if (i > 0 && in[i] == in[i - 1]) {
         size_t run = 1;
         const uint8_t v = in[i - 1];
@@ -281,15 +384,22 @@ size_t FastDeflate(const uint8_t* in, size_t n, uint8_t* out, size_t cap) {
         }
         if (run >= kMinRun) {
           lit_freq[len_table[run].sym]++;
-          tokens[ntok++] = static_cast<uint16_t>(256 + run);
+          runs.push_back({static_cast<uint32_t>(i),
+                          static_cast<uint16_t>(run)});
           any_run = true;
           i += run;
           continue;
         }
+        // 4-equal group too short for a match: take its bytes as
+        // literals scalar-side before re-entering the sweep (the
+        // sweep would re-flag the same group forever)
+        scalar_until = i + run + 1;
       }
       lit_freq[in[i]]++;
-      tokens[ntok++] = in[i];
       i++;
+    }
+    for (int s = 0; s < 256; ++s) {
+      lit_freq[s] += h1[s] + h2[s] + h3[s];
     }
   }
   lit_freq[256] = 1;  // end-of-block
@@ -340,38 +450,62 @@ size_t FastDeflate(const uint8_t* in, size_t n, uint8_t* out, size_t cap) {
     if (op.extra_bits) bw.Put(op.extra_val, op.extra_bits);
   }
 
-  // symbol stream from the token buffer; adjacent literals fuse into
-  // one bit-writer call (two codes are <= 30 bits)
+  // symbol stream: literal spans (straight from the input) between
+  // run tokens. Literals emit four-at-a-time through one wide
+  // bit-writer call — codes are <= 15 bits each and usually far
+  // shorter, so a quad nearly always fits the 56-bit budget.
   {
-    size_t t = 0;
-    while (t < ntok) {
-      uint16_t tok = tokens[t];
-      if (tok < 256) {
-        if (t + 1 < ntok && tokens[t + 1] < 256) {
-          const uint16_t tok2 = tokens[t + 1];
-          uint32_t bits = lit_code[tok];
-          const int nb1 = lit_len[tok];
-          bits |= lit_code[tok2] << nb1;
-          bw.Put(bits, nb1 + lit_len[tok2]);
-          t += 2;
-          continue;
+    bw.Align();  // Put56 needs the accumulator byte-drained
+    uint32_t packed[256];
+    for (int s = 0; s < 256; ++s) {
+      packed[s] =
+          lit_code[s] | (static_cast<uint32_t>(lit_len[s]) << 24);
+    }
+    auto emit_literals = [&](const uint8_t* p, size_t m) {
+      size_t k = 0;
+      for (; k + 4 <= m; k += 4) {
+        const uint32_t e0 = packed[p[k]], e1 = packed[p[k + 1]];
+        const uint32_t e2 = packed[p[k + 2]], e3 = packed[p[k + 3]];
+        const int n0 = e0 >> 24, n1 = e1 >> 24;
+        const int n2 = e2 >> 24, n3 = e3 >> 24;
+        if (n0 + n1 + n2 + n3 <= 56) {
+          uint64_t bits = e0 & 0xFFFFFF;
+          bits |= static_cast<uint64_t>(e1 & 0xFFFFFF) << n0;
+          bits |= static_cast<uint64_t>(e2 & 0xFFFFFF) << (n0 + n1);
+          bits |= static_cast<uint64_t>(e3 & 0xFFFFFF)
+                  << (n0 + n1 + n2);
+          bw.Put56(bits, n0 + n1 + n2 + n3);
+        } else {
+          bw.Put56(
+              (e0 & 0xFFFFFF) |
+                  (static_cast<uint64_t>(e1 & 0xFFFFFF) << n0),
+              n0 + n1);
+          bw.Put56(
+              (e2 & 0xFFFFFF) |
+                  (static_cast<uint64_t>(e3 & 0xFFFFFF) << n2),
+              n2 + n3);
         }
-        bw.Put(lit_code[tok], lit_len[tok]);
-        t++;
-        continue;
       }
+      for (; k < m; ++k) {
+        bw.Put56(packed[p[k]] & 0xFFFFFF, packed[p[k]] >> 24);
+      }
+    };
+    size_t cur = 0;
+    for (const RunTok& r : runs) {
+      emit_literals(in + cur, r.pos - cur);
       // one fused write: length code + extra bits + the 1-bit
       // distance-1 code (a zero bit) — <= 21 bits total
-      const LenCode& lc = len_table[tok - 256];
-      uint32_t bits = lit_code[lc.sym];
+      const LenCode& lc = len_table[r.len];
+      uint64_t bits = lit_code[lc.sym];
       int nb = lit_len[lc.sym];
-      bits |= static_cast<uint32_t>(lc.extra_val) << nb;
+      bits |= static_cast<uint64_t>(lc.extra_val) << nb;
       nb += lc.extra_bits + 1;
-      bw.Put(bits, nb);
-      t++;
+      bw.Put56(bits, nb);
+      cur = r.pos + r.len;
     }
+    emit_literals(in + cur, n - cur);
+    bw.Put56(lit_code[256], lit_len[256]);  // EOB
   }
-  bw.Put(lit_code[256], lit_len[256]);  // EOB
   bw.FlushByte();
   if (bw.overflow) return 0;
 

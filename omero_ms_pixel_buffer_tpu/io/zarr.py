@@ -636,8 +636,7 @@ def _write_array_v3(
     else:
         raise ZarrError(f"Unknown v3 writer compressor: {compressor}")
     codecs.append({"name": "crc32c"})
-    dt = np.dtype(data.dtype.str[1:]) if data.dtype.byteorder in "<>=|" \
-        else data.dtype
+    dt = np.dtype(data.dtype.str[1:])  # strip the byteorder prefix
     meta = {
         "zarr_format": 3,
         "node_type": "array",

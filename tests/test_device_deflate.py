@@ -244,6 +244,29 @@ class TestPipelineDeviceDeflate:
                 img[0, 0, 0, r.y : r.y + r.height, r.x : r.x + r.width],
             )
 
+    def test_adaptive_cap_across_batches(self, service):
+        """The one-sync transfer's compressed-size guess adapts: the
+        first batch may overflow it (incompressible noise), later
+        batches reuse the learned cap — all pixel-exact either way."""
+        from omero_ms_pixel_buffer_tpu.models.tile_pipeline import (
+            TilePipeline,
+        )
+
+        svc, img = service
+        pipe = TilePipeline(svc, engine="device", device_deflate=True)
+        pipe.mesh = None
+        for _ in range(3):  # fresh guess -> overflow -> learned cap
+            results = pipe.handle_batch(self._ctxs())
+            for ctx, png in zip(self._ctxs(), results):
+                decoded = np.array(Image.open(io.BytesIO(png)))
+                r = ctx.region
+                np.testing.assert_array_equal(
+                    decoded,
+                    img[0, 0, 0, r.y : r.y + r.height,
+                        r.x : r.x + r.width],
+                )
+        assert pipe._dd_cap  # the guess was learned
+
     def test_config_knob_reaches_pipeline(self):
         from omero_ms_pixel_buffer_tpu.http.server import PixelBufferApp
         from omero_ms_pixel_buffer_tpu.utils.config import Config

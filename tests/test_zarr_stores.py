@@ -203,8 +203,7 @@ class TestZarrV3:
         import json as _json
         import os
 
-        from omero_ms_pixel_buffer_tpu.io.zarr import ZarrArray, crc32c
-        import struct as _struct
+        from omero_ms_pixel_buffer_tpu.io.zarr import ZarrArray
 
         path = str(tmp_path / "v2keys")
         os.makedirs(path)
