@@ -747,12 +747,11 @@ class TilePipeline:
                 filtered = sharded_batch_filter(
                     mesh, sharded, bpp, self.png_filter
                 )[:real]
-            elif (
-                samples == 1
-                and self.use_pallas
-                and pallas_supports((bh, bw), dtype)
+            elif self.use_pallas and pallas_supports(
+                (bh, bw), dtype, samples
             ):
-                # fused Pallas kernel: byteswap + filter in one VMEM pass
+                # fused Pallas kernel: byteswap + filter in one VMEM
+                # pass (grayscale and interleaved RGB lanes alike)
                 filtered = pallas_filter_tiles(
                     jnp.asarray(batch), self.png_filter
                 )

@@ -52,36 +52,44 @@ _MODE_CODES = {
 
 # Full-plane blocks keep the kernel simple (the Up filter needs the row
 # above, which this guarantees is in VMEM). The int32 working set is
-# ~4 live planes of H*W*4 bytes (value, shifted operands, residual), so
-# blocks are capped to fit the ~16 MB/core VMEM budget; larger shapes
-# take the XLA-fusion path, which tiles freely.
-MAX_PALLAS_BLOCK_BYTES = 3 * 1024 * 1024  # H*W*4B*4 planes <= 12 MB
+# ~4 live planes of H*W*samples*itemsize*4 bytes (value, shifted
+# operands, residual, per byte plane), so blocks are capped to fit the
+# ~16 MB/core VMEM budget; larger shapes take the XLA-fusion path,
+# which tiles freely.
+MAX_PALLAS_BLOCK_BYTES = 3 * 1024 * 1024  # bytes*4 planes <= 12 MB
 
 
-def supports(shape, dtype) -> bool:
-    """Whether the Pallas path handles this lane shape/dtype."""
+def supports(shape, dtype, samples: int = 1) -> bool:
+    """Whether the Pallas path handles this lane shape/dtype/samples
+    (grayscale or interleaved RGB)."""
+    itemsize = np.dtype(dtype).itemsize
     return (
         len(shape) == 2
-        and np.dtype(dtype).itemsize in (1, 2)
-        and shape[0] * shape[1] * 4 <= MAX_PALLAS_BLOCK_BYTES
+        and samples in (1, 3)
+        and itemsize in (1, 2)
+        and shape[0] * shape[1] * samples * itemsize * 4
+        <= MAX_PALLAS_BLOCK_BYTES
     )
 
 
-def _shift(v, axis):
-    """Value one step earlier along ``axis`` (zeros at the edge) — the
-    a/b operands of the PNG filters. pltpu.roll wraps, so the first
-    row/column is re-zeroed with an iota mask."""
-    rolled = pltpu.roll(v, 1, axis)
+def _shift(v, axis, by: int = 1):
+    """Value ``by`` steps earlier along ``axis`` (zeros at the edge) —
+    the a/b operands of the PNG filters; ``by`` is the filter unit in
+    elements (samples per pixel), so interleaved RGB shifts a whole
+    pixel. pltpu.roll wraps, so the leading rows/columns are re-zeroed
+    with an iota mask."""
+    rolled = pltpu.roll(v, by, axis)
     idx = jax.lax.broadcasted_iota(jnp.int32, v.shape, axis)
-    return jnp.where(idx == 0, 0, rolled)
+    return jnp.where(idx < by, 0, rolled)
 
 
-def _residual(plane, mode):
+def _residual(plane, mode, bpp: int = 1):
     """Per-byte filter residual for one byte plane held in int32 lanes.
-    ``plane``: (1, H, W) values in [0, 255]."""
+    ``plane``: (1, H, WS) values in [0, 255]; ``bpp``: left-neighbor
+    distance in elements."""
     if mode == "none":
         return plane & 0xFF
-    a = _shift(plane, 2)
+    a = _shift(plane, 2, bpp)
     if mode == "sub":
         return (plane - a) & 0xFF
     b = _shift(plane, 1)
@@ -100,38 +108,44 @@ def _residual(plane, mode):
     raise ValueError(f"Unknown filter mode: {mode}")
 
 
-def _kernel_u16(mode, in_ref, out_ref):
-    v = in_ref[...].astype(jnp.int32)  # (1, H, W)
-    rhi = _residual(v >> 8, mode)
-    rlo = _residual(v & 0xFF, mode)
+def _kernel_u16(mode, bpp, in_ref, out_ref):
+    v = in_ref[...].astype(jnp.int32)  # (1, H, WS)
+    rhi = _residual(v >> 8, mode, bpp)
+    rlo = _residual(v & 0xFF, mode, bpp)
     # swapped pack: little-endian memory order becomes big-endian stream
     out_ref[...] = ((rlo << 8) | rhi).astype(jnp.uint16)
 
 
-def _kernel_u8(mode, in_ref, out_ref):
+def _kernel_u8(mode, bpp, in_ref, out_ref):
     v = in_ref[...].astype(jnp.int32)
-    out_ref[...] = _residual(v, mode).astype(jnp.uint8)
+    out_ref[...] = _residual(v, mode, bpp).astype(jnp.uint8)
 
 
 @partial(jax.jit, static_argnames=("mode", "interpret"))
 def _filter_tiles(tiles, mode, interpret):
-    B, H, W = tiles.shape
+    if tiles.ndim == 4:  # (B, H, W, S) interleaved samples
+        B, H, W, S = tiles.shape
+        tiles = tiles.reshape(B, H, W * S)
+    else:
+        B, H, W = tiles.shape
+        S = 1
+    WS = W * S
     itemsize = tiles.dtype.itemsize
     unsigned = {1: jnp.uint8, 2: jnp.uint16}[itemsize]
     bits = jax.lax.bitcast_convert_type(tiles, unsigned)
     kernel = _kernel_u16 if itemsize == 2 else _kernel_u8
     residuals = pl.pallas_call(
-        partial(kernel, mode),
-        out_shape=jax.ShapeDtypeStruct((B, H, W), unsigned),
+        partial(kernel, mode, S),
+        out_shape=jax.ShapeDtypeStruct((B, H, WS), unsigned),
         grid=(B,),
-        in_specs=[pl.BlockSpec((1, H, W), lambda b: (b, 0, 0))],
-        out_specs=pl.BlockSpec((1, H, W), lambda b: (b, 0, 0)),
+        in_specs=[pl.BlockSpec((1, H, WS), lambda b: (b, 0, 0))],
+        out_specs=pl.BlockSpec((1, H, WS), lambda b: (b, 0, 0)),
         interpret=interpret,
     )(bits)
     if itemsize == 2:
         res_bytes = jax.lax.bitcast_convert_type(
             residuals, jnp.uint8
-        ).reshape(B, H, W * 2)
+        ).reshape(B, H, WS * 2)
     else:
         res_bytes = residuals
     code = _MODE_CODES[mode]
@@ -140,13 +154,14 @@ def _filter_tiles(tiles, mode, interpret):
 
 
 def filter_tiles(tiles: jax.Array, mode: str = "up") -> jax.Array:
-    """(B, H, W) native uint8/int8/uint16/int16 tiles -> (B, H,
-    1 + W*itemsize) uint8 filtered big-endian scanlines, one fused
+    """(B, H, W[, S]) native uint8/int8/uint16/int16 tiles -> (B, H,
+    1 + W*S*itemsize) uint8 filtered big-endian scanlines, one fused
     Pallas kernel per lane. Same output contract as
     ``png.filter_batch(to_big_endian_bytes(tiles), ...)``."""
     if mode not in _MODE_CODES:
         raise ValueError(f"Unknown filter mode: {mode}")
-    if not supports(tiles.shape[1:], tiles.dtype):
+    samples = tiles.shape[3] if tiles.ndim == 4 else 1
+    if not supports(tiles.shape[1:3], tiles.dtype, samples):
         raise ValueError(
             f"Pallas filter does not support {tiles.shape} {tiles.dtype}"
         )

@@ -18,9 +18,14 @@ DTYPES = [np.uint8, np.int8, np.uint16, np.int16]
 
 def reference(batch: np.ndarray, mode: str) -> np.ndarray:
     out = []
+    samples = batch.shape[3] if batch.ndim == 4 else 1
     for tile in batch:
         rows = to_big_endian_bytes_np(tile)
-        out.append(filter_rows_np(rows, tile.dtype.itemsize, mode))
+        if rows.ndim == 3:  # (H, W, S*itemsize) -> scanrows
+            rows = rows.reshape(rows.shape[0], -1)
+        out.append(
+            filter_rows_np(rows, samples * tile.dtype.itemsize, mode)
+        )
     return np.stack(out)
 
 
@@ -44,12 +49,26 @@ def test_non_square_and_single_lane():
     np.testing.assert_array_equal(got, reference(batch, "up"))
 
 
+@pytest.mark.parametrize("mode", MODES)
+@pytest.mark.parametrize("dtype", [np.uint8, np.uint16])
+def test_rgb_matches_numpy_reference(mode, dtype):
+    rng = np.random.default_rng(7)
+    info = np.iinfo(dtype)
+    batch = rng.integers(
+        info.min, info.max, (2, 16, 24, 3), dtype=dtype, endpoint=True
+    )
+    got = np.asarray(filter_tiles(jnp.asarray(batch), mode))
+    np.testing.assert_array_equal(got, reference(batch, mode))
+
+
 def test_supports_gate():
     assert supports((512, 512), np.uint16)
     assert supports((256, 256), np.int8)
+    assert supports((256, 256), np.uint8, samples=3)  # interleaved RGB
     assert not supports((512, 512), np.uint32)  # 4-byte: XLA path
-    assert not supports((512, 512, 3), np.uint8)  # RGB: XLA path
+    assert not supports((256, 256), np.uint8, samples=2)  # gray+alpha
     assert not supports((4096, 4096), np.uint16)  # beyond VMEM blocks
+    assert not supports((512, 512), np.uint16, samples=3)  # over budget
 
 
 def test_unknown_mode_raises():
