@@ -56,8 +56,13 @@ _T = {"WIDTH": 256, "LENGTH": 257, "BITS": 258, "COMPRESSION": 259,
 # TIFF compression codes this reader serves (TileRequestHandler.java:
 # 104-112 reads them through Bio-Formats): 1 none, 5 LZW,
 # 7 new-style JPEG (baseline, incl. abbreviated streams with tag 347),
-# 8 deflate, 32773 PackBits.
-_SUPPORTED_COMPRESSIONS = (1, 5, 7, 8, 32773)
+# 8 deflate, 32773 PackBits, 50000 zstd (the libtiff/Bio-Formats
+# registered code).
+_SUPPORTED_COMPRESSIONS = (1, 5, 7, 8, 32773, 50000)
+
+# codecs the native batch decoder does NOT handle; their blocks decode
+# in-tree on the Python side of the batched read
+_PYTHON_SIDE_CODECS = (7, 50000)
 
 _TYPE_SIZES = {1: 1, 2: 1, 3: 2, 4: 4, 5: 8, 6: 1, 7: 1, 8: 2, 9: 4,
                10: 8, 11: 4, 12: 8, 16: 8, 17: 8, 18: 8}
@@ -276,6 +281,20 @@ class _LevelReader:
             if dtype != np.dtype(np.uint8):
                 raise TiffError("JPEG-in-TIFF requires 8-bit samples")
 
+    def decode_zstd_block(self, raw, cap: int) -> Optional[bytes]:
+        """One zstd block (compression 50000) -> raw bytes bounded at
+        the block capacity, or None when corrupt/unavailable."""
+        try:
+            import zstandard
+        except ImportError:  # pragma: no cover
+            return None
+        try:
+            return zstandard.ZstdDecompressor().decompress(
+                bytes(raw), max_output_size=cap
+            )
+        except zstandard.ZstdError:
+            return None
+
     def decode_jpeg_block(self, raw: bytes) -> Optional[np.ndarray]:
         """One JPEG block (compression 7) -> flat uint8 pixel bytes at
         the block's decoded capacity, or None when corrupt. Tables
@@ -421,6 +440,8 @@ class _LevelReader:
             if self.cache is not None:
                 self.cache[key] = decoded_jpeg
             return decoded_jpeg
+        elif self.compression == 50000:
+            plain = self.decode_zstd_block(raw, cap)
         else:  # 32773
             plain = _codecs.packbits_decode(bytes(raw), cap)
         if plain is None:
@@ -812,9 +833,11 @@ class OmeTiffPixelBuffer(PixelBuffer):
                     off, cnt, cap = r.block_span(bi)
                     spans[key] = (off, cnt, cap, r.compression, r)
 
-        # JPEG blocks (7) decode in-tree (entropy decode + vectorized
-        # IDCT, io/jpeg); the other codecs batch onto the native pool
-        keys = [k for k in spans if spans[k][3] != 7]
+        # JPEG (7) and zstd (50000) blocks decode in-tree; the other
+        # codecs batch onto the native pool
+        keys = [
+            k for k in spans if spans[k][3] not in _PYTHON_SIDE_CODECS
+        ]
         raws = [
             bytes(self.mm[off : off + cnt])
             for (off, cnt, _, _, _) in (spans[k] for k in keys)
@@ -829,10 +852,19 @@ class OmeTiffPixelBuffer(PixelBuffer):
             arr = spans[key][4].postprocess(arr)
             cache[key] = arr
             self.block_cache[key] = arr
-        for key, (off, cnt, _, codec, reader) in spans.items():
-            if codec != 7:
+        for key, (off, cnt, cap, codec, reader) in spans.items():
+            if codec not in _PYTHON_SIDE_CODECS:
                 continue
-            arr = reader.decode_jpeg_block(self.mm[off : off + cnt])
+            if codec == 7:
+                arr = reader.decode_jpeg_block(self.mm[off : off + cnt])
+            else:  # 50000 zstd
+                plain = reader.decode_zstd_block(
+                    self.mm[off : off + cnt], cap
+                )
+                arr = (
+                    reader.postprocess(np.frombuffer(plain, np.uint8))
+                    if plain is not None else None
+                )
             if arr is None:
                 continue
             cache[key] = arr
@@ -894,6 +926,7 @@ def write_ome_tiff(
     dtype = data.dtype
     comp_code = {
         None: 1, "zlib": 8, "lzw": 5, "packbits": 32773, "jpeg": 7,
+        "zstd": 50000,
     }[compression]
     if predictor not in (1, 2):
         raise TiffError(f"Unsupported predictor: {predictor}")
@@ -972,6 +1005,10 @@ def write_ome_tiff(
             return zlib.compress(raw, 1)
         if comp_code == 5:
             return _codecs.lzw_encode(raw)
+        if comp_code == 50000:
+            import zstandard
+
+            return zstandard.ZstdCompressor(level=3).compress(raw)
         if comp_code == 32773:
             return _codecs.packbits_encode(
                 raw, row_samples * dtype.itemsize
