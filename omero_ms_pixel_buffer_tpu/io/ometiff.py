@@ -280,20 +280,20 @@ class _LevelReader:
                 raise TiffError("predictor 2 is invalid with JPEG")
             if dtype != np.dtype(np.uint8):
                 raise TiffError("JPEG-in-TIFF requires 8-bit samples")
+        if self.compression == 50000:
+            try:  # fail fast, not per block as "corrupt"
+                import zstandard  # noqa: F401
+            except ImportError:  # pragma: no cover
+                raise TiffError(
+                    "zstd-compressed TIFF requires the zstandard "
+                    "package"
+                ) from None
 
     def decode_zstd_block(self, raw, cap: int) -> Optional[bytes]:
-        """One zstd block (compression 50000) -> raw bytes bounded at
-        the block capacity, or None when corrupt/unavailable."""
-        try:
-            import zstandard
-        except ImportError:  # pragma: no cover
-            return None
-        try:
-            return zstandard.ZstdDecompressor().decompress(
-                bytes(raw), max_output_size=cap
-            )
-        except zstandard.ZstdError:
-            return None
+        """One zstd block (compression 50000) -> raw bytes truly
+        bounded at the block capacity (ops/codecs.bounded_zstd — the
+        shared declared-size check), or None when corrupt."""
+        return _codecs.bounded_zstd(bytes(raw), cap)
 
     def decode_jpeg_block(self, raw: bytes) -> Optional[np.ndarray]:
         """One JPEG block (compression 7) -> flat uint8 pixel bytes at
@@ -900,10 +900,10 @@ def write_ome_tiff(
     data: np.ndarray,
     tile_size: Optional[Tuple[int, int]] = (256, 256),
     pyramid_levels: int = 1,
-    compression: Optional[str] = None,  # None|"zlib"|"lzw"|"packbits"|"jpeg"
+    compression: Optional[str] = None,  # None|zlib|lzw|packbits|jpeg|zstd
     big_endian: bool = True,
     bigtiff: bool = False,
-    predictor: int = 1,  # 2 = horizontal differencing (zlib/lzw only)
+    predictor: int = 1,  # 2 = horizontal differencing (zlib/lzw/zstd)
     jpeg_quality: int = 90,
     jpeg_subsampling: int = 0,  # 0=4:4:4, 1=4:2:2, 2=4:2:0
 ) -> None:
@@ -931,7 +931,9 @@ def write_ome_tiff(
     if predictor not in (1, 2):
         raise TiffError(f"Unsupported predictor: {predictor}")
     if predictor == 2 and comp_code in (1, 7, 32773):
-        raise TiffError("predictor 2 requires zlib or lzw compression")
+        raise TiffError(
+            "predictor 2 requires zlib, lzw, or zstd compression"
+        )
     if comp_code == 7 and dtype != np.dtype(np.uint8):
         raise TiffError("JPEG compression requires uint8 samples")
     # JPEG tile streams ship abbreviated: tables go once into tag 347

@@ -83,12 +83,12 @@ def _inflate_bounded(raw: bytes, cap: int, wbits: int) -> bytes:
 def _zstd_decode(raw: bytes, cap: int) -> bytes:
     if _zstd is None:  # pragma: no cover
         raise ZarrError("zstd unavailable")
-    try:
-        return _zstd.ZstdDecompressor().decompress(
-            raw, max_output_size=cap
-        )
-    except _zstd.ZstdError as e:
-        raise ZarrError(f"Corrupt zstd chunk: {e}") from None
+    # bounded_zstd checks the frame's DECLARED size against the cap
+    # (max_output_size alone is ignored for known-size frames)
+    out = _codecs.bounded_zstd(raw, cap)
+    if out is None:
+        raise ZarrError("Corrupt or oversized zstd chunk")
+    return out
 
 
 def _blosc_decode(raw: bytes, cap: int) -> bytes:

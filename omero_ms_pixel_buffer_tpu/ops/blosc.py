@@ -122,12 +122,13 @@ def blosc_decompress(data: bytes, expected_nbytes: int = -1) -> bytes:
         elif codec == "zstd":
             if _zstd is None:  # pragma: no cover
                 raise BloscError("zstd unavailable")
-            try:
-                block = _zstd.ZstdDecompressor().decompress(
-                    payload, max_output_size=bsize
-                )
-            except _zstd.ZstdError as e:
-                raise BloscError(f"corrupt zstd block {i}: {e}") from None
+            from . import codecs as _codecs
+
+            # declared-size-checked bound (max_output_size alone is
+            # ignored for frames that declare their content size)
+            block = _codecs.bounded_zstd(payload, bsize)
+            if block is None:
+                raise BloscError(f"corrupt zstd block {i}")
         elif codec == "zlib":
             # bounded at the block size (decompression-bomb defence,
             # same posture as the lz4/zstd paths)

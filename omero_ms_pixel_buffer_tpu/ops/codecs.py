@@ -51,6 +51,34 @@ def bounded_inflate(
         return None
 
 
+def bounded_zstd(data: bytes, cap: int) -> Optional[bytes]:
+    """zstd decompress with output truly bounded at ``cap``.
+
+    python-zstandard's ``max_output_size`` only applies when the frame
+    header does NOT declare a content size — a hostile frame declaring
+    terabytes would otherwise drive the allocation directly. Check the
+    declared size against the cap first; unknown-size frames fall back
+    to the (then effective) ``max_output_size`` bound. Returns None on
+    overflow/corruption/unavailable codec (callers degrade per-block).
+    """
+    try:
+        import zstandard
+    except ImportError:  # pragma: no cover - baked into the image
+        return None
+    try:
+        declared = zstandard.frame_content_size(data)
+    except zstandard.ZstdError:
+        return None
+    if declared is not None and declared >= 0 and declared > cap:
+        return None
+    try:
+        return zstandard.ZstdDecompressor().decompress(
+            data, max_output_size=cap
+        )
+    except zstandard.ZstdError:
+        return None
+
+
 def lzw_decode(data: bytes, cap: int) -> Optional[bytes]:
     """Decode a TIFF-flavor LZW stream to at most ``cap`` bytes.
     Returns None on a corrupt stream (callers degrade per-lane)."""

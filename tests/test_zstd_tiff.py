@@ -47,6 +47,21 @@ def test_batched_equals_sequential(fixture):
         buf.close()
 
 
+def test_hostile_declared_size_bounded():
+    """A frame declaring far more than the block capacity must be
+    rejected BEFORE allocation — python-zstandard's max_output_size is
+    ignored for known-size frames (ops/codecs.bounded_zstd)."""
+    import zstandard
+
+    from omero_ms_pixel_buffer_tpu.ops import codecs
+
+    big = zstandard.ZstdCompressor().compress(bytes(1_000_000))
+    assert codecs.bounded_zstd(big, 1000) is None  # declared 1MB > cap
+    small = zstandard.ZstdCompressor().compress(b"ok" * 10)
+    assert codecs.bounded_zstd(small, 1000) == b"ok" * 10
+    assert codecs.bounded_zstd(b"garbage!", 1000) is None
+
+
 def test_corrupt_block_degrades(fixture, tmp_path):
     data = bytearray(open(fixture, "rb").read())
     # corrupt bytes mid-file (inside some tile payload)
