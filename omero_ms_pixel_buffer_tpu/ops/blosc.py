@@ -35,6 +35,7 @@ import zlib as _zlib
 
 import numpy as np
 
+from . import codecs as _codecs
 from .lz4 import lz4_block_compress, lz4_block_decompress
 
 try:
@@ -122,8 +123,6 @@ def blosc_decompress(data: bytes, expected_nbytes: int = -1) -> bytes:
         elif codec == "zstd":
             if _zstd is None:  # pragma: no cover
                 raise BloscError("zstd unavailable")
-            from . import codecs as _codecs
-
             # declared-size-checked bound (max_output_size alone is
             # ignored for frames that declare their content size)
             block = _codecs.bounded_zstd(payload, bsize)
@@ -132,8 +131,6 @@ def blosc_decompress(data: bytes, expected_nbytes: int = -1) -> bytes:
         elif codec == "zlib":
             # bounded at the block size (decompression-bomb defence,
             # same posture as the lz4/zstd paths)
-            from . import codecs as _codecs
-
             block = _codecs.bounded_inflate(payload, bsize, 15)
             if block is None:
                 raise BloscError(f"corrupt zlib block {i}")

@@ -35,6 +35,18 @@ def enable_persistent_cache() -> None:
     try:
         import jax
 
+        if jax.default_backend() != "tpu":
+            # TPU compiles are the tens-of-seconds problem this cache
+            # solves; CPU AOT entries also reload across processes
+            # with mismatched machine-feature sets (XLA warns of
+            # SIGILL), so CPU backends stay uncached
+            if os.environ.get("OMPB_JAX_CACHE_DIR"):
+                log.info(
+                    "OMPB_JAX_CACHE_DIR set but backend is %s; the "
+                    "persistent compile cache only engages on TPU",
+                    jax.default_backend(),
+                )
+            return
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
         # cache every compile that took >1s — the probe-sized programs
