@@ -529,6 +529,64 @@ def _idct(coefs: np.ndarray, qtable: np.ndarray, mode: str) -> np.ndarray:
     return idct_blocks_host(coefs, qtable)
 
 
+def _fancy_h2(plane: np.ndarray) -> np.ndarray:
+    """libjpeg's 'fancy' 2x horizontal upsample (jdsample.c
+    h2v1_fancy_upsample): triangular 3:1 weighting with edge
+    replication — bit-exact with libjpeg's integer arithmetic."""
+    s = plane.astype(np.int32)
+    left = np.concatenate([s[:, :1], s[:, :-1]], axis=1)
+    right = np.concatenate([s[:, 1:], s[:, -1:]], axis=1)
+    out = np.empty((s.shape[0], s.shape[1] * 2), np.int32)
+    out[:, 0::2] = (3 * s + left + 1) >> 2
+    out[:, 1::2] = (3 * s + right + 2) >> 2
+    # edges replicate exactly (libjpeg special-cases them)
+    out[:, 0] = s[:, 0]
+    out[:, -1] = s[:, -1]
+    return out
+
+
+def _fancy_h2v2(plane: np.ndarray) -> np.ndarray:
+    """libjpeg's h2v2 'fancy' upsample (jdsample.c): the vertical 3:1
+    sums stay UNROUNDED 10-bit intermediates; the horizontal pass
+    combines them with biases 8/7 and one >>4 — reproducing the exact
+    integer arithmetic keeps 4:2:0 decode within libjpeg's own pixels."""
+    s = plane.astype(np.int32)
+    up = np.concatenate([s[:1], s[:-1]], axis=0)
+    down = np.concatenate([s[1:], s[-1:]], axis=0)
+    cs = np.empty((s.shape[0] * 2, s.shape[1]), np.int32)
+    cs[0::2] = 3 * s + up
+    cs[1::2] = 3 * s + down
+    left = np.concatenate([cs[:, :1], cs[:, :-1]], axis=1)
+    right = np.concatenate([cs[:, 1:], cs[:, -1:]], axis=1)
+    out = np.empty((cs.shape[0], cs.shape[1] * 2), np.int32)
+    out[:, 0::2] = (3 * cs + left + 8) >> 4
+    out[:, 1::2] = (3 * cs + right + 7) >> 4
+    out[:, 0] = (cs[:, 0] * 4 + 8) >> 4
+    out[:, -1] = (cs[:, -1] * 4 + 7) >> 4
+    return out
+
+
+def _fancy_upsample(plane: np.ndarray, ry: int, rx: int) -> np.ndarray:
+    """libjpeg 'fancy' chroma upsampling for the common 2x factors:
+    h2v2 (4:2:0) as the fused 16-bit form, h2v1 (4:2:2) horizontal
+    only, h1v2 (4:4:0) vertical 3:1 with libjpeg's rounding."""
+    if ry == 2 and rx == 2:
+        v = _fancy_h2v2(plane)
+    else:
+        s = plane.astype(np.int32)
+        if ry == 2:
+            upr = np.concatenate([s[:1], s[:-1]], axis=0)
+            dn = np.concatenate([s[1:], s[-1:]], axis=0)
+            v = np.empty((s.shape[0] * 2, s.shape[1]), np.int32)
+            v[0::2] = (3 * s + upr + 1) >> 2
+            v[1::2] = (3 * s + dn + 2) >> 2
+        else:
+            v = s
+        if rx == 2:
+            v = _fancy_h2(v)
+    return np.clip(v, 0, 255).astype(np.uint8)
+
+
 def decode_jpeg(
     data: bytes,
     tables: Optional[JpegTables] = None,
@@ -672,9 +730,15 @@ def decode_jpeg(
             .transpose(0, 2, 1, 3)
             .reshape(c.bh * 8, c.bw * 8)
         )
-        # upsample to full resolution by sample replication
         ry, rx = vmax // c.v, hmax // c.h
-        if ry > 1 or rx > 1:
+        if ry in (1, 2) and rx in (1, 2) and (ry == 2 or rx == 2):
+            # crop to the component's true extent FIRST so the fancy
+            # filter never interpolates against block padding
+            ch = -(-h // ry)
+            cw = -(-w // rx)
+            plane = _fancy_upsample(plane[:ch, :cw], ry, rx)
+        elif ry > 1 or rx > 1:
+            # exotic factors (3x/4x, incl. mixed with 2x): replicate
             plane = plane.repeat(ry, axis=0).repeat(rx, axis=1)
         planes.append(plane[:h, :w])
 

@@ -12,27 +12,92 @@ not exist (Zarr fill_value semantics — an absent chunk is legitimate).
 - ``S3Store`` — ``s3://bucket/prefix`` with AWS Signature V4 over
   stdlib (urllib + hmac/hashlib; no SDK in the image). Credentials
   from the standard env (AWS_ACCESS_KEY_ID / AWS_SECRET_ACCESS_KEY /
-  AWS_SESSION_TOKEN, region AWS_REGION); ``OMPB_S3_ENDPOINT`` points
-  at a custom endpoint (MinIO, test fakes) using path-style addressing.
-  Anonymous (unsigned) access when no credentials are configured.
+  AWS_SESSION_TOKEN, region AWS_REGION) or the shared
+  ``~/.aws/credentials`` / ``~/.aws/config`` files (profile from
+  AWS_PROFILE; IMDS/instance-role discovery is NOT implemented);
+  ``OMPB_S3_ENDPOINT`` points at a custom endpoint (MinIO, test
+  fakes) using path-style addressing. Anonymous (unsigned) access
+  when no credentials are configured.
+
+Transient failures (5xx, dropped connections) retry with a short
+backoff before surfacing as ``StoreError``; 4xx never retries.
 
 ``make_store(uri)`` picks by scheme.
 """
 
 from __future__ import annotations
 
+import configparser
 import datetime
 import hashlib
 import hmac
 import http.client
 import os
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Optional, Tuple
 
 _EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+
+_RETRY_STATUSES = (500, 502, 503, 504)
+_RETRY_DELAYS_S = (0.1, 0.4)  # two retries, short backoff
+
+
+def load_shared_credentials(
+    profile: Optional[str] = None,
+) -> Tuple[Optional[str], Optional[str], Optional[str], Optional[str]]:
+    """(access_key, secret_key, session_token, region) from the shared
+    AWS config files (``AWS_SHARED_CREDENTIALS_FILE`` /
+    ``~/.aws/credentials`` and ``AWS_CONFIG_FILE`` / ``~/.aws/config``),
+    for the given profile (default: $AWS_PROFILE or 'default').
+    All-None when nothing is configured."""
+    profile = profile or os.environ.get("AWS_PROFILE", "default")
+    cred_path = os.environ.get(
+        "AWS_SHARED_CREDENTIALS_FILE",
+        os.path.join(os.path.expanduser("~"), ".aws", "credentials"),
+    )
+    conf_path = os.environ.get(
+        "AWS_CONFIG_FILE",
+        os.path.join(os.path.expanduser("~"), ".aws", "config"),
+    )
+    access = secret = token = region = None
+    # RawConfigParser(strict=False): AWS files in the wild carry
+    # duplicate sections/options and '%' in secrets — interpolation
+    # or strictness would reject them; per-file failures keep what
+    # the other file yielded instead of degrading to anonymous
+    try:
+        if os.path.exists(cred_path):
+            ini = configparser.RawConfigParser(strict=False)
+            ini.read(cred_path)
+            if ini.has_section(profile):
+                access = ini.get(
+                    profile, "aws_access_key_id", fallback=None
+                )
+                secret = ini.get(
+                    profile, "aws_secret_access_key", fallback=None
+                )
+                token = ini.get(
+                    profile, "aws_session_token", fallback=None
+                )
+    except (configparser.Error, OSError):
+        pass
+    try:
+        if os.path.exists(conf_path):
+            ini = configparser.RawConfigParser(strict=False)
+            ini.read(conf_path)
+            # config file spells non-default sections "profile <name>"
+            section = (
+                profile if profile == "default"
+                else f"profile {profile}"
+            )
+            if ini.has_section(section):
+                region = ini.get(section, "region", fallback=None)
+    except (configparser.Error, OSError):
+        pass
+    return access, secret, token, region
 
 
 class _KeepAlive:
@@ -57,9 +122,9 @@ class _KeepAlive:
         path = parsed.path or "/"
         if parsed.query:
             path += f"?{parsed.query}"
-        last_error: Optional[Exception] = None
         for attempt in (0, 1):
             conn = conns.get(key)
+            reused = conn is not None
             if conn is None:
                 cls = (
                     http.client.HTTPSConnection
@@ -76,8 +141,12 @@ class _KeepAlive:
             except (http.client.HTTPException, OSError) as e:
                 conn.close()
                 conns.pop(key, None)
-                last_error = e
-        raise StoreError(f"GET {url} failed: {last_error}")
+                # retry ONLY a reused socket the server closed while
+                # idle; a fresh-connection failure is a real outage
+                # and belongs to the caller's (bounded) retry policy
+                if not (reused and attempt == 0):
+                    raise StoreError(f"GET {url} failed: {e}") from None
+        raise StoreError(f"GET {url} failed")  # pragma: no cover
 
 
 class StoreError(IOError):
@@ -113,7 +182,9 @@ class HTTPStore:
 
     def get(self, key: str) -> Optional[bytes]:
         url = f"{self.base_url}/{urllib.parse.quote(key)}"
-        status, body = self._conns.get(url, {}, self.timeout_s)
+        status, body = _get_with_retry(
+            lambda: self._conns.get(url, {}, self.timeout_s)
+        )
         if status == 200:
             return body
         if status in (404, 410):
@@ -122,6 +193,25 @@ class HTTPStore:
 
     def describe(self) -> str:
         return self.base_url
+
+
+def _get_with_retry(fn) -> Tuple[int, bytes]:
+    """Run a GET closure, retrying transient failures (5xx statuses
+    and transport errors) with a short backoff. 4xx returns
+    immediately — it is an answer, not an outage."""
+    last: Optional[Exception] = None
+    for attempt in range(len(_RETRY_DELAYS_S) + 1):
+        if attempt:
+            time.sleep(_RETRY_DELAYS_S[attempt - 1])
+        try:
+            status, body = fn()
+        except StoreError as e:
+            last = e
+            continue
+        if status in _RETRY_STATUSES and attempt < len(_RETRY_DELAYS_S):
+            continue
+        return status, body
+    raise last if last is not None else StoreError("GET failed")
 
 
 def _sign(key: bytes, msg: str) -> bytes:
@@ -219,6 +309,30 @@ class S3Store:
         self.access_key = os.environ.get("AWS_ACCESS_KEY_ID")
         self.secret_key = os.environ.get("AWS_SECRET_ACCESS_KEY")
         self.session_token = os.environ.get("AWS_SESSION_TOKEN")
+        env_region = (
+            os.environ.get("AWS_REGION")
+            or os.environ.get("AWS_DEFAULT_REGION")
+        )
+        # the shared files fill whatever env left unset — keys in env
+        # with region only in ~/.aws/config is a common combination
+        if not (self.access_key and self.secret_key) or not (
+            region or env_region
+        ):
+            access, secret, token, file_region = (
+                load_shared_credentials()
+            )
+            if not (self.access_key and self.secret_key) and (
+                access and secret
+            ):
+                self.access_key, self.secret_key = access, secret
+                self.session_token = self.session_token or token
+            if file_region and not (region or env_region):
+                self.region = file_region
+                if not endpoint:  # virtual-hosted URL tracks region
+                    self._base = (
+                        f"https://{self.bucket}.s3."
+                        f"{self.region}.amazonaws.com"
+                    )
         # Without s3:ListBucket, S3 answers 403 AccessDenied for keys
         # that simply don't exist — indistinguishable from real auth
         # failure. Default is the safe read (403 raises); deployments
@@ -247,7 +361,9 @@ class S3Store:
                 "GET", host, canonical_path, self.region,
                 self.access_key, self.secret_key, self.session_token,
             )
-        status, body = self._conns.get(url, headers, self.timeout_s)
+        status, body = _get_with_retry(
+            lambda: self._conns.get(url, headers, self.timeout_s)
+        )
         if status == 200:
             return body
         if status == 404:

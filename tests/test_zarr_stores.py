@@ -386,6 +386,92 @@ class TestS3Store:
             S3Store("http://not-s3")
 
 
+class TestSharedCredentials:
+    def test_loaded_from_files(self, tmp_path, monkeypatch):
+        from omero_ms_pixel_buffer_tpu.io.stores import (
+            load_shared_credentials,
+        )
+
+        cred = tmp_path / "credentials"
+        cred.write_text(
+            "[default]\n"
+            "aws_access_key_id = AKIAFILE\n"
+            "aws_secret_access_key = filesecret\n"
+            "[other]\n"
+            "aws_access_key_id = AKIAOTHER\n"
+            "aws_secret_access_key = othersecret\n"
+            "aws_session_token = tok\n"
+        )
+        conf = tmp_path / "config"
+        conf.write_text(
+            "[default]\nregion = eu-west-1\n"
+            "[profile other]\nregion = ap-south-1\n"
+        )
+        monkeypatch.setenv("AWS_SHARED_CREDENTIALS_FILE", str(cred))
+        monkeypatch.setenv("AWS_CONFIG_FILE", str(conf))
+        monkeypatch.delenv("AWS_PROFILE", raising=False)
+        assert load_shared_credentials() == (
+            "AKIAFILE", "filesecret", None, "eu-west-1"
+        )
+        assert load_shared_credentials("other") == (
+            "AKIAOTHER", "othersecret", "tok", "ap-south-1"
+        )
+
+    def test_s3_store_picks_up_file_creds(self, tmp_path, monkeypatch):
+        cred = tmp_path / "credentials"
+        cred.write_text(
+            "[default]\naws_access_key_id = AKIAFILE\n"
+            "aws_secret_access_key = filesecret\n"
+        )
+        monkeypatch.setenv("AWS_SHARED_CREDENTIALS_FILE", str(cred))
+        monkeypatch.setenv(
+            "AWS_CONFIG_FILE", str(tmp_path / "missing-config")
+        )
+        monkeypatch.delenv("AWS_ACCESS_KEY_ID", raising=False)
+        monkeypatch.delenv("AWS_SECRET_ACCESS_KEY", raising=False)
+        store = S3Store("s3://b/k", endpoint="http://e")
+        assert store.access_key == "AKIAFILE"
+        assert store.secret_key == "filesecret"
+
+
+class TestRetry:
+    def test_transient_5xx_retries_then_succeeds(self, tmp_path):
+        attempts = []
+
+        class Flaky(_DirHandler):
+            def do_GET(self):
+                attempts.append(1)
+                if len(attempts) <= 2:
+                    return self._reply(503)
+                return self._reply(200, b"payload")
+
+        server = _serve_dir(str(tmp_path), Flaky)
+        try:
+            port = server.server_address[1]
+            store = HTTPStore(f"http://127.0.0.1:{port}")
+            assert store.get("whatever") == b"payload"
+            assert len(attempts) == 3
+        finally:
+            server.shutdown()
+
+    def test_4xx_never_retries(self, tmp_path):
+        attempts = []
+
+        class Denier(_DirHandler):
+            def do_GET(self):
+                attempts.append(1)
+                return self._reply(404)
+
+        server = _serve_dir(str(tmp_path), Denier)
+        try:
+            port = server.server_address[1]
+            store = HTTPStore(f"http://127.0.0.1:{port}")
+            assert store.get("missing") is None
+            assert len(attempts) == 1
+        finally:
+            server.shutdown()
+
+
 class TestMakeStore:
     def test_dispatch(self, tmp_path):
         assert isinstance(make_store(str(tmp_path)), FileStore)
