@@ -32,7 +32,7 @@ import json
 import re
 import struct
 import zlib
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -44,7 +44,6 @@ from .pixel_buffer import (
 )
 from ..ops import codecs as _codecs
 from ..ops.convert import dtype_for, omero_type_for
-from ..ops.tiff import ome_xml_metadata  # single-plane variant
 
 _T = {"WIDTH": 256, "LENGTH": 257, "BITS": 258, "COMPRESSION": 259,
       "PHOTOMETRIC": 262, "DESCRIPTION": 270, "STRIP_OFFSETS": 273,

@@ -24,7 +24,7 @@ import json
 import os
 import struct
 import zlib
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 

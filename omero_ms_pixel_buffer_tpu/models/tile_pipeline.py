@@ -31,7 +31,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from ..io.pixel_buffer import PixelBuffer, PixelsMeta
+from ..io.pixel_buffer import PixelBuffer
 from ..io.pixels_service import PixelsService
 from ..ops.convert import to_big_endian_bytes, to_big_endian_bytes_np
 from ..ops.crop import resolve_region
@@ -82,8 +82,10 @@ class TilePipeline:
     - ``auto`` — probe the device link at first batch; use ``device``
       only on a TPU backend whose transfer bandwidth clears
       ``OMPB_DEVICE_MIN_MBPS`` (default 1000 MB/s), else ``host``.
-    - ``device`` — coalesced tiles padded to shape buckets, filtered on
-      the accelerator (Pallas/XLA), deflate on host threads.
+    - ``device`` — coalesced tiles padded to shape buckets, filtered
+      on the accelerator (Pallas/XLA); deflate either on host threads
+      or, with ``device_deflate``, on the accelerator itself so only
+      compressed bytes cross the link.
     - ``host`` — one fused native call per batch (byteswap + filter +
       deflate + PNG framing on the C++ pool, GIL released).
 
