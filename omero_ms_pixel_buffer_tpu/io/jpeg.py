@@ -16,8 +16,10 @@ carries its own, split TPU-first:
   matmuls per block. ``idct_mode='device'`` (or
   ``OMPB_JPEG_DEVICE_IDCT=1``) runs the same contraction as a jitted
   XLA program so coefficient blocks upload once and the MXU does the
-  basis transform; 'host' is the numpy fallback. Both paths are pinned
-  equal by tests.
+  basis transform; 'host' is the numpy fallback. The host path is
+  bit-exact vs libjpeg's islow; the device path is a float IDCT
+  pinned within ±1 (grayscale) / ±2 (RGB) of it by tests — on real
+  TPU the two modes can differ by a pixel count, not byte-identical.
 - Chroma upsample (4:2:0/4:2:2 sample replication) + the JFIF
   YCbCr->RGB matrix.
 
@@ -158,7 +160,11 @@ def split_tables(data: bytes) -> Tuple[bytes, bytes]:
     stream) — the JPEG-in-TIFF tag-347 form: the tables stream is
     SOI + every DQT/DHT segment + EOI; the abbreviated stream is the
     original minus those segments. Writer-side support for fixtures
-    and exports."""
+    and exports. All malformed-stream errors surface as JpegError."""
+    return _as_jpeg_error(_split_tables, data)
+
+
+def _split_tables(data: bytes) -> Tuple[bytes, bytes]:
     if len(data) < 2 or data[0] != 0xFF or data[1] != 0xD8:
         raise JpegError("missing SOI")
     tables = bytearray(b"\xff\xd8")
@@ -179,6 +185,8 @@ def split_tables(data: bytes) -> Tuple[bytes, bytes]:
         if marker == 0xD9:
             break
         (seglen,) = struct.unpack(">H", data[j + 1 : j + 3])
+        if j + 1 + seglen > len(data):
+            raise JpegError("truncated segment body")
         segment = data[i : j + 1 + seglen]
         if marker in (0xDB, 0xC4):
             tables.extend(segment)

@@ -154,12 +154,28 @@ class StoreError(IOError):
     5xx) — callers must not treat it as fill_value."""
 
 
+def validate_key(key: str) -> str:
+    """Reject keys that could escape the store root. NGFF multiscale
+    metadata supplies dataset paths verbatim (io/zarr.py), so a hostile
+    hierarchy could otherwise point ``FileStore`` outside the image
+    root (or make ``HTTPStore`` walk up the URL path — quote() keeps
+    '/'). Absolute paths, drive-letter paths, and any ``..`` segment
+    are store-level errors, never fill_value."""
+    if key.startswith(("/", "\\")) or (
+        len(key) > 1 and key[1] == ":" and key[0].isalpha()
+    ):
+        raise StoreError(f"absolute store key rejected: {key!r}")
+    if ".." in key.replace("\\", "/").split("/"):
+        raise StoreError(f"path-traversal store key rejected: {key!r}")
+    return key
+
+
 class FileStore:
     def __init__(self, root: str):
         self.root = root
 
     def get(self, key: str) -> Optional[bytes]:
-        path = os.path.join(self.root, key)
+        path = os.path.join(self.root, validate_key(key))
         try:
             with open(path, "rb") as f:
                 return f.read()
@@ -181,7 +197,7 @@ class HTTPStore:
         self._conns = _KeepAlive()
 
     def get(self, key: str) -> Optional[bytes]:
-        url = f"{self.base_url}/{urllib.parse.quote(key)}"
+        url = f"{self.base_url}/{urllib.parse.quote(validate_key(key))}"
         status, body = _get_with_retry(
             lambda: self._conns.get(url, {}, self.timeout_s)
         )
@@ -212,6 +228,35 @@ def _get_with_retry(fn) -> Tuple[int, bytes]:
             continue
         return status, body
     raise last if last is not None else StoreError("GET failed")
+
+
+def _resolve_credentials() -> Tuple[
+    Optional[str], Optional[str], Optional[str], Optional[str]
+]:
+    """(access, secret, token, file_region): env credentials, else the
+    shared files; a token in env wins over the file's. ``file_region``
+    is None when env supplied the keys (files never read). One cascade
+    shared by S3Store's constructor and its 403 refresh path so
+    precedence can't drift between them."""
+    access = os.environ.get("AWS_ACCESS_KEY_ID")
+    secret = os.environ.get("AWS_SECRET_ACCESS_KEY")
+    token = os.environ.get("AWS_SESSION_TOKEN")
+    file_region = None
+    if not (access and secret):
+        f_access, f_secret, f_token, file_region = (
+            load_shared_credentials()
+        )
+        if f_access and f_secret:
+            access, secret = f_access, f_secret
+            token = token or f_token
+    return access, secret, token, file_region
+
+
+# A 403 on a no-ListBucket bucket is the NORMAL answer for an absent
+# chunk (OMPB_S3_403_AS_MISSING deployments), so credential
+# re-resolution — which re-reads ~/.aws files — is throttled off the
+# serving hot path. Rotated creds are picked up within this bound.
+_CRED_REFRESH_MIN_S = 60.0
 
 
 def _sign(key: bytes, msg: str) -> bytes:
@@ -306,33 +351,26 @@ class S3Store:
                 f"https://{self.bucket}.s3.{self.region}.amazonaws.com"
             )
             self._path_style = False
-        self.access_key = os.environ.get("AWS_ACCESS_KEY_ID")
-        self.secret_key = os.environ.get("AWS_SECRET_ACCESS_KEY")
-        self.session_token = os.environ.get("AWS_SESSION_TOKEN")
+        access, secret, token, file_region = _resolve_credentials()
         env_region = (
             os.environ.get("AWS_REGION")
             or os.environ.get("AWS_DEFAULT_REGION")
         )
-        # the shared files fill whatever env left unset — keys in env
-        # with region only in ~/.aws/config is a common combination
-        if not (self.access_key and self.secret_key) or not (
-            region or env_region
-        ):
-            access, secret, token, file_region = (
-                load_shared_credentials()
-            )
-            if not (self.access_key and self.secret_key) and (
-                access and secret
-            ):
-                self.access_key, self.secret_key = access, secret
-                self.session_token = self.session_token or token
-            if file_region and not (region or env_region):
-                self.region = file_region
-                if not endpoint:  # virtual-hosted URL tracks region
-                    self._base = (
-                        f"https://{self.bucket}.s3."
-                        f"{self.region}.amazonaws.com"
-                    )
+        # keys in env with region only in ~/.aws/config is a common
+        # combination — read the config file for region if still unset
+        if file_region is None and not (region or env_region):
+            _, _, _, file_region = load_shared_credentials()
+        if file_region and not (region or env_region):
+            self.region = file_region
+            if not endpoint:  # virtual-hosted URL tracks region
+                self._base = (
+                    f"https://{self.bucket}.s3."
+                    f"{self.region}.amazonaws.com"
+                )
+        # one tuple attribute: refresh swaps it atomically so a
+        # concurrent signer never reads a mixed old/new key pair
+        self._creds = (access, secret, token)
+        self._last_refresh_mono = float("-inf")
         # Without s3:ListBucket, S3 answers 403 AccessDenied for keys
         # that simply don't exist — indistinguishable from real auth
         # failure. Default is the safe read (403 raises); deployments
@@ -352,25 +390,76 @@ class S3Store:
             path = f"/{quoted}"
         return self._base + path, path
 
-    def get(self, key: str) -> Optional[bytes]:
+    @property
+    def access_key(self) -> Optional[str]:
+        return self._creds[0]
+
+    @property
+    def secret_key(self) -> Optional[str]:
+        return self._creds[1]
+
+    @property
+    def session_token(self) -> Optional[str]:
+        return self._creds[2]
+
+    def _refresh_credentials(self) -> bool:
+        """Re-resolve credentials from env + the shared files; True if
+        they changed. Long-lived buffers over STS credentials go stale
+        when the operator rotates ~/.aws/credentials — a 403 is the
+        first symptom, so the read path retries once with fresh keys
+        instead of failing until restart."""
+        current = self._creds
+        now = time.monotonic()
+        if now - self._last_refresh_mono < _CRED_REFRESH_MIN_S:
+            return False
+        self._last_refresh_mono = now
+        access, secret, token, _ = _resolve_credentials()
+        fresh = (access, secret, token)
+        if fresh == current or not (access and secret):
+            return False
+        self._creds = fresh
+        return True
+
+    def _signed_get(self, key: str) -> Tuple[int, bytes]:
         url, canonical_path = self._url_and_path(key)
+        access, secret, token = self._creds
         headers: dict = {}
-        if self.access_key and self.secret_key:
+        if access and secret:
             host = urllib.parse.urlparse(url).netloc
             headers = sigv4_headers(
                 "GET", host, canonical_path, self.region,
-                self.access_key, self.secret_key, self.session_token,
+                access, secret, token,
             )
-        status, body = _get_with_retry(
+        return _get_with_retry(
             lambda: self._conns.get(url, headers, self.timeout_s)
         )
+
+    def get(self, key: str) -> Optional[bytes]:
+        validate_key(key)
+        status, body = self._signed_get(key)
+        if status == 403 and self._refresh_credentials():
+            # Expired/rotated credentials answer 403; one re-resolve
+            # from env + shared files, re-sign, retry — BEFORE the
+            # 403-as-missing mapping, so stale creds on a
+            # no-ListBucket bucket don't silently read as fill_value.
+            status, body = self._signed_get(key)
         if status == 200:
             return body
         if status == 404:
             return None
         if status == 403 and self.treat_403_as_missing:
             return None
-        raise StoreError(f"S3 {status} for s3://{self.bucket}/{key}")
+        detail = ""
+        if status == 403 and (
+            b"ExpiredToken" in body or b"TokenRefreshRequired" in body
+        ):
+            detail = (
+                " (session token expired — rotate AWS_SESSION_TOKEN or"
+                " ~/.aws/credentials; IMDS refresh is not implemented)"
+            )
+        raise StoreError(
+            f"S3 {status} for s3://{self.bucket}/{key}{detail}"
+        )
 
     def describe(self) -> str:
         return f"s3://{self.bucket}/{self.prefix}"

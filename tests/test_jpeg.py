@@ -196,6 +196,19 @@ class TestAbbreviatedStreams:
         seeded = decode_jpeg(stripped, tables=parse_tables(tables_stream))
         np.testing.assert_array_equal(full, seeded)
 
+    def test_split_tables_hostile_streams_raise_jpeg_error(self):
+        # truncated segment-length fields must surface as JpegError,
+        # not bare struct.error/IndexError (ADVICE r4)
+        data = _jpeg(GRAY, "L", quality=90)
+        dqt = data.find(b"\xff\xdb")
+        for hostile in (
+            data[: dqt + 3],            # length field cut mid-u16
+            data[:dqt] + b"\xff\xdb",   # marker with no length at all
+            data[: dqt + 10],           # declared length past the end
+        ):
+            with pytest.raises(JpegError):
+                split_tables(hostile)
+
 
 class TestIdctPaths:
     def test_device_matches_float_exactly_and_islow_closely(self):

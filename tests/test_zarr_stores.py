@@ -379,11 +379,52 @@ class TestS3Store:
         # opted in: a 403 reads as an absent chunk (fill_value)
         assert store.get(".zgroup") is None
 
+    def test_rotated_credentials_refresh_on_403(
+        self, s3_env, monkeypatch
+    ):
+        # construct with stale creds, rotate the environment, and the
+        # next read re-resolves + re-signs instead of failing forever
+        monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "stale")
+        store = S3Store("s3://test-bucket/img.zarr")
+        monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", SECRET_KEY)
+        assert store.get(".zattrs") is not None
+        assert store.secret_key == SECRET_KEY
+
     def test_uri_parse(self):
         s = S3Store("s3://bkt/a/b/c.zarr", endpoint="http://e")
         assert s.bucket == "bkt" and s.prefix == "a/b/c.zarr"
         with pytest.raises(ValueError):
             S3Store("http://not-s3")
+
+
+class TestKeyValidation:
+    """Hostile hierarchy metadata (NGFF dataset 'path' values) must not
+    walk a store outside its root (ADVICE r4)."""
+
+    def test_file_store_rejects_traversal(self, tmp_path):
+        from omero_ms_pixel_buffer_tpu.io.stores import validate_key
+
+        (tmp_path / "img").mkdir()
+        store = FileStore(str(tmp_path / "img"))
+        for key in ("../secret", "a/../../b", "/etc/passwd",
+                    "c:\\win", "..\\up"):
+            with pytest.raises(StoreError):
+                store.get(key)
+        # normal relative keys still pass, incl. POSIX-legal colons
+        assert validate_key("0/.zarray") == "0/.zarray"
+        assert validate_key("a..b/c") == "a..b/c"
+        assert validate_key("0:1/.zarray") == "0:1/.zarray"
+
+    def test_http_store_rejects_before_request(self):
+        # port 1 is unreachable: rejection must happen before any GET
+        store = HTTPStore("http://127.0.0.1:1", timeout_s=0.2)
+        with pytest.raises(StoreError, match="traversal"):
+            store.get("../secret")
+
+    def test_s3_store_rejects_before_request(self):
+        store = S3Store("s3://bkt/p", endpoint="http://127.0.0.1:1")
+        with pytest.raises(StoreError, match="traversal"):
+            store.get("../secret")
 
 
 class TestSharedCredentials:
