@@ -258,6 +258,10 @@ def bench_device(path: str, size: int, probe_info: dict) -> dict:
     env = dict(os.environ)
     env["BENCH_FIXTURE"] = path
     env["BENCH_IMAGE_SIZE"] = str(size)
+    if out.get("link_mbps"):
+        # the child folds the measured link into its compute-vs-link
+        # throughput projections (runtime/microbench.project_throughput)
+        env["BENCH_LINK_MBPS"] = str(out["link_mbps"])
     timeout_s = float(os.environ.get("BENCH_DEVICE_TIMEOUT_S", "600"))
     child = run_bounded(
         [sys.executable, os.path.abspath(__file__), "--device-sub"],
@@ -313,6 +317,26 @@ def device_sub_main():
             out[f"error_{label}"] = f"{type(e).__name__}: {e}"
             log(f"[device] {label} path failed: {e!r}")
     service.close()
+    # kernel-only compute metrics: over the tunneled chip the serving
+    # numbers above measure the LINK; these measure the TPU itself
+    # (device-resident inputs, compiles excluded) so the device design
+    # is judgeable without a co-located chip. Shapes match the serving
+    # runs, so the jit cache warmed above is reused.
+    if os.environ.get("BENCH_MICRO", "1") != "0":
+        from omero_ms_pixel_buffer_tpu.runtime.microbench import (
+            project_throughput,
+            run_microbench,
+        )
+
+        try:
+            micro = run_microbench()
+            link = float(os.environ.get("BENCH_LINK_MBPS", "0") or 0)
+            micro.update(project_throughput(micro, link or None))
+            out["micro"] = micro
+            log(f"[device] microbench: {micro}")
+        except Exception as e:
+            out["micro"] = {"error": f"{type(e).__name__}: {e}"}
+            log(f"[device] microbench failed: {e!r}")
     print(json.dumps(out))
 
 

@@ -52,69 +52,206 @@ class _LoopThread:
 
 # The HQL join, flattened to SQL over the OMERO schema: pixels rows
 # carry dimensions + FK to pixelstype (enum value = "uint16" etc.) and
-# to their image (name). Mirrors TileRequestHandler.java:228-236.
+# to their image (name, format, externalInfo — the reference's LEFT
+# OUTER JOIN FETCHes) plus the ACL columns (owner/group/permissions)
+# that let the resolver apply the permission filtering the reference
+# gets for free by running inside the caller's session.
+# Mirrors TileRequestHandler.java:220-241.
 PIXELS_QUERY = (
     "SELECT p.id, p.sizex, p.sizey, p.sizez, p.sizec, p.sizet, "
-    "pt.value, i.name "
+    "pt.value, i.name, i.owner_id, i.group_id, g.permissions, "
+    "f.value, e.entitytype, e.lsid, e.uuid "
     "FROM pixels p "
     "JOIN image i ON p.image = i.id "
     "JOIN pixelstype pt ON p.pixelstype = pt.id "
+    "LEFT JOIN experimentergroup g ON i.group_id = g.id "
+    "LEFT JOIN format f ON i.format = f.id "
+    "LEFT JOIN externalinfo e ON i.external_id = e.id "
     "WHERE i.id = $1"
 )
+
+# The caller's identity: an OMERO session key is the `session` row's
+# uuid; a closed session (closed timestamp set) no longer reads
+# anything — the analog of the reference's per-request session join
+# (PixelBufferVerticle.java:106-110) going stale.
+SESSION_USER_QUERY = (
+    "SELECT s.owner FROM session s "
+    "WHERE s.uuid = $1 AND s.closed IS NULL"
+)
+
+# Group memberships (m.owner marks a group LEADER, who reads all group
+# data) + group names ('system' membership = full admin).
+USER_GROUPS_QUERY = (
+    "SELECT m.parent, m.owner, g.name "
+    "FROM groupexperimentermap m "
+    "JOIN experimentergroup g ON m.parent = g.id "
+    "WHERE m.child = $1"
+)
+
+# OMERO permission bits (ome.model.internal.Permissions): the bigint
+# is all-ones with DENIED rights cleared; rights live in per-role
+# nibbles shifted USER=8 / GROUP=4 / WORLD=0, read = the nibble's low
+# bit. Derivation pinned by the four canonical group-permission longs:
+#   -120 'rw----' private        -> group/world nibbles cleared
+#   -104 'rwr---' read-only      -> +bit 4  (GROUP_READ)
+#    -72 'rwra--' read-annotate  -> +bit 5  (group annotate)
+#    -40 'rwrw--' read-write     -> +bit 6  (group write)
+USER_READ = 1 << 8
+GROUP_READ = 1 << 4
+WORLD_READ = 1 << 0
+_PRIVATE = -120  # default when the group row is missing
+
+
+def can_read(
+    user_ctx: Optional[tuple], owner_id: Optional[int],
+    group_id: Optional[int], permissions: int,
+) -> bool:
+    """OMERO's read rule for one object, evaluated host-side.
+
+    ``user_ctx`` is (user_id, {group_id: is_leader}, is_admin) or None
+    for an unknown/closed session (reads nothing). Mirrors the server's
+    security filter: admins read everything; group leaders read their
+    whole group; owners read their data (USER_READ); members read
+    group-readable data (GROUP_READ); WORLD_READ is public."""
+    if user_ctx is None:
+        return False
+    user_id, groups, is_admin = user_ctx
+    if is_admin:
+        return True
+    if group_id in groups and groups[group_id]:
+        return True  # group leader
+    if owner_id == user_id and permissions & USER_READ:
+        return True
+    if group_id in groups and permissions & GROUP_READ:
+        return True
+    return bool(permissions & WORLD_READ)
 
 
 class OmeroPostgresMetadataResolver:
     """MetadataResolver over the OMERO database (async core with a sync
-    adapter for the pipeline's synchronous resolve stage)."""
+    adapter for the pipeline's synchronous resolve stage).
+
+    With ``enforce_permissions`` on, ``get_pixels`` applies OMERO's
+    read ACL for the caller's session before returning metadata — the
+    behavior the reference gets by executing its HQL inside the joined
+    session (TileRequestHandler.java:220-241): an image the user cannot
+    read resolves to None, hence 404, exactly like one that does not
+    exist. The caller's identity re-resolves from the ``session`` table
+    every ``session_cache_ttl_s`` (a destroyed session stops reading
+    within that bound)."""
 
     def __init__(self, uri: str, cache_ttl_s: float = 60.0,
-                 cache_max: int = 4096):
+                 cache_max: int = 4096,
+                 enforce_permissions: bool = False,
+                 session_cache_ttl_s: float = 10.0):
         self._client = PostgresClient.from_uri(uri)
         self._runner: Optional[_LoopThread] = None
         self._runner_lock = threading.Lock()
         self._closed = False
+        self.enforce_permissions = enforce_permissions
         # Per-image TTL cache: metadata is effectively immutable for a
         # stored image, so the hot path must not pay one DB roundtrip
         # per tile (the registry path it replaces answers from memory).
+        # Entries carry (meta, owner_id, group_id, permissions); the
+        # ACL verdict is evaluated per caller, never cached with the row.
         self._cache_ttl_s = cache_ttl_s
         self._cache_max = cache_max
-        self._cache: dict = {}  # image_id -> (expires_at, meta|None)
+        self._cache: dict = {}  # image_id -> (expires_at, row)
         self._cache_lock = threading.Lock()
+        self._session_cache_ttl_s = session_cache_ttl_s
+        self._sessions: dict = {}  # key -> (expires_at, user_ctx|None)
 
-    def _cache_get(self, image_id: int):
+    def _cache_get(self, cache: dict, key):
         with self._cache_lock:
-            hit = self._cache.get(image_id)
+            hit = cache.get(key)
             if hit is not None and hit[0] > time.monotonic():
                 return True, hit[1]
         return False, None
 
-    def _cache_put(self, image_id: int, meta) -> None:
+    def _cache_put(self, cache: dict, key, value, ttl_s: float) -> None:
         with self._cache_lock:
-            if len(self._cache) >= self._cache_max:
-                self._cache.clear()  # coarse but bounded
-            self._cache[image_id] = (
-                time.monotonic() + self._cache_ttl_s, meta
-            )
+            if len(cache) >= self._cache_max:
+                cache.clear()  # coarse but bounded
+            cache[key] = (time.monotonic() + ttl_s, value)
 
-    async def get_pixels_async(self, image_id: int) -> Optional[PixelsMeta]:
-        image_id = int(image_id)
-        cached, meta = self._cache_get(image_id)
+    async def _pixels_row(self, image_id: int):
+        """(meta, owner_id, group_id, permissions) or None, TTL-cached."""
+        cached, row = self._cache_get(self._cache, image_id)
         if cached:
-            return meta
+            return row
         rows = await self._client.query(PIXELS_QUERY, [str(image_id)])
         if not rows:
             # no negative caching: an image mid-import must become
             # visible on the next request, not after a TTL of 404s
             return None  # -> 404 "Cannot find Image:<id>"
-        (_pid, sx, sy, sz, sc, st, ptype, name) = rows[0]
+        (_pid, sx, sy, sz, sc, st, ptype, name,
+         owner_id, group_id, perms, fmt, e_type, e_lsid, e_uuid) = rows[0]
+        external = None
+        if e_type is not None or e_lsid is not None or e_uuid is not None:
+            external = {"entityType": e_type, "lsid": e_lsid,
+                        "uuid": e_uuid}
         meta = PixelsMeta(
             image_id=image_id,
             size_x=int(sx), size_y=int(sy),
             size_z=int(sz), size_c=int(sc), size_t=int(st),
             pixels_type=ptype,
             image_name=name or str(image_id),
+            image_format=fmt,
+            external_info=external,
         )
-        self._cache_put(image_id, meta)
+        row = (
+            meta,
+            int(owner_id) if owner_id is not None else None,
+            int(group_id) if group_id is not None else None,
+            int(perms) if perms is not None else _PRIVATE,
+        )
+        self._cache_put(self._cache, image_id, row, self._cache_ttl_s)
+        return row
+
+    async def _session_context(self, session_key):
+        """(user_id, {group_id: is_leader}, is_admin) for a LIVE
+        session, None for unknown/closed/absent keys; cached for
+        ``session_cache_ttl_s`` (the revocation bound)."""
+        if not session_key:
+            return None
+        cached, ctx = self._cache_get(self._sessions, session_key)
+        if cached:
+            return ctx
+        ctx = None
+        rows = await self._client.query(
+            SESSION_USER_QUERY, [session_key]
+        )
+        if rows:
+            user_id = int(rows[0][0])
+            groups: dict = {}
+            is_admin = False
+            for gid, leader, gname in await self._client.query(
+                USER_GROUPS_QUERY, [str(user_id)]
+            ):
+                is_leader = str(leader).lower() in ("t", "true", "1")
+                groups[int(gid)] = is_leader
+                if gname == "system":
+                    is_admin = True
+            ctx = (user_id, groups, is_admin)
+        self._cache_put(
+            self._sessions, session_key, ctx, self._session_cache_ttl_s
+        )
+        return ctx
+
+    async def get_pixels_async(
+        self, image_id: int, session_key: Optional[str] = None
+    ) -> Optional[PixelsMeta]:
+        image_id = int(image_id)
+        row = await self._pixels_row(image_id)
+        if row is None:
+            return None
+        meta, owner_id, group_id, perms = row
+        if self.enforce_permissions:
+            ctx = await self._session_context(session_key)
+            if not can_read(ctx, owner_id, group_id, perms):
+                # unauthorized reads exactly like nonexistent — the
+                # reference's session-scoped HQL returns null for both
+                return None
         return meta
 
     def _run(self, coro):
@@ -127,15 +264,47 @@ class OmeroPostgresMetadataResolver:
             runner = self._runner
         return runner.run(coro)
 
-    def get_pixels(self, image_id: int) -> Optional[PixelsMeta]:
+    def get_pixels(
+        self, image_id: int, session_key: Optional[str] = None
+    ) -> Optional[PixelsMeta]:
         """Sync adapter (the MetadataResolver surface): dispatches onto
         a persistent background loop, so the connection — and its
         SCRAM handshake — is reused across calls. Callers already on
         an event loop should use ``get_pixels_async`` directly."""
-        cached, meta = self._cache_get(int(image_id))
-        if cached:
-            return meta
-        return self._run(self.get_pixels_async(image_id))
+        cached, row = self._cache_get(self._cache, int(image_id))
+        if cached and row is not None:
+            meta, owner_id, group_id, perms = row
+            if not self.enforce_permissions:
+                return meta
+            ctx_cached, ctx = self._cache_get(
+                self._sessions, session_key
+            )
+            if ctx_cached:
+                return (
+                    meta if can_read(ctx, owner_id, group_id, perms)
+                    else None
+                )
+        return self._run(self.get_pixels_async(image_id, session_key))
+
+    def get_pixels_unchecked(
+        self, image_id: int
+    ) -> Optional[PixelsMeta]:
+        """Metadata row WITHOUT ACL evaluation — for the buffer plane's
+        internal dimension lookups (e.g. a ROMIO plane file carries no
+        header). On the serving path authorization already happened at
+        resolve time; never expose this to request-derived calls."""
+        image_id = int(image_id)
+        cached, row = self._cache_get(self._cache, image_id)
+        if not cached:
+            row = self._run(self._pixels_row(image_id))
+        return None if row is None else row[0]
+
+    def query(self, sql: str, params: list) -> list:
+        """Run an arbitrary parameterized query on the shared
+        connection/loop (sync). The file-path resolver (db/resolver.py)
+        rides this so one SCRAM'd connection serves both the metadata
+        and the path plane."""
+        return self._run(self._client.query(sql, params))
 
     async def close(self) -> None:
         await self._client.close()

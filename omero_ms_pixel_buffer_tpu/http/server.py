@@ -149,15 +149,39 @@ class PixelBufferApp:
             config.session_store.type, config.session_store.uri
         )
         if pixels_service is None:
-            registry = ImageRegistry(config.image_registry)
             resolver = None
             db_uri = config.omero_server.get("omero.db.uri")
+            data_dir = config.omero_server.get("omero.data.dir")
             if db_uri:
                 # authoritative metadata from the OMERO database (the
-                # HQL plane); registry keeps providing storage paths
+                # HQL plane), permission-scoped by default: the
+                # reference's HQL runs inside the caller's session so
+                # ACLs filter what resolves — opt out only for
+                # deployments fronted by their own authorization
                 from ..db.metadata import OmeroPostgresMetadataResolver
 
-                resolver = OmeroPostgresMetadataResolver(db_uri)
+                # omero.server values are Java-style properties and may
+                # arrive as strings — "false"/"0"/"no"/"off" must
+                # actually disable (bool("false") would not)
+                flag = config.omero_server.get(
+                    "omero.db.enforce-permissions", True
+                )
+                resolver = OmeroPostgresMetadataResolver(
+                    db_uri,
+                    enforce_permissions=str(flag).strip().lower()
+                    not in ("false", "0", "no", "off"),
+                )
+            if db_uri and data_dir and not config.image_registry:
+                # full OMERO deployment: imageId -> storage path from
+                # the database + data dir (the OmeroFilePathResolver
+                # analog, db/resolver.py) — no JSON registry needed
+                from ..db.resolver import OmeroImageSource
+
+                registry = OmeroImageSource(
+                    db_uri, data_dir, metadata=resolver
+                )
+            else:
+                registry = ImageRegistry(config.image_registry)
             pixels_service = PixelsService(
                 registry,
                 metadata_resolver=resolver,

@@ -120,6 +120,11 @@ class PixelsMeta:
     size_t: int
     pixels_type: str  # OMERO PixelsType enum value, e.g. "uint16"
     image_name: str = ""
+    # the reference's LEFT OUTER JOIN FETCHes (i.format /
+    # i.details.externalInfo, TileRequestHandler.java:228-236): the
+    # image's Format enum value and its ExternalInfo row, when present
+    image_format: Optional[str] = None
+    external_info: Optional[dict] = None
 
     @property
     def dtype(self) -> np.dtype:

@@ -35,9 +35,25 @@ from .romio import RomioPixelBuffer
 from .zarr import ZarrPixelBuffer
 
 
+def _scoped(resolver) -> bool:
+    """Whether a resolver's get_pixels accepts ``session_key`` (i.e.
+    applies OMERO's permission model per caller)."""
+    import inspect
+
+    try:
+        return "session_key" in inspect.signature(
+            resolver.get_pixels
+        ).parameters
+    except (TypeError, ValueError):
+        return False
+
+
 class MetadataResolver:
     """The getPixels contract: imageId -> PixelsMeta or None
-    (TileRequestHandler.java:220-241)."""
+    (TileRequestHandler.java:220-241). Implementations that apply
+    OMERO's permission model additionally accept ``session_key``
+    (db/metadata.py); the service passes it through when the
+    implementation's signature takes it."""
 
     def get_pixels(self, image_id: int) -> Optional[PixelsMeta]:
         raise NotImplementedError
@@ -149,7 +165,17 @@ class PixelsService:
         # HQL contract — while the registry keeps providing the
         # buffer plane (imageId -> storage path). A resolver miss is a
         # 404 even if the registry knows a path.
+        if metadata_resolver is None and _scoped(registry):
+            # a permission-aware registry (e.g. db.resolver's
+            # OmeroImageSource) IS the metadata plane: route
+            # request-derived lookups through its scoped surface, or a
+            # bare PixelsService(OmeroImageSource(...)) would silently
+            # take the unchecked buffer-plane path and bypass ACLs
+            metadata_resolver = registry
         self.metadata_resolver = metadata_resolver
+        self._resolver_scoped = (
+            metadata_resolver is not None and _scoped(metadata_resolver)
+        )
         # ONE decoded-block cache shared by every buffer this service
         # opens — a process-wide bound, not per-buffer (None ->
         # OMPB_BLOCK_CACHE_MB default; 0 disables, e.g. for baselines).
@@ -159,11 +185,19 @@ class PixelsService:
         self._cache: OrderedDict[int, PixelBuffer] = OrderedDict()
         self._lock = threading.Lock()
 
-    def get_pixels(self, image_id: int) -> Optional[PixelsMeta]:
+    def get_pixels(
+        self, image_id: int, session_key: Optional[str] = None
+    ) -> Optional[PixelsMeta]:
         """Metadata lookup answered from the cached buffer when one is
         open (no per-request file open/parse — unlike the reference's
-        per-request HQL + buffer open, TileRequestHandler.java:201-241)."""
+        per-request HQL + buffer open, TileRequestHandler.java:201-241).
+        ``session_key`` reaches permission-scoped resolvers so an
+        unauthorized image 404s like a nonexistent one."""
         if self.metadata_resolver is not None:
+            if self._resolver_scoped:
+                return self.metadata_resolver.get_pixels(
+                    image_id, session_key=session_key
+                )
             return self.metadata_resolver.get_pixels(image_id)
         entry = self.registry.entry(image_id)
         if entry is None:

@@ -230,23 +230,24 @@ def _get_with_retry(fn) -> Tuple[int, bytes]:
     raise last if last is not None else StoreError("GET failed")
 
 
-def _resolve_credentials() -> Tuple[
+def _resolve_credentials(read_files_for_region: bool = False) -> Tuple[
     Optional[str], Optional[str], Optional[str], Optional[str]
 ]:
     """(access, secret, token, file_region): env credentials, else the
-    shared files; a token in env wins over the file's. ``file_region``
-    is None when env supplied the keys (files never read). One cascade
-    shared by S3Store's constructor and its 403 refresh path so
-    precedence can't drift between them."""
+    shared files; a token in env wins over the file's. The files are
+    read when keys are missing from env OR ``read_files_for_region``
+    (keys in env with region only in ~/.aws/config is common — one
+    read covers both needs). One cascade shared by S3Store's
+    constructor and its 403 refresh path so precedence can't drift."""
     access = os.environ.get("AWS_ACCESS_KEY_ID")
     secret = os.environ.get("AWS_SECRET_ACCESS_KEY")
     token = os.environ.get("AWS_SESSION_TOKEN")
     file_region = None
-    if not (access and secret):
+    if not (access and secret) or read_files_for_region:
         f_access, f_secret, f_token, file_region = (
             load_shared_credentials()
         )
-        if f_access and f_secret:
+        if not (access and secret) and (f_access and f_secret):
             access, secret = f_access, f_secret
             token = token or f_token
     return access, secret, token, file_region
@@ -351,15 +352,13 @@ class S3Store:
                 f"https://{self.bucket}.s3.{self.region}.amazonaws.com"
             )
             self._path_style = False
-        access, secret, token, file_region = _resolve_credentials()
         env_region = (
             os.environ.get("AWS_REGION")
             or os.environ.get("AWS_DEFAULT_REGION")
         )
-        # keys in env with region only in ~/.aws/config is a common
-        # combination — read the config file for region if still unset
-        if file_region is None and not (region or env_region):
-            _, _, _, file_region = load_shared_credentials()
+        access, secret, token, file_region = _resolve_credentials(
+            read_files_for_region=not (region or env_region)
+        )
         if file_region and not (region or env_region):
             self.region = file_region
             if not endpoint:  # virtual-hosted URL tracks region

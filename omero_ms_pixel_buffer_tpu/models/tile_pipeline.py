@@ -238,7 +238,12 @@ class TilePipeline:
         is unknown; raises on invalid coordinates (callers map to the
         reference's broad-catch -> None -> 404)."""
         with TRACER.start_span("get_pixels"):
-            meta = self.pixels_service.get_pixels(ctx.image_id)
+            # the session key scopes permission-aware resolvers — the
+            # reference's HQL runs inside the joined session, so ACLs
+            # filter what resolves (TileRequestHandler.java:220-241)
+            meta = self.pixels_service.get_pixels(
+                ctx.image_id, session_key=ctx.omero_session_key
+            )
         if meta is None:
             log.debug("Cannot find Image:%s", ctx.image_id)
             return None

@@ -1,0 +1,236 @@
+"""Kernel-only device-compute microbenchmarks.
+
+The device engine's marquee ops — the Pallas byteswap+filter kernel,
+the on-device deflate, the HBM plane-cache crop chain (rebuilding the
+reference's encode hot loop, TileRequestHandler.java:176-199) — are
+invisible in end-to-end tiles/s when the chip hangs off a ~10 MB/s
+tunnel: the link is the whole measurement. This module measures the
+COMPUTE side by itself so the TPU-first design is judgeable anywhere:
+
+- inputs are device-resident before any timing (``jax.device_put``
+  outside the timed region);
+- every timed iteration ends in ``block_until_ready`` and outputs stay
+  on device (no device→host fetch inside the loop);
+- compiles are excluded (one warm call per shape first).
+
+Emitted by ``bench.py --device-sub`` into BENCH's ``device`` section:
+``filter_gbps`` (Pallas and XLA-fusion variants), ``deflate_gbps``,
+``deflate_ratio_vs_host`` (device RLE+fixed-Huffman stream bytes vs
+the host's dynamic-Huffman zlib level 6 on identical payloads), and
+``batch_ms_steady`` for the full resident-plane chain
+(crop → filter → deflate). ``project_throughput`` then folds the
+measured link bandwidth in: tiles/s = 1 / (compute + transfer), for
+both the measured tunnel and an assumed co-located host↔device link.
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+from typing import Optional
+
+import numpy as np
+
+# PNG chunk framing the host adds around a device-built zlib stream
+# (8 sig + IHDR 25 + IDAT 12 + IEND 12): the per-tile bytes that cross
+# an HTTP socket beyond the compressed stream itself.
+_PNG_FRAME_BYTES = 57
+
+
+def synth_tiles(
+    b: int, h: int, w: int, dtype=np.uint16, seed: int = 5,
+    noise: float = 120.0,
+) -> np.ndarray:
+    """Microscopy-like content (smooth field + sensor noise) — the same
+    family as bench.py's fixture, so compressed sizes are realistic
+    rather than white-noise worst case."""
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    base = 2000 + 1500 * np.sin(xx / 97.0) + 1500 * np.cos(yy / 131.0)
+    info = np.iinfo(dtype)
+    tiles = (
+        base[None] + rng.normal(0, noise, (b, h, w))
+    ).clip(info.min, info.max)
+    return tiles.astype(dtype)
+
+
+def _time_steady(fn, iters: int) -> float:
+    """Seconds per call at steady state (fn must block on its result).
+    MEDIAN of per-call times, not the mean: dispatch crosses the
+    tunnel, and a single multi-second link stall inside the loop must
+    not masquerade as kernel cost (observed: one spike inflated a
+    1.5 ms chain to a 2.7 s 'average')."""
+    fn()  # warm: compile + first-touch allocations
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def run_microbench(
+    batch: int = 32,
+    tile: int = 512,
+    plane: int = 4096,
+    iters_filter: int = 20,
+    iters_deflate: int = 5,
+    seed: int = 5,
+) -> dict:
+    """All kernel-only metrics as one dict; raises only if jax itself
+    is unusable (callers run it inside the bounded device child)."""
+    import jax
+
+    from ..models.device_cache import DevicePlaneCache
+    from ..ops.device_deflate import deflate_filtered_batch
+    from ..ops.convert import to_big_endian_bytes
+    from ..ops.pallas.filter import filter_tiles
+    from ..ops.pallas.filter import supports as pallas_supports
+    from ..ops.png import filter_batch
+
+    out: dict = {
+        "batch": batch,
+        "tile": tile,
+        "backend": jax.default_backend(),
+    }
+    tiles_np = synth_tiles(batch, tile, tile, seed=seed)
+    itemsize = tiles_np.dtype.itemsize
+    in_bytes = tiles_np.nbytes
+    tiles = jax.device_put(tiles_np)
+    jax.block_until_ready(tiles)
+
+    # --- (a) fused byteswap + PNG filter ------------------------------
+    use_pallas = pallas_supports((tile, tile), tiles_np.dtype)
+    filtered = None
+    if use_pallas:
+        dt = _time_steady(
+            lambda: jax.block_until_ready(filter_tiles(tiles, "up")),
+            iters_filter,
+        )
+        out["filter_gbps"] = round(in_bytes / dt / 1e9, 3)
+        out["filter_ms_per_batch"] = round(dt * 1e3, 3)
+        filtered = filter_tiles(tiles, "up")
+
+    def xla_filter():
+        rows = to_big_endian_bytes(tiles)
+        return jax.block_until_ready(filter_batch(rows, itemsize, "up"))
+
+    dt = _time_steady(xla_filter, iters_filter)
+    out["filter_gbps_xla"] = round(in_bytes / dt / 1e9, 3)
+    if filtered is None:
+        filtered = xla_filter()
+
+    # --- (b) on-device deflate (RLE + fixed Huffman) ------------------
+    row_bytes = 1 + tile * itemsize
+    payload_bytes = batch * tile * row_bytes
+    dt = _time_steady(
+        lambda: jax.block_until_ready(
+            deflate_filtered_batch(filtered, tile, row_bytes)
+        ),
+        iters_deflate,
+    )
+    out["deflate_gbps"] = round(payload_bytes / dt / 1e9, 3)
+    out["deflate_ms_per_batch"] = round(dt * 1e3, 2)
+
+    # --- (c) full chain from an HBM-resident plane --------------------
+    # crop (dynamic_slice gather) → filter → deflate, nothing crossing
+    # the link inside the timed call: the steady-state cost of serving
+    # one coalesced batch when the plane is already cached on device.
+    # Coordinates are pre-staged device arrays — a per-call 128-byte
+    # upload is free on PCIe but costs a full round trip on the
+    # tunnel, which would measure the link again.
+    from ..models.device_cache import _crop_batch
+
+    plane_np = synth_tiles(1, plane, plane, seed=seed + 1)[0]
+    dplane = jax.device_put(plane_np)
+    jax.block_until_ready(dplane)
+    rng = np.random.default_rng(seed + 2)
+    span = (plane - tile) // 64 + 1
+    ys = jax.device_put(
+        (rng.integers(0, span, batch) * 64).astype(np.int32)
+    )
+    xs = jax.device_put(
+        (rng.integers(0, span, batch) * 64).astype(np.int32)
+    )
+    jax.block_until_ready((ys, xs))
+
+    def chain():
+        crops = _crop_batch(dplane, ys, xs, tile, tile)
+        if use_pallas:
+            f = filter_tiles(crops, "up")
+        else:
+            f = filter_batch(to_big_endian_bytes(crops), itemsize, "up")
+        return jax.block_until_ready(
+            deflate_filtered_batch(f, tile, row_bytes)
+        )
+
+    dt = _time_steady(chain, iters_deflate)
+    out["batch_ms_steady"] = round(dt * 1e3, 2)
+    out["chain_tiles_per_sec_compute"] = round(batch / dt, 1)
+
+    # --- compressed-ratio vs the host encoder, identical payloads -----
+    # Host reference: zlib level 6 (the serving default, dynamic
+    # Huffman — what native/fast_deflate.cc and the Java Deflater
+    # both produce trees for). Runs LAST: it downloads the filtered
+    # batch over the link, which on a tunnel can take seconds and must
+    # not sit between the kernel timings above.
+    streams, lengths = deflate_filtered_batch(filtered, tile, row_bytes)
+    dev_sizes = np.asarray(lengths, dtype=np.int64)
+    filtered_np = np.asarray(filtered)
+    host_sizes = np.array(
+        [
+            len(zlib.compress(
+                filtered_np[i, :tile, :row_bytes].tobytes(), 6
+            ))
+            for i in range(batch)
+        ],
+        dtype=np.int64,
+    )
+    out["device_bytes_per_tile"] = round(float(dev_sizes.mean()), 1)
+    out["host_bytes_per_tile"] = round(float(host_sizes.mean()), 1)
+    out["deflate_ratio_vs_host"] = round(
+        float(dev_sizes.mean() / host_sizes.mean()), 3
+    )
+    out["deflate_compression_x"] = round(
+        float(tile * row_bytes / dev_sizes.mean()), 2
+    )
+    return out
+
+
+def project_throughput(
+    micro: dict,
+    link_mbps: Optional[float],
+    colocated_gbps: float = 8.0,
+) -> dict:
+    """Fold measured compute into a compute-vs-link throughput model.
+
+    Per coalesced batch the device path moves ONLY compressed streams
+    back (the plane is HBM-resident), so
+    ``tiles/s = 1 / (batch_s/batch + bytes_per_tile / link_Bps)``.
+    Two projections: the measured link (validates the tunnel-bound
+    end-to-end numbers) and an assumed co-located host↔device link
+    (``colocated_gbps``, deliberately conservative vs real PCIe/HBM).
+    """
+    need = ("batch_ms_steady", "batch", "device_bytes_per_tile")
+    if any(k not in micro for k in need):
+        return {}
+    compute_s_per_tile = micro["batch_ms_steady"] / 1e3 / micro["batch"]
+    wire_bytes = micro["device_bytes_per_tile"] + _PNG_FRAME_BYTES
+    out = {
+        "projected_colocated_tiles_per_sec": round(
+            1.0
+            / (compute_s_per_tile + wire_bytes / (colocated_gbps * 1e9)),
+            1,
+        ),
+        "projection_model": (
+            "1/(batch_ms/batch + bytes_per_tile/link);"
+            f" colocated link {colocated_gbps:g} GB/s"
+        ),
+    }
+    if link_mbps:
+        out["projected_tunnel_tiles_per_sec"] = round(
+            1.0 / (compute_s_per_tile + wire_bytes / (link_mbps * 1e6)),
+            1,
+        )
+    return out

@@ -1,0 +1,270 @@
+"""OmeroImageSource: imageId → storage path from the OMERO database +
+data dir (the OmeroFilePathResolver analog, db/resolver.py) against a
+fake Postgres and a synthesized ``omero.data.dir``.
+
+Covers every layout the resolver walks: managed-repository OME-TIFF,
+NGFF hierarchy (root and member-file rows), legacy path+name, ROMIO
+fan-out plane files, generated pyramids — and the end-to-end claim:
+a PixelsService over only (db uri, data dir) serves pixel-exact tiles
+with no JSON registry.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from omero_ms_pixel_buffer_tpu.db.resolver import (
+    FILESET_FILES_QUERY,
+    PIXELS_ID_QUERY,
+    REPO_ROOT_QUERY,
+    OmeroImageSource,
+    pixels_fanout_path,
+)
+from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
+from omero_ms_pixel_buffer_tpu.io.pixels_service import PixelsService
+from omero_ms_pixel_buffer_tpu.io.zarr import write_ngff
+
+from test_postgres import FakePg
+
+rng = np.random.default_rng(21)
+TIFF_IMG = rng.integers(0, 60000, (1, 1, 1, 96, 128), dtype=np.uint16)
+ZARR_IMG = rng.integers(0, 60000, (1, 1, 1, 64, 80), dtype=np.uint16)
+ROMIO_IMG = rng.integers(0, 60000, (1, 1, 1, 48, 64), dtype=np.uint16)
+
+
+class TestFanout:
+    def test_small_id_is_flat(self):
+        assert pixels_fanout_path("/data", 7) == "/data/Pixels/7"
+        assert pixels_fanout_path("/data", 999) == "/data/Pixels/999"
+
+    def test_thousands_fanout(self):
+        # ome.io.nio.AbstractFileSystemService: one Dir-%03d level per
+        # division by 1000
+        assert pixels_fanout_path("/data", 1000) == (
+            "/data/Pixels/Dir-001/1000"
+        )
+        assert pixels_fanout_path("/data", 1234567) == (
+            "/data/Pixels/Dir-001/Dir-234/1234567"
+        )
+
+
+@pytest.fixture
+def data_dir(tmp_path):
+    """A synthesized omero.data.dir with one image per layout."""
+    d = tmp_path / "OMERO"
+    # image 1: FS import, managed repository OME-TIFF
+    mrepo = d / "ManagedRepository" / "demo_2" / "2026-07"
+    mrepo.mkdir(parents=True)
+    write_ome_tiff(
+        str(mrepo / "img.ome.tiff"), TIFF_IMG, tile_size=(64, 64)
+    )
+    # image 2: FS import, NGFF hierarchy in the managed repository
+    write_ngff(
+        str(mrepo / "plate.ome.zarr"), ZARR_IMG, chunks=(32, 32),
+        levels=1,
+    )
+    # image 3: pre-FS ROMIO plane file (raw big-endian planes)
+    romio = d / "Pixels"
+    romio.mkdir(parents=True)
+    (romio / "301").write_bytes(
+        ROMIO_IMG[0, 0, 0].astype(">u2").tobytes()
+    )
+    # image 4: generated pyramid next to the (absent) ROMIO file
+    write_ome_tiff(
+        str(romio / "401_pyramid"), TIFF_IMG, tile_size=(64, 64)
+    )
+    # image 5: legacy (pre-FS) original file under the data dir
+    legacy = d / "legacy_user" / "2016-01"
+    legacy.mkdir(parents=True)
+    write_ome_tiff(
+        str(legacy / "old.tiff"), TIFF_IMG, tile_size=(64, 64)
+    )
+    return str(d)
+
+
+def _rows_for(data_dir):
+    """The OMERO rows backing the five images in ``data_dir``."""
+
+    def rows(sql, params):
+        if sql == FILESET_FILES_QUERY:
+            return {
+                "1": [("demo_2/2026-07/", "img.ome.tiff", "repo-uuid",
+                       "101")],
+                # NGFF filesets list every member file; the resolver
+                # must walk up to the .zarr root
+                "2": [
+                    ("demo_2/2026-07/plate.ome.zarr/", ".zattrs",
+                     "repo-uuid", "201"),
+                    ("demo_2/2026-07/plate.ome.zarr/0/", ".zarray",
+                     "repo-uuid", "201"),
+                ],
+                "5": [("legacy_user/2016-01/", "old.tiff", None,
+                       "501")],
+            }.get(params[0], [])
+        if sql == PIXELS_ID_QUERY:
+            return {"3": [("301",)], "4": [("401",)]}.get(params[0], [])
+        if sql == REPO_ROOT_QUERY:
+            return []  # default ManagedRepository convention
+        raise AssertionError(f"unexpected SQL: {sql}")
+
+    return rows
+
+
+class TestResolution:
+    def _with_source(self, data_dir, loop, fn, rows_for=None):
+        import asyncio
+        import threading
+
+        results = {}
+        started = threading.Event()
+        stop = threading.Event()
+
+        def server_thread():
+            srv_loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(srv_loop)
+
+            async def run():
+                async with FakePg(
+                    rows_for=rows_for or _rows_for(data_dir)
+                ) as pg:
+                    results["port"] = pg.port
+                    started.set()
+                    while not stop.is_set():
+                        await asyncio.sleep(0.05)
+
+            try:
+                srv_loop.run_until_complete(run())
+            finally:
+                srv_loop.close()
+
+        t = threading.Thread(target=server_thread, daemon=True)
+        t.start()
+        assert started.wait(5)
+        source = OmeroImageSource(
+            f"postgresql://omero:pw@127.0.0.1:{results['port']}/omero",
+            data_dir,
+        )
+        try:
+            return fn(source)
+        finally:
+            source.close_sync()
+            stop.set()
+            t.join(timeout=5)
+
+    def test_managed_repo_tiff(self, data_dir, loop):
+        def check(source):
+            entry = source.entry(1)
+            assert entry["type"] == "ometiff"
+            assert entry["path"] == os.path.join(
+                data_dir, "ManagedRepository", "demo_2", "2026-07",
+                "img.ome.tiff",
+            )
+
+        self._with_source(data_dir, loop, check)
+
+    def test_ngff_member_files_walk_to_root(self, data_dir, loop):
+        def check(source):
+            entry = source.entry(2)
+            assert entry["type"] == "zarr"
+            assert entry["path"].endswith("plate.ome.zarr")
+
+        self._with_source(data_dir, loop, check)
+
+    def test_romio_fanout_and_pyramid(self, data_dir, loop):
+        def check(source):
+            e3 = source.entry(3)
+            assert e3["type"] == "romio"
+            assert e3["path"] == os.path.join(data_dir, "Pixels", "301")
+            e4 = source.entry(4)
+            assert e4["type"] == "ometiff"
+            assert e4["path"].endswith("401_pyramid")
+
+        self._with_source(data_dir, loop, check)
+
+    def test_legacy_original_file(self, data_dir, loop):
+        def check(source):
+            entry = source.entry(5)
+            assert entry["type"] == "ometiff"
+            assert entry["path"] == os.path.join(
+                data_dir, "legacy_user", "2016-01", "old.tiff"
+            )
+
+        self._with_source(data_dir, loop, check)
+
+    def test_unknown_image_is_none(self, data_dir, loop):
+        def check(source):
+            assert source.entry(99) is None  # -> 404
+
+        self._with_source(data_dir, loop, check)
+
+    def test_entries_cached(self, data_dir, loop):
+        counted = {"n": 0}
+        base = _rows_for(data_dir)
+
+        def rows_for(sql, params):
+            counted["n"] += 1
+            return base(sql, params)
+
+        def check(source):
+            e1 = source.entry(1)
+            before = counted["n"]
+            assert source.entry(1) == e1
+            assert counted["n"] == before  # TTL cache hit
+
+        self._with_source(data_dir, loop, check, rows_for=rows_for)
+
+
+class TestEndToEnd:
+    def test_serves_tiles_without_registry(self, data_dir, loop):
+        """The VERDICT 'done' bar: only (db uri, data dir), no JSON
+        registry — pixel-exact tiles from all three reader kinds."""
+
+        def rows_for(sql, params):
+            base = _rows_for(data_dir)
+            if "pixelstype" in sql:
+                # metadata plane (db/metadata.PIXELS_QUERY)
+                dims = {
+                    "1": ("101", "128", "96", "uint16", "img"),
+                    "2": ("201", "80", "64", "uint16", "plate"),
+                    "3": ("301", "64", "48", "uint16", "planes"),
+                }.get(params[0])
+                if dims is None:
+                    return []
+                pid, sx, sy, pt, name = dims
+                return [(pid, sx, sy, "1", "1", "1", pt, name,
+                         "2", "3", "-120", None, None, None, None)]
+            return base(sql, params)
+
+        def run(test, source):
+            service = PixelsService(
+                source, metadata_resolver=source.metadata
+            )
+            try:
+                tile = service.get_pixel_buffer(1).get_tile_at(
+                    0, 0, 0, 0, 16, 8, 64, 64
+                )
+                np.testing.assert_array_equal(
+                    tile, TIFF_IMG[0, 0, 0, 8:72, 16:80]
+                )
+                ztile = service.get_pixel_buffer(2).get_tile_at(
+                    0, 0, 0, 0, 0, 0, 40, 40
+                )
+                np.testing.assert_array_equal(
+                    ztile, ZARR_IMG[0, 0, 0, :40, :40]
+                )
+                rtile = service.get_pixel_buffer(3).get_tile_at(
+                    0, 0, 0, 0, 0, 0, 32, 32
+                )
+                np.testing.assert_array_equal(
+                    rtile, ROMIO_IMG[0, 0, 0, :32, :32]
+                )
+                assert service.get_pixel_buffer(99) is None  # -> 404
+            finally:
+                service.close()
+
+        TestResolution()._with_source(
+            data_dir, loop,
+            lambda source: run(self, source),
+            rows_for=rows_for,
+        )

@@ -1,0 +1,92 @@
+"""Kernel-only microbench (runtime/microbench) — shape/correctness on
+the CPU backend with tiny sizes; the real numbers come from bench.py's
+bounded device child on TPU."""
+
+import zlib
+
+import numpy as np
+import pytest
+
+from omero_ms_pixel_buffer_tpu.runtime.microbench import (
+    project_throughput,
+    run_microbench,
+    synth_tiles,
+)
+
+
+@pytest.fixture(scope="module")
+def micro():
+    return run_microbench(
+        batch=4, tile=32, plane=128, iters_filter=2, iters_deflate=1
+    )
+
+
+class TestRunMicrobench:
+    def test_metrics_present_and_positive(self, micro):
+        for key in (
+            "filter_gbps",         # 32x32 u16 fits the Pallas cap
+            "filter_gbps_xla",
+            "deflate_gbps",
+            "deflate_ms_per_batch",
+            "deflate_ratio_vs_host",
+            "device_bytes_per_tile",
+            "host_bytes_per_tile",
+            "batch_ms_steady",
+            "chain_tiles_per_sec_compute",
+        ):
+            assert micro[key] > 0, key
+
+    def test_device_streams_decode_and_ratio_is_honest(self, micro):
+        # the ratio must come from real, decodable streams: rebuild the
+        # same payloads and pin one lane end-to-end
+        from omero_ms_pixel_buffer_tpu.ops.device_deflate import (
+            deflate_filtered_batch,
+        )
+        from omero_ms_pixel_buffer_tpu.ops.pallas.filter import (
+            filter_tiles,
+        )
+
+        tiles = synth_tiles(4, 32, 32, seed=5)
+        filtered = filter_tiles(tiles, "up")
+        streams, lengths = deflate_filtered_batch(filtered, 32, 1 + 64)
+        streams, lengths = np.asarray(streams), np.asarray(lengths)
+        payload = np.asarray(filtered)[0, :32, : 1 + 64].tobytes()
+        assert zlib.decompress(
+            streams[0][: lengths[0]].tobytes()
+        ) == payload
+        # device fixed-Huffman RLE trails host dynamic Huffman but must
+        # stay in the same ballpark on run-heavy filtered content
+        assert 0.5 < micro["deflate_ratio_vs_host"] < 4.0
+
+    def test_compression_on_run_heavy_content(self):
+        # noisy 16-bit content defeats RLE at tiny tiles (honest, and
+        # recorded as-is in the ratio); run-heavy content must compress
+        from omero_ms_pixel_buffer_tpu.ops.device_deflate import (
+            deflate_filtered_batch,
+        )
+        from omero_ms_pixel_buffer_tpu.ops.pallas.filter import (
+            filter_tiles,
+        )
+
+        tiles = np.full((4, 32, 32), 777, np.uint16)  # flat field
+        filtered = filter_tiles(tiles, "up")
+        _, lengths = deflate_filtered_batch(filtered, 32, 1 + 64)
+        assert np.asarray(lengths).mean() < 0.2 * 32 * (1 + 64)
+
+
+class TestProjection:
+    def test_compute_and_link_bound_projections(self, micro):
+        proj = project_throughput(micro, link_mbps=10.0)
+        colo = proj["projected_colocated_tiles_per_sec"]
+        tun = proj["projected_tunnel_tiles_per_sec"]
+        assert 0 < tun <= colo  # a 10 MB/s link can only slow it down
+        compute_bound = micro["chain_tiles_per_sec_compute"]
+        assert colo <= compute_bound * 1.01 + 0.2  # rounding slack
+
+    def test_no_link_means_no_tunnel_projection(self, micro):
+        proj = project_throughput(micro, link_mbps=None)
+        assert "projected_tunnel_tiles_per_sec" not in proj
+        assert proj["projected_colocated_tiles_per_sec"] > 0
+
+    def test_incomplete_micro_yields_empty(self):
+        assert project_throughput({"batch": 4}, 10.0) == {}

@@ -348,13 +348,25 @@ class TestPostgresSessionStore:
         loop.run_until_complete(run())
 
 
+def pixels_row(
+    pid="99", sx="4096", sy="2048", sz="16", sc="3", st="1",
+    ptype="uint16", name="plate1", owner="2", group="3", perms="-120",
+    fmt=None, e_type=None, e_lsid=None, e_uuid=None,
+):
+    """One PIXELS_QUERY result row (the widened ACL+format shape)."""
+    return (pid, sx, sy, sz, sc, st, ptype, name, owner, group, perms,
+            fmt, e_type, e_lsid, e_uuid)
+
+
 class TestMetadataResolver:
     def test_pixels_contract(self, loop):
         def rows_for(sql, params):
             assert sql == PIXELS_QUERY
             if params == ["7"]:
-                return [("99", "4096", "2048", "16", "3", "1",
-                         "uint16", "plate1")]
+                return [pixels_row(
+                    fmt="OMETiff", e_type="ome.model.core.Image",
+                    e_lsid="urn:lsid:x", e_uuid="u-1",
+                )]
             return []
 
         async def run():
@@ -367,6 +379,13 @@ class TestMetadataResolver:
                 assert meta.size_z == 16 and meta.size_c == 3
                 assert meta.pixels_type == "uint16"
                 assert meta.image_name == "plate1"
+                # i.format / i.details.externalInfo parity
+                # (TileRequestHandler.java:228-236)
+                assert meta.image_format == "OMETiff"
+                assert meta.external_info == {
+                    "entityType": "ome.model.core.Image",
+                    "lsid": "urn:lsid:x", "uuid": "u-1",
+                }
                 assert await resolver.get_pixels_async(8) is None  # -> 404
                 await resolver.close()
 
@@ -380,7 +399,8 @@ class TestCrossLoopReuse:
         import threading
 
         def rows_for(sql, params):
-            return [("1", "64", "32", "1", "1", "1", "uint8", "img")]
+            return [pixels_row(pid="1", sx="64", sy="32", sz="1",
+                               sc="1", st="1", ptype="uint8", name="img")]
 
         results = {}
         started = threading.Event()
@@ -465,7 +485,8 @@ class TestResolverCache:
 
         def rows_for(sql, params):
             calls.append(params)
-            return [("9", "128", "64", "1", "1", "1", "uint8", "img")]
+            return [pixels_row(pid="9", sx="128", sy="64", sz="1",
+                               sc="1", st="1", ptype="uint8", name="img")]
 
         async def run():
             async with FakePg(rows_for=rows_for) as pg:

@@ -56,7 +56,7 @@ class RgbService:
     def __init__(self, plane):
         self.buffer = RgbPlaneBuffer(plane)
 
-    def get_pixels(self, image_id):
+    def get_pixels(self, image_id, session_key=None):
         return self.buffer.meta if image_id == 1 else None
 
     def get_pixel_buffer(self, image_id):
