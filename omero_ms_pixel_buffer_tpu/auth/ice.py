@@ -33,6 +33,9 @@ import struct
 import time
 from typing import Optional, Tuple
 
+from ..errors import ServiceUnavailableError
+from ..resilience.breaker import BreakerOpenError, for_dependency
+from ..resilience.faultinject import INJECTOR
 from .validator import SessionValidator
 
 HEADER_MAGIC = b"IceP"
@@ -242,10 +245,34 @@ class IceSessionValidator(SessionValidator):
         self._cache_max = cache_max
         self._valid_until: dict = {}  # key -> monotonic expiry
         self._in_flight: dict = {}  # key -> Task[bool]
+        # a wedged/unreachable router fails joins fast (503, not a
+        # worker parked behind a TLS timeout per tile); a denial is an
+        # ANSWER and never counts against the breaker
+        self.breaker = for_dependency(f"glacier2:{host}:{port}")
+
+    async def _create_session(self, key: str) -> bool:
+        """One breaker-gated Glacier2 join. BreakerOpen -> 503 (auth
+        backend unavailable, not auth denied)."""
+        try:
+            self.breaker.allow()
+        except BreakerOpenError as e:
+            raise ServiceUnavailableError(
+                str(e), retry_after_s=e.retry_after_s
+            ) from None
+        try:
+            await INJECTOR.fire_async("auth.ice")
+            joined, _reason = await self._client.create_session(key, key)
+        except ServiceUnavailableError:
+            raise
+        except Exception:
+            self.breaker.record_failure()
+            raise
+        self.breaker.record_success()
+        return joined
 
     async def _join(self, key: str) -> bool:
         try:
-            joined, _reason = await self._client.create_session(key, key)
+            joined = await self._create_session(key)
             if joined:
                 if len(self._valid_until) >= self._cache_max:
                     self._valid_until.clear()  # coarse but bounded
@@ -261,10 +288,7 @@ class IceSessionValidator(SessionValidator):
             return False
         if self._cache_ttl_s <= 0:
             # strict per-request join parity: no cache, no merging
-            joined, _reason = await self._client.create_session(
-                omero_session_key, omero_session_key
-            )
-            return joined
+            return await self._create_session(omero_session_key)
         expiry = self._valid_until.get(omero_session_key)
         if expiry is not None and expiry > time.monotonic():
             return True

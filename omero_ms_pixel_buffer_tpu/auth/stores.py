@@ -23,7 +23,15 @@ import asyncio
 from typing import Dict, Optional
 from urllib.parse import urlparse
 
+from ..errors import ServiceUnavailableError
+from ..resilience.breaker import BreakerOpenError, for_dependency
+from ..resilience.faultinject import INJECTOR
 from .django import decode_session_payload, extract_omero_session_key
+
+# Store-down (breaker open / backend unreachable) raises
+# errors.ServiceUnavailableError — the same 503 + Retry-After contract
+# the Ice edge uses. Distinct from an unknown session (-> 403): auth
+# *unavailable* must not read as auth *denied*.
 
 
 class OmeroWebSessionStore:
@@ -70,6 +78,9 @@ class RedisSessionStore(OmeroWebSessionStore):
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._lock = asyncio.Lock()
+        self.breaker = for_dependency(
+            f"session-store:redis:{self.host}:{self.port}"
+        )
 
     async def _connect(self) -> None:
         self._reader, self._writer = await asyncio.open_connection(
@@ -116,6 +127,30 @@ class RedisSessionStore(OmeroWebSessionStore):
         await self._connect()
 
     async def get_omero_session_key(self, session_id: str) -> Optional[str]:
+        try:
+            self.breaker.allow()
+        except BreakerOpenError as e:
+            raise ServiceUnavailableError(
+                f"Session store unavailable: {e}",
+                retry_after_s=e.retry_after_s,
+            ) from None
+        try:
+            await INJECTOR.fire_async("session_store")
+            result = await self._lookup(session_id)
+        except (ConnectionError, EOFError, OSError,
+                asyncio.IncompleteReadError):
+            # transport outage: breaker input
+            self.breaker.record_failure()
+            raise
+        except RuntimeError:
+            # a redis error reply (_read_reply) is an answer — the
+            # store is up; success also releases a half-open probe
+            self.breaker.record_success()
+            raise
+        self.breaker.record_success()
+        return result
+
+    async def _lookup(self, session_id: str) -> Optional[str]:
         async with self._lock:
             if self._writer is None:
                 await self._connect()
@@ -176,9 +211,21 @@ class PostgresSessionStore(OmeroWebSessionStore):
         from ..db.postgres import PostgresClient
 
         self._client = PostgresClient.from_uri(uri)
+        # breaker accounting lives on the PostgresClient; exposed here
+        # so /healthz and tests see the session store's dependency
+        self.breaker = self._client.breaker
 
     async def get_omero_session_key(self, session_id: str) -> Optional[str]:
-        rows = await self._client.query(self.QUERY, [session_id])
+        from ..db.postgres import PostgresUnavailableError
+
+        await INJECTOR.fire_async("session_store")
+        try:
+            rows = await self._client.query(self.QUERY, [session_id])
+        except PostgresUnavailableError as e:
+            raise ServiceUnavailableError(
+                f"Session store unavailable: {e}",
+                retry_after_s=e.retry_after_s,
+            ) from None
         if not rows or rows[0][0] is None:
             return None
         session = decode_session_payload(rows[0][0].encode())

@@ -20,11 +20,13 @@ deployment).
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import threading
 import time
 from typing import Optional
 
 from ..io.pixel_buffer import PixelsMeta
+from ..resilience.deadline import DeadlineExceeded, current_deadline
 from .postgres import PostgresClient
 
 
@@ -112,7 +114,17 @@ def can_read(
     for an unknown/closed session (reads nothing). Mirrors the server's
     security filter: admins read everything; group leaders read their
     whole group; owners read their data (USER_READ); members read
-    group-readable data (GROUP_READ); WORLD_READ is public."""
+    group-readable data (GROUP_READ); WORLD_READ is public.
+
+    Known over-grant (ADVICE r5): ``is_admin`` is derived from
+    'system'-group membership alone. OMERO 5.4+ *restricted* ("light")
+    admins are system-group members whose AdminPrivilege set may NOT
+    include data-read rights ("ReadSession"/sudo-style privileges
+    only); the server's security filter would deny them, this
+    short-circuit grants them. Closing it means joining
+    ``adminprivilege`` and short-circuiting only for unrestricted
+    admins; until then, deployments with restricted admins should
+    treat this resolver's admin reads as broader than the server's."""
     if user_ctx is None:
         return False
     user_id, groups, is_admin = user_ctx
@@ -254,7 +266,7 @@ class OmeroPostgresMetadataResolver:
                 return None
         return meta
 
-    def _run(self, coro):
+    def _run(self, coro, default_timeout_s: float = 30.0):
         with self._runner_lock:
             if self._closed:
                 coro.close()
@@ -262,7 +274,22 @@ class OmeroPostgresMetadataResolver:
             if self._runner is None:
                 self._runner = _LoopThread()
             runner = self._runner
-        return runner.run(coro)
+        # the sync adapter's wait is bounded by the ambient request
+        # deadline (resilience/deadline): a wedged Postgres costs the
+        # caller at most its budget — the worker thread unblocks and
+        # the request answers 504; the coroutine finishes (or fails)
+        # in the background on the resolver's own loop
+        deadline = current_deadline()
+        timeout = (
+            default_timeout_s if deadline is None
+            else max(0.01, deadline.cap(default_timeout_s))
+        )
+        try:
+            return runner.run(coro, timeout=timeout)
+        except concurrent.futures.TimeoutError:
+            if deadline is not None and deadline.expired:
+                raise DeadlineExceeded("postgres query") from None
+            raise
 
     def get_pixels(
         self, image_id: int, session_key: Optional[str] = None

@@ -25,6 +25,9 @@ import struct
 from typing import Dict, List, Optional, Sequence, Tuple
 from urllib.parse import parse_qs, unquote, urlparse
 
+from ..resilience.breaker import BreakerOpenError, for_dependency
+from ..resilience.faultinject import INJECTOR
+
 
 class PostgresError(RuntimeError):
     """Server ErrorResponse, carrying the error-field map."""
@@ -35,6 +38,17 @@ class PostgresError(RuntimeError):
             f"{fields.get('S', 'ERROR')} {fields.get('C', '')}: "
             f"{fields.get('M', 'unknown error')}"
         )
+
+
+class PostgresUnavailableError(PostgresError):
+    """The connection's circuit breaker is open: Postgres is known
+    sick and the query was rejected without touching the wire.
+    SQLSTATE 57P03 (cannot_connect_now) so consumers that key on the
+    error-field map see a sensible code."""
+
+    def __init__(self, message: str, retry_after_s: float = 0.0):
+        super().__init__({"S": "FATAL", "C": "57P03", "M": message})
+        self.retry_after_s = retry_after_s
 
 
 def _xor(a: bytes, b: bytes) -> bytes:
@@ -152,6 +166,12 @@ class PostgresClient:
         self._writer: Optional[asyncio.StreamWriter] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._lock = asyncio.Lock()
+        # per-connection breaker: a wedged/refusing Postgres fails
+        # queries fast instead of stacking connect timeouts, and the
+        # flap is visible on /healthz (resilience/breaker.py)
+        self.breaker = for_dependency(
+            f"postgres:{host}:{port}/{database}"
+        )
 
     @classmethod
     def from_uri(cls, uri: str) -> "PostgresClient":
@@ -287,16 +307,39 @@ class PostgresClient:
             await self.close_nowait()
             self._lock = asyncio.Lock()
         self._loop = running
+        try:
+            self.breaker.allow()
+        except BreakerOpenError as e:
+            raise PostgresUnavailableError(
+                str(e), e.retry_after_s
+            ) from None
         async with self._lock:
-            if self._writer is None:
-                await self.connect()
             try:
-                return await self._query_locked(sql, params)
+                await INJECTOR.fire_async("db.postgres")
+                if self._writer is None:
+                    await self.connect()
+                try:
+                    rows = await self._query_locked(sql, params)
+                except (ConnectionError, EOFError, OSError,
+                        asyncio.IncompleteReadError):
+                    await self.close_nowait()
+                    await self.connect()
+                    rows = await self._query_locked(sql, params)
             except (ConnectionError, EOFError, OSError,
                     asyncio.IncompleteReadError):
+                # transport-level outage: breaker input
                 await self.close_nowait()
-                await self.connect()
-                return await self._query_locked(sql, params)
+                self.breaker.record_failure()
+                raise
+            except PostgresError:
+                # a server ErrorResponse is an ANSWER — the database
+                # is up; recording success also releases a half-open
+                # probe slot so an erroring-but-alive server can't
+                # wedge the breaker
+                self.breaker.record_success()
+                raise
+            self.breaker.record_success()
+            return rows
 
     async def _query_locked(self, sql, params):
         # Parse (unnamed statement), Bind, Execute, Sync

@@ -76,6 +76,14 @@ REPO_ROOT_QUERY = (
 
 _ZARR_MARKERS = (".zgroup", ".zattrs", "zarr.json")
 
+# Extensions that are TIFF containers the in-tree reader opens
+# directly (classic, OME, BigTIFF, Aperio SVS — plain tiled TIFF with
+# JPEG/deflate pages). Other FS-import formats (.czi/.ndpi/...) serve
+# via their generated pyramid instead.
+_TIFF_SUFFIXES = (
+    ".tif", ".tiff", ".svs", ".btf", ".tf2", ".tf8",
+)
+
 
 def pixels_fanout_path(data_dir: str, pixels_id: int) -> str:
     """``${data.dir}/Pixels[/Dir-xxx]*/<id>`` — the thousands fan-out
@@ -200,13 +208,24 @@ class OmeroImageSource:
                     return self._entry(image_id, parent, "zarr")
                 if not parent or parent == os.sep:
                     break
-        # 2. TIFF original file (the Bio-Formats branch) — prefer the
-        # canonical OME-TIFF suffix, then any regular file
+        # 2. TIFF original file (the Bio-Formats branch) — only
+        # TIFF-container suffixes the in-tree reader can open
+        # (canonical OME-TIFF first, then plain/BigTIFF/Aperio). A
+        # fileset whose files exist but are NOT TIFF containers
+        # (.czi/.ndpi/...) falls through to the generated-pyramid
+        # lookup below: OMERO writes a <pixelsId>_pyramid tiled TIFF
+        # for originals its renderer can't stream, and that — not the
+        # unreadable original — is what serves (ADVICE r5; previously
+        # ANY existing fileset file was handed to the TIFF reader and
+        # the open errored).
         tiffs = sorted(
-            (p for p in existing if os.path.isfile(p)),
-            key=lambda p: (
-                not p.lower().endswith((".ome.tif", ".ome.tiff")),
-                not p.lower().endswith((".tif", ".tiff")),
+            (
+                p for p in existing
+                if os.path.isfile(p)
+                and p.lower().endswith(_TIFF_SUFFIXES)
+            ),
+            key=lambda p: not p.lower().endswith(
+                (".ome.tif", ".ome.tiff", ".ome.btf")
             ),
         )
         if tiffs:
@@ -223,7 +242,14 @@ class OmeroImageSource:
             return self._entry(image_id, pyramid, "ometiff")
         if os.path.isfile(romio):
             return self._entry(image_id, romio, "romio")
-        if candidates:
+        if existing:
+            log.warning(
+                "image %d: %d fileset file(s) on disk but none "
+                "readable (non-TIFF originals, no generated pyramid "
+                "at %s) — import may still be processing",
+                image_id, len(existing), pyramid,
+            )
+        elif candidates:
             log.warning(
                 "image %d: %d fileset file(s) in the DB but none on "
                 "disk under %s (first: %s)",

@@ -34,12 +34,14 @@ from typing import Any, List, Optional, Set, Tuple
 
 from ..auth.omero_session import SessionValidator
 from ..errors import (
+    GatewayTimeoutError,
     InternalError,
     NotFoundError,
     PermissionDeniedError,
     TileError,
 )
 from ..models.tile_pipeline import TilePipeline
+from ..resilience.deadline import DEADLINE_EXCEEDED, deadline_scope
 from ..tile_ctx import TileCtx
 from ..utils.metrics import REGISTRY
 from ..utils.tracing import TRACER
@@ -135,12 +137,22 @@ class BatchingTileWorker:
         else:
             span = TRACER.start_span("handle_get_tile")
         try:
+            if ctx.deadline is not None:
+                span.tag(
+                    "deadline.remaining_ms",
+                    round(ctx.deadline.remaining() * 1000, 1),
+                )
             # OmeroRequest session-join analog
             # (PixelBufferVerticle.java:106-110)
             ok = await self.session_validator.validate(ctx.omero_session_key)
             if not ok:
                 raise PermissionDeniedError()
 
+            if ctx.deadline is not None and ctx.deadline.expired:
+                # spent before we even queued (e.g. a slow session
+                # join): answer 504 now, never occupy a worker
+                DEADLINE_EXCEEDED.inc(stage="admission")
+                raise GatewayTimeoutError()
             if self._closed:
                 # after close() drains the queue there is no runner;
                 # enqueueing would hang the caller until the bus timeout
@@ -153,6 +165,12 @@ class BatchingTileWorker:
             tile = await fut
 
             if tile is None:
+                if ctx.deadline is not None and ctx.deadline.expired:
+                    # the pipeline aborted on the budget (store retries
+                    # cut off, reads abandoned): 504, not 404 — the
+                    # image may exist; the time did not
+                    DEADLINE_EXCEEDED.inc(stage="pipeline")
+                    raise GatewayTimeoutError()
                 raise NotFoundError(f"Cannot find Image:{ctx.image_id}")
             TILES_SERVED.inc(format=ctx.format or "raw")
             return tile, {"filename": ctx.filename()}
@@ -214,8 +232,18 @@ class BatchingTileWorker:
                 batch.append(self._queue.get_nowait())
 
         # drop lanes whose client already gave up (bus timeout
-        # cancelled the future) — no dead work under overload
-        live = [(c, f) for c, f in batch if not f.done()]
+        # cancelled the future) or whose budget is spent — no dead
+        # work under overload, and an expired lane answers 504 at
+        # dispatch instead of occupying an executor slot
+        live = []
+        for c, f in batch:
+            if f.done():
+                continue
+            if c.deadline is not None and c.deadline.expired:
+                DEADLINE_EXCEEDED.inc(stage="dispatch")
+                f.set_exception(GatewayTimeoutError())
+                continue
+            live.append((c, f))
         if not live:
             return
         # pipelining: dispatch this batch and immediately go back to
@@ -246,7 +274,25 @@ class BatchingTileWorker:
             "tile_batch", ctxs[0].trace_context
         )
         bspan.__enter__()
-        run_ctx = contextvars.copy_context()
+        # ambient deadline for the executor work: the LATEST lane
+        # budget (per-lane expiry is enforced at the future/dispatch
+        # level; the ambient clock exists so store retries and DB
+        # lookups deep in the pipeline stop sleeping once no lane can
+        # still use the result). A lane without a deadline keeps the
+        # batch unbounded. copy_context() carries it to the thread.
+        deadlines = [c.deadline for c in ctxs]
+        batch_deadline = (
+            max(deadlines, key=lambda d: d.expires_at)
+            if deadlines and all(d is not None for d in deadlines)
+            else None
+        )
+        if batch_deadline is not None:
+            bspan.tag(
+                "deadline.remaining_ms",
+                round(batch_deadline.remaining() * 1000, 1),
+            )
+        with deadline_scope(batch_deadline):
+            run_ctx = contextvars.copy_context()
         try:
             # pipeline work is blocking (I/O + device); keep the
             # event loop free (the reference's worker-pool move,
@@ -265,4 +311,10 @@ class BatchingTileWorker:
             bspan.__exit__(None, None, None)
         for (_, f), result in zip(batch, results):
             if not f.done():
-                f.set_result(result)
+                if isinstance(result, TileError):
+                    # typed per-lane failure (e.g. 503 dependency
+                    # breaker open) — surfaces with its own HTTP code
+                    # instead of degrading to 404
+                    f.set_exception(result)
+                else:
+                    f.set_result(result)

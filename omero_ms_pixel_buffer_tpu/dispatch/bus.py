@@ -20,7 +20,9 @@ from __future__ import annotations
 import asyncio
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
-from ..errors import TileError
+from ..errors import GatewayTimeoutError, TileError
+from ..resilience.deadline import DEADLINE_EXCEEDED
+from ..resilience.faultinject import INJECTOR
 
 # address constant (PixelBufferVerticle.java:52-53)
 GET_TILE_EVENT = "omero.pixel_buffer.get_tile"
@@ -55,11 +57,27 @@ class EventBus:
         if handler is None:
             # Vert.x NO_HANDLERS failure type
             raise TileError(-1, f"No handlers for address {address}")
+        await INJECTOR.fire_async("bus.request")
+        # The payload's request deadline (resilience/deadline) caps the
+        # wait below the configured send timeout, so a budget minted at
+        # the HTTP front is enforced here even if downstream stages
+        # never look at the clock. Expiry surfaces as 504, not the
+        # generic -1/500 reply timeout.
+        deadline = getattr(payload, "deadline", None)
+        timeout_s = timeout_ms / 1000.0
+        if deadline is not None:
+            timeout_s = deadline.cap(timeout_s)
         try:
             result = await asyncio.wait_for(
-                handler(payload), timeout=timeout_ms / 1000.0
+                handler(payload), timeout=timeout_s
             )
         except asyncio.TimeoutError:
+            if deadline is not None and deadline.expired:
+                DEADLINE_EXCEEDED.inc(stage="bus")
+                raise GatewayTimeoutError(
+                    f"Request deadline exceeded after "
+                    f"{timeout_s * 1000:.0f} ms"
+                ) from None
             raise TileError(
                 -1, f"Timed out after {timeout_ms:.0f} ms waiting for a reply"
             ) from None

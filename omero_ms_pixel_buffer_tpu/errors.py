@@ -58,6 +58,32 @@ class InternalError(TileError):
         super().__init__(500, message)
 
 
+class ServiceUnavailableError(TileError):
+    """503 — the service (or a dependency behind an open circuit
+    breaker) cannot take the request right now; clients should back
+    off and retry. ``retry_after_s`` rides to the HTTP front so shed
+    responses carry a ``Retry-After`` header (no reference analog —
+    the reference has no admission control or breakers)."""
+
+    def __init__(
+        self,
+        message: str = "Service unavailable",
+        retry_after_s: float = 1.0,
+    ):
+        super().__init__(503, message)
+        self.retry_after_s = retry_after_s
+
+
+class GatewayTimeoutError(TileError):
+    """504 — the request's end-to-end deadline expired before a tile
+    could be produced. Distinct from the bus's generic -1/500 timeout:
+    a 504 means the budget minted at the HTTP front ran out, wherever
+    in the pipeline that happened."""
+
+    def __init__(self, message: str = "Request deadline exceeded"):
+        super().__init__(504, message)
+
+
 def http_status_for_failure(exc: BaseException) -> int:
     """Map a dispatch failure to an HTTP status, mirroring
     PixelBufferMicroserviceVerticle.java:356-370: TileError carries its

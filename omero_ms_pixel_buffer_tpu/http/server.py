@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Optional
 
 from aiohttp import web
@@ -35,9 +36,16 @@ from ..auth.omero_session import (
 from ..auth.stores import OmeroWebSessionStore, make_session_store
 from ..dispatch.batcher import BatchingTileWorker
 from ..dispatch.bus import GET_TILE_EVENT, EventBus
-from ..errors import TileError, http_status_for_failure
+from ..errors import (
+    ServiceUnavailableError,
+    TileError,
+    http_status_for_failure,
+)
 from ..io.pixels_service import ImageRegistry, PixelsService
 from ..models.tile_pipeline import TilePipeline
+from ..resilience import AdmissionController, Deadline
+from ..resilience import configure as configure_resilience
+from ..resilience.breaker import BOARD
 from ..tile_ctx import TileCtx
 from ..utils.config import Config
 from ..utils.metrics import REGISTRY
@@ -96,25 +104,79 @@ def session_middleware(store: OmeroWebSessionStore, synchronicity: str = "async"
     ``session-store.synchronicity`` key (config.yaml:25-26): ``sync``
     serializes store lookups through one connection-at-a-time (the
     blocking-client semantics of the reference's sync store variants),
-    ``async`` lets lookups run concurrently."""
+    ``async`` lets lookups run concurrently.
+
+    Failure split (resilience layer): an unknown session is 403; a
+    session store that cannot ANSWER — open breaker, connection
+    refused — is 503 + Retry-After. Auth unavailable must never read
+    as auth denied, or a Redis blip logs every user out."""
     lookup_lock = asyncio.Lock() if synchronicity == "sync" else None
 
     @web.middleware
     async def middleware(request: web.Request, handler):
-        if request.path == "/metrics" or request.method == "OPTIONS":
+        if request.path in ("/metrics", "/healthz") or (
+            request.method == "OPTIONS"
+        ):
             return await handler(request)
         session_id = request.cookies.get("sessionid")
         if not session_id:
             return web.Response(status=403, text="Permission denied")
-        if lookup_lock is not None:
-            async with lookup_lock:
+        try:
+            if lookup_lock is not None:
+                async with lookup_lock:
+                    key = await store.get_omero_session_key(session_id)
+            else:
                 key = await store.get_omero_session_key(session_id)
-        else:
-            key = await store.get_omero_session_key(session_id)
+        except ServiceUnavailableError as e:
+            return web.Response(
+                status=503, text="Session store unavailable",
+                headers={"Retry-After": _retry_after(e.retry_after_s)},
+            )
+        except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+            log.warning("session store lookup failed: %s", e)
+            return web.Response(
+                status=503, text="Session store unavailable",
+                headers={"Retry-After": "1"},
+            )
         if not key:
             return web.Response(status=403, text="Permission denied")
         request["omero.session_key"] = key
         return await handler(request)
+
+    return middleware
+
+
+def _retry_after(seconds: float) -> str:
+    """Retry-After is an integer number of seconds; round up so the
+    client never probes before the window opens."""
+    return str(max(1, int(seconds + 0.999)))
+
+
+def admission_middleware(admission: AdmissionController):
+    """Load shedding at the door (resilience/admission): beyond the
+    in-flight bound, tile requests answer 503 + Retry-After
+    immediately instead of queueing toward a bus timeout. Only the
+    tile lanes are gated — discovery, metrics, and health must stay
+    reachable precisely when the service is saturated."""
+
+    @web.middleware
+    async def middleware(request: web.Request, handler):
+        if (
+            not request.path.startswith("/tile/")
+            or request.method == "OPTIONS"  # discovery/CORS preflight
+        ):
+            return await handler(request)
+        if not admission.try_acquire():
+            return web.Response(
+                status=503, text="Service overloaded",
+                headers={
+                    "Retry-After": _retry_after(admission.retry_after_s)
+                },
+            )
+        try:
+            return await handler(request)
+        finally:
+            admission.release()
 
     return middleware
 
@@ -132,6 +194,22 @@ class PixelBufferApp:
         session_validator: Optional[SessionValidator] = None,
     ):
         self.config = config
+        # resilience policy FIRST: breakers minted by the stores /
+        # clients below pick up the configured thresholds
+        configure_resilience(config.resilience)
+        self.admission = AdmissionController(
+            max_inflight=config.resilience.admission.max_inflight,
+            retry_after_s=config.resilience.admission.retry_after_s,
+        )
+        # per-request budget minted in handle_get_tile; defaults to
+        # the bus send timeout so the deadline and the reply timeout
+        # are the same clock
+        self.request_budget_s = (
+            config.resilience.request_budget_ms
+            if config.resilience.request_budget_ms is not None
+            else config.event_bus_send_timeout_ms
+        ) / 1000.0
+        self._started_at = time.time()
         # Reporter selection mirrors the reference
         # (PixelBufferMicroserviceVerticle.java:169-200): zipkin-url ->
         # batched HTTP sender; enabled without URL -> log reporter;
@@ -257,6 +335,7 @@ class PixelBufferApp:
     def make_app(self) -> web.Application:
         app = web.Application(
             middlewares=[
+                admission_middleware(self.admission),
                 tracing_middleware,
                 session_middleware(
                     self.session_store,
@@ -265,6 +344,7 @@ class PixelBufferApp:
             ]
         )
         app.router.add_get("/metrics", handle_metrics)
+        app.router.add_get("/healthz", self.handle_healthz)
         app.router.add_route("OPTIONS", "/{tail:.*}", handle_options)
         app.router.add_get(
             "/tile/{imageId}/{z}/{c}/{t}", self.handle_get_tile
@@ -289,6 +369,30 @@ class PixelBufferApp:
             TRACER.reporter.close()
             TRACER.reporter = None
 
+    async def handle_healthz(self, request: web.Request) -> web.Response:
+        """Operational health, unauthenticated (like /metrics): live
+        breaker states, admission/queue pressure, and uptime. Status
+        is "degraded" (still 200 — the service IS serving; shedding
+        and breakers are it working as designed) whenever any breaker
+        is open or requests are being shed."""
+        breakers = BOARD.snapshot()
+        admission = self.admission.snapshot()
+        queue_depth = self.worker._queue.qsize()
+        degraded = (
+            any(b["state"] == "open" for b in breakers.values())
+            or admission["inflight"] >= admission["max_inflight"]
+        )
+        return web.json_response(
+            {
+                "status": "degraded" if degraded else "ok",
+                "uptime_s": round(time.time() - self._started_at, 1),
+                "breakers": breakers,
+                "admission": admission,
+                "queue_depth": queue_depth,
+                "request_budget_ms": self.request_budget_s * 1000.0,
+            }
+        )
+
     async def handle_get_tile(self, request: web.Request) -> web.Response:
         log.info("Get tile")
         params = dict(request.match_info)
@@ -300,6 +404,10 @@ class PixelBufferApp:
         except TileError as e:
             return web.Response(status=400, text=e.message)
         ctx.trace_context = TRACER.inject(request.get("span"))
+        # the end-to-end budget: minted once here, decremented by
+        # every layer below (bus wait, batching, store retries) —
+        # resilience/deadline.py
+        ctx.deadline = Deadline.after(self.request_budget_s)
 
         try:
             reply = await self.bus.request(
@@ -311,7 +419,17 @@ class PixelBufferApp:
             status = http_status_for_failure(e)
             if status < 1:
                 status = 500
-            return web.Response(status=status)
+            headers = {}
+            if status == 503:
+                retry_s = getattr(e, "retry_after_s", None)
+                headers["Retry-After"] = _retry_after(
+                    retry_s if retry_s else
+                    self.config.resilience.admission.retry_after_s
+                )
+            span = request.get("span")
+            if span is not None:
+                span.tag("http.status", status)
+            return web.Response(status=status, headers=headers)
 
         tile: bytes = reply.body
         headers = {

@@ -207,10 +207,28 @@ class PixelsService:
         buf = self.get_pixel_buffer(image_id)
         return None if buf is None else buf.meta
 
-    def get_pixel_buffer(self, image_id: int) -> Optional[PixelBuffer]:
+    def get_pixel_buffer(
+        self, image_id: int, session_key: Optional[str] = None
+    ) -> Optional[PixelBuffer]:
         """Resolve an image id to an open, cached pixel buffer; None when
-        the image is unknown (-> 404)."""
+        the image is unknown (-> 404).
+
+        ACL seam (ADVICE r5): with ``session_key=None`` this performs
+        NO permission check — the invariant is that every
+        request-derived path calls ``get_pixels(..., session_key=...)``
+        first (TilePipeline.resolve does). Any NEW endpoint or caller
+        reaching for a buffer directly must pass the caller's
+        ``session_key``: it routes through the permission-scoped
+        metadata resolver before the buffer opens, so an unauthorized
+        image reads exactly like a nonexistent one. With an unscoped
+        resolver (plain filesystem registry) there is no ACL model and
+        the key is a no-op."""
         image_id = int(image_id)
+        if session_key is not None and self._resolver_scoped:
+            if self.metadata_resolver.get_pixels(
+                image_id, session_key=session_key
+            ) is None:
+                return None
         with self._lock:
             buf = self._cache.get(image_id)
             if buf is not None:

@@ -19,8 +19,15 @@ not exist (Zarr fill_value semantics — an absent chunk is legitimate).
   fakes) using path-style addressing. Anonymous (unsigned) access
   when no credentials are configured.
 
-Transient failures (5xx, dropped connections) retry with a short
-backoff before surfacing as ``StoreError``; 4xx never retries.
+Transient failures (5xx, dropped connections) retry under the
+resilience layer's jittered-exponential policy with a retry budget,
+bounded by the ambient request deadline (no retry outlives the
+caller's bus budget); 4xx never retries. Each remote store carries a
+per-dependency circuit breaker: repeated failures open it and
+subsequent GETs fail fast with ``StoreUnavailableError`` until a
+half-open probe heals (resilience/breaker.py). Chaos tests inject
+faults at the ``store.http`` / ``store.s3`` points
+(resilience/faultinject.py).
 
 ``make_store(uri)`` picks by scheme.
 """
@@ -40,10 +47,17 @@ import urllib.parse
 import urllib.request
 from typing import Optional, Tuple
 
+from ..resilience.breaker import (
+    NULL_BREAKER,
+    BreakerOpenError,
+    for_dependency,
+)
+from ..resilience.faultinject import INJECTOR
+from ..resilience.retry import retry_call
+
 _EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
 
 _RETRY_STATUSES = (500, 502, 503, 504)
-_RETRY_DELAYS_S = (0.1, 0.4)  # two retries, short backoff
 
 
 def load_shared_credentials(
@@ -154,6 +168,29 @@ class StoreError(IOError):
     5xx) — callers must not treat it as fill_value."""
 
 
+class StoreUnavailableError(StoreError):
+    """The store's circuit breaker is open: the dependency is known
+    sick and the GET was rejected without touching the network.
+    Subclasses StoreError so existing handling (lane -> 404, never
+    fill_value) applies; ``retry_after_s`` says when the next
+    half-open probe will be admitted."""
+
+    def __init__(self, message: str, retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class _TransientStatus(Exception):
+    """Internal retry-loop carrier for retryable HTTP statuses (5xx):
+    statuses are answers, not exceptions, but the shared retry helper
+    speaks exceptions."""
+
+    def __init__(self, status: int, body: bytes):
+        super().__init__(f"HTTP {status}")
+        self.status = status
+        self.body = body
+
+
 def validate_key(key: str) -> str:
     """Reject keys that could escape the store root. NGFF multiscale
     metadata supplies dataset paths verbatim (io/zarr.py), so a hostile
@@ -195,11 +232,15 @@ class HTTPStore:
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
         self._conns = _KeepAlive()
+        netloc = urllib.parse.urlsplit(self.base_url).netloc
+        self.breaker = for_dependency(f"store:http:{netloc}")
 
     def get(self, key: str) -> Optional[bytes]:
         url = f"{self.base_url}/{urllib.parse.quote(validate_key(key))}"
         status, body = _get_with_retry(
-            lambda: self._conns.get(url, {}, self.timeout_s)
+            lambda: self._conns.get(url, {}, self.timeout_s),
+            breaker=self.breaker, point="store.http",
+            name=self.base_url,
         )
         if status == 200:
             return body
@@ -211,26 +252,50 @@ class HTTPStore:
         return self.base_url
 
 
-def _get_with_retry(fn) -> Tuple[int, bytes]:
-    """Run a GET closure, retrying transient failures (5xx statuses
-    and transport errors) with a short backoff. 4xx returns
+def _get_with_retry(
+    fn, breaker=NULL_BREAKER, point: Optional[str] = None, name: str = "",
+) -> Tuple[int, bytes]:
+    """Run a GET closure under the resilience policy: the store's
+    circuit breaker gates the call (open -> fail fast, no network),
+    transient failures (5xx statuses and transport errors) retry with
+    jittered-exponential backoff under a retry budget AND the ambient
+    request deadline, and the outcome feeds the breaker. 4xx returns
     immediately — it is an answer, not an outage."""
-    last: Optional[Exception] = None
-    for attempt in range(len(_RETRY_DELAYS_S) + 1):
-        if attempt:
-            time.sleep(_RETRY_DELAYS_S[attempt - 1])
-        try:
-            status, body = fn()
-        except StoreError as e:
-            last = e
-            continue
-        if status in _RETRY_STATUSES and attempt < len(_RETRY_DELAYS_S):
-            continue
+    try:
+        breaker.allow()
+    except BreakerOpenError as e:
+        raise StoreUnavailableError(str(e), e.retry_after_s) from None
+
+    def attempt() -> Tuple[int, bytes]:
+        if point is not None:
+            INJECTOR.fire(point)
+        status, body = fn()
+        if status in _RETRY_STATUSES:
+            raise _TransientStatus(status, body)
         return status, body
-    raise last if last is not None else StoreError("GET failed")
+
+    try:
+        status, body = retry_call(
+            attempt,
+            retryable=(StoreError, _TransientStatus),
+            name=name,
+        )
+    except _TransientStatus as e:
+        # retries exhausted on a 5xx: surface the status to the caller
+        # (it raises StoreError with context) but count the outage
+        breaker.record_failure()
+        return e.status, e.body
+    except (StoreError, OSError):
+        breaker.record_failure()
+        raise
+    breaker.record_success()
+    return status, body
 
 
-def _resolve_credentials(read_files_for_region: bool = False) -> Tuple[
+def _resolve_credentials(
+    read_files_for_region: bool = False,
+    prefer_files: bool = False,
+) -> Tuple[
     Optional[str], Optional[str], Optional[str], Optional[str]
 ]:
     """(access, secret, token, file_region): env credentials, else the
@@ -238,18 +303,29 @@ def _resolve_credentials(read_files_for_region: bool = False) -> Tuple[
     read when keys are missing from env OR ``read_files_for_region``
     (keys in env with region only in ~/.aws/config is common — one
     read covers both needs). One cascade shared by S3Store's
-    constructor and its 403 refresh path so precedence can't drift."""
+    constructor and its 403 refresh path so precedence can't drift.
+
+    ``prefer_files`` inverts the precedence for the 403 refresh path
+    (ADVICE r5): a process launched with (now-expired) STS keys in env
+    can only ever pick up rotation from the shared files, so on
+    refresh a complete file credential set — including its token, or
+    lack of one; mixing rotated keys with a stale env token breaks
+    signing — supersedes env. Env stays the fallback when the files
+    carry nothing."""
     access = os.environ.get("AWS_ACCESS_KEY_ID")
     secret = os.environ.get("AWS_SECRET_ACCESS_KEY")
     token = os.environ.get("AWS_SESSION_TOKEN")
     file_region = None
-    if not (access and secret) or read_files_for_region:
+    if not (access and secret) or read_files_for_region or prefer_files:
         f_access, f_secret, f_token, file_region = (
             load_shared_credentials()
         )
-        if not (access and secret) and (f_access and f_secret):
-            access, secret = f_access, f_secret
-            token = token or f_token
+        if f_access and f_secret:
+            if prefer_files:
+                access, secret, token = f_access, f_secret, f_token
+            elif not (access and secret):
+                access, secret = f_access, f_secret
+                token = token or f_token
     return access, secret, token, file_region
 
 
@@ -379,6 +455,7 @@ class S3Store:
             os.environ.get("OMPB_S3_403_AS_MISSING", "0") == "1"
         )
         self._conns = _KeepAlive()
+        self.breaker = for_dependency(f"store:s3:{self.bucket}")
 
     def _url_and_path(self, key: str) -> Tuple[str, str]:
         rel = f"{self.prefix}/{key}" if self.prefix else key
@@ -401,27 +478,38 @@ class S3Store:
     def session_token(self) -> Optional[str]:
         return self._creds[2]
 
-    def _refresh_credentials(self) -> bool:
-        """Re-resolve credentials from env + the shared files; True if
-        they changed. Long-lived buffers over STS credentials go stale
-        when the operator rotates ~/.aws/credentials — a 403 is the
-        first symptom, so the read path retries once with fresh keys
-        instead of failing until restart."""
-        current = self._creds
+    def _refresh_candidate(self) -> Optional[Tuple]:
+        """A CANDIDATE credential set re-resolved from env + the
+        shared files, or None when throttled/unchanged/incomplete.
+        Long-lived buffers over STS credentials go stale when the
+        operator rotates ~/.aws/credentials — a 403 is the first
+        symptom, so the read path retries once with fresh keys
+        instead of failing until restart. Shared-file credentials
+        supersede env in the cascade (``prefer_files``): env can't
+        rotate after launch, the files can.
+
+        The candidate is NOT committed here: on a no-ListBucket
+        bucket a 403 is the *normal* answer for an absent key, and an
+        unrelated ~/.aws profile must never silently replace working
+        env credentials — ``get()`` retries with the candidate and
+        commits only when the answer stops being 403."""
         now = time.monotonic()
         if now - self._last_refresh_mono < _CRED_REFRESH_MIN_S:
-            return False
+            return None
         self._last_refresh_mono = now
-        access, secret, token, _ = _resolve_credentials()
+        access, secret, token, _ = _resolve_credentials(
+            prefer_files=True
+        )
         fresh = (access, secret, token)
-        if fresh == current or not (access and secret):
-            return False
-        self._creds = fresh
-        return True
+        if fresh == self._creds or not (access and secret):
+            return None
+        return fresh
 
-    def _signed_get(self, key: str) -> Tuple[int, bytes]:
+    def _signed_get(
+        self, key: str, creds: Optional[Tuple] = None
+    ) -> Tuple[int, bytes]:
         url, canonical_path = self._url_and_path(key)
-        access, secret, token = self._creds
+        access, secret, token = creds if creds is not None else self._creds
         headers: dict = {}
         if access and secret:
             host = urllib.parse.urlparse(url).netloc
@@ -430,18 +518,28 @@ class S3Store:
                 access, secret, token,
             )
         return _get_with_retry(
-            lambda: self._conns.get(url, headers, self.timeout_s)
+            lambda: self._conns.get(url, headers, self.timeout_s),
+            breaker=self.breaker, point="store.s3",
+            name=f"s3://{self.bucket}",
         )
 
     def get(self, key: str) -> Optional[bytes]:
         validate_key(key)
         status, body = self._signed_get(key)
-        if status == 403 and self._refresh_credentials():
+        if status == 403:
             # Expired/rotated credentials answer 403; one re-resolve
             # from env + shared files, re-sign, retry — BEFORE the
             # 403-as-missing mapping, so stale creds on a
             # no-ListBucket bucket don't silently read as fill_value.
-            status, body = self._signed_get(key)
+            # The candidate commits ONLY if it stops the 403: a 403
+            # that is the normal no-ListBucket answer must not let an
+            # unrelated ~/.aws profile displace working credentials.
+            fresh = self._refresh_candidate()
+            if fresh is not None:
+                status2, body2 = self._signed_get(key, creds=fresh)
+                if status2 != 403:
+                    self._creds = fresh  # rotation confirmed
+                    status, body = status2, body2
         if status == 200:
             return body
         if status == 404:

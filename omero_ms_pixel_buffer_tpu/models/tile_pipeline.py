@@ -31,8 +31,12 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from ..db.postgres import PostgresUnavailableError
+from ..errors import ServiceUnavailableError
 from ..io.pixel_buffer import PixelBuffer
 from ..io.pixels_service import PixelsService
+from ..io.stores import StoreUnavailableError
+from ..resilience.deadline import DeadlineExceeded, current_deadline
 from ..ops.convert import to_big_endian_bytes, to_big_endian_bytes_np
 from ..ops.crop import resolve_region
 from ..ops.pallas import (
@@ -54,6 +58,18 @@ from ..utils.tracing import TRACER
 log = logging.getLogger("omero_ms_pixel_buffer_tpu.pipeline")
 
 FORMATS = (None, "png", "tif")
+
+# Dependency-down markers: a lane that failed because a breaker is
+# open (store / Postgres) must answer 503 + Retry-After, NOT the 404 a
+# truly unknown image gets — a 404 reads as "image gone" to viewers
+# and caches, for the whole open duration.
+_UNAVAILABLE = (StoreUnavailableError, PostgresUnavailableError)
+
+
+def _lane_unavailable(e: Exception) -> ServiceUnavailableError:
+    return ServiceUnavailableError(
+        str(e), retry_after_s=getattr(e, "retry_after_s", 1.0) or 1.0
+    )
 
 
 class ResolvedTile:
@@ -142,6 +158,17 @@ class TilePipeline:
         # 0 disables.
         self.max_tile_bytes = max_tile_bytes
         self.buckets = tuple(sorted(buckets))
+        # whether the service's buffer plane takes the caller's
+        # session key (the ACL seam, io/pixels_service.py); duck-typed
+        # stand-ins in tests/benches may not
+        import inspect
+
+        try:
+            self._buffer_scoped = "session_key" in inspect.signature(
+                pixels_service.get_pixel_buffer
+            ).parameters
+        except (TypeError, ValueError, AttributeError):
+            self._buffer_scoped = False
         self._encode_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=encode_workers, thread_name_prefix="encode"
         )
@@ -233,10 +260,20 @@ class TilePipeline:
     # resolve / read — the metadata + I/O stages
     # ------------------------------------------------------------------
 
+    @staticmethod
+    def _check_deadline(ctx: TileCtx, what: str) -> None:
+        """Stop work the moment the request budget is spent — the
+        stage raising ``DeadlineExceeded`` degrades to None per lane,
+        and the dispatch layer answers 504 (expired) instead of 404."""
+        deadline = ctx.deadline or current_deadline()
+        if deadline is not None:
+            deadline.check(what)
+
     def resolve(self, ctx: TileCtx) -> Optional[ResolvedTile]:
         """Metadata + buffer + region resolution. ``None`` when the image
         is unknown; raises on invalid coordinates (callers map to the
         reference's broad-catch -> None -> 404)."""
+        self._check_deadline(ctx, "resolve")
         with TRACER.start_span("get_pixels"):
             # the session key scopes permission-aware resolvers — the
             # reference's HQL runs inside the joined session, so ACLs
@@ -248,7 +285,18 @@ class TilePipeline:
             log.debug("Cannot find Image:%s", ctx.image_id)
             return None
         with TRACER.start_span("get_pixel_buffer"):
-            buffer = self.pixels_service.get_pixel_buffer(ctx.image_id)
+            # session key again at the buffer seam: the metadata check
+            # above already authorized, but the cached re-check is
+            # near-free and keeps the ACL invariant local to every
+            # buffer open (io/pixels_service.get_pixel_buffer)
+            if self._buffer_scoped:
+                buffer = self.pixels_service.get_pixel_buffer(
+                    ctx.image_id, session_key=ctx.omero_session_key
+                )
+            else:
+                buffer = self.pixels_service.get_pixel_buffer(
+                    ctx.image_id
+                )
         if buffer is None:
             return None
         level = 0
@@ -281,6 +329,7 @@ class TilePipeline:
         return ResolvedTile(ctx, meta, buffer, level, x, y, w, h)
 
     def read(self, rt: ResolvedTile) -> np.ndarray:
+        self._check_deadline(rt.ctx, "read")
         with TRACER.start_span("get_tile_direct"):
             return rt.buffer.get_tile_at(
                 rt.level, rt.ctx.z, rt.ctx.c, rt.ctx.t, rt.x, rt.y, rt.w, rt.h
@@ -290,9 +339,11 @@ class TilePipeline:
     # single-request path (reference parity; also the fallback)
     # ------------------------------------------------------------------
 
-    def handle(self, ctx: TileCtx) -> Optional[bytes]:
-        """getTile analog: bytes or None (-> 404). Broad-catch like the
-        reference (TileRequestHandler.java:133-137)."""
+    def handle(self, ctx: TileCtx):
+        """getTile analog: bytes, None (-> 404), or a
+        ``ServiceUnavailableError`` marker (-> 503, dependency breaker
+        open). Broad-catch like the reference
+        (TileRequestHandler.java:133-137)."""
         with TRACER.start_span("get_tile"):
             try:
                 rt = self.resolve(ctx)
@@ -300,6 +351,14 @@ class TilePipeline:
                     return None
                 tile = self.read(rt)
                 return self.encode(ctx, tile)
+            except DeadlineExceeded:
+                # expected under overload: the dispatch layer turns
+                # the expired lane into a 504 — no stack-trace noise
+                log.debug("deadline exceeded for image %s", ctx.image_id)
+                return None
+            except _UNAVAILABLE as e:
+                log.warning("dependency unavailable: %s", e)
+                return _lane_unavailable(e)
             except Exception:
                 log.exception("Exception while retrieving tile")
                 return None
@@ -351,7 +410,9 @@ class TilePipeline:
         jit call per bucket -> host deflate in parallel threads ->
         per-lane container assembly. Raw/TIFF lanes take the host
         byte path (pure memcpy). Per-lane failures degrade to None
-        (404) without failing the batch.
+        (404) without failing the batch — except dependency-down
+        failures (open breaker), which become per-lane
+        ``ServiceUnavailableError`` markers (-> 503 + Retry-After).
         """
         n = len(ctxs)
         results: List[Optional[bytes]] = [None] * n
@@ -359,6 +420,11 @@ class TilePipeline:
         for i, ctx in enumerate(ctxs):
             try:
                 resolved[i] = self.resolve(ctx)
+            except DeadlineExceeded:
+                resolved[i] = None  # lane -> 504 at the dispatch layer
+            except _UNAVAILABLE as e:
+                resolved[i] = None
+                results[i] = _lane_unavailable(e)  # lane -> 503
             except Exception:
                 log.exception("resolve failed for lane %d", i)
                 resolved[i] = None
@@ -406,6 +472,12 @@ class TilePipeline:
                     batch = buf.read_tiles(coords, level=level)
                     for i, tile in zip(lanes, batch):
                         tiles[i] = tile
+                except _UNAVAILABLE as e:
+                    log.warning("store unavailable for image %d: %s",
+                                image_id, e)
+                    marker = _lane_unavailable(e)
+                    for i in lanes:
+                        results[i] = marker  # lanes -> 503
                 except Exception:
                     log.exception("batched read failed; lanes -> 404")
 

@@ -1,0 +1,73 @@
+"""Unified resilience layer: circuit breakers, deadline propagation,
+retry budgets, admission control, and a deterministic fault-injection
+harness.
+
+One policy surface for every remote-I/O edge (S3/HTTP stores,
+Postgres, session stores, Glacier2, the dispatch bus) instead of
+ad-hoc per-module error handling. Thresholds live under the
+``resilience:`` block of conf/config.yaml (utils.config.
+ResilienceConfig); ``configure()`` applies them process-wide at app
+startup. All state is observable: breaker transitions, shed counts,
+retry totals, and deadline-exceeded events export through
+utils.metrics, and ``/healthz`` (http/server.py) reports the live
+breaker board + queue depth.
+"""
+
+from __future__ import annotations
+
+from .admission import AdmissionController
+from .breaker import (
+    BOARD,
+    BreakerOpenError,
+    CircuitBreaker,
+    for_dependency,
+)
+from .deadline import (
+    Deadline,
+    DeadlineExceeded,
+    current_deadline,
+    deadline_scope,
+)
+from .faultinject import INJECTOR
+from .retry import RetryPolicy, retry_call, set_default_policy
+
+__all__ = [
+    "AdmissionController",
+    "BOARD",
+    "BreakerOpenError",
+    "CircuitBreaker",
+    "Deadline",
+    "DeadlineExceeded",
+    "INJECTOR",
+    "RetryPolicy",
+    "configure",
+    "current_deadline",
+    "deadline_scope",
+    "for_dependency",
+    "retry_call",
+    "set_default_policy",
+]
+
+
+def configure(res_config) -> None:
+    """Apply a utils.config.ResilienceConfig to the process-wide
+    defaults (breaker board + default retry policy). Called by the
+    HTTP app at startup; tests call it with crafted configs."""
+    BOARD.configure(
+        enabled=res_config.enabled,
+        failure_threshold=res_config.breaker.failure_threshold,
+        failure_rate_threshold=res_config.breaker.failure_rate_threshold,
+        window=res_config.breaker.window,
+        min_calls=res_config.breaker.min_calls,
+        open_duration_s=res_config.breaker.open_duration_ms / 1000.0,
+        half_open_probes=res_config.breaker.half_open_probes,
+    )
+    set_default_policy(
+        RetryPolicy(
+            max_attempts=res_config.retry.max_attempts,
+            base_delay_s=res_config.retry.base_delay_ms / 1000.0,
+            max_delay_s=res_config.retry.max_delay_ms / 1000.0,
+            jitter=res_config.retry.jitter,
+            budget_s=res_config.retry.budget_ms / 1000.0,
+        )
+    )

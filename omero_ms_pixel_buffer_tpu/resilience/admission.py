@@ -1,0 +1,73 @@
+"""Admission control — load shedding at the HTTP front.
+
+The worker pool and the coalescing queue are both bounded, but before
+this layer the HTTP front accepted every request and let the excess
+time out 15 s later inside the bus — the worst failure mode under
+overload: every client waits the full budget and *then* fails, and
+p50 for admitted work collapses because the queue is full of doomed
+requests. Shedding at the door inverts that: beyond
+``max_inflight`` concurrent tile requests the front answers 503 with
+``Retry-After`` immediately, keeping latency for admitted requests
+near the unloaded baseline (the graceful-degradation property the
+tile-serving literature calls out, arXiv:2207.01734).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..utils.metrics import REGISTRY
+
+SHED = REGISTRY.counter(
+    "resilience_shed_total",
+    "Requests shed (503) by admission control",
+)
+INFLIGHT = REGISTRY.gauge(
+    "resilience_inflight_requests",
+    "Tile requests currently admitted and in flight",
+)
+
+
+class AdmissionController:
+    """Bounded in-flight gate. ``try_acquire`` never blocks — a full
+    service answers *now*, it does not queue the caller."""
+
+    def __init__(self, max_inflight: int = 256, retry_after_s: float = 1.0):
+        self.max_inflight = max_inflight
+        self.retry_after_s = retry_after_s
+        self._inflight = 0
+        self._shed = 0
+        self._lock = threading.Lock()
+
+    def try_acquire(self) -> bool:
+        with self._lock:
+            if self._inflight >= self.max_inflight:
+                self._shed += 1
+                SHED.inc()
+                return False
+            self._inflight += 1
+            INFLIGHT.set(self._inflight)
+            return True
+
+    def release(self) -> None:
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+            INFLIGHT.set(self._inflight)
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    @property
+    def shed_total(self) -> int:
+        with self._lock:
+            return self._shed
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "inflight": self._inflight,
+                "max_inflight": self.max_inflight,
+                "shed_total": self._shed,
+            }

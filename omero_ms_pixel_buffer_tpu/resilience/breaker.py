@@ -1,0 +1,335 @@
+"""Circuit breakers — per-dependency failure isolation.
+
+The service talks to five classes of remote dependency (S3/HTTP
+stores, Postgres, Redis/PG session stores, Glacier2, the device
+probe). Without breakers, one wedged dependency converts every
+request that touches it into a full timeout — and under load that
+exhausts the worker pool and takes down lanes that never needed the
+sick dependency (the ImageBox3 degrade-not-stall argument,
+arXiv:2207.01734). A breaker converts "slow failure, every time" into
+"fast failure until the dependency heals".
+
+Standard three-state machine:
+
+- ``closed`` — calls flow; outcomes recorded. Opens on EITHER
+  ``failure_threshold`` consecutive failures OR a failure rate above
+  ``failure_rate_threshold`` across the last ``window`` calls (once at
+  least ``min_calls`` outcomes exist).
+- ``open`` — calls rejected instantly with ``BreakerOpenError`` until
+  ``open_duration_s`` elapses.
+- ``half_open`` — up to ``half_open_probes`` trial calls pass; a
+  success closes the breaker (and clears history), a failure re-opens
+  it for another ``open_duration_s``.
+
+Thread-safe (stores and the pipeline run on executor threads); the
+clock is injectable so the chaos suite drives state transitions
+without sleeping. Every transition, rejection, and the live state are
+exported through utils.metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict
+
+from ..utils.metrics import REGISTRY
+
+BREAKER_STATE = REGISTRY.gauge(
+    "resilience_breaker_state",
+    "Circuit-breaker state per dependency (0=closed 1=half_open 2=open)",
+)
+BREAKER_TRANSITIONS = REGISTRY.counter(
+    "resilience_breaker_transitions_total",
+    "Circuit-breaker state transitions by dependency and new state",
+)
+BREAKER_REJECTED = REGISTRY.counter(
+    "resilience_breaker_rejected_total",
+    "Calls rejected by an open circuit breaker",
+)
+
+CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
+_STATE_CODE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+class BreakerOpenError(RuntimeError):
+    """Rejected without calling the dependency: its breaker is open.
+
+    Carries the dependency name and how long until the next half-open
+    probe, so HTTP fronts can answer 503 with a meaningful
+    ``Retry-After``."""
+
+    def __init__(self, dependency: str, retry_after_s: float):
+        super().__init__(
+            f"circuit breaker open for {dependency} "
+            f"(retry in {retry_after_s:.1f}s)"
+        )
+        self.dependency = dependency
+        self.retry_after_s = retry_after_s
+
+
+class CircuitBreaker:
+    def __init__(
+        self,
+        name: str,
+        failure_threshold: int = 5,
+        failure_rate_threshold: float = 0.5,
+        window: int = 20,
+        min_calls: int = 10,
+        open_duration_s: float = 30.0,
+        half_open_probes: int = 1,
+        clock=time.monotonic,
+    ):
+        self.name = name
+        self.failure_threshold = failure_threshold
+        self.failure_rate_threshold = failure_rate_threshold
+        self.window = window
+        self.min_calls = min_calls
+        self.open_duration_s = open_duration_s
+        self.half_open_probes = half_open_probes
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._outcomes: deque = deque(maxlen=window)  # True = failure
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probes_in_flight = 0
+        self._probe_admitted_at = 0.0
+        self._stats = {"rejected": 0, "opened": 0}
+        BREAKER_STATE.set(0, dependency=name)
+
+    # -- state machine -------------------------------------------------
+
+    def _transition(self, state: str) -> None:
+        # callers hold self._lock
+        if state == self._state:
+            return
+        self._state = state
+        if state == OPEN:
+            self._opened_at = self.clock()
+            self._stats["opened"] += 1
+        if state in (OPEN, HALF_OPEN):
+            self._probes_in_flight = 0
+        if state == CLOSED:
+            self._outcomes.clear()
+            self._consecutive_failures = 0
+        BREAKER_STATE.set(_STATE_CODE[state], dependency=self.name)
+        BREAKER_TRANSITIONS.inc(dependency=self.name, state=state)
+
+    def allow(self) -> None:
+        """Gate a call: no-op when closed, admits a bounded number of
+        probes when half-open, raises ``BreakerOpenError`` when open."""
+        with self._lock:
+            if self._state == OPEN:
+                elapsed = self.clock() - self._opened_at
+                if elapsed < self.open_duration_s:
+                    self._stats["rejected"] += 1
+                    BREAKER_REJECTED.inc(dependency=self.name)
+                    raise BreakerOpenError(
+                        self.name, self.open_duration_s - elapsed
+                    )
+                self._transition(HALF_OPEN)
+            if self._state == HALF_OPEN:
+                if self._probes_in_flight >= self.half_open_probes:
+                    # self-heal abandoned probes: a gated call can exit
+                    # without an outcome (caller cancelled, deadline
+                    # expired before the dependency was touched) — if
+                    # no probe has reported within a full open period,
+                    # assume it was lost and admit a fresh one, or the
+                    # breaker would reject forever
+                    if (
+                        self.clock() - self._probe_admitted_at
+                        >= self.open_duration_s
+                    ):
+                        self._probes_in_flight = 0
+                    else:
+                        self._stats["rejected"] += 1
+                        BREAKER_REJECTED.inc(dependency=self.name)
+                        raise BreakerOpenError(self.name, 0.0)
+                self._probes_in_flight += 1
+                self._probe_admitted_at = self.clock()
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self._state == HALF_OPEN:
+                # one healthy probe closes; history restarts clean
+                self._transition(CLOSED)
+                return
+            self._consecutive_failures = 0
+            self._outcomes.append(False)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            if self._state == HALF_OPEN:
+                self._transition(OPEN)
+                return
+            if self._state == OPEN:
+                return
+            self._consecutive_failures += 1
+            self._outcomes.append(True)
+            if self._consecutive_failures >= self.failure_threshold:
+                self._transition(OPEN)
+                return
+            if len(self._outcomes) >= self.min_calls:
+                rate = sum(self._outcomes) / len(self._outcomes)
+                if rate >= self.failure_rate_threshold:
+                    self._transition(OPEN)
+
+    # -- conveniences --------------------------------------------------
+
+    def call(self, fn, *args, **kwargs):
+        """Run ``fn`` under the breaker: gate, record, re-raise."""
+        self.allow()
+        try:
+            result = fn(*args, **kwargs)
+        except Exception:
+            self.record_failure()
+            raise
+        self.record_success()
+        return result
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            # surface open->half_open promotion without a caller
+            if (
+                self._state == OPEN
+                and self.clock() - self._opened_at >= self.open_duration_s
+            ):
+                return HALF_OPEN
+            return self._state
+
+    def snapshot(self) -> dict:
+        """The /healthz view of one breaker. Reports the same
+        open->half_open promotion the ``state`` property surfaces: an
+        idle breaker whose open period has elapsed would admit a probe
+        on the next call, so health must not read "open"/degraded
+        forever just because no traffic has touched it."""
+        with self._lock:
+            state = self._state
+            if (
+                state == OPEN
+                and self.clock() - self._opened_at
+                >= self.open_duration_s
+            ):
+                state = HALF_OPEN
+            return {
+                "state": state,
+                "consecutive_failures": self._consecutive_failures,
+                "window_failures": sum(self._outcomes),
+                "window_size": len(self._outcomes),
+                "rejected_total": self._stats["rejected"],
+                "opened_total": self._stats["opened"],
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._transition(CLOSED)
+
+
+class BreakerBoard:
+    """Process-wide breaker registry: one place to mint per-dependency
+    breakers with the configured defaults and to snapshot every live
+    state for ``/healthz``.
+
+    Entries are held STRONGLY and keyed by dependency name — the
+    failure history belongs to the dependency, not to any one client
+    instance. This matters for stores that fail at *open* time: the
+    buffer layer re-constructs them per request, and breakers scoped
+    to the instance would reset on every attempt and never trip. The
+    name space is bounded in practice (one per bucket/host/database);
+    a coarse cap guards pathological churn. ``enabled: False`` hands
+    out ``NullBreaker`` so the whole layer can be switched off from
+    config without touching call sites."""
+
+    _MAX_BREAKERS = 1024
+
+    def __init__(self):
+        self.enabled = True
+        self.defaults: dict = {}
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._lock = threading.Lock()
+
+    def configure(self, enabled: bool = True, **defaults) -> None:
+        self.enabled = enabled
+        self.defaults = dict(defaults)
+
+    def create(self, name: str, **overrides) -> "CircuitBreaker":
+        """The breaker for one dependency *name*, registered for
+        health reporting. A live breaker with the same name is REUSED
+        (unless explicit ``overrides`` ask for a fresh one): the
+        failure history belongs to the dependency, not the client
+        instance — a store that fails at open time is re-constructed
+        per request, and per-instance breakers would reset on every
+        attempt and never trip."""
+        if not self.enabled:
+            return NULL_BREAKER
+        with self._lock:
+            existing = self._breakers.get(name)
+            if existing is not None and not overrides:
+                return existing
+            if (
+                name not in self._breakers
+                and len(self._breakers) >= self._MAX_BREAKERS
+            ):
+                self._breakers.clear()  # coarse but bounded
+            breaker = CircuitBreaker(
+                name, **{**self.defaults, **overrides}
+            )
+            self._breakers[name] = breaker
+        return breaker
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            items = list(self._breakers.items())
+        return {name: b.snapshot() for name, b in items}
+
+    def any_open(self) -> bool:
+        with self._lock:
+            items = list(self._breakers.values())
+        return any(b.state == OPEN for b in items)
+
+    def reset(self) -> None:
+        """Test hook: forget every registered breaker."""
+        with self._lock:
+            for b in list(self._breakers.values()):
+                b.reset()
+            self._breakers = {}
+
+
+class NullBreaker:
+    """Disabled-resilience stand-in: same surface, no state."""
+
+    name = "null"
+    state = CLOSED
+
+    def allow(self) -> None:
+        pass
+
+    def record_success(self) -> None:
+        pass
+
+    def record_failure(self) -> None:
+        pass
+
+    def call(self, fn, *args, **kwargs):
+        return fn(*args, **kwargs)
+
+    def snapshot(self) -> dict:
+        return {"state": CLOSED}
+
+    def reset(self) -> None:
+        pass
+
+
+NULL_BREAKER = NullBreaker()
+
+# Default process-wide board (the REGISTRY/TRACER pattern).
+BOARD = BreakerBoard()
+
+
+def for_dependency(name: str, **overrides) -> CircuitBreaker:
+    """Mint a breaker for one dependency instance on the default
+    board."""
+    return BOARD.create(name, **overrides)

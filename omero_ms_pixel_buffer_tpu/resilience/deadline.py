@@ -1,0 +1,117 @@
+"""End-to-end request deadlines.
+
+A request's budget is minted ONCE at the HTTP front (http/server.py)
+and carried on the ``TileCtx`` across the dispatch boundary; every
+layer below — bus wait, batch coalescing, store retries, Postgres
+lookups — decrements the same clock instead of stacking independent
+timeouts. The invariant this buys (the PATCHEDSERVE/SLO-serving
+property, arXiv:2501.09253): no downstream retry or backoff ever
+outlives the caller, so a wedged dependency costs at most one budget,
+never a worker parked behind it.
+
+Two transport surfaces:
+
+- explicit — ``ctx.deadline`` on the DTO, JSON-serialized as the
+  *remaining* budget in ms (absolute monotonic times don't cross
+  process boundaries);
+- ambient — a contextvar the batcher sets around pipeline execution,
+  so synchronous depths (store GET loops, the retry helper) can honor
+  the budget without threading a parameter through every signature.
+  ``contextvars.copy_context`` carries it onto executor threads.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import time
+from typing import Optional
+
+from ..errors import GatewayTimeoutError
+from ..utils.metrics import REGISTRY
+
+DEADLINE_EXCEEDED = REGISTRY.counter(
+    "resilience_deadline_exceeded_total",
+    "Requests that ran out of budget, by the stage that noticed",
+)
+
+
+class DeadlineExceeded(GatewayTimeoutError):
+    """Raised when work is attempted past its request budget; maps to
+    HTTP 504 via the TileError code it carries."""
+
+    def __init__(self, what: str = ""):
+        detail = f" ({what})" if what else ""
+        super().__init__(f"Request deadline exceeded{detail}")
+
+
+class Deadline:
+    """A monotonic expiry point. ``clock`` is injectable so the chaos
+    suite can test expiry without sleeping."""
+
+    __slots__ = ("expires_at", "clock")
+
+    def __init__(self, expires_at: float, clock=time.monotonic):
+        self.expires_at = expires_at
+        self.clock = clock
+
+    @classmethod
+    def after(cls, budget_s: float, clock=time.monotonic) -> "Deadline":
+        return cls(clock() + budget_s, clock)
+
+    def remaining(self) -> float:
+        """Seconds left, floored at 0."""
+        return max(0.0, self.expires_at - self.clock())
+
+    @property
+    def expired(self) -> bool:
+        return self.clock() >= self.expires_at
+
+    def check(self, what: str = "") -> None:
+        """Raise ``DeadlineExceeded`` if the budget is spent."""
+        if self.expired:
+            raise DeadlineExceeded(what)
+
+    def cap(self, timeout_s: Optional[float]) -> float:
+        """Bound a per-call timeout by the remaining budget — the one
+        primitive every blocking call below the front should use."""
+        rem = self.remaining()
+        return rem if timeout_s is None else min(timeout_s, rem)
+
+    # -- dispatch-boundary (de)serialization ---------------------------
+    # Remaining-budget encoding: a cross-process hop re-mints the
+    # deadline from what's left, so transit time is charged to the
+    # request, never refunded.
+
+    def to_json(self) -> dict:
+        return {"budgetMs": self.remaining() * 1000.0}
+
+    @classmethod
+    def from_json(cls, obj: Optional[dict]) -> Optional["Deadline"]:
+        if not obj or obj.get("budgetMs") is None:
+            return None
+        return cls.after(float(obj["budgetMs"]) / 1000.0)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"Deadline(remaining={self.remaining() * 1000:.1f}ms)"
+
+
+_current_deadline: contextvars.ContextVar[Optional[Deadline]] = (
+    contextvars.ContextVar("resilience_deadline", default=None)
+)
+
+
+def current_deadline() -> Optional[Deadline]:
+    """The ambient deadline, or None outside a request scope."""
+    return _current_deadline.get()
+
+
+@contextlib.contextmanager
+def deadline_scope(deadline: Optional[Deadline]):
+    """Make ``deadline`` ambient for the dynamic extent of the block
+    (and, via copy_context, for executor work dispatched inside it)."""
+    token = _current_deadline.set(deadline)
+    try:
+        yield deadline
+    finally:
+        _current_deadline.reset(token)

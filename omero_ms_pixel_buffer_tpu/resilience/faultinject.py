@@ -1,0 +1,160 @@
+"""Deterministic fault injection — the chaos harness.
+
+Remote-I/O edges carry named injection points (``store.http``,
+``store.s3``, ``db.postgres``, ``session_store``, ``auth.ice``,
+``bus.request``); each point consults the process-wide ``INJECTOR``
+with one dict lookup, so an empty injector costs nothing on the hot
+path. The chaos suite installs *schedules* — pure functions of the
+call index — making every failure, latency spike, and flap cycle
+exactly reproducible: the same seed and schedule produce the same
+outage on every run, which is what lets tests assert breaker
+transitions instead of hoping for them.
+
+Outcomes per call: ``None`` (pass through), ``Fail(exc)`` (raise
+before touching the dependency), ``Latency(seconds)`` (delay, then
+pass). Sync sites call ``fire``; async sites ``fire_async`` (latency
+awaits instead of blocking the loop).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+
+class Fail:
+    """Raise ``exc`` (a factory or instance) instead of calling the
+    dependency."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc):
+        self.exc = exc
+
+    def raise_(self) -> None:
+        raise self.exc() if callable(self.exc) else self.exc
+
+
+class Latency:
+    __slots__ = ("seconds",)
+
+    def __init__(self, seconds: float):
+        self.seconds = seconds
+
+
+Outcome = Optional[object]  # None | Fail | Latency
+Schedule = Callable[[int], Outcome]
+
+
+# -- schedule combinators (all pure in the call index) ------------------
+
+
+def always(exc) -> Schedule:
+    return lambda n: Fail(exc)
+
+
+def first_n(n_fail: int, exc) -> Schedule:
+    """Fail the first ``n_fail`` calls, then heal."""
+    return lambda n: Fail(exc) if n < n_fail else None
+
+
+def flap(fail_n: int, ok_n: int, exc) -> Schedule:
+    """A flapping dependency: ``fail_n`` failures, ``ok_n`` successes,
+    repeat."""
+    period = fail_n + ok_n
+
+    def schedule(n: int) -> Outcome:
+        return Fail(exc) if n % period < fail_n else None
+
+    return schedule
+
+
+def latency(seconds: float, every: int = 1) -> Schedule:
+    """Inject ``seconds`` of latency on every ``every``-th call."""
+    return lambda n: Latency(seconds) if n % every == 0 else None
+
+
+def seeded(seed: int, p_fail: float, exc) -> Schedule:
+    """Pseudo-random failures that are a pure function of (seed, n):
+    the same seed yields the same failure pattern on every run."""
+
+    def schedule(n: int) -> Outcome:
+        # integer mix keeps the outcome a pure function of (seed, n)
+        # across runs and Python versions
+        return (
+            Fail(exc)
+            if random.Random(seed * 1_000_003 + n).random() < p_fail
+            else None
+        )
+
+    return schedule
+
+
+class FaultInjector:
+    """Process-wide registry of point -> schedule with per-point call
+    counters. ``install``/``clear`` from tests; ``fire`` from
+    instrumented code."""
+
+    def __init__(self):
+        self._schedules: Dict[str, Schedule] = {}
+        self._counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def install(self, point: str, schedule: Schedule) -> None:
+        with self._lock:
+            self._schedules[point] = schedule
+            self._counts[point] = 0
+
+    def uninstall(self, point: str) -> None:
+        with self._lock:
+            self._schedules.pop(point, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._schedules.clear()
+            self._counts.clear()
+
+    def calls(self, point: str) -> int:
+        with self._lock:
+            return self._counts.get(point, 0)
+
+    def _outcome(self, point: str) -> Outcome:
+        with self._lock:
+            schedule = self._schedules.get(point)
+            if schedule is None:
+                return None
+            n = self._counts.get(point, 0)
+            self._counts[point] = n + 1
+        return schedule(n)
+
+    def fire(self, point: str) -> None:
+        """Sync injection site. No-op unless a schedule is installed."""
+        if not self._schedules:  # fast path: chaos off
+            return
+        outcome = self._outcome(point)
+        if outcome is None:
+            return
+        if isinstance(outcome, Latency):
+            time.sleep(outcome.seconds)
+            return
+        outcome.raise_()
+
+    async def fire_async(self, point: str) -> None:
+        """Async injection site: latency awaits, never blocks the
+        loop."""
+        if not self._schedules:
+            return
+        outcome = self._outcome(point)
+        if outcome is None:
+            return
+        if isinstance(outcome, Latency):
+            await asyncio.sleep(outcome.seconds)
+            return
+        outcome.raise_()
+
+
+# Default process-wide injector (the REGISTRY/TRACER/BOARD pattern).
+INJECTOR = FaultInjector()

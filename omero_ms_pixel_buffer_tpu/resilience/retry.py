@@ -1,0 +1,118 @@
+"""Jittered-exponential retry with a budget, bounded by the caller's
+deadline.
+
+Replaces the fixed twice-retry-with-short-backoff that io/stores.py
+shipped with: attempts back off exponentially with multiplicative
+jitter (decorrelating a thundering herd of tile lanes hitting the same
+sick bucket), total sleep is capped by a retry *budget*, and — the
+deadline-propagation invariant — no attempt or backoff ever starts
+past the ambient request deadline, so retries can never outlive the
+15 s bus budget minted at the HTTP front.
+
+Determinism for the chaos suite: the jitter RNG is injectable
+(``random.Random(seed)``), as is the sleep function and the clock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+from ..utils.metrics import REGISTRY
+from .deadline import Deadline, DeadlineExceeded, current_deadline
+
+RETRIES = REGISTRY.counter(
+    "resilience_retries_total", "Retry attempts by dependency"
+)
+RETRY_BUDGET_EXHAUSTED = REGISTRY.counter(
+    "resilience_retry_budget_exhausted_total",
+    "Retry sequences abandoned because the sleep budget ran out",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """``max_attempts`` counts the first call: 3 means up to 2
+    retries. ``budget_s`` caps the *cumulative sleep* of one call's
+    retry sequence; ``jitter`` subtracts up to that fraction of each
+    delay (full-jitter style, decorrelated but never longer than the
+    deterministic schedule)."""
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.5
+    budget_s: float = 5.0
+
+    def delay(self, attempt: int, rng: random.Random) -> float:
+        """Backoff before retry ``attempt`` (1-based)."""
+        d = min(
+            self.max_delay_s,
+            self.base_delay_s * (self.multiplier ** (attempt - 1)),
+        )
+        if self.jitter > 0:
+            d *= 1.0 - self.jitter * rng.random()
+        return d
+
+
+# Module default; resilience.configure() swaps it from the config's
+# resilience.retry block.
+DEFAULT_POLICY = RetryPolicy()
+
+_rng = random.Random()
+
+
+def set_default_policy(policy: RetryPolicy) -> None:
+    global DEFAULT_POLICY
+    DEFAULT_POLICY = policy
+
+
+def retry_call(
+    fn: Callable,
+    *,
+    policy: Optional[RetryPolicy] = None,
+    retryable: Tuple[Type[BaseException], ...] = (Exception,),
+    should_retry: Optional[Callable[[BaseException], bool]] = None,
+    deadline: Optional[Deadline] = None,
+    name: str = "",
+    rng: Optional[random.Random] = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> object:
+    """Call ``fn`` with bounded, deadline-aware retries.
+
+    ``deadline`` defaults to the ambient request deadline; when it
+    cannot cover the next backoff the sequence aborts with
+    ``DeadlineExceeded`` instead of sleeping past the caller.
+    ``should_retry`` refines ``retryable`` (e.g. only 5xx store
+    errors)."""
+    policy = policy or DEFAULT_POLICY
+    rng = rng or _rng
+    if deadline is None:
+        deadline = current_deadline()
+    slept = 0.0
+    attempt = 0
+    while True:
+        if deadline is not None:
+            deadline.check(name or "retry")
+        attempt += 1
+        try:
+            return fn()
+        except retryable as e:
+            if attempt >= policy.max_attempts:
+                raise
+            if should_retry is not None and not should_retry(e):
+                raise
+            delay = policy.delay(attempt, rng)
+            if slept + delay > policy.budget_s:
+                RETRY_BUDGET_EXHAUSTED.inc(dependency=name or "unknown")
+                raise
+            if deadline is not None and deadline.remaining() < delay:
+                # sleeping would outlive the caller: surface the
+                # deadline, not a would-have-retried dependency error
+                raise DeadlineExceeded(name or "retry backoff") from e
+            RETRIES.inc(dependency=name or "unknown")
+            sleep(delay)
+            slept += delay

@@ -16,6 +16,7 @@ import dataclasses
 from typing import Any, Mapping, Optional
 
 from .errors import BadRequestError
+from .resilience.deadline import Deadline
 
 
 @dataclasses.dataclass
@@ -66,6 +67,10 @@ class TileCtx:
     format: Optional[str] = None
     omero_session_key: Optional[str] = None
     trace_context: dict = dataclasses.field(default_factory=dict)
+    # per-request budget minted at the HTTP front (resilience/deadline):
+    # every layer below decrements this one clock; None = unbounded
+    # (tests and direct pipeline callers)
+    deadline: Optional[Deadline] = None
 
     @classmethod
     def from_params(
@@ -112,6 +117,11 @@ class TileCtx:
             "format": self.format,
             "omeroSessionKey": self.omero_session_key,
             "traceContext": dict(self.trace_context),
+            # remaining-budget encoding: transit time across the
+            # dispatch boundary is charged to the request, not refunded
+            "deadline": (
+                None if self.deadline is None else self.deadline.to_json()
+            ),
         }
 
     @classmethod
@@ -136,6 +146,7 @@ class TileCtx:
                 format=obj.get("format"),
                 omero_session_key=obj.get("omeroSessionKey"),
                 trace_context=dict(obj.get("traceContext") or {}),
+                deadline=Deadline.from_json(obj.get("deadline")),
             )
         except BadRequestError:
             raise

@@ -91,6 +91,63 @@ class BackendConfig:
 
 
 @dataclasses.dataclass
+class BreakerConfig:
+    """Circuit-breaker thresholds (resilience.breaker). A breaker
+    opens on EITHER ``failure_threshold`` consecutive failures or a
+    failure rate >= ``failure_rate_threshold`` over the last
+    ``window`` calls (once ``min_calls`` outcomes exist); it stays
+    open ``open_duration_ms`` and then admits ``half_open_probes``
+    trial calls."""
+
+    failure_threshold: int = 5
+    failure_rate_threshold: float = 0.5
+    window: int = 20
+    min_calls: int = 10
+    open_duration_ms: float = 30000.0
+    half_open_probes: int = 1
+
+
+@dataclasses.dataclass
+class RetryConfig:
+    """Jittered-exponential retry shape for remote-I/O edges
+    (resilience.retry). ``budget_ms`` caps cumulative backoff sleep
+    per call; the ambient request deadline additionally bounds every
+    attempt."""
+
+    max_attempts: int = 3
+    base_delay_ms: float = 50.0
+    max_delay_ms: float = 2000.0
+    jitter: float = 0.5
+    budget_ms: float = 5000.0
+
+
+@dataclasses.dataclass
+class AdmissionConfig:
+    """HTTP-front load shedding (resilience.admission): beyond
+    ``max_inflight`` concurrent tile requests the front answers 503
+    with ``Retry-After: retry_after_s``."""
+
+    max_inflight: int = 256
+    retry_after_s: float = 1.0
+
+
+@dataclasses.dataclass
+class ResilienceConfig:
+    """The resilience: block — one policy surface for breakers,
+    retries, deadlines, and admission control (resilience/ package).
+    ``request_budget_ms`` None means "use event-bus-send-timeout" (the
+    deadline minted per request at the HTTP front)."""
+
+    enabled: bool = True
+    breaker: BreakerConfig = dataclasses.field(default_factory=BreakerConfig)
+    retry: RetryConfig = dataclasses.field(default_factory=RetryConfig)
+    admission: AdmissionConfig = dataclasses.field(
+        default_factory=AdmissionConfig
+    )
+    request_budget_ms: Optional[float] = None
+
+
+@dataclasses.dataclass
 class LoggingConfig:
     """Reference logging (src/dist/conf/logback.xml): stdout by
     default; with a file, daily rolling with 7-day retention."""
@@ -131,6 +188,9 @@ class Config:
     zipkin_url: Optional[str] = None
     jmx_metrics_enabled: bool = True  # config.yaml:43-44 analog
     backend: BackendConfig = dataclasses.field(default_factory=BackendConfig)
+    resilience: ResilienceConfig = dataclasses.field(
+        default_factory=ResilienceConfig
+    )
     logging: LoggingConfig = dataclasses.field(default_factory=LoggingConfig)
     # Filesystem image registry (stands in for the OMERO Postgres
     # metadata plane when running without a server; see io.pixels_service).
@@ -156,6 +216,80 @@ class Config:
                 "'omero.session-validation-ttl' must be >= 0"
             )
         return ttl
+
+    @staticmethod
+    def _parse_resilience(raw: dict) -> ResilienceConfig:
+        """Validate the resilience: block — typos and nonsense values
+        must fail at startup, not silently run with defaults (the
+        session-store.type precedent)."""
+        res_raw = raw.get("resilience") or {}
+        br = res_raw.get("breaker") or {}
+        rt = res_raw.get("retry") or {}
+        ad = res_raw.get("admission") or {}
+
+        def _num(block: dict, key: str, default, minimum, cast=float):
+            try:
+                value = cast(block.get(key, default))
+            except (TypeError, ValueError):
+                raise ConfigError(
+                    f"Invalid value for 'resilience...{key}': "
+                    f"{block.get(key)!r}"
+                ) from None
+            if value < minimum:
+                raise ConfigError(
+                    f"'resilience...{key}' must be >= {minimum}"
+                )
+            return value
+
+        rate = _num(br, "failure-rate-threshold", 0.5, 0.0)
+        if rate > 1.0:
+            raise ConfigError(
+                "'resilience.breaker.failure-rate-threshold' must be "
+                "in [0, 1]"
+            )
+        jitter = _num(rt, "jitter", 0.5, 0.0)
+        if jitter > 1.0:
+            # jitter subtracts up to this fraction of each delay;
+            # > 1 would produce negative sleeps
+            raise ConfigError("'resilience.retry.jitter' must be in [0, 1]")
+        window = _num(br, "window", 20, 1, int)
+        min_calls = _num(br, "min-calls", 10, 1, int)
+        if min_calls > window:
+            # outcomes live in a window-sized deque: a min-calls the
+            # window can never reach silently disables the rate rule
+            raise ConfigError(
+                "'resilience.breaker.min-calls' must be <= "
+                "'resilience.breaker.window'"
+            )
+        budget = res_raw.get("request-budget-ms")
+        return ResilienceConfig(
+            enabled=bool(res_raw.get("enabled", True)),
+            breaker=BreakerConfig(
+                failure_threshold=_num(
+                    br, "failure-threshold", 5, 1, int
+                ),
+                failure_rate_threshold=rate,
+                window=window,
+                min_calls=min_calls,
+                open_duration_ms=_num(br, "open-duration-ms", 30000.0, 0.0),
+                half_open_probes=_num(br, "half-open-probes", 1, 1, int),
+            ),
+            retry=RetryConfig(
+                max_attempts=_num(rt, "max-attempts", 3, 1, int),
+                base_delay_ms=_num(rt, "base-delay-ms", 50.0, 0.0),
+                max_delay_ms=_num(rt, "max-delay-ms", 2000.0, 0.0),
+                jitter=jitter,
+                budget_ms=_num(rt, "budget-ms", 5000.0, 0.0),
+            ),
+            admission=AdmissionConfig(
+                max_inflight=_num(ad, "max-inflight", 256, 1, int),
+                retry_after_s=_num(ad, "retry-after-s", 1.0, 0.0),
+            ),
+            request_budget_ms=(
+                None if budget is None
+                else _num(res_raw, "request-budget-ms", None, 1.0)
+            ),
+        )
 
     @classmethod
     def from_dict(cls, raw: dict) -> "Config":
@@ -242,6 +376,7 @@ class Config:
             zipkin_url=tracing.get("zipkin-url"),
             jmx_metrics_enabled=bool(jmx.get("enabled", True)),
             backend=backend,
+            resilience=cls._parse_resilience(raw),
             logging=LoggingConfig(
                 file=log_raw.get("file"),
                 level=str(log_raw.get("level", "INFO")),

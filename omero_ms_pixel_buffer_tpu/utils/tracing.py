@@ -16,6 +16,13 @@ Span taxonomy kept verbatim from the reference so dashboards translate
 180,203,226) — plus TPU-side additions ``batch_stage``,
 ``batch_device``, ``batch_encode``.
 
+Resilience tags (resilience/, no reference analog):
+``deadline.remaining_ms`` on ``handle_get_tile`` and ``tile_batch``
+spans (the request budget as it crosses the dispatch boundary),
+``http.status`` on failed front responses, and ``error`` carrying
+``BreakerOpenError``/``DeadlineExceeded`` reprs when a dependency
+breaker rejects or a budget expires mid-span.
+
 Reporter model mirrors the reference's config gates: disabled -> noop
 spans (zero per-request cost, no metrics); enabled without sink -> log
 reporter (LogSpanReporter analog). With tracing enabled, span

@@ -22,6 +22,20 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Shared zstd gate: the *encode* paths (fixture writing, blosc
+# cname="zstd") need the real codec; suites import `needs_zstd` from
+# here and skip those cases where python-zstandard isn't installed.
+try:
+    import zstandard  # noqa: F401
+
+    HAVE_ZSTD = True
+except ImportError:
+    HAVE_ZSTD = False
+
+needs_zstd = pytest.mark.skipif(
+    not HAVE_ZSTD, reason="python-zstandard not installed"
+)
+
 
 # -- minimal async-test support (no pytest-asyncio in the image) -----------
 

@@ -80,6 +80,19 @@ def data_dir(tmp_path):
     write_ome_tiff(
         str(legacy / "old.tiff"), TIFF_IMG, tile_size=(64, 64)
     )
+    # image 6: FS-imported non-TIFF original (.czi) — OMERO generated
+    # a pyramid for it; the pyramid, not the original, must serve
+    # (ADVICE r5 regression)
+    (mrepo / "scan.czi").write_bytes(b"ZISRAWFILE not a tiff")
+    write_ome_tiff(
+        str(romio / "601_pyramid"), TIFF_IMG, tile_size=(64, 64)
+    )
+    # image 7: non-TIFF original, no pyramid, no ROMIO file —
+    # unresolvable, never handed to the TIFF reader
+    (mrepo / "slide.ndpi").write_bytes(b"NDPI not a tiff")
+    # image 8: TIFF container with a non-.tif suffix (Aperio-style):
+    # must serve directly, NOT fall through to pyramid/404
+    write_ome_tiff(str(mrepo / "scan.svs"), TIFF_IMG, tile_size=(64, 64))
     return str(d)
 
 
@@ -101,6 +114,12 @@ def _rows_for(data_dir):
                 ],
                 "5": [("legacy_user/2016-01/", "old.tiff", None,
                        "501")],
+                "6": [("demo_2/2026-07/", "scan.czi", "repo-uuid",
+                       "601")],
+                "7": [("demo_2/2026-07/", "slide.ndpi", "repo-uuid",
+                       "701")],
+                "8": [("demo_2/2026-07/", "scan.svs", "repo-uuid",
+                       "801")],
             }.get(params[0], [])
         if sql == PIXELS_ID_QUERY:
             return {"3": [("301",)], "4": [("401",)]}.get(params[0], [])
@@ -195,6 +214,27 @@ class TestResolution:
     def test_unknown_image_is_none(self, data_dir, loop):
         def check(source):
             assert source.entry(99) is None  # -> 404
+
+        self._with_source(data_dir, loop, check)
+
+    def test_non_tiff_fileset_serves_generated_pyramid(
+        self, data_dir, loop
+    ):
+        """ADVICE r5 regression: an FS-imported .czi must resolve to
+        its generated <pixelsId>_pyramid, not hand the unreadable
+        original to the TIFF reader; without a pyramid it resolves to
+        nothing (404), never to a doomed 'ometiff' entry."""
+
+        def check(source):
+            e6 = source.entry(6)
+            assert e6["type"] == "ometiff"
+            assert e6["path"].endswith("601_pyramid")
+            assert source.entry(7) is None
+            # TIFF containers with non-.tif suffixes (.svs) still
+            # serve directly
+            e8 = source.entry(8)
+            assert e8["type"] == "ometiff"
+            assert e8["path"].endswith("scan.svs")
 
         self._with_source(data_dir, loop, check)
 

@@ -12,7 +12,8 @@ import zlib
 
 import numpy as np
 import pytest
-import zstandard
+
+from conftest import needs_zstd
 
 from omero_ms_pixel_buffer_tpu.ops.blosc import (
     BloscError,
@@ -109,7 +110,10 @@ class TestLz4RoundTrip:
 
 
 class TestBlosc:
-    @pytest.mark.parametrize("cname", ["lz4", "zstd", "zlib"])
+    @pytest.mark.parametrize(
+        "cname",
+        ["lz4", pytest.param("zstd", marks=needs_zstd), "zlib"],
+    )
     @pytest.mark.parametrize("typesize,shuffle", [
         (1, False), (2, True), (4, True), (8, True),
     ])
@@ -163,6 +167,7 @@ class TestBlosc:
                 800,
             )
 
+    @needs_zstd
     def test_zstd_payload_decodes_with_real_zstd(self):
         # cross-check container plumbing against the reference codec
         data = np.arange(4096, dtype=np.uint16).tobytes()

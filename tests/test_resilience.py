@@ -1,0 +1,972 @@
+"""Chaos suite for the unified resilience layer.
+
+Deterministic by construction: breaker transitions drive off injected
+clocks, retry jitter off seeded RNGs, and outages off fault-injection
+schedules that are pure functions of the call index
+(resilience/faultinject.py) — the same seed reproduces the same outage
+on every run. Covers the acceptance bar end to end: breakers trip and
+recover via half-open probes, expired deadlines answer 504 without
+occupying workers past their budget, overload sheds 503 + Retry-After
+while admitted p50 stays bounded, /healthz + /metrics expose it all,
+and a flapping Postgres cannot take down the healthy Zarr lane
+(fault isolation, not global outage).
+"""
+
+import asyncio
+import random
+import time
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from omero_ms_pixel_buffer_tpu.auth.stores import MemorySessionStore
+from omero_ms_pixel_buffer_tpu.db.metadata import (
+    OmeroPostgresMetadataResolver,
+)
+from omero_ms_pixel_buffer_tpu.errors import ServiceUnavailableError
+from omero_ms_pixel_buffer_tpu.http.server import PixelBufferApp
+from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+    ImageRegistry,
+    PixelsService,
+)
+from omero_ms_pixel_buffer_tpu.io.stores import (
+    HTTPStore,
+    S3Store,
+    StoreError,
+    StoreUnavailableError,
+)
+from omero_ms_pixel_buffer_tpu.io.zarr import write_ngff
+from omero_ms_pixel_buffer_tpu.resilience import (
+    BOARD,
+    INJECTOR,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+    configure as configure_resilience,
+    current_deadline,
+    deadline_scope,
+    retry_call,
+    set_default_policy,
+)
+from omero_ms_pixel_buffer_tpu.resilience.breaker import BreakerOpenError
+from omero_ms_pixel_buffer_tpu.resilience.faultinject import (
+    Latency,
+    first_n,
+    flap,
+    latency,
+    seeded,
+)
+from omero_ms_pixel_buffer_tpu.resilience.retry import DEFAULT_POLICY
+from omero_ms_pixel_buffer_tpu.utils.config import Config
+
+from test_postgres import FakePg, pixels_row
+
+pytestmark = pytest.mark.resilience
+
+rng = np.random.default_rng(11)
+IMG = rng.integers(0, 60000, (1, 1, 1, 64, 64), dtype=np.uint16)
+
+AUTH = {"Cookie": "sessionid=ck"}
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience():
+    """Every test starts with chaos off and stock policy, and leaves
+    it that way."""
+    saved_policy = DEFAULT_POLICY
+    yield
+    INJECTOR.clear()
+    BOARD.reset()  # breakers are held strongly, keyed by dependency
+    BOARD.configure(enabled=True)
+    set_default_policy(saved_policy)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker state machine
+# ---------------------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def _breaker(self, clock, **kw):
+        kw.setdefault("failure_threshold", 3)
+        kw.setdefault("open_duration_s", 10.0)
+        kw.setdefault("min_calls", 100)  # isolate consecutive rule
+        return CircuitBreaker("dep", clock=clock, **kw)
+
+    def test_opens_after_consecutive_failures(self):
+        clock = FakeClock()
+        b = self._breaker(clock)
+        for _ in range(2):
+            b.allow()
+            b.record_failure()
+        assert b.state == "closed"
+        b.allow()
+        b.record_failure()
+        assert b.state == "open"
+        with pytest.raises(BreakerOpenError) as ei:
+            b.allow()
+        assert ei.value.retry_after_s == pytest.approx(10.0)
+
+    def test_success_resets_consecutive_count(self):
+        clock = FakeClock()
+        b = self._breaker(clock)
+        for _ in range(2):
+            b.allow()
+            b.record_failure()
+        b.allow()
+        b.record_success()
+        for _ in range(2):
+            b.allow()
+            b.record_failure()
+        assert b.state == "closed"
+
+    def test_half_open_probe_recovers(self):
+        clock = FakeClock()
+        b = self._breaker(clock)
+        for _ in range(3):
+            b.record_failure()
+        assert b.state == "open"
+        clock.advance(10.1)  # open duration elapses
+        assert b.state == "half_open"
+        b.allow()  # the probe is admitted
+        b.record_success()
+        assert b.state == "closed"
+        b.allow()  # and traffic flows again
+
+    def test_half_open_failure_reopens(self):
+        clock = FakeClock()
+        b = self._breaker(clock)
+        for _ in range(3):
+            b.record_failure()
+        clock.advance(10.1)
+        b.allow()
+        b.record_failure()
+        assert b.state == "open"
+        with pytest.raises(BreakerOpenError):
+            b.allow()
+        # a second open period must elapse before the next probe
+        clock.advance(10.1)
+        b.allow()
+        b.record_success()
+        assert b.state == "closed"
+
+    def test_half_open_bounds_concurrent_probes(self):
+        clock = FakeClock()
+        b = self._breaker(clock, half_open_probes=1)
+        for _ in range(3):
+            b.record_failure()
+        clock.advance(10.1)
+        b.allow()  # probe slot taken, outcome pending
+        with pytest.raises(BreakerOpenError):
+            b.allow()
+
+    def test_abandoned_half_open_probe_self_heals(self):
+        """A gated call can exit without reporting an outcome (caller
+        cancelled, deadline expired first). The probe slot must not
+        leak forever — after a full open period with no outcome a
+        fresh probe is admitted."""
+        clock = FakeClock()
+        b = self._breaker(clock)
+        for _ in range(3):
+            b.record_failure()
+        clock.advance(10.1)
+        b.allow()  # probe admitted... and then abandoned
+        with pytest.raises(BreakerOpenError):
+            b.allow()  # slot taken, stale-window not yet elapsed
+        clock.advance(10.1)  # full open period, probe never reported
+        b.allow()  # self-heal: fresh probe admitted
+        b.record_success()
+        assert b.state == "closed"
+
+    def test_failure_rate_window_trips(self):
+        clock = FakeClock()
+        b = CircuitBreaker(
+            "dep", clock=clock, failure_threshold=100,
+            failure_rate_threshold=0.5, window=10, min_calls=10,
+            open_duration_s=10.0,
+        )
+        # alternate: 50% failures over the window, never consecutive
+        for i in range(10):
+            b.allow()
+            (b.record_failure if i % 2 else b.record_success)()
+        assert b.state == "open"
+
+    def test_snapshot_shape(self):
+        b = self._breaker(FakeClock())
+        b.record_failure()
+        snap = b.snapshot()
+        assert snap["state"] == "closed"
+        assert snap["consecutive_failures"] == 1
+        assert {"window_failures", "rejected_total",
+                "opened_total"} <= set(snap)
+
+
+# ---------------------------------------------------------------------------
+# deadlines
+# ---------------------------------------------------------------------------
+
+
+class TestDeadline:
+    def test_remaining_expired_check(self):
+        clock = FakeClock()
+        d = Deadline.after(1.0, clock=clock)
+        assert d.remaining() == pytest.approx(1.0)
+        assert not d.expired
+        clock.advance(0.6)
+        assert d.remaining() == pytest.approx(0.4)
+        clock.advance(0.5)
+        assert d.expired and d.remaining() == 0.0
+        with pytest.raises(DeadlineExceeded):
+            d.check("unit")
+
+    def test_cap_bounds_timeouts(self):
+        clock = FakeClock()
+        d = Deadline.after(2.0, clock=clock)
+        assert d.cap(15.0) == pytest.approx(2.0)
+        assert d.cap(0.5) == pytest.approx(0.5)
+        assert d.cap(None) == pytest.approx(2.0)
+
+    def test_json_round_trip_charges_transit(self):
+        d = Deadline.after(5.0)
+        d2 = Deadline.from_json(d.to_json())
+        assert d2 is not None
+        assert 0 < d2.remaining() <= 5.0
+        assert Deadline.from_json(None) is None
+        assert Deadline.from_json({}) is None
+
+    def test_ambient_scope(self):
+        assert current_deadline() is None
+        d = Deadline.after(1.0)
+        with deadline_scope(d):
+            assert current_deadline() is d
+        assert current_deadline() is None
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+# ---------------------------------------------------------------------------
+
+
+class TestRetry:
+    def test_deterministic_with_seed(self):
+        def delays_for(seed):
+            sleeps = []
+            calls = {"n": 0}
+
+            def fn():
+                calls["n"] += 1
+                if calls["n"] < 4:
+                    raise IOError("flaky")
+                return "ok"
+
+            out = retry_call(
+                fn,
+                policy=RetryPolicy(max_attempts=4, base_delay_s=0.1,
+                                   jitter=0.5, budget_s=10.0),
+                retryable=(IOError,),
+                rng=random.Random(seed),
+                sleep=sleeps.append,
+            )
+            assert out == "ok"
+            return sleeps
+
+        a, b = delays_for(7), delays_for(7)
+        assert a == b and len(a) == 3
+        assert delays_for(8) != a
+        # exponential shape survives the jitter (jitter only shrinks)
+        assert a[0] <= 0.1 and a[1] <= 0.2 and a[2] <= 0.4
+        assert a[1] >= 0.1 and a[2] >= 0.2
+
+    def test_exhausts_attempts(self):
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            raise IOError("down")
+
+        with pytest.raises(IOError):
+            retry_call(
+                fn,
+                policy=RetryPolicy(max_attempts=3, base_delay_s=0.001),
+                retryable=(IOError,),
+                sleep=lambda s: None,
+            )
+        assert calls["n"] == 3
+
+    def test_budget_stops_retrying(self):
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            raise IOError("down")
+
+        with pytest.raises(IOError):
+            retry_call(
+                fn,
+                policy=RetryPolicy(
+                    max_attempts=10, base_delay_s=1.0, jitter=0.0,
+                    budget_s=2.5,
+                ),
+                retryable=(IOError,),
+                sleep=lambda s: None,
+            )
+        # sleeps 1 + 2 = 3 > 2.5 budget -> stops after the 2nd delay
+        # would overflow: attempts = 2
+        assert calls["n"] == 2
+
+    def test_deadline_cuts_backoff(self):
+        """The invariant: a retry sequence NEVER sleeps past the
+        request deadline — it surfaces 504 instead."""
+        clock = FakeClock()
+        d = Deadline.after(0.15, clock=clock)
+
+        def sleeping(s):
+            clock.advance(s)
+
+        def fn():
+            clock.advance(0.01)  # each attempt costs a little
+            raise IOError("down")
+
+        with pytest.raises(DeadlineExceeded):
+            retry_call(
+                fn,
+                policy=RetryPolicy(max_attempts=10, base_delay_s=0.1,
+                                   jitter=0.0, budget_s=60.0),
+                retryable=(IOError,),
+                deadline=d,
+                sleep=sleeping,
+            )
+        assert not clock.t - 1000.0 > 0.15 + 0.11  # never slept past
+
+    def test_should_retry_filter(self):
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            raise IOError("permanent")
+
+        with pytest.raises(IOError):
+            retry_call(
+                fn,
+                policy=RetryPolicy(max_attempts=5, base_delay_s=0.001),
+                retryable=(IOError,),
+                should_retry=lambda e: "transient" in str(e),
+                sleep=lambda s: None,
+            )
+        assert calls["n"] == 1
+
+
+# ---------------------------------------------------------------------------
+# fault injection determinism
+# ---------------------------------------------------------------------------
+
+
+class TestFaultInjection:
+    def test_flap_schedule(self):
+        s = flap(2, 3, IOError)
+        pattern = [isinstance(s(n), type(None)) for n in range(10)]
+        assert pattern == [False, False, True, True, True] * 2
+
+    def test_first_n_heals(self):
+        s = first_n(3, IOError)
+        assert [s(n) is None for n in range(5)] == (
+            [False, False, False, True, True]
+        )
+
+    def test_seeded_reproducible(self):
+        a = [seeded(42, 0.5, IOError)(n) is None for n in range(50)]
+        b = [seeded(42, 0.5, IOError)(n) is None for n in range(50)]
+        c = [seeded(43, 0.5, IOError)(n) is None for n in range(50)]
+        assert a == b and a != c and 5 < sum(a) < 45
+
+    def test_latency_schedule(self):
+        s = latency(0.25, every=2)
+        assert isinstance(s(0), Latency) and s(0).seconds == 0.25
+        assert s(1) is None and isinstance(s(2), Latency)
+
+    def test_injector_fire_counts_and_clear(self):
+        INJECTOR.install("p", first_n(1, lambda: IOError("boom")))
+        with pytest.raises(IOError):
+            INJECTOR.fire("p")
+        INJECTOR.fire("p")  # healed
+        assert INJECTOR.calls("p") == 2
+        INJECTOR.clear()
+        INJECTOR.fire("p")  # no schedule: no-op, not counted
+        assert INJECTOR.calls("p") == 0
+
+
+# ---------------------------------------------------------------------------
+# store edges: breaker trips, fails fast, recovers
+# ---------------------------------------------------------------------------
+
+
+class TestStoreBreaker:
+    def test_http_store_breaker_opens_and_recovers(self, tmp_path):
+        from test_zarr_stores import _DirHandler, _serve_dir
+
+        (tmp_path / "key").write_bytes(b"payload")
+        server = _serve_dir(str(tmp_path), _DirHandler)
+        try:
+            port = server.server_address[1]
+            store = HTTPStore(f"http://127.0.0.1:{port}")
+            clock = FakeClock()
+            store.breaker = CircuitBreaker(
+                "store", failure_threshold=3, open_duration_s=5.0,
+                min_calls=100, clock=clock,
+            )
+            set_default_policy(
+                RetryPolicy(max_attempts=1)  # isolate breaker math
+            )
+            assert store.get("key") == b"payload"
+
+            INJECTOR.install(
+                "store.http", first_n(3, StoreError("injected outage"))
+            )
+            for _ in range(3):
+                with pytest.raises(StoreError):
+                    store.get("key")
+            assert store.breaker.state == "open"
+
+            # open: fails fast WITHOUT touching the dependency
+            fired = INJECTOR.calls("store.http")
+            with pytest.raises(StoreUnavailableError):
+                store.get("key")
+            assert INJECTOR.calls("store.http") == fired
+
+            # half-open probe heals (schedule already exhausted)
+            clock.advance(5.1)
+            assert store.get("key") == b"payload"
+            assert store.breaker.state == "closed"
+        finally:
+            server.shutdown()
+
+    def test_store_retries_respect_ambient_deadline(self, tmp_path):
+        """A GET under an (almost-spent) request budget must not sit
+        in backoff: it aborts with DeadlineExceeded quickly."""
+        store = HTTPStore("http://127.0.0.1:1", timeout_s=0.2)
+        set_default_policy(
+            RetryPolicy(max_attempts=5, base_delay_s=0.5, jitter=0.0,
+                        budget_s=30.0)
+        )
+        t0 = time.monotonic()
+        with deadline_scope(Deadline.after(0.25)):
+            with pytest.raises((StoreError, DeadlineExceeded)):
+                store.get("x")
+        assert time.monotonic() - t0 < 1.0  # never 4 x 0.5s backoffs
+
+
+class TestCredentialRotation:
+    def test_file_rotation_supersedes_stale_env(
+        self, tmp_path, monkeypatch
+    ):
+        """ADVICE r5: launched with (now-stale) STS creds in env, the
+        403 refresh path must pick up rotated ~/.aws file credentials
+        — the FakeS3 answers 403 until the signature matches the
+        rotated secret, then 200."""
+        from test_zarr_stores import (
+            ACCESS_KEY,
+            SECRET_KEY,
+            _FakeS3Handler,
+            _serve_dir,
+        )
+
+        root = tmp_path / "bucket"
+        root.mkdir()
+        (root / "img.zarr").mkdir()
+        (root / "img.zarr" / ".zattrs").write_bytes(b"{}")
+        server = _serve_dir(str(root), _FakeS3Handler)
+        try:
+            port = server.server_address[1]
+            monkeypatch.setenv(
+                "OMPB_S3_ENDPOINT", f"http://127.0.0.1:{port}"
+            )
+            monkeypatch.setenv("AWS_REGION", "us-east-1")
+            # env carries STALE credentials (expired STS)
+            monkeypatch.setenv("AWS_ACCESS_KEY_ID", ACCESS_KEY)
+            monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "stale-secret")
+            cred = tmp_path / "credentials"
+            monkeypatch.setenv(
+                "AWS_SHARED_CREDENTIALS_FILE", str(cred)
+            )
+            monkeypatch.setenv(
+                "AWS_CONFIG_FILE", str(tmp_path / "no-config")
+            )
+            store = S3Store("s3://test-bucket/img.zarr")
+            # before rotation: 403 forever (refresh finds nothing
+            # fresher than the stale env)
+            with pytest.raises(StoreError):
+                store.get(".zattrs")
+            # operator rotates the shared credentials file
+            cred.write_text(
+                f"[default]\naws_access_key_id = {ACCESS_KEY}\n"
+                f"aws_secret_access_key = {SECRET_KEY}\n"
+            )
+            store._last_refresh_mono = float("-inf")  # pass throttle
+            assert store.get(".zattrs") == b"{}"
+            assert store.secret_key == SECRET_KEY  # files won
+        finally:
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# glacier2 validator: breaker-open -> 503, never 403
+# ---------------------------------------------------------------------------
+
+
+class TestIceBreaker:
+    async def test_unreachable_router_opens_breaker_503(self):
+        from omero_ms_pixel_buffer_tpu.auth.ice import (
+            IceSessionValidator,
+        )
+
+        v = IceSessionValidator(
+            "127.0.0.1", port=1, secure=False, timeout_s=0.2,
+            cache_ttl_s=0,
+        )
+        v.breaker = CircuitBreaker(
+            "glacier2", failure_threshold=2, open_duration_s=60.0,
+            min_calls=100,
+        )
+        for _ in range(2):
+            with pytest.raises(OSError):
+                await v.validate("key")
+        with pytest.raises(ServiceUnavailableError) as ei:
+            await v.validate("key")
+        assert ei.value.code == 503 and ei.value.retry_after_s > 0
+
+
+# ---------------------------------------------------------------------------
+# HTTP front: healthz, shedding, deadlines
+# ---------------------------------------------------------------------------
+
+
+async def _make_app(tmp_path, *, resilience=None, config_extra=None,
+                    slow_s=0.0, workers=4):
+    """A served zarr image behind the full app, with an optionally
+    slowed pipeline (deterministic busy-time per tile)."""
+    path = str(tmp_path / "img.zarr")
+    write_ngff(path, IMG, chunks=(32, 32))
+    registry = ImageRegistry()
+    registry.add(1, path, type="zarr")
+    raw = {
+        "session-store": {"type": "memory"},
+        "worker_pool_size": workers,
+        "backend": {"batching": {"max-batch": 1,
+                                 "coalesce-window-ms": 0.0}},
+    }
+    if resilience:
+        raw["resilience"] = resilience
+    if config_extra:
+        raw.update(config_extra)
+    config = Config.from_dict(raw)
+    app_obj = PixelBufferApp(
+        config,
+        pixels_service=PixelsService(registry),
+        session_store=MemorySessionStore({"ck": "key"}),
+    )
+    if slow_s:
+        inner = app_obj.pipeline.handle
+
+        def slowed(ctx):
+            time.sleep(slow_s)
+            return inner(ctx)
+
+        app_obj.pipeline.handle = slowed
+    client = TestClient(
+        TestServer(app_obj.make_app()), loop=asyncio.get_running_loop()
+    )
+    await client.start_server()
+    return app_obj, client
+
+
+class TestHealthz:
+    async def test_schema_and_degraded_transition(self, tmp_path, loop):
+        app_obj, client = await _make_app(tmp_path)
+        try:
+            resp = await client.get("/healthz")  # unauthenticated
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["status"] == "ok"
+            assert {"breakers", "admission", "queue_depth",
+                    "uptime_s"} <= set(body)
+            assert body["admission"]["max_inflight"] == 256
+
+            # an open breaker flips the status to degraded
+            from omero_ms_pixel_buffer_tpu.resilience import (
+                for_dependency,
+            )
+
+            b = for_dependency(
+                "store:s3:chaos", failure_threshold=1, min_calls=100
+            )
+            b.record_failure()
+            resp = await client.get("/healthz")
+            body = await resp.json()
+            assert body["status"] == "degraded"
+            assert body["breakers"]["store:s3:chaos"]["state"] == "open"
+            del b
+        finally:
+            await client.close()
+
+    async def test_metrics_expose_resilience_counters(
+        self, tmp_path, loop
+    ):
+        _, client = await _make_app(tmp_path)
+        try:
+            text = await (await client.get("/metrics")).text()
+            for name in (
+                "resilience_breaker_state",
+                "resilience_breaker_transitions_total",
+                "resilience_shed_total",
+                "resilience_deadline_exceeded_total",
+                "resilience_retries_total",
+            ):
+                assert name in text, name
+        finally:
+            await client.close()
+
+
+class TestLoadShedding:
+    async def test_overload_sheds_503_with_retry_after(
+        self, tmp_path, loop
+    ):
+        """2x queue-capacity synthetic load: excess sheds immediately
+        with 503 + Retry-After; admitted requests stay near the
+        unloaded latency (p50 within 2x)."""
+        app_obj, client = await _make_app(
+            tmp_path,
+            resilience={"admission": {"max-inflight": 4,
+                                      "retry-after-s": 2}},
+            slow_s=0.1, workers=4,
+        )
+        try:
+            # unloaded baseline
+            unloaded = []
+            for _ in range(3):
+                t0 = time.monotonic()
+                r = await client.get("/tile/1/0/0/0?w=32&h=32",
+                                     headers=AUTH)
+                unloaded.append(time.monotonic() - t0)
+                assert r.status == 200
+            unloaded_p50 = sorted(unloaded)[1]
+
+            async def fetch():
+                t0 = time.monotonic()
+                r = await client.get("/tile/1/0/0/0?w=32&h=32",
+                                     headers=AUTH)
+                return r, time.monotonic() - t0
+
+            results = await asyncio.gather(*(fetch() for _ in range(8)))
+            admitted = [(r, dt) for r, dt in results if r.status == 200]
+            shed = [r for r, _ in results if r.status == 503]
+            assert admitted and shed  # both behaviors under overload
+            assert len(admitted) <= 4
+            for r in shed:
+                assert r.headers["Retry-After"] == "2"
+            lat = sorted(dt for _, dt in admitted)
+            admitted_p50 = lat[len(lat) // 2]
+            assert admitted_p50 <= 2 * unloaded_p50 + 0.05
+            assert app_obj.admission.shed_total == len(shed)
+
+            # load gone: the gate reopens
+            r = await client.get("/tile/1/0/0/0?w=32&h=32",
+                                 headers=AUTH)
+            assert r.status == 200
+        finally:
+            await client.close()
+
+    async def test_healthz_reachable_under_saturation(
+        self, tmp_path, loop
+    ):
+        app_obj, client = await _make_app(
+            tmp_path,
+            resilience={"admission": {"max-inflight": 1}},
+            slow_s=0.2, workers=1,
+        )
+        try:
+            tile = asyncio.ensure_future(
+                client.get("/tile/1/0/0/0?w=32&h=32", headers=AUTH)
+            )
+            await asyncio.sleep(0.05)  # tile in flight, gate full
+            r = await client.get("/healthz")
+            assert r.status == 200  # never shed
+            assert (await r.json())["admission"]["inflight"] == 1
+            assert (await tile).status == 200
+        finally:
+            await client.close()
+
+
+class TestDeadline504:
+    async def test_expired_budget_is_504_and_prompt(
+        self, tmp_path, loop
+    ):
+        """Pipeline busy-time (0.5 s) far exceeds the 100 ms request
+        budget: the front answers 504 at ~the budget, not after the
+        full pipeline time — the caller is never parked behind the
+        straggler."""
+        _, client = await _make_app(
+            tmp_path,
+            resilience={"request-budget-ms": 100},
+            slow_s=0.5, workers=1,
+        )
+        try:
+            t0 = time.monotonic()
+            r = await client.get("/tile/1/0/0/0?w=32&h=32",
+                                 headers=AUTH)
+            elapsed = time.monotonic() - t0
+            assert r.status == 504
+            assert elapsed < 0.4  # answered at the budget, not 0.5s+
+            text = await (await client.get("/metrics")).text()
+            assert "resilience_deadline_exceeded_total" in text
+            assert 'stage="bus"' in text
+        finally:
+            await client.close()
+
+    async def test_queued_expired_lane_never_reaches_executor(
+        self, tmp_path, loop
+    ):
+        """Lanes that expire while queued are failed at dispatch (504)
+        instead of occupying a worker."""
+        from omero_ms_pixel_buffer_tpu.dispatch.bus import (
+            GET_TILE_EVENT,
+        )
+
+        app_obj, client = await _make_app(tmp_path, slow_s=0.0)
+        try:
+            ctx_calls = []
+            inner = app_obj.pipeline.handle
+
+            def counting(ctx):
+                ctx_calls.append(ctx.image_id)
+                return inner(ctx)
+
+            app_obj.pipeline.handle = counting
+            from omero_ms_pixel_buffer_tpu.tile_ctx import TileCtx
+
+            ctx = TileCtx.from_params(
+                {"imageId": "1", "z": "0", "c": "0", "t": "0",
+                 "w": "32", "h": "32"}, "key",
+            )
+            clock = FakeClock()
+            ctx.deadline = Deadline(clock() - 1.0, clock)  # born dead
+            with pytest.raises(Exception) as ei:
+                await app_obj.bus.request(GET_TILE_EVENT, ctx,
+                                          timeout_ms=2000)
+            assert getattr(ei.value, "code", None) == 504
+            assert ctx_calls == []  # pipeline never touched
+        finally:
+            await client.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos: postgres flaps, zarr lane keeps serving (fault isolation)
+# ---------------------------------------------------------------------------
+
+
+class _SplitResolver:
+    """Scoped metadata façade: image 2's metadata comes from the OMERO
+    Postgres resolver (the flapping dependency); everything else from
+    the file-backed registry (no DB on its path)."""
+
+    def __init__(self, registry, db_resolver):
+        self.registry = registry
+        self.db = db_resolver
+
+    def get_pixels(self, image_id, session_key=None):
+        if int(image_id) == 2:
+            return self.db.get_pixels(
+                image_id, session_key=session_key
+            )
+        return self.registry.get_pixels(image_id)
+
+
+class TestPostgresFlapIsolation:
+    @pytest.fixture
+    def chaos_app(self, tmp_path, loop):
+        """Two images: 1 = zarr straight off the registry (healthy S3/
+        filesystem analog), 2 = zarr whose *metadata* rides the
+        Postgres resolver against a live FakePg."""
+        for img_id in (1, 2):
+            write_ngff(
+                str(tmp_path / f"{img_id}.zarr"), IMG, chunks=(32, 32)
+            )
+        registry = ImageRegistry()
+        registry.add(1, str(tmp_path / "1.zarr"), type="zarr")
+        registry.add(2, str(tmp_path / "2.zarr"), type="zarr")
+
+        def rows_for(sql, params):
+            if params and params[0] == "2":
+                return [pixels_row()]
+            return []
+
+        pg = FakePg(rows_for=rows_for)
+        loop.run_until_complete(pg.__aenter__())
+
+        raw = {
+            "session-store": {"type": "memory"},
+            "worker_pool_size": 4,
+            "backend": {"batching": {"max-batch": 1,
+                                     "coalesce-window-ms": 0.0}},
+            "resilience": {
+                # open duration far beyond the test's runtime so the
+                # open -> half_open promotion never races the asserts;
+                # the heal step force-elapses it instead of sleeping
+                "breaker": {"failure-threshold": 3, "window": 100,
+                            "min-calls": 100,
+                            "open-duration-ms": 60000},
+                "retry": {"max-attempts": 1},
+                "request-budget-ms": 2000,
+            },
+        }
+        config = Config.from_dict(raw)
+        configure_resilience(config.resilience)  # before the resolver
+        db_resolver = OmeroPostgresMetadataResolver(
+            f"postgresql://omero:pw@127.0.0.1:{pg.port}/omero",
+            cache_ttl_s=0.0,  # no caching: every request hits the DB
+        )
+        pixels_service = PixelsService(
+            registry,
+            metadata_resolver=_SplitResolver(registry, db_resolver),
+        )
+        app_obj = PixelBufferApp(
+            config,
+            pixels_service=pixels_service,
+            session_store=MemorySessionStore({"ck": "key"}),
+        )
+        client = TestClient(TestServer(app_obj.make_app()), loop=loop)
+        loop.run_until_complete(client.start_server())
+        yield app_obj, client, db_resolver
+        loop.run_until_complete(client.close())
+        db_resolver.close_sync()
+        loop.run_until_complete(pg.__aexit__(None, None, None))
+
+    async def test_flap_isolated_and_recovers(self, chaos_app):
+        app_obj, client, db_resolver = chaos_app
+        breaker = db_resolver._client.breaker
+
+        # healthy: both lanes serve
+        for img in (1, 2):
+            r = await client.get(f"/tile/{img}/0/0/0?w=32&h=32",
+                                 headers=AUTH)
+            assert r.status == 200, img
+
+        # postgres goes down hard (connection errors, deterministic)
+        INJECTOR.install(
+            "db.postgres",
+            first_n(50, ConnectionError("pg flapping")),
+        )
+        statuses = []
+        for _ in range(5):
+            r = await client.get("/tile/2/0/0/0?w=32&h=32",
+                                 headers=AUTH)
+            statuses.append(r.status)
+        # transport errors before the trip read as 404/500; once the
+        # breaker opens the lane answers a typed 503
+        assert all(s in (404, 500, 503, 504) for s in statuses)
+        assert statuses[-1] == 503  # breaker open -> unavailable
+        assert breaker.state == "open"  # trip after 3 failures
+
+        # FAULT ISOLATION: the zarr registry lane keeps serving, fast
+        t0 = time.monotonic()
+        for _ in range(5):
+            r = await client.get("/tile/1/0/0/0?w=32&h=32",
+                                 headers=AUTH)
+            assert r.status == 200
+        assert time.monotonic() - t0 < 2.0
+
+        # and the sick lane fails FAST (breaker, not timeout): the
+        # postgres edge is not consulted while open
+        fired = INJECTOR.calls("db.postgres")
+        t0 = time.monotonic()
+        r = await client.get("/tile/2/0/0/0?w=32&h=32", headers=AUTH)
+        assert r.status != 200
+        assert time.monotonic() - t0 < 0.5
+        assert INJECTOR.calls("db.postgres") == fired
+
+        # /healthz names the open breaker
+        body = await (await client.get("/healthz")).json()
+        assert body["status"] == "degraded"
+        open_deps = [
+            n for n, b in body["breakers"].items()
+            if b["state"] == "open"
+        ]
+        assert any(n.startswith("postgres:") for n in open_deps)
+
+        # heal: chaos off + open period force-elapsed (no wall-clock
+        # wait) -> the half-open probe recovers the lane end to end
+        INJECTOR.clear()
+        breaker._opened_at = float("-inf")
+        r = await client.get("/tile/2/0/0/0?w=32&h=32", headers=AUTH)
+        assert r.status == 200
+        assert breaker.state == "closed"
+
+    async def test_deadline_cuts_slow_postgres(self, chaos_app):
+        """Breaker + deadline interplay: a *slow* (not failing)
+        Postgres can't park the caller — the 2 s request budget is
+        the worst case, not the dependency's timeout."""
+        app_obj, client, db_resolver = chaos_app
+        INJECTOR.install("db.postgres", latency(5.0))
+        t0 = time.monotonic()
+        r = await client.get("/tile/2/0/0/0?w=32&h=32", headers=AUTH)
+        elapsed = time.monotonic() - t0
+        assert r.status == 504
+        assert elapsed < 3.5  # budget 2s + slack, never the 5s latency
+        # the healthy lane is untouched while the slow call drains
+        r = await client.get("/tile/1/0/0/0?w=32&h=32", headers=AUTH)
+        assert r.status == 200
+
+
+# ---------------------------------------------------------------------------
+# session store unavailability: 503, never 403
+# ---------------------------------------------------------------------------
+
+
+class TestSessionStore503:
+    async def test_breaker_open_maps_to_503_not_403(
+        self, tmp_path, loop
+    ):
+        from omero_ms_pixel_buffer_tpu.auth.stores import (
+            RedisSessionStore,
+        )
+
+        store = RedisSessionStore("redis://127.0.0.1:1/0")
+        store.breaker = CircuitBreaker(
+            "session-store", failure_threshold=1, open_duration_s=60.0,
+            min_calls=100,
+        )
+        path = str(tmp_path / "img.zarr")
+        write_ngff(path, IMG, chunks=(32, 32))
+        registry = ImageRegistry()
+        registry.add(1, path, type="zarr")
+        config = Config.from_dict({"session-store": {"type": "memory"}})
+        app_obj = PixelBufferApp(
+            config,
+            pixels_service=PixelsService(registry),
+            session_store=store,
+        )
+        client = TestClient(TestServer(app_obj.make_app()), loop=loop)
+        await client.start_server()
+        try:
+            # first hit: connection refused -> 503 (store down != auth
+            # denied), breaker records the outage
+            r = await client.get("/tile/1/0/0/0?w=8&h=8", headers=AUTH)
+            assert r.status == 503
+            # breaker now open: still 503, with Retry-After, fast
+            r = await client.get("/tile/1/0/0/0?w=8&h=8", headers=AUTH)
+            assert r.status == 503
+            assert "Retry-After" in r.headers
+        finally:
+            await client.close()

@@ -30,6 +30,8 @@ from omero_ms_pixel_buffer_tpu.io.zarr import (
     write_ngff,
 )
 
+from conftest import needs_zstd
+
 rng = np.random.default_rng(67)
 IMG = rng.integers(0, 60000, (1, 2, 2, 100, 120), dtype=np.uint16)
 
@@ -127,7 +129,14 @@ class _FakeS3Handler(_DirHandler):
 class TestCodecMatrix:
     @pytest.mark.parametrize(
         "compressor",
-        ["blosc-lz4", "blosc-zstd", "blosc-zlib", "zstd", "lz4", "zlib"],
+        [
+            "blosc-lz4",
+            pytest.param("blosc-zstd", marks=needs_zstd),
+            "blosc-zlib",
+            pytest.param("zstd", marks=needs_zstd),
+            "lz4",
+            "zlib",
+        ],
     )
     def test_pixel_exact(self, tmp_path, compressor):
         path = str(tmp_path / f"{compressor}.zarr")
@@ -152,7 +161,14 @@ class TestZarrV3:
     codec pipelines (bytes endian + gzip/zstd/blosc + crc32c)."""
 
     @pytest.mark.parametrize(
-        "compressor", [None, "zlib", "zstd", "blosc-lz4", "blosc-zstd"]
+        "compressor",
+        [
+            None,
+            "zlib",
+            pytest.param("zstd", marks=needs_zstd),
+            "blosc-lz4",
+            pytest.param("blosc-zstd", marks=needs_zstd),
+        ],
     )
     def test_pixel_exact(self, tmp_path, compressor):
         path = str(tmp_path / "v3.zarr")
@@ -169,6 +185,7 @@ class TestZarrV3:
             lv, IMG[0, 0, 0, ::2, ::2][:20, :30]
         )
 
+    @needs_zstd
     def test_crc32c_detects_corruption(self, tmp_path):
         import os
 
