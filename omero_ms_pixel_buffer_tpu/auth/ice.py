@@ -259,6 +259,7 @@ class IceSessionValidator(SessionValidator):
             raise ServiceUnavailableError(
                 str(e), retry_after_s=e.retry_after_s
             ) from None
+        t0 = time.monotonic()  # slow-call input (chaos latency included)
         try:
             await INJECTOR.fire_async("auth.ice")
             joined, _reason = await self._client.create_session(key, key)
@@ -267,7 +268,7 @@ class IceSessionValidator(SessionValidator):
         except Exception:
             self.breaker.record_failure()
             raise
-        self.breaker.record_success()
+        self.breaker.record_success(duration_s=time.monotonic() - t0)
         return joined
 
     async def _join(self, key: str) -> bool:

@@ -20,6 +20,7 @@ handler turns into a 403.
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Dict, Optional
 from urllib.parse import urlparse
 
@@ -134,6 +135,7 @@ class RedisSessionStore(OmeroWebSessionStore):
                 f"Session store unavailable: {e}",
                 retry_after_s=e.retry_after_s,
             ) from None
+        t0 = time.monotonic()  # slow-call input (chaos latency included)
         try:
             await INJECTOR.fire_async("session_store")
             result = await self._lookup(session_id)
@@ -145,9 +147,11 @@ class RedisSessionStore(OmeroWebSessionStore):
         except RuntimeError:
             # a redis error reply (_read_reply) is an answer — the
             # store is up; success also releases a half-open probe
-            self.breaker.record_success()
+            self.breaker.record_success(
+                duration_s=time.monotonic() - t0
+            )
             raise
-        self.breaker.record_success()
+        self.breaker.record_success(duration_s=time.monotonic() - t0)
         return result
 
     async def _lookup(self, session_id: str) -> Optional[str]:

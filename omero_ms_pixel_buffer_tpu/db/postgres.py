@@ -22,6 +22,7 @@ import hmac
 import logging
 import os
 import struct
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 from urllib.parse import parse_qs, unquote, urlparse
 
@@ -303,10 +304,11 @@ class PostgresClient:
         # call) must get fresh ones, not primitives whose futures
         # belong to a closed loop.
         running = asyncio.get_running_loop()
+        # ompb-lint: disable=lock-discipline -- loop-affinity check MUST precede the lock: the lock itself may belong to a closed loop and can't be awaited
         if self._loop is not None and self._loop is not running:
             await self.close_nowait()
             self._lock = asyncio.Lock()
-        self._loop = running
+        self._loop = running  # ompb-lint: disable=lock-discipline -- same pre-lock affinity bookkeeping
         try:
             self.breaker.allow()
         except BreakerOpenError as e:
@@ -314,6 +316,9 @@ class PostgresClient:
                 str(e), e.retry_after_s
             ) from None
         async with self._lock:
+            # wall time of the whole guarded exchange (injected chaos
+            # latency included): the slow-call trip rule's input
+            t0 = time.monotonic()
             try:
                 await INJECTOR.fire_async("db.postgres")
                 if self._writer is None:
@@ -336,9 +341,13 @@ class PostgresClient:
                 # is up; recording success also releases a half-open
                 # probe slot so an erroring-but-alive server can't
                 # wedge the breaker
-                self.breaker.record_success()
+                self.breaker.record_success(
+                    duration_s=time.monotonic() - t0
+                )
                 raise
-            self.breaker.record_success()
+            self.breaker.record_success(
+                duration_s=time.monotonic() - t0
+            )
             return rows
 
     async def _query_locked(self, sql, params):

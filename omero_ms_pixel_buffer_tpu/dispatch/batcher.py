@@ -101,7 +101,12 @@ class BatchingTileWorker:
             try:
                 await self._runner
             except asyncio.CancelledError:
-                pass
+                # reap the runner WE just cancelled — but if the
+                # CancelledError was aimed at close() itself (shutdown
+                # timeout cancelling cleanup mid-await), it belongs to
+                # our caller and must propagate
+                if not self._runner.cancelled():
+                    raise
             self._runner = None
         # fail queued requests FIRST (they haven't started; nothing to
         # wait for), then let in-flight executor batches finish so

@@ -48,6 +48,7 @@ from ..resilience import configure as configure_resilience
 from ..resilience.breaker import BOARD
 from ..tile_ctx import TileCtx
 from ..utils.config import Config
+from ..utils.loop_watchdog import LoopWatchdog
 from ..utils.metrics import REGISTRY
 from ..utils.tracing import TRACER, configure as configure_tracing
 
@@ -210,6 +211,18 @@ class PixelBufferApp:
             else config.event_bus_send_timeout_ms
         ) / 1000.0
         self._started_at = time.time()
+        # the runtime twin of tools/analyze's loop-block rule: a lag
+        # monitor + blocked-loop stack dumper (the Vert.x
+        # BlockedThreadChecker analog, utils/loop_watchdog.py) — armed
+        # on the serving loop at startup
+        wd = config.resilience.watchdog
+        self.watchdog = (
+            LoopWatchdog(
+                interval_s=wd.interval_ms / 1000.0,
+                warn_after_s=wd.warn_ms / 1000.0,
+            )
+            if wd.enabled else None
+        )
         # Reporter selection mirrors the reference
         # (PixelBufferMicroserviceVerticle.java:169-200): zipkin-url ->
         # batched HTTP sender; enabled without URL -> log reporter;
@@ -354,11 +367,15 @@ class PixelBufferApp:
         return app
 
     async def _on_startup(self, app) -> None:
+        if self.watchdog is not None:
+            self.watchdog.start()  # on the serving loop's thread
         await self.worker.start()
 
     async def _on_cleanup(self, app) -> None:
         # stop() analog (:298-308): worker, session store, pixel
         # buffers, then the span reporter/sender
+        if self.watchdog is not None:
+            self.watchdog.stop()
         await self.worker.close()
         await self.session_store.close()
         self.pixels_service.close()
@@ -378,9 +395,15 @@ class PixelBufferApp:
         breakers = BOARD.snapshot()
         admission = self.admission.snapshot()
         queue_depth = self.worker._queue.qsize()
+        loop_health = (
+            self.watchdog.snapshot()
+            if self.watchdog is not None
+            else {"enabled": False}
+        )
         degraded = (
             any(b["state"] == "open" for b in breakers.values())
             or admission["inflight"] >= admission["max_inflight"]
+            or loop_health.get("blocked", False)
         )
         return web.json_response(
             {
@@ -389,6 +412,7 @@ class PixelBufferApp:
                 "breakers": breakers,
                 "admission": admission,
                 "queue_depth": queue_depth,
+                "loop": loop_health,
                 "request_budget_ms": self.request_budget_s * 1000.0,
             }
         )

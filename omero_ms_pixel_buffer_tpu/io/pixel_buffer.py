@@ -103,7 +103,8 @@ class BlockCache:
 
     @property
     def nbytes(self) -> int:
-        return self._bytes
+        with self._lock:
+            return self._bytes
 
 
 @dataclasses.dataclass(frozen=True)

@@ -266,10 +266,20 @@ def _get_with_retry(
     except BreakerOpenError as e:
         raise StoreUnavailableError(str(e), e.retry_after_s) from None
 
+    # duration of the LAST attempt, for the breaker's slow-call rule:
+    # per-attempt (not per-retry-sequence) so backoff sleeps don't
+    # count, but injected chaos latency — which models a slow
+    # dependency — does (t0 precedes the injection point)
+    last_attempt_s = [0.0]
+
     def attempt() -> Tuple[int, bytes]:
-        if point is not None:
-            INJECTOR.fire(point)
-        status, body = fn()
+        t0 = time.monotonic()
+        try:
+            if point is not None:
+                INJECTOR.fire(point)
+            status, body = fn()
+        finally:
+            last_attempt_s[0] = time.monotonic() - t0
         if status in _RETRY_STATUSES:
             raise _TransientStatus(status, body)
         return status, body
@@ -288,7 +298,7 @@ def _get_with_retry(
     except (StoreError, OSError):
         breaker.record_failure()
         raise
-    breaker.record_success()
+    breaker.record_success(duration_s=last_attempt_s[0])
     return status, body
 
 

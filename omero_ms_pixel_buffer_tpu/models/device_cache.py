@@ -162,7 +162,8 @@ class DevicePlaneCache:
 
     @property
     def nbytes(self) -> int:
-        return self._bytes
+        with self._lock:
+            return self._bytes
 
     def __len__(self) -> int:
         with self._lock:

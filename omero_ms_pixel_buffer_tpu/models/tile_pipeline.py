@@ -635,6 +635,7 @@ class TilePipeline:
             )
         else:
             self._finish_png_lanes(
+                # ompb-lint: disable=jax-hotpath -- the ONE intended device->host pull of this path (filtered scanlines for the host deflate tail)
                 np.asarray(filtered), lanes, sizes, results, itemsize
             )
 
@@ -684,7 +685,11 @@ class TilePipeline:
             }
             for i, fut in futs.items():
                 try:
-                    results[i] = fut.result()
+                    # audited: this runs on a BATCHER executor thread,
+                    # never the event loop, and the futures resolve on
+                    # the separate _encode_pool — distinct pools, so
+                    # the wait cannot self-deadlock
+                    results[i] = fut.result()  # ompb-lint: disable=loop-block -- executor-thread wait on a different pool
                 except Exception:
                     log.exception("encode failed for lane %d", i)
                     results[i] = None
@@ -751,6 +756,7 @@ class TilePipeline:
                             full_cap,
                             1 << max(max_len - 1, 0).bit_length(),
                         )
+                        # ompb-lint: disable=jax-hotpath -- guess overflow: one extra pull, rare by construction (cap tracks the running max)
                         streams_np = np.asarray(streams[:, :cap])
                     self._dd_cap[(w, h)] = min(
                         full_cap,
@@ -849,6 +855,7 @@ class TilePipeline:
             )
         else:
             self._finish_png_lanes(
+                # ompb-lint: disable=jax-hotpath -- the ONE intended device->host pull of this path (filtered scanlines for the host deflate tail)
                 np.asarray(filtered), lanes, sizes, results, itemsize,
                 samples,
             )
@@ -872,6 +879,7 @@ class TilePipeline:
         arr = np.pad(tile, ((0, pad), (0, 0))) if pad else tile
         with TRACER.start_span("batch_device"):
             rows_sharded = shard_rows(mesh, jnp.asarray(arr))
+            # ompb-lint: disable=jax-hotpath -- the ONE intended device->host pull: filtered scanlines return once per plane
             filtered = np.asarray(
                 distributed_filter_plane(mesh, rows_sharded, mode="up")
             )[:h]

@@ -61,6 +61,12 @@ def configure(res_config) -> None:
         min_calls=res_config.breaker.min_calls,
         open_duration_s=res_config.breaker.open_duration_ms / 1000.0,
         half_open_probes=res_config.breaker.half_open_probes,
+        slow_call_duration_s=(
+            res_config.breaker.slow_call_duration_ms / 1000.0
+        ),
+        slow_call_rate_threshold=(
+            res_config.breaker.slow_call_rate_threshold
+        ),
     )
     set_default_policy(
         RetryPolicy(

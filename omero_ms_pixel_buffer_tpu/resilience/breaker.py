@@ -14,7 +14,12 @@ Standard three-state machine:
 - ``closed`` — calls flow; outcomes recorded. Opens on EITHER
   ``failure_threshold`` consecutive failures OR a failure rate above
   ``failure_rate_threshold`` across the last ``window`` calls (once at
-  least ``min_calls`` outcomes exist).
+  least ``min_calls`` outcomes exist) OR — when a slow-call threshold
+  is configured — a *slow-call* rate above
+  ``slow_call_rate_threshold``: a dependency that answers correctly
+  but takes ``slow_call_duration_s`` per answer is an outage in
+  everything but status code (each touch burns most of a request
+  budget), and failure counting alone would never notice it.
 - ``open`` — calls rejected instantly with ``BreakerOpenError`` until
   ``open_duration_s`` elapses.
 - ``half_open`` — up to ``half_open_probes`` trial calls pass; a
@@ -32,7 +37,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Dict
+from typing import Dict, Optional
 
 from ..utils.metrics import REGISTRY
 
@@ -47,6 +52,10 @@ BREAKER_TRANSITIONS = REGISTRY.counter(
 BREAKER_REJECTED = REGISTRY.counter(
     "resilience_breaker_rejected_total",
     "Calls rejected by an open circuit breaker",
+)
+BREAKER_SLOW = REGISTRY.counter(
+    "resilience_breaker_slow_calls_total",
+    "Successful calls that exceeded the slow-call duration threshold",
 )
 
 CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
@@ -79,6 +88,8 @@ class CircuitBreaker:
         min_calls: int = 10,
         open_duration_s: float = 30.0,
         half_open_probes: int = 1,
+        slow_call_duration_s: float = 0.0,
+        slow_call_rate_threshold: float = 1.0,
         clock=time.monotonic,
     ):
         self.name = name
@@ -88,10 +99,14 @@ class CircuitBreaker:
         self.min_calls = min_calls
         self.open_duration_s = open_duration_s
         self.half_open_probes = half_open_probes
+        # 0 disables the slow-call rule (KNOWN_GAPS r6: failures-only)
+        self.slow_call_duration_s = slow_call_duration_s
+        self.slow_call_rate_threshold = slow_call_rate_threshold
         self.clock = clock
         self._lock = threading.Lock()
         self._state = CLOSED
-        self._outcomes: deque = deque(maxlen=window)  # True = failure
+        # (failure, slow) per outcome in the sliding window
+        self._outcomes: deque = deque(maxlen=window)
         self._consecutive_failures = 0
         self._opened_at = 0.0
         self._probes_in_flight = 0
@@ -150,14 +165,39 @@ class CircuitBreaker:
                 self._probes_in_flight += 1
                 self._probe_admitted_at = self.clock()
 
-    def record_success(self) -> None:
+    def _is_slow(self, duration_s) -> bool:
+        return (
+            self.slow_call_duration_s > 0
+            and duration_s is not None
+            and duration_s >= self.slow_call_duration_s
+        )
+
+    def record_success(self, duration_s: Optional[float] = None) -> None:
+        """Record a correct answer; ``duration_s`` (when the call site
+        measures it) feeds the slow-call rule — a dependency can be
+        *up* and still unusable."""
+        slow = self._is_slow(duration_s)
+        if slow:
+            BREAKER_SLOW.inc(dependency=self.name)
         with self._lock:
             if self._state == HALF_OPEN:
+                if slow:
+                    # the probe answered, but at outage latency: the
+                    # dependency has not healed — re-open rather than
+                    # letting one slow success re-admit full traffic
+                    self._transition(OPEN)
+                    return
                 # one healthy probe closes; history restarts clean
                 self._transition(CLOSED)
                 return
             self._consecutive_failures = 0
-            self._outcomes.append(False)
+            self._outcomes.append((False, slow))
+            if slow and len(self._outcomes) >= self.min_calls:
+                rate = sum(
+                    1 for _f, s in self._outcomes if s
+                ) / len(self._outcomes)
+                if rate >= self.slow_call_rate_threshold:
+                    self._transition(OPEN)
 
     def record_failure(self) -> None:
         with self._lock:
@@ -167,26 +207,30 @@ class CircuitBreaker:
             if self._state == OPEN:
                 return
             self._consecutive_failures += 1
-            self._outcomes.append(True)
+            self._outcomes.append((True, False))
             if self._consecutive_failures >= self.failure_threshold:
                 self._transition(OPEN)
                 return
             if len(self._outcomes) >= self.min_calls:
-                rate = sum(self._outcomes) / len(self._outcomes)
+                rate = sum(
+                    1 for f, _s in self._outcomes if f
+                ) / len(self._outcomes)
                 if rate >= self.failure_rate_threshold:
                     self._transition(OPEN)
 
     # -- conveniences --------------------------------------------------
 
     def call(self, fn, *args, **kwargs):
-        """Run ``fn`` under the breaker: gate, record, re-raise."""
+        """Run ``fn`` under the breaker: gate, record (with duration,
+        so the slow-call rule sees it), re-raise."""
         self.allow()
+        t0 = self.clock()
         try:
             result = fn(*args, **kwargs)
         except Exception:
             self.record_failure()
             raise
-        self.record_success()
+        self.record_success(duration_s=self.clock() - t0)
         return result
 
     @property
@@ -217,7 +261,12 @@ class CircuitBreaker:
             return {
                 "state": state,
                 "consecutive_failures": self._consecutive_failures,
-                "window_failures": sum(self._outcomes),
+                "window_failures": sum(
+                    1 for f, _s in self._outcomes if f
+                ),
+                "window_slow": sum(
+                    1 for _f, s in self._outcomes if s
+                ),
                 "window_size": len(self._outcomes),
                 "rejected_total": self._stats["rejected"],
                 "opened_total": self._stats["opened"],
@@ -252,8 +301,9 @@ class BreakerBoard:
         self._lock = threading.Lock()
 
     def configure(self, enabled: bool = True, **defaults) -> None:
-        self.enabled = enabled
-        self.defaults = dict(defaults)
+        with self._lock:
+            self.enabled = enabled
+            self.defaults = dict(defaults)
 
     def create(self, name: str, **overrides) -> "CircuitBreaker":
         """The breaker for one dependency *name*, registered for
@@ -263,9 +313,9 @@ class BreakerBoard:
         instance — a store that fails at open time is re-constructed
         per request, and per-instance breakers would reset on every
         attempt and never trip."""
-        if not self.enabled:
-            return NULL_BREAKER
         with self._lock:
+            if not self.enabled:
+                return NULL_BREAKER
             existing = self._breakers.get(name)
             if existing is not None and not overrides:
                 return existing
@@ -307,7 +357,7 @@ class NullBreaker:
     def allow(self) -> None:
         pass
 
-    def record_success(self) -> None:
+    def record_success(self, duration_s: Optional[float] = None) -> None:
         pass
 
     def record_failure(self) -> None:

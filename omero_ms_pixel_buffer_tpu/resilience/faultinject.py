@@ -131,20 +131,42 @@ class FaultInjector:
         return schedule(n)
 
     def fire(self, point: str) -> None:
-        """Sync injection site. No-op unless a schedule is installed."""
+        """Sync injection site — for EXECUTOR-THREAD call sites only
+        (stores, the pipeline); coroutines use ``fire_async``. The
+        unlocked empty-dict read is the deliberate hot-path fast exit:
+        worst case a racing ``install`` is observed one call late,
+        which schedules (pure functions of the call index) absorb.
+        """
+        # ompb-lint: disable=lock-discipline -- intentional racy fast path: empty-dict check; a just-installed schedule lands next call
         if not self._schedules:  # fast path: chaos off
             return
         outcome = self._outcome(point)
         if outcome is None:
             return
         if isinstance(outcome, Latency):
-            time.sleep(outcome.seconds)
+            # Guard the loop: injected latency models a slow
+            # *dependency*, and sleeping on the event-loop thread
+            # would stall every concurrent lane instead — a chaos
+            # harness must not create the very failure mode the suite
+            # exists to catch. Misuse fails loudly at the test site.
+            try:
+                asyncio.get_running_loop()
+            except RuntimeError:
+                pass
+            else:
+                raise RuntimeError(
+                    f"FaultInjector.fire({point!r}) would sleep on "
+                    "the event-loop thread; use fire_async() at "
+                    "coroutine injection sites"
+                )
+            time.sleep(outcome.seconds)  # ompb-lint: disable=loop-block -- executor-thread site by contract (guarded above)
             return
         outcome.raise_()
 
     async def fire_async(self, point: str) -> None:
         """Async injection site: latency awaits, never blocks the
         loop."""
+        # ompb-lint: disable=lock-discipline -- intentional racy fast path (see fire)
         if not self._schedules:
             return
         outcome = self._outcome(point)

@@ -105,6 +105,12 @@ class BreakerConfig:
     min_calls: int = 10
     open_duration_ms: float = 30000.0
     half_open_probes: int = 1
+    # Slow-call trip rule: a call that *succeeds* slower than
+    # ``slow_call_duration_ms`` counts toward a separate rate; past
+    # ``slow_call_rate_threshold`` over the window the breaker opens.
+    # 0 disables (failures-only, the pre-r7 behavior).
+    slow_call_duration_ms: float = 0.0
+    slow_call_rate_threshold: float = 1.0
 
 
 @dataclasses.dataclass
@@ -132,6 +138,18 @@ class AdmissionConfig:
 
 
 @dataclasses.dataclass
+class WatchdogConfig:
+    """Event-loop lag watchdog (resilience.watchdog) — the Vert.x
+    BlockedThreadChecker analog (utils/loop_watchdog.py). ``warn_ms``
+    is the blocked threshold past which the loop thread's stack is
+    logged; lag histograms export regardless."""
+
+    enabled: bool = True
+    interval_ms: float = 100.0
+    warn_ms: float = 1000.0
+
+
+@dataclasses.dataclass
 class ResilienceConfig:
     """The resilience: block — one policy surface for breakers,
     retries, deadlines, and admission control (resilience/ package).
@@ -143,6 +161,9 @@ class ResilienceConfig:
     retry: RetryConfig = dataclasses.field(default_factory=RetryConfig)
     admission: AdmissionConfig = dataclasses.field(
         default_factory=AdmissionConfig
+    )
+    watchdog: WatchdogConfig = dataclasses.field(
+        default_factory=WatchdogConfig
     )
     request_budget_ms: Optional[float] = None
 
@@ -226,6 +247,7 @@ class Config:
         br = res_raw.get("breaker") or {}
         rt = res_raw.get("retry") or {}
         ad = res_raw.get("admission") or {}
+        wd = res_raw.get("watchdog") or {}
 
         def _num(block: dict, key: str, default, minimum, cast=float):
             try:
@@ -246,6 +268,12 @@ class Config:
             raise ConfigError(
                 "'resilience.breaker.failure-rate-threshold' must be "
                 "in [0, 1]"
+            )
+        slow_rate = _num(br, "slow-call-rate-threshold", 1.0, 0.0)
+        if slow_rate > 1.0:
+            raise ConfigError(
+                "'resilience.breaker.slow-call-rate-threshold' must "
+                "be in [0, 1]"
             )
         jitter = _num(rt, "jitter", 0.5, 0.0)
         if jitter > 1.0:
@@ -273,6 +301,10 @@ class Config:
                 min_calls=min_calls,
                 open_duration_ms=_num(br, "open-duration-ms", 30000.0, 0.0),
                 half_open_probes=_num(br, "half-open-probes", 1, 1, int),
+                slow_call_duration_ms=_num(
+                    br, "slow-call-duration-ms", 0.0, 0.0
+                ),
+                slow_call_rate_threshold=slow_rate,
             ),
             retry=RetryConfig(
                 max_attempts=_num(rt, "max-attempts", 3, 1, int),
@@ -284,6 +316,11 @@ class Config:
             admission=AdmissionConfig(
                 max_inflight=_num(ad, "max-inflight", 256, 1, int),
                 retry_after_s=_num(ad, "retry-after-s", 1.0, 0.0),
+            ),
+            watchdog=WatchdogConfig(
+                enabled=bool(wd.get("enabled", True)),
+                interval_ms=_num(wd, "interval-ms", 100.0, 1.0),
+                warn_ms=_num(wd, "warn-ms", 1000.0, 1.0),
             ),
             request_budget_ms=(
                 None if budget is None

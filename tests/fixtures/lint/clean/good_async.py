@@ -1,0 +1,30 @@
+"""Clean corpus: async code that blocks ONLY behind executor hops —
+ompb-lint must report nothing here."""
+
+import asyncio
+import time
+
+
+def blocking_helper():
+    time.sleep(0.1)
+
+
+async def fetch(loop):
+    await asyncio.sleep(0.01)
+    await loop.run_in_executor(None, blocking_helper)
+
+
+async def inline_lambda(loop):
+    return await loop.run_in_executor(None, lambda: time.sleep(0.2))
+
+
+async def named_nested(loop):
+    def work():
+        time.sleep(0.2)
+
+    return await loop.run_in_executor(None, work)
+
+
+async def via_assigned_lambda(loop):
+    work = lambda: time.sleep(0.2)  # noqa: E731
+    return await loop.run_in_executor(None, work)

@@ -1,0 +1,15 @@
+# ompb-lint: scope=error-taxonomy
+"""Clean corpus: taxonomy-mapped raises, cancellation propagates."""
+
+import asyncio
+
+
+async def worker(q):
+    try:
+        await q.get()
+    except asyncio.CancelledError:
+        raise
+
+
+def handler(image_id):
+    raise NotFoundError(f"Cannot find Image:{image_id}")  # noqa: F821

@@ -1,0 +1,30 @@
+# ompb-lint: scope=jax-hotpath
+"""Clean corpus: device values pulled once through jax.device_get,
+jit at module level or behind a module-level cache."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnums=(1,))
+def roll_rows(x, k):
+    return jnp.roll(x, k, axis=0)
+
+
+_fn_cache: dict = {}
+
+
+def cached_jit(x):
+    fn = _fn_cache.get("fn")
+    if fn is None:
+        fn = jax.jit(lambda v: v * 2)
+        _fn_cache["fn"] = fn
+    return fn(x)
+
+
+def single_pull(x):
+    y = jnp.abs(x)
+    total, host = jax.device_get((y.sum(), y))
+    return int(total), host

@@ -1,0 +1,30 @@
+"""Clean corpus: every mutable shared attribute stays under the lock;
+immutable config attrs set once in __init__ don't need it. A helper
+only ever called with the lock held is recognized as lock-held."""
+
+import threading
+
+
+class GoodQueue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+        self.limit = 10  # immutable after __init__
+
+    def add(self, x):
+        with self._lock:
+            if len(self.items) < self.limit:
+                self.items.append(x)
+            self._trim()
+
+    def _trim(self):
+        # callers hold self._lock
+        while len(self.items) > self.limit:
+            self.items.pop()
+
+    def size(self):
+        with self._lock:
+            return len(self.items)
+
+    def cap(self):
+        return self.limit

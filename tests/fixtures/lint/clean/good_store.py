@@ -1,0 +1,37 @@
+# ompb-lint: scope=resilience-coverage
+"""Clean corpus: the remote GET flows through a breaker gate and a
+fault-injection point (in a caller — guard markers propagate over the
+module-local call graph)."""
+
+import http.client
+
+
+class _Breaker:
+    def allow(self):
+        pass
+
+    def record_success(self, duration_s=None):
+        pass
+
+
+class _Injector:
+    def fire(self, point):
+        pass
+
+
+breaker = _Breaker()
+INJECTOR = _Injector()
+
+
+def raw_get(host, key):
+    conn = http.client.HTTPConnection(host)
+    conn.request("GET", "/" + key)
+    return conn.getresponse().read()
+
+
+def guarded_get(host, key):
+    breaker.allow()
+    INJECTOR.fire("store.fixture")
+    body = raw_get(host, key)
+    breaker.record_success()
+    return body

@@ -1,0 +1,24 @@
+# ompb-lint: scope=error-taxonomy
+"""Seeded error-taxonomy violations: a bare except, a swallowed
+CancelledError, and an exception with no HTTP status mapping raised
+on a (fixture) request path."""
+
+import asyncio
+
+
+def parse(raw):
+    try:
+        return int(raw)
+    except:  # SEEDED: error-taxonomy (bare except)  # noqa: E722
+        return None
+
+
+async def worker(q):
+    try:
+        await q.get()
+    except asyncio.CancelledError:  # SEEDED: error-taxonomy (swallowed)
+        pass
+
+
+def handler(image_id):
+    raise KeyError(image_id)  # SEEDED: error-taxonomy (unmapped)

@@ -1,0 +1,32 @@
+"""Seeded loop-block violations (never imported — parsed by ompb-lint
+in tests/test_lint.py). One violation per async function below."""
+
+import subprocess
+import time
+
+
+def helper():
+    # not a violation by itself: sync helpers may block — the rule
+    # fires where an ASYNC caller reaches this without an executor hop
+    time.sleep(0.5)
+
+
+async def direct_sleep():
+    time.sleep(1)  # SEEDED: loop-block (direct)
+
+
+async def indirect_sleep():
+    helper()  # SEEDED: loop-block (via the intra-module call graph)
+
+
+async def future_wait(fut):
+    return fut.result()  # SEEDED: loop-block (blocking Future.result)
+
+
+async def sync_read(path):
+    with open(path) as f:  # SEEDED: loop-block (sync file I/O)
+        return f.read()
+
+
+async def shell_out():
+    subprocess.run(["ls"])  # SEEDED: loop-block (subprocess)

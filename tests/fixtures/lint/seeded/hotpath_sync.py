@@ -1,0 +1,23 @@
+# ompb-lint: scope=jax-hotpath
+"""Seeded jax-hotpath violations: a host sync on a device value, an
+explicit block_until_ready, and a per-call jit."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sync_pull(x):
+    y = jnp.abs(x)
+    return np.asarray(y)  # SEEDED: jax-hotpath (host sync)
+
+
+def eager_wait(x):
+    y = jnp.abs(x)
+    y.block_until_ready()  # SEEDED: jax-hotpath (full device sync)
+    return y
+
+
+def per_call_jit(x):
+    fn = jax.jit(lambda v: v + 1)  # SEEDED: jax-hotpath (re-traces per call)
+    return fn(x)

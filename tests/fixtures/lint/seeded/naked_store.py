@@ -1,0 +1,11 @@
+# ompb-lint: scope=resilience-coverage
+"""Seeded resilience-coverage violation: a remote GET with no circuit
+breaker and no fault-injection point on any caller path."""
+
+import http.client
+
+
+def naked_get(host, key):
+    conn = http.client.HTTPConnection(host)  # SEEDED: resilience-coverage
+    conn.request("GET", "/" + key)
+    return conn.getresponse().read()

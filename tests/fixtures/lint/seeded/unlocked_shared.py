@@ -1,0 +1,19 @@
+"""Seeded lock-discipline violation: ``items`` is mutated under the
+lock in ``add`` but drained without it in ``drain``."""
+
+import threading
+
+
+class SharedQueue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+
+    def add(self, x):
+        with self._lock:
+            self.items.append(x)
+
+    def drain(self):
+        out = list(self.items)  # SEEDED: lock-discipline
+        self.items.clear()  # SEEDED: lock-discipline
+        return out

@@ -1,0 +1,14 @@
+"""Suppression fixture: the violation is real but carries an inline
+rule-scoped suppression — ompb-lint must count it as suppressed, not
+as a finding."""
+
+import time
+
+
+async def justified():
+    time.sleep(0.001)  # ompb-lint: disable=loop-block -- fixture: deliberate, justified inline
+
+
+async def standalone_comment_form():
+    # ompb-lint: disable=loop-block -- fixture: comment-above form
+    time.sleep(0.001)

@@ -1,0 +1,196 @@
+"""ompb-lint (tools/analyze) — detection, precision, and policy.
+
+Three contracts:
+
+- every seeded violation in ``tests/fixtures/lint/seeded`` is caught
+  by its rule (detection);
+- the clean corpus produces ZERO findings (precision — a linter that
+  cries wolf gets deleted from CI within a month);
+- the escape hatches behave: inline suppressions count as suppressed,
+  the baseline hides exactly what it lists, and hot-path modules are
+  REFUSED baseline entries.
+
+Plus the acceptance bar itself: the repo is clean under the checked-in
+baseline — the same invariant the CI ``lint`` job enforces via
+``python -m tools.analyze``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tools.analyze import run_paths, write_baseline
+from tools.analyze.core import REPO_ROOT
+
+FIXTURES = Path(__file__).resolve().parent / "fixtures" / "lint"
+SEEDED = str(FIXTURES / "seeded")
+CLEAN = str(FIXTURES / "clean")
+SUPPRESSED = str(FIXTURES / "suppressed")
+
+
+def _by_file(report):
+    out = {}
+    for f in report.findings:
+        out.setdefault(os.path.basename(f.path), []).append(f)
+    return out
+
+
+class TestSeededViolations:
+    @pytest.fixture(scope="class")
+    def seeded(self):
+        return _by_file(run_paths([SEEDED], baseline_path=None))
+
+    def test_loop_block_direct_and_indirect(self, seeded):
+        found = seeded["blocking_async.py"]
+        assert all(f.rule == "loop-block" for f in found)
+        messages = " | ".join(f.message for f in found)
+        # one per seeded async function: direct sleep, call-graph
+        # reach, Future.result, open(), subprocess
+        assert len(found) == 5
+        assert "time.sleep" in messages
+        assert "helper() -> time.sleep" in messages
+        assert "Future.result" in messages
+        assert "sync file open" in messages
+        assert "subprocess" in messages
+
+    def test_lock_discipline(self, seeded):
+        found = seeded["unlocked_shared.py"]
+        assert found and all(f.rule == "lock-discipline" for f in found)
+        assert any(
+            "SharedQueue.items" in f.message and "'drain'" in f.message
+            for f in found
+        )
+
+    def test_resilience_coverage(self, seeded):
+        found = seeded["naked_store.py"]
+        assert [f.rule for f in found] == ["resilience-coverage"]
+        assert "HTTPConnection" in found[0].message
+
+    def test_jax_hotpath(self, seeded):
+        found = seeded["hotpath_sync.py"]
+        assert all(f.rule == "jax-hotpath" for f in found)
+        messages = " | ".join(f.message for f in found)
+        assert "np.asarray(...)" in messages       # host sync
+        assert "block_until_ready" in messages     # full sync
+        assert "re-traces" in messages             # per-call jit
+
+    def test_error_taxonomy(self, seeded):
+        found = seeded["bad_errors.py"]
+        assert all(f.rule == "error-taxonomy" for f in found)
+        messages = " | ".join(f.message for f in found)
+        assert "bare 'except:'" in messages
+        assert "CancelledError" in messages
+        assert "'KeyError'" in messages
+
+    def test_every_rule_fired(self, seeded):
+        fired = {f.rule for fs in seeded.values() for f in fs}
+        assert fired == {
+            "loop-block", "lock-discipline", "resilience-coverage",
+            "jax-hotpath", "error-taxonomy",
+        }
+
+
+class TestPrecision:
+    def test_clean_corpus_no_false_positives(self):
+        report = run_paths([CLEAN], baseline_path=None)
+        assert report.findings == [], [
+            f.format() for f in report.findings
+        ]
+
+    def test_inline_suppressions(self):
+        report = run_paths([SUPPRESSED], baseline_path=None)
+        assert report.findings == []
+        # both spellings (same-line and comment-above) counted
+        assert len(report.suppressed) == 2
+        assert all(f.rule == "loop-block" for f in report.suppressed)
+
+
+class TestBaseline:
+    def test_roundtrip(self, tmp_path):
+        baseline = str(tmp_path / "baseline.json")
+        dirty = run_paths([SEEDED], baseline_path=None)
+        assert dirty.findings
+        written, hot = write_baseline([SEEDED], baseline_path=baseline)
+        assert written == len(dirty.findings) and not hot
+        clean = run_paths([SEEDED], baseline_path=baseline)
+        assert clean.findings == []
+        assert len(clean.baselined) == written
+
+    def test_baseline_does_not_hide_new_findings(self, tmp_path):
+        baseline = str(tmp_path / "baseline.json")
+        write_baseline([SEEDED], baseline_path=baseline)
+        # a finding the baseline has never seen stays live
+        extra = tmp_path / "extra.py"
+        extra.write_text(
+            "import time\n\nasync def fresh():\n    time.sleep(1)\n"
+        )
+        report = run_paths(
+            [SEEDED, str(extra)], baseline_path=baseline
+        )
+        assert [f.rule for f in report.findings] == ["loop-block"]
+
+    def test_hot_path_refused(self, tmp_path):
+        root = tmp_path
+        hot_dir = root / "omero_ms_pixel_buffer_tpu" / "models"
+        hot_dir.mkdir(parents=True)
+        bad = hot_dir / "bad.py"
+        bad.write_text(
+            "import time\n\nasync def f():\n    time.sleep(1)\n"
+        )
+        baseline = str(tmp_path / "baseline.json")
+        written, hot = write_baseline(
+            ["omero_ms_pixel_buffer_tpu/models/bad.py"],
+            baseline_path=baseline, root=str(root),
+        )
+        assert written == 0
+        assert hot and hot[0].rule == "loop-block"
+        assert not os.path.exists(baseline)
+
+
+class TestRepoIsClean:
+    def test_package_has_no_unsuppressed_findings(self):
+        """The acceptance criterion: ``python -m tools.analyze`` exits
+        0 on the repo — every live finding has been fixed, justified
+        inline, or (non-hot-path only) baselined."""
+        report = run_paths()  # default paths + checked-in baseline
+        assert report.findings == [], "\n" + "\n".join(
+            f.format() for f in report.findings
+        )
+
+    def test_baseline_entries_all_match_reality(self):
+        """Stale baseline entries (code fixed but entry kept) must be
+        pruned so the debt list tracks reality."""
+        from tools.analyze.core import load_baseline
+
+        report = run_paths()
+        assert len(report.baselined) == len(load_baseline())
+
+
+class TestCli:
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "tools.analyze", *args],
+            cwd=REPO_ROOT, capture_output=True, text=True,
+        )
+
+    def test_exit_codes(self):
+        assert self._run(CLEAN).returncode == 0
+        dirty = self._run(SEEDED)
+        assert dirty.returncode == 1
+        assert "loop-block" in dirty.stdout
+
+    def test_json_output(self):
+        proc = self._run(SEEDED, "--json")
+        data = json.loads(proc.stdout)
+        assert data["findings"] and all(
+            {"rule", "path", "line", "message"} <= set(f)
+            for f in data["findings"]
+        )
+
+    def test_repo_gate(self):
+        """Exactly what CI runs."""
+        assert self._run().returncode == 0

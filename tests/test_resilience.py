@@ -210,8 +210,143 @@ class TestCircuitBreaker:
         snap = b.snapshot()
         assert snap["state"] == "closed"
         assert snap["consecutive_failures"] == 1
-        assert {"window_failures", "rejected_total",
+        assert {"window_failures", "window_slow", "rejected_total",
                 "opened_total"} <= set(snap)
+
+
+class TestSlowCallRule:
+    """Latency-based trips (KNOWN_GAPS r6 closed): a dependency that
+    answers correctly but at outage latency opens the breaker."""
+
+    def _breaker(self, clock, **kw):
+        kw.setdefault("failure_threshold", 100)  # isolate the rule
+        kw.setdefault("slow_call_duration_s", 0.5)
+        kw.setdefault("slow_call_rate_threshold", 0.5)
+        kw.setdefault("window", 10)
+        kw.setdefault("min_calls", 4)
+        kw.setdefault("open_duration_s", 10.0)
+        return CircuitBreaker("slowdep", clock=clock, **kw)
+
+    def test_slow_successes_trip(self):
+        b = self._breaker(FakeClock())
+        for _ in range(4):
+            b.allow()
+            b.record_success(duration_s=1.0)  # 2x the threshold
+        assert b.state == "open"
+        assert b.snapshot()["window_slow"] == 4
+
+    def test_fast_successes_stay_closed(self):
+        b = self._breaker(FakeClock())
+        for _ in range(20):
+            b.allow()
+            b.record_success(duration_s=0.01)
+        assert b.state == "closed"
+
+    def test_rate_below_threshold_stays_closed(self):
+        b = self._breaker(FakeClock())
+        # 1-in-4 slow: the windowed rate never reaches 0.5 at any
+        # evaluation point (evaluations happen on slow outcomes once
+        # min_calls outcomes exist)
+        for i in range(12):
+            b.allow()
+            b.record_success(duration_s=1.0 if i % 4 == 0 else 0.01)
+        assert b.state == "closed"
+
+    def test_disabled_by_default(self):
+        b = CircuitBreaker(
+            "dep", clock=FakeClock(), failure_threshold=100,
+            min_calls=2, window=4,
+        )
+        for _ in range(8):
+            b.allow()
+            b.record_success(duration_s=100.0)
+        assert b.state == "closed"  # slow_call_duration_s=0: off
+
+    def test_unmeasured_calls_never_count_slow(self):
+        b = self._breaker(FakeClock())
+        for _ in range(10):
+            b.allow()
+            b.record_success()  # call site doesn't time: no verdict
+        assert b.state == "closed"
+
+    def test_half_open_slow_probe_reopens(self):
+        clock = FakeClock()
+        b = self._breaker(clock)
+        for _ in range(4):
+            b.allow()
+            b.record_success(duration_s=1.0)
+        assert b.state == "open"
+        clock.advance(10.1)
+        b.allow()  # probe admitted
+        b.record_success(duration_s=1.0)  # answered... at outage latency
+        assert b.state == "open"  # NOT healed
+        clock.advance(10.1)
+        b.allow()
+        b.record_success(duration_s=0.01)  # a genuinely fast probe
+        assert b.state == "closed"
+
+    def test_call_convenience_measures_duration(self):
+        clock = FakeClock()
+        b = self._breaker(clock, min_calls=2, window=4)
+
+        def slow_fn():
+            clock.advance(1.0)  # the call itself burns injected time
+            return "ok"
+
+        for _ in range(2):
+            assert b.call(slow_fn) == "ok"
+        assert b.state == "open"
+
+    def test_store_get_trips_on_injected_latency(self):
+        """End to end through the store wrapper: chaos latency on the
+        injection point counts as dependency latency, and a uniformly
+        slow store opens its breaker -> fail-fast
+        StoreUnavailableError."""
+        from omero_ms_pixel_buffer_tpu.io.stores import _get_with_retry
+
+        INJECTOR.install("store.s3", latency(0.03))
+        b = CircuitBreaker(
+            "s3-slow", failure_threshold=100, min_calls=2, window=4,
+            slow_call_duration_s=0.01, slow_call_rate_threshold=0.5,
+        )
+        for _ in range(2):
+            status, _body = _get_with_retry(
+                lambda: (200, b"chunk"), breaker=b, point="store.s3",
+            )
+            assert status == 200
+        assert b.state == "open"
+        with pytest.raises(StoreUnavailableError):
+            _get_with_retry(
+                lambda: (200, b"chunk"), breaker=b, point="store.s3",
+            )
+
+    def test_config_knobs_flow_to_board(self):
+        config = Config.from_dict({
+            "session-store": {"type": "memory"},
+            "resilience": {"breaker": {
+                "slow-call-duration-ms": 250,
+                "slow-call-rate-threshold": 0.6,
+            }},
+        })
+        assert config.resilience.breaker.slow_call_duration_ms == 250
+        assert (
+            config.resilience.breaker.slow_call_rate_threshold == 0.6
+        )
+        configure_resilience(config.resilience)
+        b = BOARD.create("slow-configured")
+        assert b.slow_call_duration_s == pytest.approx(0.25)
+        assert b.slow_call_rate_threshold == pytest.approx(0.6)
+
+    def test_config_rejects_bad_rate(self):
+        from omero_ms_pixel_buffer_tpu.utils.config import ConfigError
+
+        with pytest.raises(ConfigError):
+            Config.from_dict({
+                "session-store": {"type": "memory"},
+                "resilience": {"breaker": {
+                    "slow-call-rate-threshold": 1.5,
+                }},
+            })
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,3 @@
+"""Developer tooling that ships with the repo (not part of the
+serving package). ``tools.analyze`` is ompb-lint, the project-specific
+static-analysis pass wired into CI."""

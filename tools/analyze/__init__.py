@@ -1,0 +1,97 @@
+"""ompb-lint — project-specific AST invariant checker.
+
+Run ``python -m tools.analyze`` from the repo root (CI runs it as a
+blocking job). See ``core.py`` for the suppression/baseline model and
+``checkers.py`` for the rules.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from . import core
+from .callgraph import build_indexes
+from .checkers import ALL_CHECKERS
+from .core import (  # noqa: F401  (public surface)
+    BASELINE_PATH,
+    Finding,
+    Project,
+    discover,
+    is_hot_path,
+)
+
+#: What a plain ``python -m tools.analyze`` scans.
+DEFAULT_PATHS = ["omero_ms_pixel_buffer_tpu"]
+
+
+@dataclasses.dataclass
+class Report:
+    findings: List[Finding]          # unsuppressed, non-baselined
+    suppressed: List[Finding]
+    baselined: List[Finding]
+    project: Project
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+
+def run_paths(
+    paths: Optional[List[str]] = None,
+    rules: Optional[List[str]] = None,
+    baseline_path: Optional[str] = core.BASELINE_PATH,
+    root: str = core.REPO_ROOT,
+) -> Report:
+    """Analyze ``paths`` and split raw findings into live / suppressed
+    / baselined. ``baseline_path=None`` disables the baseline."""
+    project = discover(paths or DEFAULT_PATHS, root=root)
+    indexes = build_indexes(project)
+    raw: List[Finding] = []
+    for sf in project.files:
+        if sf.parse_error:
+            raw.append(
+                Finding("parse", sf.path, 1, sf.parse_error)
+            )
+    for rule, checker in ALL_CHECKERS.items():
+        if rules and rule not in rules:
+            continue
+        raw.extend(checker(project, indexes))
+    raw.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+
+    live: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in raw:
+        sf = project.by_path.get(f.path)
+        if sf is not None and sf.suppressed(f.rule, f.line):
+            suppressed.append(f)
+        else:
+            live.append(f)
+
+    baselined: List[Finding] = []
+    if baseline_path is not None:
+        baseline = core.load_baseline(baseline_path)
+        new, _used = core.apply_baseline(live, project, baseline)
+        baselined = [f for f in live if f not in new]
+        live = new
+    return Report(live, suppressed, baselined, project)
+
+
+def write_baseline(
+    paths: Optional[List[str]] = None,
+    baseline_path: str = core.BASELINE_PATH,
+    root: str = core.REPO_ROOT,
+) -> Tuple[int, List[Finding]]:
+    """Accept today's findings as the new baseline. Hot-path findings
+    are REFUSED (returned as the second element with count 0 written)
+    — serving modules fix or inline-suppress, they don't accrue debt."""
+    report = run_paths(paths, baseline_path=None, root=root)
+    hot = [f for f in report.findings if is_hot_path(f.path)]
+    if hot:
+        return 0, hot
+    entries = []
+    for f in report.findings:
+        sf = report.project.by_path.get(f.path)
+        entries.append((f, sf.context(f.line) if sf else ""))
+    core.save_baseline(entries, baseline_path)
+    return len(entries), []

@@ -1,0 +1,86 @@
+"""CLI: ``python -m tools.analyze [paths...] [--baseline] [...]``.
+
+Exit codes: 0 clean (no unsuppressed, non-baselined findings);
+1 findings; 2 usage / refused baseline write.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import DEFAULT_PATHS, run_paths, write_baseline
+from .core import BASELINE_PATH
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description="ompb-lint: AST invariant checker for this repo",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=None,
+        help=f"files/dirs to analyze (default: {DEFAULT_PATHS})",
+    )
+    parser.add_argument(
+        "--baseline", action="store_true",
+        help="accept current findings into the baseline file "
+        "(refused for hot-path modules)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="report findings the baseline would otherwise hide",
+    )
+    parser.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="machine-readable output",
+    )
+    args = parser.parse_args(argv)
+    paths = args.paths or None
+    rules = (
+        [r.strip() for r in args.rules.split(",") if r.strip()]
+        if args.rules else None
+    )
+
+    if args.baseline:
+        written, hot = write_baseline(paths)
+        if hot:
+            print(
+                "REFUSED: hot-path modules may not be baselined — fix "
+                "or inline-suppress these first:", file=sys.stderr,
+            )
+            for f in hot:
+                print(f"  {f.format()}", file=sys.stderr)
+            return 2
+        print(f"baseline written: {written} finding(s) -> {BASELINE_PATH}")
+        return 0
+
+    report = run_paths(
+        paths, rules=rules,
+        baseline_path=None if args.no_baseline else BASELINE_PATH,
+    )
+    if args.as_json:
+        print(json.dumps({
+            "findings": [vars(f) for f in report.findings],
+            "suppressed": [vars(f) for f in report.suppressed],
+            "baselined": [vars(f) for f in report.baselined],
+        }, indent=2))
+    else:
+        for f in report.findings:
+            print(f.format())
+        print(
+            f"ompb-lint: {len(report.findings)} finding(s), "
+            f"{len(report.suppressed)} suppressed, "
+            f"{len(report.baselined)} baselined, "
+            f"{len(report.project.files)} file(s)"
+        )
+    return 0 if report.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

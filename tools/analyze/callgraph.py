@@ -1,0 +1,224 @@
+"""Conservative intra-module/intra-package call graph.
+
+Resolution is deliberately name-based and local — the goal is a
+linter that never hallucinates edges across unrelated objects, not a
+whole-program points-to analysis:
+
+- **strict** edges (loop-block): a bare name resolves to a function
+  defined at module level in the same module; ``self.m`` resolves to a
+  method of the enclosing class; ``OBJ.m`` resolves through
+  module-level ``OBJ = ClassName()`` singletons (the REGISTRY/INJECTOR
+  pattern this codebase uses everywhere).
+- **loose** edges (resilience-coverage): any function or method in the
+  same module whose bare name matches the call's attribute tail. That
+  over-connects (``.get`` matches every ``get``), which is safe for a
+  reachability argument that only *admits* guard markers.
+
+Calls that appear inside arguments to ``run_in_executor`` /
+``asyncio.to_thread`` / executor ``submit`` — including lambdas and
+local functions passed by name — are tagged ``in_executor``: they run
+on a pool thread, so blocking there is the *correct* pattern, not a
+loop hazard.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Project, SourceFile
+
+EXECUTOR_ENTRYPOINTS = {"run_in_executor", "to_thread", "submit"}
+
+
+@dataclasses.dataclass
+class CallSite:
+    base: Optional[str]  # "self" | base identifier | dotted | None (bare name)
+    name: str            # attribute tail or bare name
+    line: int
+    in_executor: bool
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    module: str          # repo-relative path
+    qualname: str        # "path::Class.method" / "path::func"
+    name: str
+    class_name: Optional[str]
+    node: ast.AST
+    is_async: bool
+    lineno: int
+    calls: List[CallSite] = dataclasses.field(default_factory=list)
+
+
+def _base_of(func: ast.expr) -> Tuple[Optional[str], Optional[str]]:
+    """(base, name) of a call's callee expression."""
+    if isinstance(func, ast.Name):
+        return None, func.id
+    if isinstance(func, ast.Attribute):
+        value = func.value
+        if isinstance(value, ast.Name):
+            return value.id, func.attr
+        if isinstance(value, ast.Attribute) and isinstance(
+            value.value, ast.Name
+        ):
+            return f"{value.value.id}.{value.attr}", func.attr
+        return "<expr>", func.attr
+    return None, None
+
+
+class _FunctionScanner:
+    """Collect every call in a function body, tracking executor args.
+
+    Lambdas fold into the enclosing function. Nested ``def``s are kept
+    as part of the parent (they execute in the parent's context when
+    called there), EXCEPT when their name is passed to an executor —
+    then their calls are tagged ``in_executor``.
+    """
+
+    def __init__(self, fn: FunctionInfo):
+        self.fn = fn
+        self.executor_names: Set[str] = set()
+        self._collect_executor_names(fn.node)
+
+    def _collect_executor_names(self, root: ast.AST) -> None:
+        # names (plain identifiers) passed as args to executor entry
+        # points anywhere in the body; lambdas assigned to a name that
+        # is later passed also count via the name
+        for node in ast.walk(root):
+            if isinstance(node, ast.Call):
+                _, name = _base_of(node.func)
+                if name in EXECUTOR_ENTRYPOINTS:
+                    for arg in list(node.args) + [
+                        kw.value for kw in node.keywords
+                    ]:
+                        if isinstance(arg, ast.Name):
+                            self.executor_names.add(arg.id)
+
+    def scan(self) -> None:
+        body = getattr(self.fn.node, "body", [])
+        for stmt in body:
+            self._visit(stmt, in_exec=False)
+
+    def _visit(self, node: ast.AST, in_exec: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            nested_exec = in_exec or node.name in self.executor_names
+            for stmt in node.body:
+                self._visit(stmt, nested_exec)
+            return
+        if isinstance(node, ast.Lambda):
+            # a lambda assigned to an executor-passed name runs on the
+            # pool; detection is by the surrounding Assign, handled in
+            # the generic path below (we can't see our target here), so
+            # approximate: lambdas only flip context inside executor
+            # call args (handled in ast.Call) — recurse as-is
+            self._visit(node.body, in_exec)
+            return
+        if isinstance(node, ast.Call):
+            base, name = _base_of(node.func)
+            if name is not None:
+                self.fn.calls.append(
+                    CallSite(base, name, node.lineno, in_exec)
+                )
+            arg_exec = in_exec or (name in EXECUTOR_ENTRYPOINTS)
+            self._visit(node.func, in_exec)
+            for arg in node.args:
+                self._visit(arg, arg_exec)
+            for kw in node.keywords:
+                self._visit(kw.value, arg_exec)
+            return
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Lambda
+        ):
+            # `work = lambda: ...` later passed to an executor: the
+            # lambda body belongs to the pool thread
+            targets = {
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            }
+            lam_exec = in_exec or bool(targets & self.executor_names)
+            self._visit(node.value.body, lam_exec)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, in_exec)
+
+
+class ModuleIndex:
+    """Functions/methods of one module plus local resolution tables."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.functions: List[FunctionInfo] = []
+        self.by_bare_name: Dict[str, List[FunctionInfo]] = {}
+        self.methods: Dict[Tuple[str, str], FunctionInfo] = {}
+        self.module_level: Dict[str, FunctionInfo] = {}
+        self.instances: Dict[str, str] = {}  # var -> ClassName
+        if sf.tree is None:
+            return
+        self._index(sf.tree)
+        for fn in self.functions:
+            _FunctionScanner(fn).scan()
+
+    def _index(self, tree: ast.AST) -> None:
+        for node in tree.body:  # type: ignore[attr-defined]
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add(node, class_name=None)
+            elif isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(
+                        item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        self._add(item, class_name=node.name)
+            elif isinstance(node, ast.Assign):
+                # module-level singletons: INJECTOR = FaultInjector()
+                if (
+                    isinstance(node.value, ast.Call)
+                    and isinstance(node.value.func, ast.Name)
+                    and node.value.func.id[:1].isupper()
+                ):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.instances[t.id] = node.value.func.id
+
+    def _add(self, node, class_name: Optional[str]) -> None:
+        qual = (
+            f"{self.sf.path}::{class_name}.{node.name}"
+            if class_name
+            else f"{self.sf.path}::{node.name}"
+        )
+        fn = FunctionInfo(
+            module=self.sf.path,
+            qualname=qual,
+            name=node.name,
+            class_name=class_name,
+            node=node,
+            is_async=isinstance(node, ast.AsyncFunctionDef),
+            lineno=node.lineno,
+        )
+        self.functions.append(fn)
+        self.by_bare_name.setdefault(node.name, []).append(fn)
+        if class_name is None:
+            self.module_level[node.name] = fn
+        else:
+            self.methods[(class_name, node.name)] = fn
+
+    # -- resolution ----------------------------------------------------
+
+    def resolve_strict(
+        self, caller: FunctionInfo, call: CallSite
+    ) -> Optional[FunctionInfo]:
+        if call.base is None:
+            return self.module_level.get(call.name)
+        if call.base == "self" and caller.class_name is not None:
+            return self.methods.get((caller.class_name, call.name))
+        cls = self.instances.get(call.base)
+        if cls is not None:
+            return self.methods.get((cls, call.name))
+        return None
+
+    def resolve_loose(self, call: CallSite) -> List[FunctionInfo]:
+        return self.by_bare_name.get(call.name, [])
+
+
+def build_indexes(project: Project) -> Dict[str, ModuleIndex]:
+    return {sf.path: ModuleIndex(sf) for sf in project.files}

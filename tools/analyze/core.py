@@ -1,0 +1,225 @@
+"""ompb-lint core: findings, source files, suppressions, baseline.
+
+The analyzer is stdlib-``ast`` only (nothing to install, nothing the
+CI image doesn't already have) and project-specific by design: the
+rules encode THIS codebase's invariants — an asyncio front that must
+never block, executor-shared structures that must stay under their
+locks, remote-I/O edges that must flow through the PR-1 resilience
+wrappers, and JAX hot paths that must not host-sync or recompile per
+request. Generic linters can't check any of that.
+
+Three escape hatches, in order of preference:
+
+- fix the code;
+- an inline rule-scoped suppression
+  (``# ompb-lint: disable=<rule>[,<rule>] -- <why>``) where the
+  violation is intentional and the justification belongs next to it;
+- the checked-in baseline (``tools/analyze/baseline.json``) for
+  temporarily accepted findings — refreshed with ``--baseline``, and
+  REFUSED for hot-path modules so serving code can't quietly accrue
+  debt.
+
+Baseline entries match on (rule, path, normalized source line), not
+line numbers, so unrelated edits above a finding don't invalidate it.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BASELINE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.json"
+)
+
+#: Serving hot-path modules: findings here must be fixed or inline-
+#: suppressed with a justification — they may NOT be baselined.
+HOT_PATH_PREFIXES = (
+    "omero_ms_pixel_buffer_tpu/models/",
+    "omero_ms_pixel_buffer_tpu/ops/",
+    "omero_ms_pixel_buffer_tpu/dispatch/",
+    "omero_ms_pixel_buffer_tpu/io/stores.py",
+)
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*ompb-lint:\s*disable=([a-z0-9_,\-\s]+?)(?:\s*--.*)?$"
+)
+_SCOPE_RE = re.compile(r"#\s*ompb-lint:\s*scope=([a-z0-9_,\-\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}"
+
+
+class SourceFile:
+    """One parsed module: AST + per-line suppressions + scope cookies.
+
+    A suppression comment applies to its own line; a comment-only line
+    applies to the next source line (both spellings are common in
+    linters and both read naturally above long statements).
+    """
+
+    def __init__(self, abs_path: str, rel_path: str, text: str):
+        self.abs_path = abs_path
+        self.path = rel_path.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(text, filename=rel_path)
+        except SyntaxError as e:
+            self.parse_error = f"syntax error: {e.msg} (line {e.lineno})"
+        self.suppressions: Dict[int, set] = {}
+        self.scopes: set = set()
+        self._scan_comments()
+
+    def _scan_comments(self) -> None:
+        carry: Optional[set] = None
+        for i, line in enumerate(self.lines, start=1):
+            stripped = line.strip()
+            m = _SCOPE_RE.search(line)
+            if m:
+                self.scopes.update(
+                    s.strip() for s in m.group(1).split(",") if s.strip()
+                )
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                rules = {
+                    s.strip() for s in m.group(1).split(",") if s.strip()
+                }
+                self.suppressions.setdefault(i, set()).update(rules)
+                if stripped.startswith("#"):
+                    carry = rules  # comment-only line: cover the next line
+                    continue
+            if carry is not None and stripped and not stripped.startswith("#"):
+                self.suppressions.setdefault(i, set()).update(carry)
+                carry = None
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        rules = self.suppressions.get(line)
+        return bool(rules) and (rule in rules or "*" in rules)
+
+    def context(self, line: int) -> str:
+        """Normalized source text of ``line`` (baseline matching key)."""
+        if 1 <= line <= len(self.lines):
+            return " ".join(self.lines[line - 1].split())
+        return ""
+
+
+class Project:
+    """The file set one analysis run sees."""
+
+    def __init__(self, files: List[SourceFile]):
+        self.files = files
+        self.by_path: Dict[str, SourceFile] = {f.path: f for f in files}
+
+    def in_scope(self, sf: SourceFile, rule: str, path_prefixes: Tuple[str, ...]) -> bool:
+        """A file is in a checker's scope if its repo-relative path
+        matches one of the configured prefixes, or it carries an
+        explicit ``# ompb-lint: scope=<rule>`` cookie (how the test
+        fixture corpus opts flat files into path-scoped rules)."""
+        if rule in sf.scopes:
+            return True
+        return any(
+            sf.path == p or sf.path.startswith(p) for p in path_prefixes
+        )
+
+
+def discover(paths: List[str], root: str = REPO_ROOT) -> Project:
+    """Load every ``.py`` under the given files/directories."""
+    files: List[SourceFile] = []
+    seen = set()
+    for p in paths:
+        abs_p = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(abs_p):
+            candidates = [abs_p]
+        else:
+            candidates = []
+            for dirpath, dirnames, filenames in os.walk(abs_p):
+                dirnames[:] = [
+                    d for d in dirnames
+                    if d not in ("__pycache__", ".git")
+                ]
+                candidates.extend(
+                    os.path.join(dirpath, f)
+                    for f in sorted(filenames)
+                    if f.endswith(".py")
+                )
+        for c in candidates:
+            if c in seen:
+                continue
+            seen.add(c)
+            rel = os.path.relpath(c, root)
+            with open(c, "r", encoding="utf-8") as fh:
+                files.append(SourceFile(c, rel, fh.read()))
+    return Project(files)
+
+
+# -- baseline ----------------------------------------------------------------
+
+
+def load_baseline(path: str = BASELINE_PATH) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return list(data.get("findings", []))
+
+
+def save_baseline(
+    findings: List[Tuple[Finding, str]], path: str = BASELINE_PATH
+) -> None:
+    entries = [
+        {"rule": f.rule, "path": f.path, "context": ctx, "message": f.message}
+        for f, ctx in findings
+    ]
+    entries.sort(key=lambda e: (e["path"], e["rule"], e["context"]))
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"findings": entries}, fh, indent=2)
+        fh.write("\n")
+
+
+def apply_baseline(
+    findings: List[Finding],
+    project: Project,
+    baseline: List[dict],
+) -> Tuple[List[Finding], List[dict]]:
+    """Split findings into (new, baselined-entries-used). Matching is
+    (rule, path, context) with multiset semantics — two identical
+    offending lines need two baseline entries."""
+    pool: Dict[Tuple[str, str, str], int] = {}
+    for e in baseline:
+        key = (e["rule"], e["path"], e.get("context", ""))
+        pool[key] = pool.get(key, 0) + 1
+    new: List[Finding] = []
+    used: List[dict] = []
+    for f in findings:
+        sf = project.by_path.get(f.path)
+        ctx = sf.context(f.line) if sf else ""
+        key = (f.rule, f.path, ctx)
+        if pool.get(key, 0) > 0:
+            pool[key] -= 1
+            used.append({"rule": f.rule, "path": f.path, "context": ctx})
+        else:
+            new.append(f)
+    return new, used
+
+
+def is_hot_path(path: str) -> bool:
+    return any(
+        path == p or path.startswith(p) for p in HOT_PATH_PREFIXES
+    )
