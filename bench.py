@@ -239,6 +239,154 @@ def bench_http(
     return asyncio.run(run())
 
 
+def bench_cache(
+    path: str, n_tiles: int = 192, concurrency: int = 1,
+    engine: str = "host",
+) -> dict:
+    """Cache warm-pass mode: the same full HTTP stack as bench_http
+    but with the tiered tile-result cache enabled. Pass 1 (cold)
+    renders and memoizes a unique tile set; pass 2 (warm) replays the
+    identical URLs. Records the hit ratio and the p50/p99 delta — the
+    repeated-tile serving story — and verifies every warm body is
+    byte-identical to its cold twin (the correctness bar: a cache that
+    alters bytes is worse than no cache).
+
+    Default concurrency is 1: this section is a LATENCY probe (what
+    one viewer feels per tile, cold vs hit), so it must not run at
+    saturation — at high concurrency both passes measure queueing on
+    the shared loop, not the path under test. bench_http carries the
+    throughput story; BENCH_CACHE_CONCURRENCY overrides."""
+    import hashlib
+
+    from aiohttp import web
+
+    from omero_ms_pixel_buffer_tpu.auth.stores import MemorySessionStore
+    from omero_ms_pixel_buffer_tpu.http.server import PixelBufferApp
+    from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+        ImageRegistry,
+        PixelsService,
+    )
+    from omero_ms_pixel_buffer_tpu.utils.config import Config
+
+    registry = ImageRegistry()
+    registry.add(1, path)
+    config = Config.from_dict(
+        {
+            "session-store": {"type": "memory"},
+            "backend": {"engine": engine},
+            "cache": {"memory-mb": 512,
+                      # the bench replays exact URLs; speculative
+                      # neighbors would blur the hit-ratio reading
+                      "prefetch": {"enabled": False}},
+        }
+    )
+    service = PixelsService(registry)
+    app_obj = PixelBufferApp(
+        config,
+        pixels_service=service,
+        session_store=MemorySessionStore({"bench-cookie": "bench-key"}),
+    )
+    size = int(os.environ.get("BENCH_IMAGE_SIZE", "8192"))
+    rng = np.random.default_rng(29)
+    urls = []
+    seen = set()
+    while len(urls) < n_tiles:
+        x = int(rng.integers(0, (size - 512) // 64)) * 64
+        y = int(rng.integers(0, (size - 512) // 64)) * 64
+        if (x, y) not in seen:  # unique tiles: pass 1 is all misses
+            seen.add((x, y))
+            urls.append(
+                f"/tile/1/0/0/0?x={x}&y={y}&w=512&h=512&format=png"
+            )
+
+    async def run() -> dict:
+        runner = web.AppRunner(app_obj.make_app(), access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = runner.addresses[0][1]
+
+        async def drive(request_urls):
+            latencies, digests = [], {}
+            queue: asyncio.Queue = asyncio.Queue()
+            for u in request_urls:
+                queue.put_nowait(u)
+            for _ in range(concurrency):
+                queue.put_nowait(None)
+
+            async def worker():
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", port
+                )
+                try:
+                    while True:
+                        url = await queue.get()
+                        if url is None:
+                            return
+                        t0 = time.perf_counter()
+                        writer.write(
+                            f"GET {url} HTTP/1.1\r\n"
+                            "Host: bench\r\n"
+                            "Cookie: sessionid=bench-cookie\r\n"
+                            "\r\n".encode()
+                        )
+                        await writer.drain()
+                        status_line = await reader.readline()
+                        status = int(status_line.split()[1])
+                        clen = 0
+                        while True:
+                            line = await reader.readline()
+                            if line in (b"\r\n", b""):
+                                break
+                            if line.lower().startswith(
+                                b"content-length:"
+                            ):
+                                clen = int(line.split(b":", 1)[1])
+                        body = await reader.readexactly(clen)
+                        assert status == 200, (status, body[:200])
+                        latencies.append(time.perf_counter() - t0)
+                        digests[url] = hashlib.sha1(body).hexdigest()
+                finally:
+                    writer.close()
+
+            await asyncio.gather(
+                *(worker() for _ in range(concurrency))
+            )
+            return latencies, digests
+
+        try:
+            # engine/jit/native warmup outside the timed passes
+            await drive(urls[:concurrency])
+            cold_lat, cold_digests = await drive(urls)
+            # hit ratio reads the WARM pass only
+            app_obj.result_cache.memory.hits = 0
+            app_obj.result_cache.memory.misses = 0
+            warm_lat, warm_digests = await drive(urls)
+        finally:
+            await runner.cleanup()
+            service.close()
+        mem = app_obj.result_cache.memory.snapshot()
+        cold = np.array(cold_lat) * 1000.0
+        warm = np.array(warm_lat) * 1000.0
+        identical = cold_digests == warm_digests
+        p50_cold = float(np.percentile(cold, 50))
+        p50_warm = float(np.percentile(warm, 50))
+        return {
+            "tiles": len(urls),
+            "hit_ratio": round(
+                mem["hits"] / max(1, mem["hits"] + mem["misses"]), 4
+            ),
+            "p50_cold_ms": round(p50_cold, 3),
+            "p99_cold_ms": round(float(np.percentile(cold, 99)), 3),
+            "p50_warm_ms": round(p50_warm, 3),
+            "p99_warm_ms": round(float(np.percentile(warm, 99)), 3),
+            "p50_speedup": round(p50_cold / max(p50_warm, 1e-6), 2),
+            "identical_bytes": identical,
+        }
+
+    return asyncio.run(run())
+
+
 def bench_device(path: str, size: int, probe_info: dict) -> dict:
     """Accelerator-engine sub-run, recorded even when slower than host
     (over a tunneled chip the link dominates; BENCH tail carries the
@@ -432,6 +580,22 @@ def main():
             http_stats = {"http_error": f"{type(e).__name__}: {e}"}
             log(f"http bench failed: {e!r}")
 
+    # --- cache warm-pass: repeated-tile serving (hit ratio + p50/p99
+    # delta; identical bytes is the correctness bar) -------------------
+    cache_stats: dict = {}
+    if os.environ.get("BENCH_CACHE_PASS", "1") != "0":
+        try:
+            cache_stats = bench_cache(
+                path,
+                int(os.environ.get("BENCH_CACHE_TILES", "192")),
+                int(os.environ.get("BENCH_CACHE_CONCURRENCY", "1")),
+                engine=pipe.engine,  # probe-gated, never re-read
+            )
+            log(f"cache warm pass: {cache_stats}")
+        except Exception as e:
+            cache_stats = {"error": f"{type(e).__name__}: {e}"}
+            log(f"cache bench failed: {e!r}")
+
     if os.environ.get("BENCH_SUBS", "1") != "0":
         try:
             sub_benches(pipe, service, size, cache_dir)
@@ -459,6 +623,8 @@ def main():
     record.update(
         {k: v for k, v in http_stats.items() if k != "engine"}
     )
+    if cache_stats:
+        record["cache"] = cache_stats
     if device_stats:
         record["device"] = device_stats
     print(json.dumps(record))

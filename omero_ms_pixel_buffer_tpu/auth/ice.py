@@ -36,6 +36,7 @@ from typing import Optional, Tuple
 from ..errors import ServiceUnavailableError
 from ..resilience.breaker import BreakerOpenError, for_dependency
 from ..resilience.faultinject import INJECTOR
+from ..resilience.timeouts import io_timeout_s
 from .validator import SessionValidator
 
 HEADER_MAGIC = b"IceP"
@@ -123,12 +124,22 @@ class Glacier2Client:
 
     def __init__(
         self, host: str, port: int = 4064, secure: bool = False,
-        timeout_s: float = 10.0, verify_tls: bool = True,
+        timeout_s: Optional[float] = None, verify_tls: bool = True,
     ):
         self.host, self.port = host, port
         self.secure = secure
-        self.timeout_s = timeout_s
+        # None -> the process-wide per-call I/O timeout
+        # (resilience.io-timeout-ms), read per call so configure()
+        # at startup takes effect; an explicit value pins it
+        self._timeout_s = timeout_s
         self.verify_tls = verify_tls
+
+    @property
+    def timeout_s(self) -> float:
+        if self._timeout_s is not None:
+            return self._timeout_s
+        configured = io_timeout_s()
+        return configured if configured > 0 else 10.0
 
     async def _connect(self):
         ssl_ctx = None
@@ -234,7 +245,7 @@ class IceSessionValidator(SessionValidator):
 
     def __init__(
         self, host: str, port: int = 4064, secure: bool = False,
-        timeout_s: float = 10.0, verify_tls: bool = True,
+        timeout_s: Optional[float] = None, verify_tls: bool = True,
         cache_ttl_s: float = 30.0, cache_max: int = 10_000,
     ):
         self._client = Glacier2Client(

@@ -27,6 +27,7 @@ from urllib.parse import urlparse
 from ..errors import ServiceUnavailableError
 from ..resilience.breaker import BreakerOpenError, for_dependency
 from ..resilience.faultinject import INJECTOR
+from ..resilience.timeouts import io_timeout_s
 from .django import decode_session_payload, extract_omero_session_key
 
 # Store-down (breaker open / backend unreachable) raises
@@ -137,8 +138,26 @@ class RedisSessionStore(OmeroWebSessionStore):
             ) from None
         t0 = time.monotonic()  # slow-call input (chaos latency included)
         try:
-            await INJECTOR.fire_async("session_store")
-            result = await self._lookup(session_id)
+            # per-call cap (resilience/timeouts): one lookup exchange
+            # — connect + GET probes, injected chaos latency included
+            # — is bounded; a Redis that stops answering fails (and
+            # feeds the breaker) like one that refuses connections
+            timeout = io_timeout_s()
+            if timeout > 0:
+                result = await asyncio.wait_for(
+                    self._faulted_lookup(session_id), timeout
+                )
+            else:
+                result = await self._faulted_lookup(session_id)
+        except asyncio.TimeoutError:
+            # mid-protocol connection is desynced: drop it (under the
+            # lock — the cancelled lookup has released it)
+            async with self._lock:
+                if self._writer is not None:
+                    self._writer.close()
+                    self._writer = None
+            self.breaker.record_failure()
+            raise
         except (ConnectionError, EOFError, OSError,
                 asyncio.IncompleteReadError):
             # transport outage: breaker input
@@ -153,6 +172,13 @@ class RedisSessionStore(OmeroWebSessionStore):
             raise
         self.breaker.record_success(duration_s=time.monotonic() - t0)
         return result
+
+    async def _faulted_lookup(self, session_id: str) -> Optional[str]:
+        """Fault point + lookup under ONE clock, so injected chaos
+        latency counts against the per-call timeout like real network
+        stall would."""
+        await INJECTOR.fire_async("session_store")
+        return await self._lookup(session_id)
 
     async def _lookup(self, session_id: str) -> Optional[str]:
         async with self._lock:

@@ -1,0 +1,46 @@
+"""Tiered tile-result cache, single-flight coalescing, and viewport
+prefetch.
+
+The batcher coalesces *concurrent* requests into lanes but never
+memoizes: before this package every ``GET /tile/...`` re-ran the full
+decode -> crop -> encode pipeline even when the identical tile was
+rendered milliseconds ago. Viewer traffic (OpenSeaDragon-style
+pan/zoom streams) is dominated by exactly that locality — the Iris
+result (PAPERS.md, arXiv:2508.06615) serves pre-encoded tiles, and
+PATCHEDSERVE (arXiv:2501.09253) shows patch caching/reuse is the
+dominant lever in hybrid-resolution tile serving.
+
+Three cooperating pieces:
+
+- ``result_cache`` — post-encode bytes + strong content ETag, keyed on
+  (image, z, c, t, region, resolution, format, quality): a
+  byte-budgeted segmented-LRU host-RAM tier (scan-resistant) over an
+  optional disk-spill tier. A broken cache must never fail a request:
+  every operation degrades to pass-through, and the disk tier sits
+  behind its own circuit breaker + fault point so the chaos suite can
+  kill it.
+- ``single_flight`` — concurrent misses on one key collapse into ONE
+  pipeline execution; waiters share the result, errors fan out to all,
+  and one waiter's cancellation never kills the flight.
+- ``prefetch`` — watches the per-session access stream, predicts
+  neighbor / next-zoom tiles, and warms the result cache (and, through
+  the pipeline, the ``DevicePlaneCache``) via a low-priority queue
+  that admission control sheds first.
+
+Invalidation: the Postgres metadata resolver (db/metadata.py) notifies
+listeners when it observes a changed ``pixels`` row; the HTTP app
+purges the result cache, the open pixel buffer, and the device plane
+cache for that image.
+"""
+
+from .result_cache import CachedTile, TileResultCache, make_etag
+from .single_flight import SingleFlight
+from .prefetch import ViewportPrefetcher
+
+__all__ = [
+    "CachedTile",
+    "SingleFlight",
+    "TileResultCache",
+    "ViewportPrefetcher",
+    "make_etag",
+]

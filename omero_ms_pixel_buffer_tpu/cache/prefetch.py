@@ -1,0 +1,231 @@
+"""Viewport prefetcher: predict the pan, warm the cache.
+
+A viewer panning a slide requests tiles along a trajectory; the next
+few tiles are highly predictable from the last two. This watcher
+observes the per-session access stream and, when a stream shows
+motion, enqueues the continuation tiles (plus the perpendicular
+neighbors of the next step, and the next-zoom tile under the viewport
+center) for background rendering through the SAME miss path real
+requests use — so a warmed tile lands in the result cache with its
+ETag, and the pipeline's own caches (decoded-block cache, device
+plane cache) warm as a side effect.
+
+Prefetch is strictly lower-class traffic:
+
+- the queue is bounded and *drops* when full (never backpressures a
+  real request);
+- before issuing, the worker consults admission control's headroom —
+  under load, prefetch is the FIRST thing shed (a real request sheds
+  only at ``max_inflight``; prefetch already sheds at
+  ``headroom_fraction`` of it);
+- each prefetch carries a short deadline so a slow store can't park
+  the worker;
+- results nobody ever views just age out of probation (the SLRU's
+  scan resistance keeps speculative tiles from displacing the real
+  working set).
+
+Failures are expected (predictions can fall off the image edge ->
+404) and are counted, never logged as errors.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import OrderedDict
+from typing import Awaitable, Callable, List, Optional, Tuple
+
+from ..resilience.deadline import Deadline
+from ..tile_ctx import RegionDef, TileCtx
+from ..utils.metrics import REGISTRY
+
+log = logging.getLogger("omero_ms_pixel_buffer_tpu.prefetch")
+
+PREFETCH = REGISTRY.counter(
+    "tile_prefetch_total", "Prefetch predictions by outcome"
+)
+
+# fetch(ctx, content_key) -> None; provided by the HTTP app (goes
+# through the coalesced bus path and fills the result cache)
+FetchFn = Callable[[TileCtx, str], Awaitable[None]]
+
+
+class _Stream:
+    """Last two accesses of one (session, plane) stream."""
+
+    __slots__ = ("x", "y", "dx", "dy")
+
+    def __init__(self, x: int, y: int):
+        self.x, self.y = x, y
+        self.dx, self.dy = 0, 0
+
+
+class ViewportPrefetcher:
+    def __init__(
+        self,
+        fetch: FetchFn,
+        cache,
+        admission,
+        quality: str = "",
+        queue_size: int = 256,
+        headroom_fraction: float = 0.5,
+        budget_s: float = 2.0,
+        lookahead: int = 2,
+        max_streams: int = 1024,
+    ):
+        self._fetch = fetch
+        self._cache = cache
+        self._admission = admission
+        self._quality = quality
+        self.headroom_fraction = headroom_fraction
+        self.budget_s = budget_s
+        self.lookahead = lookahead
+        self._queue: "asyncio.Queue[Tuple[TileCtx, str]]" = asyncio.Queue(
+            maxsize=queue_size
+        )
+        self._streams: "OrderedDict[tuple, _Stream]" = OrderedDict()
+        self._max_streams = max_streams
+        self._worker: Optional[asyncio.Task] = None
+        self._stats = {
+            "observed": 0, "enqueued": 0, "warmed": 0, "shed": 0,
+            "already_cached": 0, "dropped_queue_full": 0, "failed": 0,
+        }
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        if self._worker is None:
+            self._worker = asyncio.get_running_loop().create_task(
+                self._run()
+            )
+
+    async def close(self) -> None:
+        if self._worker is not None:
+            self._worker.cancel()
+            try:
+                await self._worker
+            except asyncio.CancelledError:
+                if not self._worker.cancelled():
+                    raise
+            self._worker = None
+
+    # -- the access stream ---------------------------------------------
+
+    def observe(self, ctx: TileCtx) -> None:
+        """Feed one real access; may enqueue predictions. Cheap and
+        non-blocking — called inline on the serving path for hits and
+        misses alike (panning is mostly hits)."""
+        self._stats["observed"] += 1
+        r = ctx.region
+        if r.width <= 0 or r.height <= 0:
+            return  # full-plane defaulting request: no grid to predict
+        stream_key = (
+            ctx.omero_session_key, ctx.image_id, ctx.z, ctx.c, ctx.t,
+            ctx.resolution, ctx.format,
+        )
+        stream = self._streams.get(stream_key)
+        if stream is None:
+            stream = _Stream(r.x, r.y)
+            self._streams[stream_key] = stream
+            while len(self._streams) > self._max_streams:
+                self._streams.popitem(last=False)
+            return  # one point is not a direction
+        self._streams.move_to_end(stream_key)
+        dx, dy = r.x - stream.x, r.y - stream.y
+        stream.x, stream.y, stream.dx, stream.dy = r.x, r.y, dx, dy
+        for region, resolution in self._predict(ctx, dx, dy):
+            self._enqueue(ctx, region, resolution)
+
+    def _predict(
+        self, ctx: TileCtx, dx: int, dy: int
+    ) -> List[Tuple[RegionDef, Optional[int]]]:
+        """Continuation tiles along the motion vector, the next step's
+        perpendicular neighbors, and the next-zoom tile under the new
+        center. Off-image predictions are pruned by the pipeline (404
+        -> counted, ignored)."""
+        r = ctx.region
+        out: List[Tuple[RegionDef, Optional[int]]] = []
+
+        def add(x: int, y: int, w: int, h: int, res) -> None:
+            if x >= 0 and y >= 0:
+                out.append((RegionDef(x, y, w, h), res))
+
+        if dx or dy:
+            for i in range(1, self.lookahead + 1):
+                add(r.x + dx * i, r.y + dy * i, r.width, r.height,
+                    ctx.resolution)
+            # perpendicular neighbors of the next step: pans wobble
+            nx, ny = r.x + dx, r.y + dy
+            if dx == 0:
+                add(nx - r.width, ny, r.width, r.height, ctx.resolution)
+                add(nx + r.width, ny, r.width, r.height, ctx.resolution)
+            else:
+                add(nx, ny - r.height, r.width, r.height, ctx.resolution)
+                add(nx, ny + r.height, r.width, r.height, ctx.resolution)
+        if ctx.resolution is not None and ctx.resolution > 0:
+            # zoom-in prediction: the finer level's tile under this
+            # tile's center (OMERO levels halve per step), aligned to
+            # the tile grid
+            cx = (r.x + r.width // 2) * 2
+            cy = (r.y + r.height // 2) * 2
+            add((cx // r.width) * r.width, (cy // r.height) * r.height,
+                r.width, r.height, ctx.resolution - 1)
+        return out
+
+    def _enqueue(
+        self, origin: TileCtx, region: RegionDef, resolution
+    ) -> None:
+        ctx = TileCtx(
+            image_id=origin.image_id, z=origin.z, c=origin.c,
+            t=origin.t, region=region, resolution=resolution,
+            format=origin.format,
+            omero_session_key=origin.omero_session_key,
+        )
+        key = ctx.cache_key(self._quality)
+        if self._cache is not None and self._cache.contains(key):
+            self._stats["already_cached"] += 1
+            return
+        try:
+            self._queue.put_nowait((ctx, key))
+            self._stats["enqueued"] += 1
+        except asyncio.QueueFull:
+            self._stats["dropped_queue_full"] += 1
+            PREFETCH.inc(outcome="dropped_queue_full")
+
+    # -- the low-priority worker ---------------------------------------
+
+    async def _run(self) -> None:
+        while True:
+            ctx, key = await self._queue.get()
+            if not self._admission.has_headroom(self.headroom_fraction):
+                # the service is busy with real traffic: speculative
+                # work is the first thing to go
+                self._stats["shed"] += 1
+                PREFETCH.inc(outcome="shed")
+                continue
+            if self._cache is not None and self._cache.contains(key):
+                self._stats["already_cached"] += 1
+                PREFETCH.inc(outcome="already_cached")
+                continue
+            ctx.deadline = Deadline.after(self.budget_s)
+            try:
+                await self._fetch(ctx, key)
+                self._stats["warmed"] += 1
+                PREFETCH.inc(outcome="warmed")
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # expected: off-image predictions 404, busy pipelines
+                # 503/504 — speculative work never logs above debug
+                self._stats["failed"] += 1
+                PREFETCH.inc(outcome="failed")
+                log.debug("prefetch failed for %s", key, exc_info=True)
+
+    # -- observability -------------------------------------------------
+
+    def snapshot(self) -> dict:
+        return {
+            "enabled": True,
+            "queued": self._queue.qsize(),
+            **self._stats,
+        }

@@ -1,0 +1,672 @@
+"""Rendered-tile result cache: segmented-LRU RAM tier + disk spill.
+
+Key schema (tile_ctx.TileCtx.cache_key)::
+
+    img=<id>|z=<z>|c=<c>|t=<t>|x=..|y=..|w=..|h=..|res=..|fmt=..|q=<sig>
+
+where ``q`` is the pipeline's encode signature (PNG filter/level/
+strategy) so a config change never serves stale bytes under an old
+ETag.
+
+Memory tier — **segmented LRU** (SLRU), the scan-resistant shape: a
+new key lands in *probation*; only a second touch promotes it to
+*protected*. A one-pass scan (a robot walking every tile of a slide
+once) churns probation but cannot displace the protected working set
+of the interactive viewers. Both segments share one byte budget;
+protected is additionally capped at ``protected_fraction`` of it, with
+overflow demoting back to probation MRU.
+
+Disk tier — optional spill directory: entries evicted from memory are
+written ``<sha1>.tile`` (tmp + rename); a disk hit re-admits to
+probation. The tier sits behind its own circuit breaker
+(``cache:disk``) and fault point (``cache.disk``): repeated I/O errors
+open the breaker and the tier silently drops out. The memory tier
+carries a fault point too (``cache.memory``).
+
+The contract enforced at the public surface: **a broken cache must
+never fail a request** — every ``get``/``put`` catches, counts, and
+degrades to pass-through (the caller just runs the pipeline).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import hashlib
+import logging
+import os
+import threading
+import time
+import weakref
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from ..resilience.breaker import BreakerOpenError, for_dependency
+from ..resilience.faultinject import INJECTOR
+from ..resilience.timeouts import io_timeout_s
+from ..utils.metrics import REGISTRY
+
+log = logging.getLogger("omero_ms_pixel_buffer_tpu.cache")
+
+CACHE_REQUESTS = REGISTRY.counter(
+    "tile_cache_requests_total",
+    "Result-cache lookups by tier and outcome",
+)
+CACHE_STORES = REGISTRY.counter(
+    "tile_cache_stores_total", "Entries admitted, by tier"
+)
+CACHE_EVICTIONS = REGISTRY.counter(
+    "tile_cache_evictions_total", "Entries evicted, by tier"
+)
+CACHE_ERRORS = REGISTRY.counter(
+    "tile_cache_errors_total",
+    "Cache operations that degraded to pass-through, by tier",
+)
+CACHE_INVALIDATIONS = REGISTRY.counter(
+    "tile_cache_invalidations_total",
+    "Entries purged by image invalidation",
+)
+
+# ONE process-wide bytes gauge over every live cache instance: the
+# registry never unregisters, so a per-instance GaugeFn would both
+# leak the closed cache's contents (the closure pins them) and emit
+# duplicate metric families when an app is re-created in-process
+# (bench, tests). Weak references: a dropped cache simply stops
+# contributing.
+_LIVE_CACHES: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _bytes_by_tier() -> Dict[tuple, float]:
+    caches = list(_LIVE_CACHES)
+    out = {
+        (("tier", "memory"),): float(
+            sum(c.memory.nbytes for c in caches)
+        )
+    }
+    disk = [c.disk.nbytes for c in caches if c.disk is not None]
+    if disk:
+        out[(("tier", "disk"),)] = float(sum(disk))
+    return out
+
+
+CACHE_BYTES = REGISTRY.gauge_fn(
+    "tile_cache_bytes", "Live bytes held per cache tier",
+    _bytes_by_tier,
+)
+
+
+def make_etag(body: bytes) -> str:
+    """Strong content ETag: a quoted digest of the encoded bytes —
+    identical bytes get identical validators across processes and
+    restarts."""
+    return '"' + hashlib.blake2b(body, digest_size=16).hexdigest() + '"'
+
+
+def etag_matches(if_none_match: str, etag: str) -> bool:
+    """If-None-Match comparison: comma-separated validators, weak
+    comparison (a ``W/`` prefix on either side still matches — the
+    bytes behind a strong ETag are the same bytes). ``*`` is
+    deliberately NOT honored: the 304 precheck's safety argument is
+    "a matching strong ETag proves prior possession of these exact
+    bytes", and ``*`` proves nothing — honoring it would hand an
+    unauthorized caller a cache-state/image-existence oracle. A
+    client sending ``*`` simply takes the fully-authorized path."""
+    if not if_none_match:
+        return False
+    for candidate in if_none_match.split(","):
+        candidate = candidate.strip()
+        if candidate.startswith("W/"):
+            candidate = candidate[2:]
+        if candidate == etag:
+            return True
+    return False
+
+
+class CachedTile:
+    """One memoized response: encoded bytes + validator + the reply
+    filename header."""
+
+    __slots__ = ("body", "etag", "filename", "stored_at")
+
+    def __init__(
+        self, body: bytes, etag: Optional[str] = None,
+        filename: str = "", stored_at: Optional[float] = None,
+    ):
+        self.body = body
+        self.etag = etag if etag is not None else make_etag(body)
+        self.filename = filename
+        self.stored_at = (
+            time.monotonic() if stored_at is None else stored_at
+        )
+
+    @property
+    def nbytes(self) -> int:
+        return len(self.body)
+
+
+class SegmentedLRU:
+    """Byte-budgeted SLRU of ``CachedTile`` entries. Thread-safe (the
+    event loop reads it; invalidation listeners may fire from resolver
+    threads). ``put`` returns the evicted ``(key, entry)`` pairs so
+    the owner can spill them to the disk tier."""
+
+    def __init__(self, max_bytes: int, protected_fraction: float = 0.8):
+        self.max_bytes = max_bytes
+        self.protected_max = int(max_bytes * protected_fraction)
+        self._probation: "OrderedDict[str, CachedTile]" = OrderedDict()
+        self._protected: "OrderedDict[str, CachedTile]" = OrderedDict()
+        self._bytes = 0
+        self._protected_bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: str) -> Optional[CachedTile]:
+        with self._lock:
+            entry = self._protected.get(key)
+            if entry is not None:
+                self._protected.move_to_end(key)
+                self.hits += 1
+                return entry
+            entry = self._probation.pop(key, None)
+            if entry is None:
+                self.misses += 1
+                return None
+            # second touch: promote; overflow demotes protected LRU
+            # back to probation MRU (they keep their residency, just
+            # lose scan immunity)
+            self.hits += 1
+            self._protected[key] = entry
+            self._protected_bytes += entry.nbytes
+            while self._protected_bytes > self.protected_max and len(
+                self._protected
+            ) > 1:
+                demoted_key, demoted = self._protected.popitem(last=False)
+                self._protected_bytes -= demoted.nbytes
+                self._probation[demoted_key] = demoted
+            return entry
+
+    def peek(self, key: str) -> Optional[CachedTile]:
+        """Presence check without promotion or hit accounting (the
+        prefetcher's dedupe probe)."""
+        with self._lock:
+            return self._protected.get(key) or self._probation.get(key)
+
+    def put(self, key: str, entry: CachedTile) -> List[Tuple[str, CachedTile]]:
+        evicted: List[Tuple[str, CachedTile]] = []
+        if entry.nbytes > self.max_bytes:
+            return evicted  # can never fit; not admitted
+        with self._lock:
+            old = self._probation.pop(key, None)
+            if old is None:
+                old = self._protected.pop(key, None)
+                if old is not None:
+                    self._protected_bytes -= old.nbytes
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._probation[key] = entry
+            self._bytes += entry.nbytes
+            while self._bytes > self.max_bytes:
+                if self._probation:
+                    k, e = self._probation.popitem(last=False)
+                elif self._protected:
+                    k, e = self._protected.popitem(last=False)
+                    self._protected_bytes -= e.nbytes
+                else:  # pragma: no cover - guarded by the size gate
+                    break
+                if k == key:
+                    # the entry we just admitted is the LRU (cache
+                    # smaller than the working item): it just leaves
+                    self._bytes -= e.nbytes
+                    continue
+                self._bytes -= e.nbytes
+                evicted.append((k, e))
+        return evicted
+
+    def remove(self, key: str) -> bool:
+        with self._lock:
+            entry = self._probation.pop(key, None)
+            if entry is None:
+                entry = self._protected.pop(key, None)
+                if entry is not None:
+                    self._protected_bytes -= entry.nbytes
+            if entry is None:
+                return False
+            self._bytes -= entry.nbytes
+            return True
+
+    def remove_prefix(self, prefix: str) -> int:
+        """Drop every key under ``prefix`` (image invalidation)."""
+        removed = 0
+        with self._lock:
+            for seg, protected in (
+                (self._probation, False), (self._protected, True)
+            ):
+                victims = [k for k in seg if k.startswith(prefix)]
+                for k in victims:
+                    entry = seg.pop(k)
+                    self._bytes -= entry.nbytes
+                    if protected:
+                        self._protected_bytes -= entry.nbytes
+                removed += len(victims)
+        return removed
+
+    @property
+    def nbytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._probation) + len(self._protected)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._probation) + len(self._protected),
+                "protected_entries": len(self._protected),
+                "bytes": self._bytes,
+                "max_bytes": self.max_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+            }
+
+
+class DiskTier:
+    """Spill directory with an in-memory LRU index. All methods run on
+    the cache's I/O executor thread — blocking file I/O is the point.
+    Entries do not survive a restart (the index is authoritative and
+    process-local); leftover files from a previous run are swept at
+    startup."""
+
+    def __init__(self, directory: str, max_bytes: int):
+        self.directory = directory
+        self.max_bytes = max_bytes
+        # key -> (path, nbytes, etag, filename, stored_at)
+        self._index: "OrderedDict[str, tuple]" = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        os.makedirs(directory, exist_ok=True)
+        for stale in os.listdir(directory):
+            if stale.endswith((".tile", ".tmp")):
+                try:
+                    os.unlink(os.path.join(directory, stale))
+                except OSError:
+                    pass
+
+    @staticmethod
+    def _fname(key: str) -> str:
+        return hashlib.sha1(key.encode()).hexdigest() + ".tile"
+
+    def get(self, key: str) -> Optional[CachedTile]:
+        with self._lock:
+            meta = self._index.get(key)
+            if meta is not None:
+                self._index.move_to_end(key)
+        if meta is None:
+            with self._lock:
+                self.misses += 1
+            return None
+        path, _nbytes, etag, filename, stored_at = meta
+        with open(path, "rb") as fh:
+            body = fh.read()
+        with self._lock:
+            self.hits += 1
+        return CachedTile(body, etag, filename, stored_at)
+
+    def put(self, key: str, entry: CachedTile) -> None:
+        if entry.nbytes > self.max_bytes:
+            return
+        path = os.path.join(self.directory, self._fname(key))
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(entry.body)
+        os.replace(tmp, path)
+        victims: List[str] = []
+        with self._lock:
+            old = self._index.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._index[key] = (
+                path, entry.nbytes, entry.etag, entry.filename,
+                entry.stored_at,
+            )
+            self._bytes += entry.nbytes
+            while self._bytes > self.max_bytes and len(self._index) > 1:
+                _, meta = self._index.popitem(last=False)
+                self._bytes -= meta[1]
+                victims.append(meta[0])
+        for victim in victims:
+            CACHE_EVICTIONS.inc(tier="disk")
+            try:
+                os.unlink(victim)
+            except OSError:
+                pass
+
+    def remove(self, key: str) -> None:
+        with self._lock:
+            meta = self._index.pop(key, None)
+            if meta is not None:
+                self._bytes -= meta[1]
+        if meta is not None:
+            try:
+                os.unlink(meta[0])
+            except OSError:
+                pass
+
+    def remove_prefix(self, prefix: str) -> int:
+        with self._lock:
+            victims = [
+                (k, meta) for k, meta in self._index.items()
+                if k.startswith(prefix)
+            ]
+            for k, meta in victims:
+                del self._index[k]
+                self._bytes -= meta[1]
+        for _, meta in victims:
+            try:
+                os.unlink(meta[0])
+            except OSError:
+                pass
+        return len(victims)
+
+    @property
+    def nbytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._index)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._index),
+                "bytes": self._bytes,
+                "max_bytes": self.max_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+            }
+
+
+class TileResultCache:
+    """The two tiers behind one async surface, wrapped in the
+    pass-through contract. ``get``/``put`` are called on the event
+    loop; disk work hops to a single-thread I/O executor."""
+
+    def __init__(
+        self,
+        memory_bytes: int = 256 << 20,
+        protected_fraction: float = 0.8,
+        disk_dir: Optional[str] = None,
+        disk_bytes: int = 1 << 30,
+        ttl_s: float = 0.0,
+        max_entry_bytes: int = 4 << 20,
+    ):
+        self.memory = SegmentedLRU(memory_bytes, protected_fraction)
+        self.ttl_s = ttl_s  # 0 = no expiry (DB invalidation handles it)
+        self.max_entry_bytes = max_entry_bytes
+        # invalidation generation: bumped on every purge. A fill whose
+        # render STARTED under an older generation is discarded at put
+        # time — otherwise a tile rendered from pre-change bytes could
+        # land after the purge and (with ttl 0) serve stale forever.
+        # One global counter, not per-image: invalidations are rare,
+        # discarding the handful of concurrent fills is free, and the
+        # state stays O(1).
+        self._generation = 0
+        self._generation_lock = threading.Lock()
+        self.disk: Optional[DiskTier] = None
+        self._io: Optional[concurrent.futures.ThreadPoolExecutor] = None
+        self._disk_breaker = None
+        self._disk_error_logged = False
+        if disk_dir:
+            try:
+                self.disk = DiskTier(disk_dir, disk_bytes)
+                self._io = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="tile-cache-io"
+                )
+                self._disk_breaker = for_dependency("cache:disk")
+            except Exception:
+                # pass-through from construction onward: a bad spill
+                # dir must not take the service (or the RAM tier) down
+                log.exception(
+                    "disk cache tier unavailable at %s; memory-only",
+                    disk_dir,
+                )
+                self.disk = None
+
+        _LIVE_CACHES.add(self)
+
+    # -- tiered lookup / store ----------------------------------------
+
+    def _fresh(self, entry: Optional[CachedTile]) -> Optional[CachedTile]:
+        if entry is None:
+            return None
+        if self.ttl_s > 0 and (
+            time.monotonic() - entry.stored_at > self.ttl_s
+        ):
+            return None
+        return entry
+
+    async def get(self, key: str) -> Optional[CachedTile]:
+        """Memory, then disk (re-admitting to memory); None on miss —
+        or on ANY cache failure (pass-through)."""
+        try:
+            await INJECTOR.fire_async("cache.memory")
+            entry = self._fresh(self.memory.get(key))
+            if entry is not None:
+                CACHE_REQUESTS.inc(tier="memory", outcome="hit")
+                return entry
+            CACHE_REQUESTS.inc(tier="memory", outcome="miss")
+            if not self._disk_usable():
+                return None
+            # generation snapshot BEFORE the executor hop: an
+            # invalidation racing the disk read must block the
+            # re-admission below, or the purged tile re-enters memory
+            generation = self.generation()
+            loop = asyncio.get_running_loop()
+            # per-call bound on the disk wait (the io-timeout the
+            # Postgres/Redis edges get): a HUNG disk — NFS D-state,
+            # no error ever raised — must read as a miss, not park
+            # the request (which has no deadline yet at cache-lookup
+            # time) and every later miss behind it on this executor
+            fut = loop.run_in_executor(self._io, self._disk_get, key)
+            timeout = io_timeout_s()
+            try:
+                if timeout > 0:
+                    entry = await asyncio.wait_for(fut, timeout)
+                else:
+                    entry = await fut
+            except asyncio.TimeoutError:
+                # the thread is still stuck in the syscall; the
+                # breaker input here is what stops NEW work from
+                # queueing behind it (_disk_usable gates loop-side)
+                self._disk_failure()
+                CACHE_REQUESTS.inc(tier="disk", outcome="miss")
+                return None
+            entry = self._fresh(entry)
+            if entry is not None:
+                evicted = self._put_guarded(key, entry, generation)
+                if evicted is None:
+                    # an invalidation raced the disk read: the bytes
+                    # may predate the change — serve a miss, never a
+                    # maybe-stale body
+                    CACHE_REQUESTS.inc(tier="disk", outcome="miss")
+                    return None
+                # re-admission displaces like any insert: spill the
+                # victims, don't silently drop them from both tiers
+                self._spill_evicted(evicted)
+                CACHE_REQUESTS.inc(tier="disk", outcome="hit")
+                return entry
+            CACHE_REQUESTS.inc(tier="disk", outcome="miss")
+            return None
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            CACHE_ERRORS.inc(tier="get")
+            log.exception("cache get failed; passing through")
+            return None
+
+    def contains(self, key: str) -> bool:
+        """Memory-only presence probe (no promotion, no disk touch) —
+        the prefetcher's cheap dedupe check."""
+        try:
+            return self._fresh(self.memory.peek(key)) is not None
+        except Exception:
+            return False
+
+    def generation(self) -> int:
+        """Snapshot for ``put(..., generation=...)``: capture BEFORE
+        starting the render (or disk read) the entry comes from."""
+        with self._generation_lock:
+            return self._generation
+
+    def _put_guarded(
+        self, key: str, entry: CachedTile, generation: Optional[int]
+    ) -> Optional[List[Tuple[str, CachedTile]]]:
+        """Insert into the memory tier atomically with respect to the
+        generation counter: the check and the insert happen under one
+        lock, so an invalidation from another thread either precedes
+        the check (insert rejected, returns None) or follows the
+        insert (its purge removes the key). Returns the eviction list
+        on success."""
+        with self._generation_lock:
+            if generation is not None and generation != self._generation:
+                # an invalidation landed while this entry was being
+                # produced: its source data may predate the change —
+                # drop it, the next miss re-renders
+                return None
+            return self.memory.put(key, entry)
+
+    async def put(
+        self, key: str, entry: CachedTile,
+        generation: Optional[int] = None,
+    ) -> None:
+        try:
+            await INJECTOR.fire_async("cache.memory")
+            if entry.nbytes > self.max_entry_bytes:
+                return
+            evicted = self._put_guarded(key, entry, generation)
+            if evicted is None:
+                return
+            CACHE_STORES.inc(tier="memory")
+            self._spill_evicted(evicted)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            CACHE_ERRORS.inc(tier="put")
+            log.exception("cache put failed; passing through")
+
+    def _disk_usable(self) -> bool:
+        """Loop-side gate: no disk work is even QUEUED while the tier's
+        breaker is open — a hung disk wedges the one I/O thread, and
+        piling more jobs behind it would grow the queue unboundedly."""
+        return (
+            self.disk is not None
+            and self._io is not None
+            and self._disk_breaker.state != "open"
+        )
+
+    def _spill_evicted(
+        self, evicted: List[Tuple[str, CachedTile]]
+    ) -> None:
+        """Count + fire-and-forget the disk spill of displaced memory
+        entries. Never awaited: the spill runs inside the response
+        path (the single-flight's on_result), and a slow disk must
+        cost the eviction, never the freshly rendered reply."""
+        if not evicted:
+            return
+        CACHE_EVICTIONS.inc(len(evicted), tier="memory")
+        if self._disk_usable():
+            self._io.submit(self._disk_spill, evicted)
+
+    # -- disk-tier internals (I/O executor thread) ---------------------
+
+    def _disk_get(self, key: str) -> Optional[CachedTile]:
+        """Breaker-gated disk read: an open breaker (or any I/O error)
+        reads as a miss, never a failure."""
+        try:
+            self._disk_breaker.allow()
+        except BreakerOpenError:
+            return None
+        try:
+            INJECTOR.fire("cache.disk")
+            entry = self.disk.get(key)
+        except Exception:
+            self._disk_failure()
+            return None
+        self._disk_breaker.record_success()
+        return entry
+
+    def _disk_spill(self, evicted: List[Tuple[str, CachedTile]]) -> None:
+        try:
+            self._disk_breaker.allow()
+        except BreakerOpenError:
+            return
+        try:
+            INJECTOR.fire("cache.disk")
+            for key, entry in evicted:
+                self.disk.put(key, entry)
+                CACHE_STORES.inc(tier="disk")
+        except Exception:
+            self._disk_failure()
+            return
+        self._disk_breaker.record_success()
+
+    def _disk_failure(self) -> None:
+        self._disk_breaker.record_failure()
+        if not self._disk_error_logged:
+            self._disk_error_logged = True
+            log.warning(
+                "disk cache tier failing; degrading to memory-only "
+                "until its breaker heals", exc_info=True,
+            )
+
+    # -- invalidation --------------------------------------------------
+
+    def invalidate_image(self, image_id: int) -> int:
+        """Purge every cached tile of one image, both tiers. Callable
+        from any thread (the metadata resolver's loop thread fires
+        invalidation listeners); disk work is queued on the I/O
+        executor, never awaited."""
+        prefix = f"img={int(image_id)}|"
+        removed = 0
+        try:
+            with self._generation_lock:
+                self._generation += 1
+            removed = self.memory.remove_prefix(prefix)
+            if removed:
+                CACHE_INVALIDATIONS.inc(removed, tier="memory")
+            if self.disk is not None and self._io is not None:
+                self._io.submit(self._disk_invalidate, prefix)
+        except Exception:
+            CACHE_ERRORS.inc(tier="invalidate")
+            log.exception("cache invalidation failed for image %s",
+                          image_id)
+        return removed
+
+    def _disk_invalidate(self, prefix: str) -> None:
+        try:
+            removed = self.disk.remove_prefix(prefix)
+            if removed:
+                CACHE_INVALIDATIONS.inc(removed, tier="disk")
+        except Exception:
+            self._disk_failure()
+
+    # -- lifecycle / observability -------------------------------------
+
+    def snapshot(self) -> dict:
+        out = {"enabled": True, "memory": self.memory.snapshot()}
+        if self.disk is not None:
+            disk = self.disk.snapshot()
+            disk["breaker"] = self._disk_breaker.state
+            out["disk"] = disk
+        return out
+
+    def close(self) -> None:
+        _LIVE_CACHES.discard(self)
+        if self._io is not None:
+            self._io.shutdown(wait=False)

@@ -1,0 +1,86 @@
+"""Single-flight coalescing: one execution per key, shared by all.
+
+Concurrent misses on the same tile key must collapse into ONE pipeline
+execution — without this, a popular tile going cold (deploy, eviction,
+invalidation) triggers a miss *stampede*: every viewer session re-runs
+the identical decode/encode simultaneously and the coalesced batch
+fills with duplicates. (The same pattern already guards Glacier2 joins
+in auth/ice.py; this is the generalized primitive.)
+
+Semantics:
+
+- the first caller for a key becomes the *leader*: its factory runs as
+  an independent task;
+- later callers (*joiners*) await the same task — one execution, one
+  result object shared by all;
+- an error raised by the factory propagates to every waiter;
+- cancelling one waiter (a client hanging up mid-flight) NEVER cancels
+  the flight: the work is already paid for and other waiters — or the
+  cache — still want the result (``asyncio.shield``);
+- each waiter can bound its own wait (``timeout_s``) without affecting
+  the flight or other waiters.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+from ..utils.metrics import REGISTRY
+
+FLIGHTS = REGISTRY.counter(
+    "tile_cache_flights_total",
+    "Single-flight participations by role (leader starts an execution;"
+    " a joiner shares one already in flight)",
+)
+
+
+class SingleFlight:
+    """Per-key coalescer. Single event loop only (flights are tasks on
+    the caller's loop); the process-wide instances live on the event
+    bus and the HTTP app."""
+
+    def __init__(self):
+        self._flights: Dict[Any, asyncio.Task] = {}
+
+    @property
+    def active(self) -> int:
+        return len(self._flights)
+
+    async def do(
+        self,
+        key: Any,
+        factory: Callable[[], Awaitable[Any]],
+        timeout_s: Optional[float] = None,
+    ) -> Any:
+        """Return the (possibly shared) result of ``factory`` for
+        ``key``. Raises whatever the factory raised — to every waiter
+        — or ``asyncio.TimeoutError`` when this waiter's own
+        ``timeout_s`` elapses first (the flight keeps going)."""
+        task = self._flights.get(key)
+        if task is None:
+            task = asyncio.get_running_loop().create_task(
+                self._lead(key, factory)
+            )
+            # if every waiter cancels before the flight fails, nobody
+            # retrieves the exception ("Task exception was never
+            # retrieved" noise) — consume it
+            task.add_done_callback(
+                lambda t: t.cancelled() or t.exception()
+            )
+            self._flights[key] = task
+            FLIGHTS.inc(role="leader")
+        else:
+            FLIGHTS.inc(role="joiner")
+        if timeout_s is None:
+            return await asyncio.shield(task)
+        return await asyncio.wait_for(asyncio.shield(task), timeout_s)
+
+    async def _lead(self, key: Any, factory) -> Any:
+        try:
+            return await factory()
+        finally:
+            # deregister BEFORE waiters resume: a caller that misses
+            # immediately after completion starts a fresh flight
+            # instead of re-reading a finished one
+            self._flights.pop(key, None)

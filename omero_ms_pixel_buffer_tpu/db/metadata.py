@@ -21,13 +21,16 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import logging
 import threading
 import time
-from typing import Optional
+from typing import Callable, List, Optional
 
 from ..io.pixel_buffer import PixelsMeta
 from ..resilience.deadline import DeadlineExceeded, current_deadline
 from .postgres import PostgresClient
+
+log = logging.getLogger("omero_ms_pixel_buffer_tpu.db.metadata")
 
 
 class _LoopThread:
@@ -172,6 +175,43 @@ class OmeroPostgresMetadataResolver:
         self._cache_lock = threading.Lock()
         self._session_cache_ttl_s = session_cache_ttl_s
         self._sessions: dict = {}  # key -> (expires_at, user_ctx|None)
+        # invalidation listeners: fired with the image id whenever a
+        # TTL refresh observes the pixels row CHANGED (dimensions,
+        # type, ownership, permissions) or GONE — the cache layer
+        # (cache/ package, http/server) purges rendered tiles, open
+        # buffers, and device planes for the image in response
+        self._listeners: List[Callable[[int], None]] = []
+
+    def add_invalidation_listener(
+        self, fn: Callable[[int], None]
+    ) -> None:
+        """Register ``fn(image_id)`` to run when this resolver observes
+        a changed/deleted pixels row. Listeners fire on whatever
+        thread refreshed the row (usually the resolver's background
+        loop) and must be thread-safe and non-blocking; exceptions are
+        logged and isolated."""
+        self._listeners.append(fn)
+
+    def _notify_invalidated(self, image_id: int) -> None:
+        for fn in list(self._listeners):
+            try:
+                fn(image_id)
+            except Exception:
+                log.exception(
+                    "invalidation listener failed for image %s", image_id
+                )
+
+    @staticmethod
+    def _row_signature(row) -> tuple:
+        """The change-detection fingerprint of one pixels row: any
+        difference here means cached tiles rendered from the old row
+        may be stale (or newly unauthorized)."""
+        meta, owner_id, group_id, perms = row
+        return (
+            meta.size_x, meta.size_y, meta.size_z, meta.size_c,
+            meta.size_t, meta.pixels_type, meta.image_name,
+            owner_id, group_id, perms,
+        )
 
     def _cache_get(self, cache: dict, key):
         with self._cache_lock:
@@ -183,16 +223,45 @@ class OmeroPostgresMetadataResolver:
     def _cache_put(self, cache: dict, key, value, ttl_s: float) -> None:
         with self._cache_lock:
             if len(cache) >= self._cache_max:
-                cache.clear()  # coarse but bounded
+                # evict the oldest-inserted tenth, NOT everything:
+                # pixels rows double as the invalidation-detection
+                # baselines (_pixels_row compares the stale entry
+                # against the refresh), and a wholesale clear would
+                # silently disarm change detection for every image at
+                # once
+                for stale_key in list(cache)[
+                    : max(1, self._cache_max // 10)
+                ]:
+                    del cache[stale_key]
             cache[key] = (time.monotonic() + ttl_s, value)
+
+    def _cache_peek_stale(self, cache: dict, key):
+        """The entry's value even when EXPIRED (the change-detection
+        baseline at refresh time); None when absent."""
+        with self._cache_lock:
+            hit = cache.get(key)
+        return None if hit is None else hit[1]
+
+    def _cache_pop(self, cache: dict, key) -> None:
+        with self._cache_lock:
+            cache.pop(key, None)
 
     async def _pixels_row(self, image_id: int):
         """(meta, owner_id, group_id, permissions) or None, TTL-cached."""
         cached, row = self._cache_get(self._cache, image_id)
         if cached:
             return row
+        # the expired (or absent) previous row is the change-detection
+        # baseline: a refresh that reads something DIFFERENT fires the
+        # invalidation listeners
+        prev_row = self._cache_peek_stale(self._cache, image_id)
         rows = await self._client.query(PIXELS_QUERY, [str(image_id)])
         if not rows:
+            if prev_row is not None:
+                # the image vanished (deleted mid-serving): purge our
+                # own stale row and everything cached downstream
+                self._cache_pop(self._cache, image_id)
+                self._notify_invalidated(image_id)
             # no negative caching: an image mid-import must become
             # visible on the next request, not after a TTL of 404s
             return None  # -> 404 "Cannot find Image:<id>"
@@ -217,8 +286,22 @@ class OmeroPostgresMetadataResolver:
             int(group_id) if group_id is not None else None,
             int(perms) if perms is not None else _PRIVATE,
         )
+        if prev_row is not None and self._row_signature(
+            prev_row
+        ) != self._row_signature(row):
+            log.info("pixels row changed for image %s; invalidating",
+                     image_id)
+            self._notify_invalidated(image_id)
         self._cache_put(self._cache, image_id, row, self._cache_ttl_s)
         return row
+
+    def invalidate(self, image_id: int) -> None:
+        """Operational hook: forget the cached row NOW and fire the
+        listeners (e.g. an import pipeline that knows it just rewrote
+        the image, without waiting out the TTL)."""
+        image_id = int(image_id)
+        self._cache_pop(self._cache, image_id)
+        self._notify_invalidated(image_id)
 
     async def _session_context(self, session_key):
         """(user_id, {group_id: is_leader}, is_admin) for a LIVE

@@ -28,6 +28,7 @@ from urllib.parse import parse_qs, unquote, urlparse
 
 from ..resilience.breaker import BreakerOpenError, for_dependency
 from ..resilience.faultinject import INJECTOR
+from ..resilience.timeouts import io_timeout_s
 
 
 class PostgresError(RuntimeError):
@@ -320,16 +321,32 @@ class PostgresClient:
             # latency included): the slow-call trip rule's input
             t0 = time.monotonic()
             try:
-                await INJECTOR.fire_async("db.postgres")
-                if self._writer is None:
-                    await self.connect()
-                try:
-                    rows = await self._query_locked(sql, params)
-                except (ConnectionError, EOFError, OSError,
-                        asyncio.IncompleteReadError):
-                    await self.close_nowait()
-                    await self.connect()
-                    rows = await self._query_locked(sql, params)
+                # per-call cap (resilience/timeouts): one exchange —
+                # connect + auth + query round trip, injected chaos
+                # latency included — may never park the caller longer
+                # than the configured bound; a Postgres that stops
+                # ANSWERING fails like one that refuses connections
+                timeout = io_timeout_s()
+                if timeout > 0:
+                    rows = await asyncio.wait_for(
+                        self._exchange(sql, params), timeout
+                    )
+                else:
+                    rows = await self._exchange(sql, params)
+            except asyncio.TimeoutError:
+                # the connection is mid-protocol: unusable — drop it,
+                # and the silence is breaker input like a refusal.
+                # Surface as UNAVAILABLE (-> 503 via the pipeline's
+                # dependency-down mapping), never a raw TimeoutError:
+                # that would fall into the broad catch and read as
+                # 404 "Cannot find Image" for an image that exists
+                await self.close_nowait()
+                self.breaker.record_failure()
+                raise PostgresUnavailableError(
+                    f"postgres exchange exceeded the "
+                    f"{timeout * 1000:.0f} ms per-call io-timeout",
+                    retry_after_s=1.0,
+                ) from None
             except (ConnectionError, EOFError, OSError,
                     asyncio.IncompleteReadError):
                 # transport-level outage: breaker input
@@ -349,6 +366,21 @@ class PostgresClient:
                 duration_s=time.monotonic() - t0
             )
             return rows
+
+    async def _exchange(self, sql, params):
+        """One guarded exchange (fault point + lazy connect + the
+        reconnect-once retry); the caller holds the lock and bounds
+        the whole thing with the per-call timeout."""
+        await INJECTOR.fire_async("db.postgres")
+        if self._writer is None:
+            await self.connect()
+        try:
+            return await self._query_locked(sql, params)
+        except (ConnectionError, EOFError, OSError,
+                asyncio.IncompleteReadError):
+            await self.close_nowait()
+            await self.connect()
+            return await self._query_locked(sql, params)
 
     async def _query_locked(self, sql, params):
         # Parse (unnamed statement), Bind, Execute, Sync

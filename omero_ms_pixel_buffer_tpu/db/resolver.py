@@ -146,6 +146,17 @@ class OmeroImageSource:
         self._cache: dict = {}  # image_id -> (expires_at, entry)
         self._repo_roots: dict = {}  # repo uuid -> root dir
         self._lock = threading.Lock()
+        # a changed pixels row also means the storage path may have
+        # moved (re-import, regenerated pyramid): drop the resolved
+        # entry so the next request re-walks the fileset
+        if hasattr(self.metadata, "add_invalidation_listener"):
+            self.metadata.add_invalidation_listener(self.invalidate)
+
+    def invalidate(self, image_id: int) -> None:
+        """Forget the resolved storage entry for one image (the
+        metadata plane's invalidation listener)."""
+        with self._lock:
+            self._cache.pop(int(image_id), None)
 
     # -- registry surface -------------------------------------------------
 

@@ -53,6 +53,10 @@ BATCH_SIZE = REGISTRY.histogram(
     "tile_batch_size", "Lanes per coalesced batch",
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, float("inf")),
 )
+LANES_DEDUPED = REGISTRY.counter(
+    "tile_batch_deduped_lanes_total",
+    "Batch lanes that shared another identical lane's execution",
+)
 
 
 class BatchingTileWorker:
@@ -267,6 +271,24 @@ class BatchingTileWorker:
         self, batch: List[Tuple[TileCtx, asyncio.Future]], loop
     ) -> None:
         BATCH_SIZE.observe(len(batch))
+        # Identical-key dedup: lanes equal under lane_key (tile spec +
+        # session) execute ONCE; followers share the canonical lane's
+        # result. The HTTP front's single-flight already collapses its
+        # own duplicates, but direct bus users and the window between
+        # cache layers can still seed a batch with copies — the
+        # pipeline must never render the same tile twice in one batch.
+        canonical: List[Tuple[TileCtx, asyncio.Future]] = []
+        followers: dict = {}  # canonical index -> [(ctx, fut), ...]
+        seen: dict = {}
+        for c, f in batch:
+            k = c.lane_key()
+            if k in seen:
+                followers.setdefault(seen[k], []).append((c, f))
+                LANES_DEDUPED.inc()
+            else:
+                seen[k] = len(canonical)
+                canonical.append((c, f))
+        batch = canonical
         ctxs = [b[0] for b in batch]
         if len(batch) == 1:
             work = lambda: [self.pipeline.handle(ctxs[0])]  # noqa: E731
@@ -308,18 +330,30 @@ class BatchingTileWorker:
         except Exception as e:
             bspan.error(e)
             log.exception("batch execution failed")
-            for _, f in batch:
-                if not f.done():
-                    f.set_exception(InternalError())
+            for i, (_, f) in enumerate(batch):
+                for _, lf in [(None, f)] + followers.get(i, []):
+                    if not lf.done():
+                        lf.set_exception(InternalError())
             return
         finally:
             bspan.__exit__(None, None, None)
-        for (_, f), result in zip(batch, results):
-            if not f.done():
+        for i, ((ctx, f), result) in enumerate(zip(batch, results)):
+            lanes = [(ctx, f)] + followers.get(i, [])
+            for lane_ctx, lane_fut in lanes:
+                if lane_ctx is not ctx:
+                    # the pipeline resolved w/h==0 defaulting into the
+                    # canonical ctx's region; mirror it so follower
+                    # replies carry the same filename header
+                    lane_ctx.region.x = ctx.region.x
+                    lane_ctx.region.y = ctx.region.y
+                    lane_ctx.region.width = ctx.region.width
+                    lane_ctx.region.height = ctx.region.height
+                if lane_fut.done():
+                    continue
                 if isinstance(result, TileError):
                     # typed per-lane failure (e.g. 503 dependency
                     # breaker open) — surfaces with its own HTTP code
                     # instead of degrading to 404
-                    f.set_exception(result)
+                    lane_fut.set_exception(result)
                 else:
-                    f.set_result(result)
+                    lane_fut.set_result(result)

@@ -18,11 +18,16 @@ fails with code -1, which the HTTP mapping coerces to 500
 from __future__ import annotations
 
 import asyncio
+import inspect
+import logging
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
+from ..cache.single_flight import SingleFlight
 from ..errors import GatewayTimeoutError, TileError
 from ..resilience.deadline import DEADLINE_EXCEEDED
 from ..resilience.faultinject import INJECTOR
+
+log = logging.getLogger("omero_ms_pixel_buffer_tpu.bus")
 
 # address constant (PixelBufferVerticle.java:52-53)
 GET_TILE_EVENT = "omero.pixel_buffer.get_tile"
@@ -44,6 +49,9 @@ class Message:
 class EventBus:
     def __init__(self):
         self._consumers: Dict[str, Handler] = {}
+        # single-flight registry for request_coalesced: concurrent
+        # identical-key requests share ONE consumer execution
+        self._flights = SingleFlight()
 
     def consumer(self, address: str, handler: Handler) -> None:
         """Register the handler for an address. Handlers return
@@ -85,3 +93,63 @@ class EventBus:
             return result
         body, headers = result
         return Message(body, headers)
+
+    async def request_coalesced(
+        self,
+        address: str,
+        payload: Any,
+        key: Any,
+        timeout_ms: float = 15000.0,
+        on_result: Optional[Callable[[Message], Any]] = None,
+    ) -> Message:
+        """``request`` with single-flight coalescing: concurrent calls
+        sharing ``key`` collapse into ONE consumer execution whose
+        reply every caller receives (cache/single_flight.py). The
+        leader's payload drives the execution; joiners only wait —
+        bounded by their OWN deadline, so a short-budget joiner times
+        out (504) without disturbing the flight. A consumer failure
+        fans out to every waiter; a waiter's cancellation (client
+        hung up) never cancels the flight.
+
+        ``on_result`` runs exactly once per execution, inside the
+        flight, before any waiter resumes — the HTTP front uses it to
+        fill the result cache (and stamp the ETag header) exactly
+        once no matter how many requests coalesced. Its failures are
+        logged, never propagated: memoization must not fail the
+        request it memoizes."""
+
+        async def factory() -> Message:
+            msg = await self.request(address, payload, timeout_ms)
+            if on_result is not None:
+                try:
+                    result = on_result(msg)
+                    if inspect.isawaitable(result):
+                        await result
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    log.exception("on_result hook failed (ignored)")
+            return msg
+
+        deadline = getattr(payload, "deadline", None)
+        timeout_s = timeout_ms / 1000.0
+        if deadline is not None:
+            timeout_s = deadline.cap(timeout_s)
+        try:
+            return await self._flights.do(
+                (address, key), factory, timeout_s=timeout_s
+            )
+        except asyncio.TimeoutError:
+            # this WAITER ran out of time (the flight may still land
+            # for others): same mapping as request()
+            if deadline is not None and deadline.expired:
+                DEADLINE_EXCEEDED.inc(stage="bus")
+                raise GatewayTimeoutError(
+                    f"Request deadline exceeded after "
+                    f"{timeout_s * 1000:.0f} ms"
+                ) from None
+            raise TileError(
+                -1,
+                f"Timed out after {timeout_ms:.0f} ms waiting for a "
+                "coalesced reply",
+            ) from None

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 import time
 from typing import Optional
 
@@ -34,8 +35,14 @@ from ..auth.omero_session import (
     SessionValidator,
 )
 from ..auth.stores import OmeroWebSessionStore, make_session_store
+from ..cache.prefetch import ViewportPrefetcher
+from ..cache.result_cache import (
+    CachedTile,
+    TileResultCache,
+    etag_matches,
+)
 from ..dispatch.batcher import BatchingTileWorker
-from ..dispatch.bus import GET_TILE_EVENT, EventBus
+from ..dispatch.bus import GET_TILE_EVENT, EventBus, Message
 from ..errors import (
     ServiceUnavailableError,
     TileError,
@@ -326,6 +333,55 @@ class PixelBufferApp:
         )
         self.bus = EventBus()
         self.bus.consumer(GET_TILE_EVENT, self.worker.handle)
+        # -- tiered tile-result cache + viewport prefetch (cache/) ----
+        cc = config.cache
+        self.result_cache: Optional[TileResultCache] = None
+        self.prefetcher: Optional[ViewportPrefetcher] = None
+        if cc.enabled:
+            self.result_cache = TileResultCache(
+                memory_bytes=cc.memory_mb << 20,
+                protected_fraction=cc.protected_fraction,
+                disk_dir=cc.disk_dir,
+                disk_bytes=cc.disk_mb << 20,
+                ttl_s=cc.ttl_s,
+                max_entry_bytes=cc.max_entry_kb << 10,
+            )
+            if cc.prefetch.enabled:
+                self.prefetcher = ViewportPrefetcher(
+                    self._prefetch_fetch,
+                    self.result_cache,
+                    self.admission,
+                    quality=self.pipeline.encode_signature(),
+                    queue_size=cc.prefetch.queue_size,
+                    headroom_fraction=cc.prefetch.headroom,
+                    # 0 = the full request budget: real requests JOIN
+                    # prefetch flights, so a shorter leader deadline
+                    # would 504 them on stores a direct request rides out
+                    budget_s=(
+                        cc.prefetch.budget_ms / 1000.0
+                        or self.request_budget_s
+                    ),
+                    lookahead=cc.prefetch.lookahead,
+                )
+        # authorization-verdict TTL cache for the hit path: a session
+        # that just took the FULL path for an image (session join +
+        # ACL inside the worker/resolver) stays authorized for that
+        # image for a short window, so serving a RAM hit costs a dict
+        # probe instead of an executor hop per tile. The 10 s bound
+        # matches the resolver's session-context TTL (db/metadata):
+        # a revoked session or ACL stops reading within it.
+        self._authz_ttl_s = 10.0
+        self._authz_cache: dict = {}  # (session, image) -> expiry
+        self._authz_lock = threading.Lock()  # invalidation is x-thread
+        # invalidation: when the metadata resolver observes a changed
+        # pixels row, purge every cached artifact of the image —
+        # rendered tiles (both tiers), the open pixel buffer, and any
+        # device-resident planes
+        resolver = getattr(self.pixels_service, "metadata_resolver", None)
+        if resolver is not None and hasattr(
+            resolver, "add_invalidation_listener"
+        ):
+            resolver.add_invalidation_listener(self._invalidate_image)
         if config.jmx_metrics_enabled:
             # JMX/hotspot collectors analog (:202-218), config-gated
             from ..utils.process_metrics import install as install_process
@@ -370,12 +426,18 @@ class PixelBufferApp:
         if self.watchdog is not None:
             self.watchdog.start()  # on the serving loop's thread
         await self.worker.start()
+        if self.prefetcher is not None:
+            self.prefetcher.start()
 
     async def _on_cleanup(self, app) -> None:
         # stop() analog (:298-308): worker, session store, pixel
         # buffers, then the span reporter/sender
         if self.watchdog is not None:
             self.watchdog.stop()
+        if self.prefetcher is not None:
+            await self.prefetcher.close()
+        if self.result_cache is not None:
+            self.result_cache.close()
         await self.worker.close()
         await self.session_store.close()
         self.pixels_service.close()
@@ -400,6 +462,19 @@ class PixelBufferApp:
             if self.watchdog is not None
             else {"enabled": False}
         )
+        cache_health = (
+            self.result_cache.snapshot()
+            if self.result_cache is not None
+            else {"enabled": False}
+        )
+        planes = self.pipeline.plane_cache_snapshot()
+        if planes is not None:
+            cache_health["device_planes"] = planes
+        prefetch_health = (
+            self.prefetcher.snapshot()
+            if self.prefetcher is not None
+            else {"enabled": False}
+        )
         degraded = (
             any(b["state"] == "open" for b in breakers.values())
             or admission["inflight"] >= admission["max_inflight"]
@@ -413,9 +488,152 @@ class PixelBufferApp:
                 "admission": admission,
                 "queue_depth": queue_depth,
                 "loop": loop_health,
+                "cache": cache_health,
+                "prefetch": prefetch_health,
                 "request_budget_ms": self.request_budget_s * 1000.0,
             }
         )
+
+    # -- tile serving: cache hit / conditional GET / coalesced miss ----
+
+    def _cache_headers(self, etag: Optional[str]) -> dict:
+        """Validator + freshness headers on every tile answer the
+        cache layer saw. ``private``: tile responses are authorized
+        per browser session, so shared proxies must not store them."""
+        headers = {}
+        if etag:
+            headers["ETag"] = etag
+            headers["Cache-Control"] = (
+                f"private, max-age={int(self.config.cache.max_age_s)}"
+            )
+        return headers
+
+    def _tile_response(
+        self, ctx: TileCtx, body: bytes, filename: str,
+        etag: Optional[str], x_cache: Optional[str] = None,
+    ) -> web.Response:
+        headers = {
+            "Content-Type": CONTENT_TYPES.get(
+                ctx.format, "application/octet-stream"
+            ),
+            "Content-Length": str(len(body)),
+            "Content-Disposition": (
+                f'attachment; filename="{filename}"'
+            ),
+            **self._cache_headers(etag),
+        }
+        if x_cache:
+            headers["X-Cache"] = x_cache
+        return web.Response(body=body, headers=headers)
+
+    def _authz_fresh(self, ctx: TileCtx) -> bool:
+        with self._authz_lock:
+            expiry = self._authz_cache.get(
+                (ctx.omero_session_key, ctx.image_id)
+            )
+        return expiry is not None and expiry > time.monotonic()
+
+    def _authz_record(self, ctx: TileCtx) -> None:
+        with self._authz_lock:
+            if len(self._authz_cache) >= 65536:
+                self._authz_cache.clear()  # coarse but bounded
+            self._authz_cache[(ctx.omero_session_key, ctx.image_id)] = (
+                time.monotonic() + self._authz_ttl_s
+            )
+
+    def _authz_purge(self, image_id: int) -> None:
+        with self._authz_lock:
+            for key in [
+                k for k in self._authz_cache if k[1] == image_id
+            ]:
+                del self._authz_cache[key]
+
+    async def _authorize_cached(self, ctx: TileCtx) -> bool:
+        """A cache hit skips the *pipeline*, never the auth: the
+        caller's session must still validate (Glacier2/allow-list,
+        TTL-cached) and — under a permission-scoped resolver — the
+        image must still resolve for this caller (the ACL contract:
+        unauthorized reads exactly like nonexistent). Any failure
+        answers False and the request takes the full miss path, which
+        maps auth/store failures to proper statuses."""
+        if self._authz_fresh(ctx):
+            return True
+        try:
+            ok = await self.session_validator.validate(
+                ctx.omero_session_key
+            )
+            if not ok:
+                return False
+            svc = self.pixels_service
+            loop = asyncio.get_running_loop()
+            meta = await loop.run_in_executor(
+                None,
+                lambda: svc.get_pixels(
+                    ctx.image_id, session_key=ctx.omero_session_key
+                ),
+            )
+            if meta is None:
+                return False
+            self._authz_record(ctx)
+            return True
+        except Exception:
+            log.debug("cache-hit authorization failed; full path",
+                      exc_info=True)
+            return False
+
+    def _cache_filler(self, key: str):
+        """The request_coalesced on_result hook: memoize exactly once
+        per flight (no matter how many requests coalesced) and stamp
+        the ETag onto the shared reply so every waiter's response
+        carries the validator. The invalidation generation is captured
+        NOW — before the render — so a purge landing mid-flight
+        discards this fill instead of racing it into the cache."""
+        cache = self.result_cache
+        generation = cache.generation()
+
+        async def fill(msg: Message) -> None:
+            entry = CachedTile(
+                bytes(msg.body),
+                filename=msg.headers.get("filename", ""),
+            )
+            msg.headers["etag"] = entry.etag
+            await cache.put(key, entry, generation=generation)
+
+        return fill
+
+    async def _fetch_tile(self, ctx: TileCtx, key: str) -> Message:
+        """The shared miss path: coalesced bus request, memoized on
+        completion. ``key`` is the content key; the flight dedupes on
+        the session-scoped key so one caller never rides past another
+        caller's ACL check."""
+        quality = self.pipeline.encode_signature()
+        on_result = (
+            self._cache_filler(key)
+            if self.result_cache is not None else None
+        )
+        return await self.bus.request_coalesced(
+            GET_TILE_EVENT,
+            ctx,
+            ctx.dedupe_key(quality),
+            timeout_ms=self.config.event_bus_send_timeout_ms,
+            on_result=on_result,
+        )
+
+    async def _prefetch_fetch(self, ctx: TileCtx, key: str) -> None:
+        """The prefetcher's fetch hook: identical machinery to a real
+        miss, so warmed tiles land in the cache with their ETags and
+        dedupe against concurrent real requests."""
+        await self._fetch_tile(ctx, key)
+
+    def _invalidate_image(self, image_id: int) -> None:
+        """Metadata-change listener: purge every cached artifact of
+        the image (called from the resolver's refresh thread) — tiles,
+        authorization verdicts (the row change may BE an ACL change),
+        the open buffer, and device planes."""
+        if self.result_cache is not None:
+            self.result_cache.invalidate_image(image_id)
+        self._authz_purge(image_id)
+        self.pipeline.invalidate_image(image_id)
 
     async def handle_get_tile(self, request: web.Request) -> web.Response:
         log.info("Get tile")
@@ -427,6 +645,41 @@ class PixelBufferApp:
             )
         except TileError as e:
             return web.Response(status=400, text=e.message)
+
+        cache = self.result_cache
+        inm = request.headers.get("If-None-Match", "")
+        key = None
+        if cache is not None:
+            key = ctx.cache_key(self.pipeline.encode_signature())
+            entry = await cache.get(key)
+            if entry is not None:
+                if inm and etag_matches(inm, entry.etag) and (
+                    self.config.cache.etag_precheck
+                ):
+                    # conditional-GET short circuit BEFORE the
+                    # session join / ACL re-check: a matching strong
+                    # content ETag proves the client already holds
+                    # these exact bytes — revalidation discloses
+                    # nothing new (config `cache.etag-precheck: false`
+                    # moves this below the authorization step)
+                    return web.Response(
+                        status=304, headers=self._cache_headers(entry.etag)
+                    )
+                if await self._authorize_cached(ctx):
+                    if self.prefetcher is not None:
+                        self.prefetcher.observe(ctx)
+                    if inm and etag_matches(inm, entry.etag):
+                        return web.Response(
+                            status=304,
+                            headers=self._cache_headers(entry.etag),
+                        )
+                    return self._tile_response(
+                        ctx, entry.body, entry.filename, entry.etag,
+                        x_cache="hit",
+                    )
+                # authorization didn't confirm: fall through to the
+                # full pipeline path, which maps 403/404/503 properly
+
         ctx.trace_context = TRACER.inject(request.get("span"))
         # the end-to-end budget: minted once here, decremented by
         # every layer below (bus wait, batching, store retries) —
@@ -434,11 +687,18 @@ class PixelBufferApp:
         ctx.deadline = Deadline.after(self.request_budget_s)
 
         try:
-            reply = await self.bus.request(
-                GET_TILE_EVENT,
-                ctx,
-                timeout_ms=self.config.event_bus_send_timeout_ms,
-            )
+            if key is not None:
+                reply = await self._fetch_tile(ctx, key)
+            else:
+                # cache.enabled: false disables the WHOLE subsystem,
+                # single-flight included — operators who turn it off
+                # (e.g. the chaos suite) get true per-request
+                # execution back
+                reply = await self.bus.request(
+                    GET_TILE_EVENT,
+                    ctx,
+                    timeout_ms=self.config.event_bus_send_timeout_ms,
+                )
         except Exception as e:
             status = http_status_for_failure(e)
             if status < 1:
@@ -455,17 +715,25 @@ class PixelBufferApp:
                 span.tag("http.status", status)
             return web.Response(status=status, headers=headers)
 
-        tile: bytes = reply.body
-        headers = {
-            "Content-Type": CONTENT_TYPES.get(
-                ctx.format, "application/octet-stream"
-            ),
-            "Content-Length": str(len(tile)),
-            "Content-Disposition": (
-                f'attachment; filename="{reply.headers.get("filename", "")}"'
-            ),
-        }
-        return web.Response(body=tile, headers=headers)
+        # the full path just validated the session AND resolved the
+        # image under its ACL: remember the verdict for the hit path
+        # (only the hit path reads it — no bookkeeping when the cache
+        # is off)
+        if cache is not None:
+            self._authz_record(ctx)
+        if self.prefetcher is not None:
+            self.prefetcher.observe(ctx)
+        etag = reply.headers.get("etag")
+        if inm and etag and etag_matches(inm, etag):
+            # freshly rendered, but it matches what the client holds
+            # (e.g. the cache was cold after a restart): spare the body
+            return web.Response(
+                status=304, headers=self._cache_headers(etag)
+            )
+        return self._tile_response(
+            ctx, reply.body, reply.headers.get("filename", ""), etag,
+            x_cache="miss" if cache is not None else None,
+        )
 
 
 def create_app(

@@ -256,6 +256,18 @@ class PixelsService:
                 self._cache.popitem(last=False)
         return buf
 
+    def invalidate(self, image_id: int) -> Optional[int]:
+        """Drop the image's cached buffer (cache-invalidation hook: a
+        changed ``pixels`` row makes the parsed IFD/zarr structure
+        stale). The buffer is NOT closed — concurrent requests may be
+        mid-read; it closes on finalization like an LRU eviction.
+        Returns the dropped buffer's block/plane cache namespace so
+        callers can purge dependent caches, or None if nothing was
+        open."""
+        with self._lock:
+            buf = self._cache.pop(int(image_id), None)
+        return getattr(buf, "cache_ns", None) if buf is not None else None
+
     def close(self) -> None:
         with self._lock:
             for buf in self._cache.values():

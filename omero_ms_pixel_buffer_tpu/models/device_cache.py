@@ -160,6 +160,36 @@ class DevicePlaneCache:
         xs = jnp.asarray([c[1] for c in coords], jnp.int32)
         return _crop_batch(plane, ys, xs, bh, bw)
 
+    def invalidate_ns(self, cache_ns) -> int:
+        """Drop every resident plane (and pending admission count) of
+        one buffer namespace — the image-invalidation hook: a changed
+        ``pixels`` row means the staged planes no longer match disk.
+        Returns how many planes were dropped."""
+        with self._lock:
+            victims = [k for k in self._planes if k[0] == cache_ns]
+            for k in victims:
+                plane = self._planes.pop(k)
+                self._bytes -= plane.nbytes
+            for k in [t for t in self._touches if t[0] == cache_ns]:
+                self._touches.pop(k, None)
+        if victims:
+            log.info(
+                "invalidated %d device plane(s) for namespace %s",
+                len(victims), cache_ns,
+            )
+        return len(victims)
+
+    def snapshot(self) -> dict:
+        """/healthz view: residency + effectiveness of the HBM tier."""
+        with self._lock:
+            return {
+                "planes": len(self._planes),
+                "bytes": self._bytes,
+                "max_bytes": self.max_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+            }
+
     @property
     def nbytes(self) -> int:
         with self._lock:

@@ -173,6 +173,32 @@ class TilePipeline:
             max_workers=encode_workers, thread_name_prefix="encode"
         )
 
+    def encode_signature(self) -> str:
+        """The 'quality' component of the result-cache key schema
+        (cache/result_cache): encoded bytes depend on the PNG encode
+        policy, so a config change must produce new keys (and new
+        ETags), never serve bytes rendered under the old policy."""
+        return f"{self.png_filter}.{self.png_level}.{self.png_strategy}"
+
+    def invalidate_image(self, image_id: int) -> None:
+        """Cache-invalidation hook (a changed ``pixels`` row): drop
+        the image's open buffer — its parsed structure is stale — and
+        any device-resident planes staged from it. The next request
+        re-opens from disk; orphaned decoded blocks age out of the
+        shared BlockCache by LRU (their namespace is never reused)."""
+        svc = self.pixels_service
+        ns = None
+        if hasattr(svc, "invalidate"):
+            ns = svc.invalidate(image_id)
+        if ns is not None and self._plane_cache is not None:
+            self._plane_cache.invalidate_ns(ns)
+
+    def plane_cache_snapshot(self) -> Optional[dict]:
+        """/healthz view of the HBM plane tier; None when the device
+        path hasn't staged anything (host serving never builds it)."""
+        cache = self._plane_cache
+        return None if cache is None else cache.snapshot()
+
     @property
     def engine(self) -> str:
         """The resolved engine.

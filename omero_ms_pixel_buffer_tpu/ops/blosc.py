@@ -23,6 +23,15 @@ Frame layout (c-blosc 1.x, BLOSC_VERSION_FORMAT 2):
 Shuffle is per block: the leading ``size - size % typesize`` bytes are
 a (typesize, n) byte transpose; the remainder is copied verbatim.
 
+Bit-shuffle (flag bit 2) is the bitshuffle-library transform c-blosc
+embeds: the block's leading whole group of ``8*typesize``-byte units
+is treated as an (elements, typesize*8) bit matrix — bit order within
+an element is byte-major then LSB-first, matching
+``bshuf_trans_bit_elem``'s scalar reference — and transposed into
+bit-planes, each plane packing element bits LSB-first. The trailing
+partial group (fewer than 8 elements) is copied verbatim, like the
+byte-shuffle remainder.
+
 Supported codecs: lz4 (in-tree, ops/lz4), zstd (the ``zstandard``
 wheel), zlib (stdlib), memcpy. blosclz/snappy raise a clear error —
 callers surface it as an unreadable chunk.
@@ -73,6 +82,48 @@ def _shuffle(block: bytes, typesize: int) -> bytes:
     return sh.tobytes() + block[main:]
 
 
+def _bit_main(block: bytes, typesize: int) -> int:
+    """Bytes covered by whole 8-element groups (the bit-transposable
+    region); the remainder is copied verbatim on both directions."""
+    nelem = len(block) // typesize
+    return (nelem - nelem % 8) * typesize
+
+
+def _bit_shuffle(block: bytes, typesize: int) -> bytes:
+    """bitshuffle forward transform: (elements, typesize*8) bit matrix
+    -> transposed bit planes, LSB-first within bytes on both axes."""
+    if typesize < 1:
+        return block
+    main = _bit_main(block, typesize)
+    if main == 0:
+        return block
+    nelem = main // typesize
+    arr = np.frombuffer(block, np.uint8, count=main).reshape(
+        nelem, typesize
+    )
+    bits = np.unpackbits(arr, axis=1, bitorder="little")
+    planes = np.packbits(bits.T, axis=1, bitorder="little")
+    return planes.tobytes() + block[main:]
+
+
+def _bit_unshuffle(block: bytes, typesize: int) -> bytes:
+    """Inverse of ``_bit_shuffle``: unpack the bit planes and
+    re-interleave each element's bits."""
+    if typesize < 1:
+        return block
+    main = _bit_main(block, typesize)
+    if main == 0:
+        return block
+    nelem = main // typesize
+    nbits = typesize * 8
+    planes = np.frombuffer(block, np.uint8, count=main).reshape(
+        nbits, nelem // 8
+    )
+    bits = np.unpackbits(planes, axis=1, bitorder="little")
+    elems = np.packbits(bits.T, axis=1, bitorder="little")
+    return elems.tobytes() + block[main:]
+
+
 def blosc_decompress(data: bytes, expected_nbytes: int = -1) -> bytes:
     """Decode one Blosc frame. ``expected_nbytes`` (e.g. the Zarr chunk
     capacity) bounds hostile headers; -1 trusts the frame."""
@@ -89,8 +140,8 @@ def blosc_decompress(data: bytes, expected_nbytes: int = -1) -> bytes:
             f"blosc frame declares {nbytes} bytes, expected "
             f"<= {expected_nbytes}"
         )
-    if flags & _BIT_SHUFFLE:
-        raise BloscError("blosc bit-shuffle is not supported")
+    if (flags & _BIT_SHUFFLE) and (flags & _BYTE_SHUFFLE):
+        raise BloscError("both shuffle flags set")
     if nbytes == 0:
         return b""
     if flags & _MEMCPYED:
@@ -142,6 +193,8 @@ def blosc_decompress(data: bytes, expected_nbytes: int = -1) -> bytes:
             )
         if flags & _BYTE_SHUFFLE:
             block = _unshuffle(block, typesize)
+        elif flags & _BIT_SHUFFLE:
+            block = _bit_unshuffle(block, typesize)
         out.extend(block)
     return bytes(out)
 
@@ -150,19 +203,28 @@ def blosc_compress(
     data: bytes,
     typesize: int = 1,
     cname: str = "lz4",
-    shuffle: bool = True,
+    shuffle=True,
     blocksize: int = 0,
 ) -> bytes:
     """Fixture/test-grade Blosc frame writer (valid frames, no tuning).
     ``blocksize`` 0 picks one block for small inputs, 256 KiB blocks
-    otherwise (the c-blosc ballpark)."""
+    otherwise (the c-blosc ballpark). ``shuffle``: True/"byte" for
+    byte shuffle, "bit" for bit shuffle, False/None for none."""
     nbytes = len(data)
     if cname not in ("lz4", "zstd", "zlib"):
         raise BloscError(f"unsupported compressor: {cname}")
     if blocksize <= 0:
         blocksize = nbytes if nbytes <= (1 << 18) else (1 << 18)
     blocksize = max(blocksize, typesize, 1)
-    flags = (_CODEC_IDS[cname] << 5) | (_BYTE_SHUFFLE if shuffle else 0)
+    if shuffle in (True, "byte"):
+        shuffle_flag = _BYTE_SHUFFLE
+    elif shuffle == "bit":
+        shuffle_flag = _BIT_SHUFFLE
+    elif shuffle in (False, None, "none"):
+        shuffle_flag = 0
+    else:
+        raise BloscError(f"unknown shuffle mode: {shuffle!r}")
+    flags = (_CODEC_IDS[cname] << 5) | shuffle_flag
     if nbytes == 0:
         header = struct.pack(
             "<BBBBiii", 2, 1, flags, typesize, 0, blocksize, _HEADER
@@ -172,8 +234,10 @@ def blosc_compress(
     chunks = []
     for i in range(nblocks):
         block = data[i * blocksize : (i + 1) * blocksize]
-        if shuffle:
+        if shuffle_flag == _BYTE_SHUFFLE:
             block = _shuffle(block, typesize)
+        elif shuffle_flag == _BIT_SHUFFLE:
+            block = _bit_shuffle(block, typesize)
         if cname == "lz4":
             comp = lz4_block_compress(block)
         elif cname == "zstd":

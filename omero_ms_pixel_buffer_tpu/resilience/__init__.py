@@ -30,6 +30,7 @@ from .deadline import (
 )
 from .faultinject import INJECTOR
 from .retry import RetryPolicy, retry_call, set_default_policy
+from .timeouts import io_timeout_s, set_io_timeout
 
 __all__ = [
     "AdmissionController",
@@ -44,8 +45,10 @@ __all__ = [
     "current_deadline",
     "deadline_scope",
     "for_dependency",
+    "io_timeout_s",
     "retry_call",
     "set_default_policy",
+    "set_io_timeout",
 ]
 
 
@@ -77,3 +80,4 @@ def configure(res_config) -> None:
             budget_s=res_config.retry.budget_ms / 1000.0,
         )
     )
+    set_io_timeout(res_config.io_timeout_ms / 1000.0)

@@ -54,6 +54,14 @@ class AdmissionController:
             self._inflight = max(0, self._inflight - 1)
             INFLIGHT.set(self._inflight)
 
+    def has_headroom(self, fraction: float = 0.5) -> bool:
+        """Whether real traffic is using less than ``fraction`` of the
+        in-flight bound — the gate for strictly-lower-class work (the
+        tile prefetcher): speculative requests are shed well before a
+        single real request would be."""
+        with self._lock:
+            return self._inflight < max(1, int(self.max_inflight * fraction))
+
     @property
     def inflight(self) -> int:
         with self._lock:

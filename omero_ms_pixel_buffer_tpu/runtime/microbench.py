@@ -70,6 +70,18 @@ def _time_steady(fn, iters: int) -> float:
     return times[len(times) // 2]
 
 
+def _sig(value: float, digits: int = 3) -> float:
+    """Round to significant figures, not fixed decimals: a GB/s
+    metric over a KB-scale test payload can be legitimately tiny
+    (loaded CI box, scheduler stall inside the median), and
+    fixed-decimal rounding would flatten a real positive rate to
+    exactly 0.0 — which reads as "kernel produced nothing" to every
+    consumer asserting positivity."""
+    if value == 0:
+        return 0.0
+    return float(f"{value:.{digits}g}")
+
+
 def run_microbench(
     batch: int = 32,
     tile: int = 512,
@@ -108,7 +120,7 @@ def run_microbench(
             lambda: jax.block_until_ready(filter_tiles(tiles, "up")),
             iters_filter,
         )
-        out["filter_gbps"] = round(in_bytes / dt / 1e9, 3)
+        out["filter_gbps"] = _sig(in_bytes / dt / 1e9)
         out["filter_ms_per_batch"] = round(dt * 1e3, 3)
         filtered = filter_tiles(tiles, "up")
 
@@ -117,7 +129,7 @@ def run_microbench(
         return jax.block_until_ready(filter_batch(rows, itemsize, "up"))
 
     dt = _time_steady(xla_filter, iters_filter)
-    out["filter_gbps_xla"] = round(in_bytes / dt / 1e9, 3)
+    out["filter_gbps_xla"] = _sig(in_bytes / dt / 1e9)
     if filtered is None:
         filtered = xla_filter()
 
@@ -130,7 +142,7 @@ def run_microbench(
         ),
         iters_deflate,
     )
-    out["deflate_gbps"] = round(payload_bytes / dt / 1e9, 3)
+    out["deflate_gbps"] = _sig(payload_bytes / dt / 1e9)
     out["deflate_ms_per_batch"] = round(dt * 1e3, 2)
 
     # --- (c) full chain from an HBM-resident plane --------------------

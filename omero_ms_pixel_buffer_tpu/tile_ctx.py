@@ -154,6 +154,42 @@ class TileCtx:
             # worker-side decode failure (PixelBufferVerticle.java:95-100)
             raise BadRequestError("Illegal tile context") from None
 
+    # -- cache keys --------------------------------------------------------
+    # Two keys, two scopes (cache/ package): the CONTENT key identifies
+    # the bytes a request produces (no session — identical tiles are
+    # identical for every authorized caller); the DEDUPE key adds the
+    # session so single-flight/batch dedupe never lets caller B ride
+    # caller A's pipeline execution past B's own ACL check. Keys use
+    # the *requested* region — resolve() later rewrites w/h==0 to the
+    # full plane, so the defaulted and explicit spellings of the same
+    # tile cache separately (a documented, harmless split).
+
+    def cache_key(self, quality: str = "") -> str:
+        """Canonical result-cache key:
+        (image, z, c, t, region, resolution, format, quality)."""
+        r = self.region
+        return (
+            f"img={self.image_id}|z={self.z}|c={self.c}|t={self.t}"
+            f"|x={r.x}|y={r.y}|w={r.width}|h={r.height}"
+            f"|res={self.resolution}|fmt={self.format}|q={quality}"
+        )
+
+    def dedupe_key(self, quality: str = "") -> str:
+        """Single-flight key: the content key scoped to the caller's
+        session (cross-user sharing happens only through the result
+        cache, where hits re-authorize)."""
+        return self.cache_key(quality) + f"|sess={self.omero_session_key}"
+
+    def lane_key(self) -> tuple:
+        """Hashable batch-dedupe key (dispatch/batcher): lanes equal
+        under it produce byte-identical tiles for the same caller."""
+        r = self.region
+        return (
+            self.image_id, self.z, self.c, self.t,
+            r.x, r.y, r.width, r.height,
+            self.resolution, self.format, self.omero_session_key,
+        )
+
     def filename(self) -> str:
         """Reply filename header (PixelBufferVerticle.java:118-127)."""
         ext = self.format if self.format is not None else "bin"

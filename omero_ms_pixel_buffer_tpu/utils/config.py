@@ -154,7 +154,10 @@ class ResilienceConfig:
     """The resilience: block — one policy surface for breakers,
     retries, deadlines, and admission control (resilience/ package).
     ``request_budget_ms`` None means "use event-bus-send-timeout" (the
-    deadline minted per request at the HTTP front)."""
+    deadline minted per request at the HTTP front).
+    ``io_timeout_ms`` caps every single network exchange on the
+    Postgres/Redis/Glacier2 edges (resilience/timeouts.py); 0
+    disables, leaving only the request deadline."""
 
     enabled: bool = True
     breaker: BreakerConfig = dataclasses.field(default_factory=BreakerConfig)
@@ -166,6 +169,50 @@ class ResilienceConfig:
         default_factory=WatchdogConfig
     )
     request_budget_ms: Optional[float] = None
+    io_timeout_ms: float = 5000.0
+
+
+@dataclasses.dataclass
+class PrefetchConfig:
+    """Viewport prefetch (cache.prefetch): speculative warming of the
+    result cache from per-session access streams, shed first under
+    load (``headroom`` is the fraction of admission capacity real
+    traffic may use before prefetch stops entirely). ``budget_ms`` 0
+    (default) gives each prefetch the full request budget: a REAL
+    request that pans onto a predicted tile joins the prefetch's
+    single-flight, so a shorter prefetch deadline would 504 the real
+    request on a slow store where a direct request would have
+    succeeded."""
+
+    enabled: bool = True
+    queue_size: int = 256
+    headroom: float = 0.5
+    budget_ms: float = 0.0
+    lookahead: int = 2
+
+
+@dataclasses.dataclass
+class CacheConfig:
+    """The cache: block — the tiered rendered-tile result cache
+    (cache/ package). ``disk_dir`` None disables the spill tier;
+    ``ttl_s`` 0 disables time-based expiry (metadata invalidation
+    still purges); ``etag_precheck`` answers If-None-Match 304s from
+    the cache before the per-request OMERO session join (safe: a
+    matching strong content ETag proves the client already holds
+    those exact bytes)."""
+
+    enabled: bool = True
+    memory_mb: int = 256
+    protected_fraction: float = 0.8
+    disk_dir: Optional[str] = None
+    disk_mb: int = 1024
+    ttl_s: float = 0.0
+    max_entry_kb: int = 4096
+    max_age_s: float = 60.0
+    etag_precheck: bool = True
+    prefetch: PrefetchConfig = dataclasses.field(
+        default_factory=PrefetchConfig
+    )
 
 
 @dataclasses.dataclass
@@ -212,6 +259,7 @@ class Config:
     resilience: ResilienceConfig = dataclasses.field(
         default_factory=ResilienceConfig
     )
+    cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
     logging: LoggingConfig = dataclasses.field(default_factory=LoggingConfig)
     # Filesystem image registry (stands in for the OMERO Postgres
     # metadata plane when running without a server; see io.pixels_service).
@@ -326,6 +374,55 @@ class Config:
                 None if budget is None
                 else _num(res_raw, "request-budget-ms", None, 1.0)
             ),
+            io_timeout_ms=_num(res_raw, "io-timeout-ms", 5000.0, 0.0),
+        )
+
+    @staticmethod
+    def _parse_cache(raw: dict) -> CacheConfig:
+        """Validate the cache: block — same posture as resilience:
+        typos and nonsense fail at startup, never silently default."""
+        cc = raw.get("cache") or {}
+        pf = cc.get("prefetch") or {}
+
+        def _num(block: dict, key: str, default, minimum, cast=float):
+            try:
+                value = cast(block.get(key, default))
+            except (TypeError, ValueError):
+                raise ConfigError(
+                    f"Invalid value for 'cache...{key}': "
+                    f"{block.get(key)!r}"
+                ) from None
+            if value < minimum:
+                raise ConfigError(f"'cache...{key}' must be >= {minimum}")
+            return value
+
+        protected = _num(cc, "protected-fraction", 0.8, 0.0)
+        if protected > 1.0:
+            raise ConfigError(
+                "'cache.protected-fraction' must be in [0, 1]"
+            )
+        headroom = _num(pf, "headroom", 0.5, 0.0)
+        if headroom > 1.0:
+            raise ConfigError(
+                "'cache.prefetch.headroom' must be in [0, 1]"
+            )
+        return CacheConfig(
+            enabled=bool(cc.get("enabled", True)),
+            memory_mb=_num(cc, "memory-mb", 256, 1, int),
+            protected_fraction=protected,
+            disk_dir=cc.get("disk-dir"),
+            disk_mb=_num(cc, "disk-mb", 1024, 1, int),
+            ttl_s=_num(cc, "ttl-s", 0.0, 0.0),
+            max_entry_kb=_num(cc, "max-entry-kb", 4096, 1, int),
+            max_age_s=_num(cc, "max-age-s", 60.0, 0.0),
+            etag_precheck=bool(cc.get("etag-precheck", True)),
+            prefetch=PrefetchConfig(
+                enabled=bool(pf.get("enabled", True)),
+                queue_size=_num(pf, "queue-size", 256, 1, int),
+                headroom=headroom,
+                budget_ms=_num(pf, "budget-ms", 0.0, 0.0),
+                lookahead=_num(pf, "lookahead", 2, 1, int),
+            ),
         )
 
     @classmethod
@@ -414,6 +511,7 @@ class Config:
             jmx_metrics_enabled=bool(jmx.get("enabled", True)),
             backend=backend,
             resilience=cls._parse_resilience(raw),
+            cache=cls._parse_cache(raw),
             logging=LoggingConfig(
                 file=log_raw.get("file"),
                 level=str(log_raw.get("level", "INFO")),

@@ -98,6 +98,30 @@ class Histogram:
             yield f"{self.name}_sum{_fmt_labels(labels)} {sums[labels]}"
 
 
+class GaugeFn:
+    """Callback gauge: the value is computed at scrape time, so
+    structures that mutate on the hot path (caches, queues) export
+    exact state without paying a metric update per operation. ``fn``
+    returns either a float or a dict mapping label tuples
+    (``(("tier", "memory"),): value``) to floats; a failing callback
+    skips the sample rather than breaking the whole exposition."""
+
+    def __init__(self, name: str, help_: str, fn):
+        self.name, self.help, self.fn = name, help_, fn
+
+    def collect(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} gauge"
+        try:
+            values = self.fn()
+        except Exception:
+            return
+        if not isinstance(values, dict):
+            values = {(): values}
+        for labels, v in sorted(values.items()):
+            yield f"{self.name}{_fmt_labels(tuple(labels))} {float(v)}"
+
+
 class _Timer:
     def __init__(self, hist: Histogram, labels: dict):
         self.hist, self.labels = hist, labels
@@ -123,6 +147,9 @@ class Registry:
 
     def histogram(self, name: str, help_: str = "", **kw) -> Histogram:
         return self._register(Histogram(name, help_, **kw))
+
+    def gauge_fn(self, name: str, help_: str, fn) -> GaugeFn:
+        return self._register(GaugeFn(name, help_, fn))
 
     def register(self, collector):
         """Register any collector exposing ``collect() -> iterable of

@@ -1,7 +1,8 @@
 # ompb-lint: scope=resilience-coverage
-"""Clean corpus: the remote GET flows through a breaker gate and a
-fault-injection point (in a caller — guard markers propagate over the
-module-local call graph)."""
+"""Seeded resilience-coverage violation (timeout flavor): the remote
+GET is breaker-gated and fault-injected, but NO caller path bounds the
+exchange with a per-call timeout — a dependency that stops answering
+parks the caller."""
 
 import http.client
 
@@ -24,9 +25,7 @@ INJECTOR = _Injector()
 
 
 def raw_get(host, key):
-    # the per-call timeout rides the primitive itself (the
-    # resilience-coverage timeout marker)
-    conn = http.client.HTTPConnection(host, timeout=2)
+    conn = http.client.HTTPConnection(host)  # SEEDED: resilience-coverage (no timeout)
     conn.request("GET", "/" + key)
     return conn.getresponse().read()
 

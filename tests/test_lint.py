@@ -69,6 +69,14 @@ class TestSeededViolations:
         found = seeded["naked_store.py"]
         assert [f.rule for f in found] == ["resilience-coverage"]
         assert "HTTPConnection" in found[0].message
+        assert "circuit-breaker" in found[0].message
+
+    def test_resilience_coverage_requires_timeout(self, seeded):
+        """Breaker + fault point alone no longer suffice: the rule
+        also demands a per-call timeout on some caller path."""
+        found = seeded["no_timeout.py"]
+        assert [f.rule for f in found] == ["resilience-coverage"]
+        assert "per-call timeout" in found[0].message
 
     def test_jax_hotpath(self, seeded):
         found = seeded["hotpath_sync.py"]

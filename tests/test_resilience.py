@@ -701,6 +701,11 @@ async def _make_app(tmp_path, *, resilience=None, config_extra=None,
         "worker_pool_size": workers,
         "backend": {"batching": {"max-batch": 1,
                                  "coalesce-window-ms": 0.0}},
+        # the chaos suite measures the PIPELINE path: identical-tile
+        # requests must each execute, not hit the result cache or
+        # coalesce into one flight (the cache has its own suite,
+        # tests/test_tile_cache.py)
+        "cache": {"enabled": False},
     }
     if resilience:
         raw["resilience"] = resilience
@@ -955,6 +960,10 @@ class TestPostgresFlapIsolation:
             "worker_pool_size": 4,
             "backend": {"batching": {"max-batch": 1,
                                      "coalesce-window-ms": 0.0}},
+            # cache off: a warm result cache (rightly) serves repeated
+            # tiles THROUGH a Postgres outage; this suite measures the
+            # pipeline's breaker behavior, so every request must reach it
+            "cache": {"enabled": False},
             "resilience": {
                 # open duration far beyond the test's runtime so the
                 # open -> half_open promotion never races the asserts;

@@ -1,0 +1,944 @@
+"""Tiered tile-result cache, single-flight, conditional GET, prefetch.
+
+Covers the cache/ package end to end: SLRU mechanics (budget,
+promotion, scan resistance), the key schema, single-flight semantics
+(one execution, error fan-out, cancellation isolation), HTTP ETag/304
+behavior and byte-identity on hits, invalidation (unit + resolver
+listener), batch-level key dedup — plus the chaos contract under
+``-m resilience``: a faulted disk tier degrades to pass-through, a
+flight-leader failure fans out to every waiter, prefetch sheds under
+admission pressure, and per-call network timeouts bound the
+Postgres/Redis edges.
+"""
+
+import asyncio
+import io
+import time
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+from PIL import Image
+
+from omero_ms_pixel_buffer_tpu.auth.omero_session import AllowListValidator
+from omero_ms_pixel_buffer_tpu.auth.stores import MemorySessionStore
+from omero_ms_pixel_buffer_tpu.cache.prefetch import ViewportPrefetcher
+from omero_ms_pixel_buffer_tpu.cache.result_cache import (
+    CachedTile,
+    SegmentedLRU,
+    TileResultCache,
+    etag_matches,
+    make_etag,
+)
+from omero_ms_pixel_buffer_tpu.cache.single_flight import SingleFlight
+from omero_ms_pixel_buffer_tpu.http.server import PixelBufferApp
+from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
+from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+    ImageRegistry,
+    PixelsService,
+)
+from omero_ms_pixel_buffer_tpu.resilience import faultinject
+from omero_ms_pixel_buffer_tpu.resilience.breaker import BOARD
+from omero_ms_pixel_buffer_tpu.resilience.faultinject import INJECTOR
+from omero_ms_pixel_buffer_tpu.resilience.timeouts import set_io_timeout
+from omero_ms_pixel_buffer_tpu.tile_ctx import RegionDef, TileCtx
+from omero_ms_pixel_buffer_tpu.utils.config import Config, ConfigError
+
+rng = np.random.default_rng(7)
+IMG = rng.integers(0, 60000, (1, 1, 2, 256, 256), dtype=np.uint16)
+AUTH = {"Cookie": "sessionid=ck"}
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    INJECTOR.clear()
+    yield
+    INJECTOR.clear()
+    BOARD.reset()
+    set_io_timeout(5.0)
+
+
+def _entry(body: bytes) -> CachedTile:
+    return CachedTile(body, filename="f.png")
+
+
+def _ctx(image_id=1, z=0, c=0, t=0, x=0, y=0, w=64, h=64,
+         resolution=None, fmt="png", session="omero-key"):
+    return TileCtx(
+        image_id=image_id, z=z, c=c, t=t,
+        region=RegionDef(x, y, w, h), resolution=resolution,
+        format=fmt, omero_session_key=session,
+    )
+
+
+async def _make_app(tmp_path, cache_config=None, validator=None,
+                    session_key="omero-key-1"):
+    write_ome_tiff(
+        str(tmp_path / "img.ome.tiff"), IMG, tile_size=(64, 64),
+        pyramid_levels=2,
+    )
+    registry = ImageRegistry()
+    registry.add(1, str(tmp_path / "img.ome.tiff"))
+    config = Config.from_dict({
+        "session-store": {"type": "memory"},
+        "backend": {"batching": {"coalesce-window-ms": 1.0}},
+        "cache": cache_config if cache_config is not None else {},
+    })
+    app_obj = PixelBufferApp(
+        config,
+        pixels_service=PixelsService(registry),
+        session_store=MemorySessionStore({"ck": session_key}),
+        session_validator=validator,
+    )
+    client = TestClient(
+        TestServer(app_obj.make_app()), loop=asyncio.get_running_loop()
+    )
+    await client.start_server()
+    return app_obj, client
+
+
+# ---------------------------------------------------------------------------
+# memory tier: segmented LRU
+# ---------------------------------------------------------------------------
+
+class TestSegmentedLRU:
+    def test_byte_budget_evicts_lru(self):
+        lru = SegmentedLRU(max_bytes=300)
+        for i in range(4):
+            lru.put(f"k{i}", _entry(b"x" * 100))
+        assert lru.nbytes <= 300
+        assert lru.get("k0") is None  # oldest one-touch entry left
+        assert lru.get("k3") is not None
+
+    def test_second_touch_promotes(self):
+        lru = SegmentedLRU(max_bytes=1000)
+        lru.put("a", _entry(b"x" * 10))
+        assert lru.get("a") is not None  # promoted to protected
+        snap = lru.snapshot()
+        assert snap["protected_entries"] == 1
+
+    def test_scan_resistance(self):
+        """A one-pass scan of cold keys cannot displace the protected
+        working set."""
+        lru = SegmentedLRU(max_bytes=500, protected_fraction=0.8)
+        for k in ("hot1", "hot2"):
+            lru.put(k, _entry(b"h" * 100))
+            assert lru.get(k) is not None  # promote
+        for i in range(50):  # the scan: 50 one-touch entries
+            lru.put(f"scan{i}", _entry(b"s" * 100))
+        assert lru.get("hot1") is not None
+        assert lru.get("hot2") is not None
+
+    def test_oversized_entry_not_admitted(self):
+        lru = SegmentedLRU(max_bytes=100)
+        lru.put("big", _entry(b"x" * 1000))
+        assert len(lru) == 0
+
+    def test_remove_prefix(self):
+        lru = SegmentedLRU(max_bytes=10_000)
+        lru.put("img=1|a", _entry(b"x"))
+        lru.put("img=1|b", _entry(b"y"))
+        lru.put("img=2|a", _entry(b"z"))
+        assert lru.remove_prefix("img=1|") == 2
+        assert lru.peek("img=2|a") is not None
+        assert lru.peek("img=1|a") is None
+
+
+# ---------------------------------------------------------------------------
+# key schema + validators
+# ---------------------------------------------------------------------------
+
+class TestKeySchema:
+    def test_every_dimension_distinguishes(self):
+        base = _ctx()
+        variants = [
+            _ctx(image_id=2), _ctx(z=1), _ctx(c=1), _ctx(t=1),
+            _ctx(x=64), _ctx(y=64), _ctx(w=128), _ctx(h=128),
+            _ctx(resolution=1), _ctx(fmt="tif"), _ctx(fmt=None),
+        ]
+        keys = {v.cache_key("q") for v in variants}
+        assert base.cache_key("q") not in keys
+        assert len(keys) == len(variants)
+        # quality (encode signature) is part of the schema
+        assert base.cache_key("q1") != base.cache_key("q2")
+
+    def test_session_scopes_dedupe_not_content(self):
+        a, b = _ctx(session="s1"), _ctx(session="s2")
+        assert a.cache_key("q") == b.cache_key("q")
+        assert a.dedupe_key("q") != b.dedupe_key("q")
+
+    def test_etag_matching(self):
+        etag = make_etag(b"bytes")
+        assert etag_matches(etag, etag)
+        assert etag_matches(f'W/{etag}', etag)
+        assert etag_matches(f'"other", {etag}', etag)
+        # '*' proves no possession: it must NOT match (it would hand
+        # an unauthorized caller a cache-state oracle via the 304
+        # precheck)
+        assert not etag_matches("*", etag)
+        assert not etag_matches('"nope"', etag)
+        assert not etag_matches("", etag)
+
+
+# ---------------------------------------------------------------------------
+# single-flight
+# ---------------------------------------------------------------------------
+
+class TestSingleFlight:
+    async def test_concurrent_misses_one_execution(self):
+        flight = SingleFlight()
+        calls = []
+
+        async def factory():
+            calls.append(1)
+            await asyncio.sleep(0.02)
+            return "tile"
+
+        results = await asyncio.gather(
+            *(flight.do("k", factory) for _ in range(8))
+        )
+        assert results == ["tile"] * 8
+        assert len(calls) == 1
+        assert flight.active == 0
+
+    async def test_error_fans_out_to_all_waiters(self):
+        flight = SingleFlight()
+        calls = []
+
+        async def boom():
+            calls.append(1)
+            await asyncio.sleep(0.02)
+            raise RuntimeError("leader failed")
+
+        results = await asyncio.gather(
+            *(flight.do("k", boom) for _ in range(5)),
+            return_exceptions=True,
+        )
+        assert len(calls) == 1
+        assert all(isinstance(r, RuntimeError) for r in results)
+
+    async def test_waiter_cancellation_does_not_kill_flight(self):
+        flight = SingleFlight()
+        done = asyncio.Event()
+
+        async def factory():
+            await asyncio.sleep(0.05)
+            done.set()
+            return "tile"
+
+        w1 = asyncio.ensure_future(flight.do("k", factory))
+        await asyncio.sleep(0.01)
+        w2 = asyncio.ensure_future(flight.do("k", factory))
+        await asyncio.sleep(0.01)
+        w1.cancel()
+        assert await w2 == "tile"  # survivor gets the result
+        assert done.is_set()
+
+    async def test_waiter_timeout_leaves_flight_running(self):
+        flight = SingleFlight()
+
+        async def slow():
+            await asyncio.sleep(0.08)
+            return "tile"
+
+        fast = asyncio.ensure_future(flight.do("k", slow, timeout_s=0.01))
+        patient = asyncio.ensure_future(flight.do("k", slow))
+        with pytest.raises(asyncio.TimeoutError):
+            await fast
+        assert await patient == "tile"
+
+    async def test_sequential_calls_rerun(self):
+        flight = SingleFlight()
+        calls = []
+
+        async def factory():
+            calls.append(1)
+            return len(calls)
+
+        assert await flight.do("k", factory) == 1
+        assert await flight.do("k", factory) == 2  # no stale reuse
+
+
+# ---------------------------------------------------------------------------
+# tiered cache behavior
+# ---------------------------------------------------------------------------
+
+class TestTieredCache:
+    async def test_disk_spill_and_readmission(self, tmp_path):
+        cache = TileResultCache(
+            memory_bytes=250, disk_dir=str(tmp_path / "spill"),
+            disk_bytes=1 << 20,
+        )
+        try:
+            await cache.put("img=1|a", _entry(b"a" * 100))
+            await cache.put("img=1|b", _entry(b"b" * 100))
+            await cache.put("img=1|c", _entry(b"c" * 100))  # evicts a
+            # wait out the executor hop
+            for _ in range(50):
+                if len(cache.disk):
+                    break
+                await asyncio.sleep(0.01)
+            assert len(cache.disk) >= 1
+            entry = await cache.get("img=1|a")  # disk hit, re-admitted
+            assert entry is not None and entry.body == b"a" * 100
+            assert cache.contains("img=1|a")
+        finally:
+            cache.close()
+
+    async def test_invalidate_image_purges_both_tiers(self, tmp_path):
+        cache = TileResultCache(
+            memory_bytes=1 << 20, disk_dir=str(tmp_path / "spill"),
+        )
+        try:
+            await cache.put("img=7|x=0", _entry(b"seven"))
+            await cache.put("img=8|x=0", _entry(b"eight"))
+            cache.invalidate_image(7)
+            assert await cache.get("img=7|x=0") is None
+            assert (await cache.get("img=8|x=0")).body == b"eight"
+        finally:
+            cache.close()
+
+    async def test_fill_discarded_when_invalidation_races(self):
+        """A render that STARTED before an invalidation must not land
+        after the purge (with ttl 0 it would serve stale forever)."""
+        cache = TileResultCache(memory_bytes=1 << 20)
+        gen = cache.generation()  # captured before the render
+        cache.invalidate_image(1)  # the pixels row changes mid-flight
+        await cache.put("img=1|k", _entry(b"stale"), generation=gen)
+        assert await cache.get("img=1|k") is None  # discarded
+        await cache.put(
+            "img=1|k", _entry(b"fresh"), generation=cache.generation()
+        )
+        assert (await cache.get("img=1|k")).body == b"fresh"
+
+    def test_bytes_gauge_is_one_family(self):
+        """Multiple cache instances (bench, tests, app re-creation)
+        must not duplicate the tile_cache_bytes metric family or pin
+        closed caches' contents."""
+        from omero_ms_pixel_buffer_tpu.utils.metrics import REGISTRY
+
+        c1 = TileResultCache(memory_bytes=4096)
+        c2 = TileResultCache(memory_bytes=4096)
+        try:
+            text = REGISTRY.exposition()
+            assert text.count("# TYPE tile_cache_bytes gauge") == 1
+        finally:
+            c1.close()
+            c2.close()
+
+    async def test_ttl_expiry(self):
+        cache = TileResultCache(memory_bytes=1 << 20, ttl_s=0.02)
+        await cache.put("k", _entry(b"v"))
+        assert (await cache.get("k")) is not None
+        time.sleep(0.03)
+        assert await cache.get("k") is None
+
+    @pytest.mark.resilience
+    async def test_hung_disk_reads_as_miss_within_io_timeout(
+        self, tmp_path
+    ):
+        """A disk that HANGS (no error, NFS D-state) must not park
+        the request: the loop-side wait is bounded by the per-call
+        io-timeout, the hang feeds the breaker, and the lookup reads
+        as a miss (pass-through)."""
+        cache = TileResultCache(
+            memory_bytes=1 << 20, disk_dir=str(tmp_path / "spill"),
+        )
+        try:
+            set_io_timeout(0.05)
+            cache._disk_get = lambda key: time.sleep(5)  # the hang
+            t0 = time.monotonic()
+            assert await cache.get("img=1|k") is None
+            assert time.monotonic() - t0 < 1.0  # never the 5 s
+            assert cache._disk_breaker.snapshot()[
+                "consecutive_failures"
+            ] >= 1
+        finally:
+            cache.close()
+
+    @pytest.mark.resilience
+    async def test_memory_fault_degrades_to_passthrough(self):
+        cache = TileResultCache(memory_bytes=1 << 20)
+        await cache.put("k", _entry(b"v"))
+        INJECTOR.install(
+            "cache.memory", faultinject.always(RuntimeError("ram gone"))
+        )
+        assert await cache.get("k") is None  # pass-through, no raise
+        await cache.put("k2", _entry(b"w"))  # swallowed
+        INJECTOR.clear()
+        assert (await cache.get("k")).body == b"v"  # tier intact
+
+    @pytest.mark.resilience
+    async def test_disk_fault_opens_breaker_memory_survives(
+        self, tmp_path
+    ):
+        cache = TileResultCache(
+            memory_bytes=300, disk_dir=str(tmp_path / "spill"),
+        )
+        try:
+            INJECTOR.install(
+                "cache.disk", faultinject.always(OSError("disk dead"))
+            )
+            for i in range(12):  # spills fail -> breaker input
+                await cache.put(f"img=1|{i}", _entry(b"x" * 100))
+                entry = await cache.get(f"img=1|{i}")
+                assert entry is not None  # memory tier still serves
+            for _ in range(50):
+                if cache._disk_breaker.state == "open":
+                    break
+                await asyncio.sleep(0.01)
+            assert cache._disk_breaker.state == "open"
+            # with the breaker open, disk ops are skipped entirely
+            before = INJECTOR.calls("cache.disk")
+            assert await cache.get("img=1|0") is None  # evicted, lost
+            assert INJECTOR.calls("cache.disk") == before
+        finally:
+            cache.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP: ETag / 304 / hit semantics
+# ---------------------------------------------------------------------------
+
+class TestConditionalGet:
+    async def test_miss_then_hit_identical_bytes(self, tmp_path):
+        app_obj, client = await _make_app(tmp_path)
+        try:
+            url = "/tile/1/0/0/0?x=64&y=64&w=64&h=64&format=png"
+            r1 = await client.get(url, headers=AUTH)
+            assert r1.status == 200
+            assert r1.headers["X-Cache"] == "miss"
+            etag = r1.headers["ETag"]
+            assert etag.startswith('"')
+            assert "max-age" in r1.headers["Cache-Control"]
+            body1 = await r1.read()
+
+            r2 = await client.get(url, headers=AUTH)
+            assert r2.status == 200
+            assert r2.headers["X-Cache"] == "hit"
+            assert r2.headers["ETag"] == etag
+            body2 = await r2.read()
+            assert body1 == body2  # byte-identical service
+            decoded = np.array(Image.open(io.BytesIO(body2)))
+            np.testing.assert_array_equal(
+                decoded.astype(np.uint16), IMG[0, 0, 0, 64:128, 64:128]
+            )
+        finally:
+            await client.close()
+
+    async def test_if_none_match_304(self, tmp_path):
+        app_obj, client = await _make_app(tmp_path)
+        try:
+            url = "/tile/1/0/0/0?w=64&h=64&format=png"
+            r1 = await client.get(url, headers=AUTH)
+            etag = r1.headers["ETag"]
+            r2 = await client.get(
+                url, headers={**AUTH, "If-None-Match": etag}
+            )
+            assert r2.status == 304
+            assert await r2.read() == b""
+            assert r2.headers["ETag"] == etag
+            # stale validator still gets the full body
+            r3 = await client.get(
+                url, headers={**AUTH, "If-None-Match": '"stale"'}
+            )
+            assert r3.status == 200
+            assert len(await r3.read()) > 0
+        finally:
+            await client.close()
+
+    async def test_etag_precheck_short_circuits_auth(self, tmp_path):
+        """With a matching strong ETag cached, revalidation answers 304
+        BEFORE the session join; a request without the validator still
+        takes the full (denied -> 403) path."""
+        app_obj, client = await _make_app(
+            tmp_path, validator=AllowListValidator(allowed={"nobody"}),
+        )
+        try:
+            body = b"cached-tile-bytes"
+            entry = CachedTile(body, filename="t.png")
+            ctx = _ctx(w=64, h=64, session="omero-key-1")
+            key = ctx.cache_key(app_obj.pipeline.encode_signature())
+            await app_obj.result_cache.put(key, entry)
+            url = "/tile/1/0/0/0?w=64&h=64&format=png"
+            r1 = await client.get(
+                url, headers={**AUTH, "If-None-Match": entry.etag}
+            )
+            assert r1.status == 304  # validator never consulted
+            r2 = await client.get(url, headers=AUTH)
+            assert r2.status == 403  # hit not served: not authorized
+        finally:
+            await client.close()
+
+    async def test_invalidation_serves_fresh_etag(self, tmp_path):
+        app_obj, client = await _make_app(tmp_path)
+        try:
+            url = "/tile/1/0/0/0?w=64&h=64&format=png"
+            r1 = await client.get(url, headers=AUTH)
+            etag = r1.headers["ETag"]
+            app_obj._invalidate_image(1)  # the resolver-listener path
+            r2 = await client.get(
+                url, headers={**AUTH, "If-None-Match": etag}
+            )
+            # cache purged: full re-render; identical pixels -> the
+            # strong ETag matches again and revalidation still wins
+            assert r2.status in (200, 304)
+            r3 = await client.get(url, headers=AUTH)
+            assert r3.status == 200
+            assert r3.headers["ETag"] == etag  # content unchanged
+        finally:
+            await client.close()
+
+    async def test_cache_disabled_still_serves(self, tmp_path):
+        app_obj, client = await _make_app(
+            tmp_path, cache_config={"enabled": False}
+        )
+        try:
+            assert app_obj.result_cache is None
+            r = await client.get(
+                "/tile/1/0/0/0?w=64&h=64&format=png", headers=AUTH
+            )
+            assert r.status == 200
+            assert "ETag" not in r.headers
+            assert "X-Cache" not in r.headers
+        finally:
+            await client.close()
+
+
+class TestFlightThroughHttp:
+    @pytest.mark.resilience
+    async def test_leader_failure_fans_out(self, tmp_path):
+        """Concurrent identical requests collapse into one pipeline
+        execution; when it fails, EVERY waiter sees the failure."""
+        app_obj, client = await _make_app(tmp_path)
+        calls = []
+
+        def boom(ctx):
+            calls.append(1)
+            time.sleep(0.05)  # hold the flight open for the joiners
+            raise RuntimeError("pipeline down")
+
+        app_obj.pipeline.handle = boom
+        try:
+            results = await asyncio.gather(*(
+                client.get(
+                    "/tile/1/0/0/0?w=64&h=64&format=png", headers=AUTH
+                )
+                for _ in range(6)
+            ))
+            assert [r.status for r in results] == [500] * 6
+            assert len(calls) == 1  # ONE execution for six requests
+        finally:
+            await client.close()
+
+    async def test_concurrent_misses_coalesce(self, tmp_path):
+        app_obj, client = await _make_app(tmp_path)
+        executions = []
+        inner = app_obj.pipeline.handle
+
+        def counting(ctx):
+            executions.append(1)
+            time.sleep(0.03)
+            return inner(ctx)
+
+        app_obj.pipeline.handle = counting
+        try:
+            results = await asyncio.gather(*(
+                client.get(
+                    "/tile/1/0/0/0?x=64&w=64&h=64&format=png",
+                    headers=AUTH,
+                )
+                for _ in range(8)
+            ))
+            bodies = [await r.read() for r in results]
+            assert all(r.status == 200 for r in results)
+            assert len(set(bodies)) == 1
+            assert len(executions) == 1
+        finally:
+            await client.close()
+
+
+# ---------------------------------------------------------------------------
+# batcher: identical-lane dedup
+# ---------------------------------------------------------------------------
+
+class TestBatchDedup:
+    async def test_duplicate_lanes_execute_once(self):
+        from omero_ms_pixel_buffer_tpu.dispatch.batcher import (
+            BatchingTileWorker,
+        )
+
+        seen_batches = []
+
+        class FakePipeline:
+            def handle(self, ctx):
+                seen_batches.append([ctx])
+                return b"one"
+
+            def handle_batch(self, ctxs):
+                seen_batches.append(list(ctxs))
+                return [f"tile-{c.region.x}".encode() for c in ctxs]
+
+        worker = BatchingTileWorker(
+            FakePipeline(), AllowListValidator(),
+            max_batch=8, coalesce_window_ms=30.0,
+        )
+        await worker.start()
+        try:
+            dup1 = _ctx(x=0)
+            dup2 = _ctx(x=0)  # identical lane key
+            other = _ctx(x=64)
+            r = await asyncio.gather(
+                worker.handle(dup1), worker.handle(dup2),
+                worker.handle(other),
+            )
+            executed = [c for batch in seen_batches for c in batch]
+            assert len(executed) == 2  # dup collapsed
+            assert r[0][0] == r[1][0] == b"tile-0"
+            assert r[2][0] == b"tile-64"
+        finally:
+            await worker.close()
+
+
+# ---------------------------------------------------------------------------
+# prefetch
+# ---------------------------------------------------------------------------
+
+class _FakeAdmission:
+    def __init__(self, headroom=True):
+        self.headroom = headroom
+
+    def has_headroom(self, fraction=0.5):
+        return self.headroom
+
+
+class TestPrefetcher:
+    async def test_motion_predicts_and_warms(self):
+        fetched = []
+
+        async def fetch(ctx, key):
+            fetched.append((ctx.region.x, ctx.region.y, ctx.resolution))
+
+        pre = ViewportPrefetcher(
+            fetch, cache=None, admission=_FakeAdmission(), lookahead=2
+        )
+        pre.start()
+        try:
+            pre.observe(_ctx(x=0, y=64))
+            pre.observe(_ctx(x=64, y=64))  # moving right
+            for _ in range(100):
+                if len(fetched) >= 4:
+                    break
+                await asyncio.sleep(0.01)
+            # continuation x=128, x=192 plus perpendicular neighbors
+            assert (128, 64, None) in fetched
+            assert (192, 64, None) in fetched
+            assert (128, 0, None) in fetched
+            assert (128, 128, None) in fetched
+        finally:
+            await pre.close()
+
+    async def test_zoom_prediction(self):
+        fetched = []
+
+        async def fetch(ctx, key):
+            fetched.append((ctx.region.x, ctx.region.y, ctx.resolution))
+
+        pre = ViewportPrefetcher(fetch, None, _FakeAdmission())
+        pre.start()
+        try:
+            pre.observe(_ctx(x=0, y=0, resolution=2))
+            pre.observe(_ctx(x=64, y=0, resolution=2))
+            for _ in range(100):
+                if any(res == 1 for *_xy, res in fetched):
+                    break
+                await asyncio.sleep(0.01)
+            assert any(res == 1 for *_xy, res in fetched)
+        finally:
+            await pre.close()
+
+    @pytest.mark.resilience
+    async def test_sheds_under_admission_pressure(self):
+        fetched = []
+
+        async def fetch(ctx, key):
+            fetched.append(ctx)
+
+        admission = _FakeAdmission(headroom=False)
+        pre = ViewportPrefetcher(fetch, None, admission)
+        pre.start()
+        try:
+            pre.observe(_ctx(x=0))
+            pre.observe(_ctx(x=64))
+            # y=0 prunes one perpendicular neighbor (negative y):
+            # 2 continuation + 1 neighbor predictions, all shed
+            for _ in range(100):
+                if pre.snapshot()["shed"] >= 3:
+                    break
+                await asyncio.sleep(0.01)
+            assert pre.snapshot()["shed"] >= 3
+            assert not fetched  # nothing issued while saturated
+            admission.headroom = True  # load drains -> prefetch resumes
+            pre.observe(_ctx(x=128))
+            for _ in range(100):
+                if fetched:
+                    break
+                await asyncio.sleep(0.01)
+            assert fetched
+        finally:
+            await pre.close()
+
+    async def test_http_pan_warms_neighbor(self, tmp_path):
+        app_obj, client = await _make_app(tmp_path)
+        try:
+            for x in (0, 64):
+                r = await client.get(
+                    f"/tile/1/0/0/0?x={x}&y=64&w=64&h=64&format=png",
+                    headers=AUTH,
+                )
+                assert r.status == 200
+            neighbor = _ctx(x=128, y=64, session=None)
+            key = neighbor.cache_key(app_obj.pipeline.encode_signature())
+            cache = app_obj.result_cache
+            for _ in range(200):
+                if cache.contains(key):
+                    break
+                await asyncio.sleep(0.01)
+            assert cache.contains(key)
+            # and the warmed tile now serves as a hit
+            r = await client.get(
+                "/tile/1/0/0/0?x=128&y=64&w=64&h=64&format=png",
+                headers=AUTH,
+            )
+            assert r.status == 200
+            assert r.headers["X-Cache"] == "hit"
+        finally:
+            await client.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos: disk-tier outage through the full HTTP stack
+# ---------------------------------------------------------------------------
+
+class TestDiskChaosHttp:
+    @pytest.mark.resilience
+    async def test_disk_fault_serves_every_request(self, tmp_path):
+        """The acceptance bar: with the disk tier faulted, every
+        request still answers correctly via pass-through."""
+        app_obj, client = await _make_app(
+            tmp_path,
+            cache_config={
+                "memory-mb": 1,
+                "disk-dir": str(tmp_path / "spill"),
+            },
+        )
+        INJECTOR.install(
+            "cache.disk", faultinject.always(OSError("disk tier dead"))
+        )
+        # shrink the RAM tier so evictions actually reach the (dead)
+        # disk tier during the run
+        app_obj.result_cache.memory.max_bytes = 4096
+        app_obj.result_cache.memory.protected_max = 3276
+        try:
+            for x in (0, 64, 128, 192):
+                for repeat in range(2):
+                    r = await client.get(
+                        f"/tile/1/0/0/0?x={x}&w=64&h=64&format=png",
+                        headers=AUTH,
+                    )
+                    assert r.status == 200
+                    body = await r.read()
+                    decoded = np.array(Image.open(io.BytesIO(body)))
+                    np.testing.assert_array_equal(
+                        decoded.astype(np.uint16),
+                        IMG[0, 0, 0, 0:64, x:x + 64],
+                    )
+            assert INJECTOR.calls("cache.disk") > 0  # tier WAS hit
+            health = await (await client.get("/healthz")).json()
+            assert health["cache"]["enabled"] is True
+        finally:
+            await client.close()
+
+
+# ---------------------------------------------------------------------------
+# invalidation via the metadata resolver
+# ---------------------------------------------------------------------------
+
+ROW_V1 = ("10", "256", "256", "1", "1", "2", "uint16", "img", "2", "3",
+          "-120", None, None, None, None)
+ROW_V2 = ("10", "512", "512", "1", "1", "2", "uint16", "img", "2", "3",
+          "-120", None, None, None, None)
+
+
+class _FakePgClient:
+    def __init__(self):
+        self.rows = [ROW_V1]
+
+    async def query(self, sql, params):
+        if "FROM pixels" in sql:
+            return list(self.rows)
+        return []
+
+    async def close(self):
+        pass
+
+
+class TestResolverInvalidation:
+    async def test_changed_row_fires_listener(self):
+        from omero_ms_pixel_buffer_tpu.db.metadata import (
+            OmeroPostgresMetadataResolver,
+        )
+
+        resolver = OmeroPostgresMetadataResolver(
+            "postgresql://u@localhost/db", cache_ttl_s=0.0
+        )
+        fake = _FakePgClient()
+        resolver._client = fake
+        fired = []
+        resolver.add_invalidation_listener(fired.append)
+
+        meta = await resolver.get_pixels_async(1)
+        assert meta is not None and meta.size_x == 256
+        assert fired == []  # unchanged refresh: no invalidation
+        await resolver.get_pixels_async(1)
+        assert fired == []
+        fake.rows = [ROW_V2]  # the pixels row changed
+        meta = await resolver.get_pixels_async(1)
+        assert meta.size_x == 512
+        assert fired == [1]
+        fake.rows = []  # the image vanished
+        assert await resolver.get_pixels_async(1) is None
+        assert fired == [1, 1]
+
+    async def test_manual_invalidate(self):
+        from omero_ms_pixel_buffer_tpu.db.metadata import (
+            OmeroPostgresMetadataResolver,
+        )
+
+        resolver = OmeroPostgresMetadataResolver(
+            "postgresql://u@localhost/db"
+        )
+        resolver._client = _FakePgClient()
+        fired = []
+        resolver.add_invalidation_listener(fired.append)
+        resolver.invalidate(5)
+        assert fired == [5]
+
+
+class TestPipelineInvalidation:
+    def test_invalidate_image_drops_buffer(self, tmp_path):
+        from omero_ms_pixel_buffer_tpu.models.tile_pipeline import (
+            TilePipeline,
+        )
+
+        write_ome_tiff(str(tmp_path / "i.ome.tiff"), IMG)
+        registry = ImageRegistry()
+        registry.add(1, str(tmp_path / "i.ome.tiff"))
+        service = PixelsService(registry)
+        pipe = TilePipeline(service, engine="host")
+        assert pipe.handle(_ctx(w=32, h=32, session="k")) is not None
+        buf = service.get_pixel_buffer(1)
+        assert buf is not None
+        pipe.invalidate_image(1)
+        assert service.get_pixel_buffer(1) is not buf  # re-opened
+        assert pipe.handle(_ctx(w=32, h=32, session="k")) is not None
+
+
+# ---------------------------------------------------------------------------
+# per-call network timeouts (satellite: KNOWN_GAPS closure)
+# ---------------------------------------------------------------------------
+
+class TestPerCallTimeouts:
+    @pytest.mark.resilience
+    async def test_postgres_exchange_bounded(self):
+        from omero_ms_pixel_buffer_tpu.db.postgres import (
+            PostgresClient,
+            PostgresUnavailableError,
+        )
+
+        set_io_timeout(0.05)
+        INJECTOR.install("db.postgres", faultinject.latency(5.0))
+        client = PostgresClient(host="localhost", port=59999)
+        t0 = time.monotonic()
+        # surfaces as UNAVAILABLE (-> 503), never a raw TimeoutError
+        # (which the pipeline's broad catch would turn into 404)
+        with pytest.raises(PostgresUnavailableError):
+            await client.query("SELECT 1")
+        assert time.monotonic() - t0 < 1.0  # never the injected 5 s
+        assert client.breaker.snapshot()["consecutive_failures"] >= 1
+
+    @pytest.mark.resilience
+    async def test_redis_lookup_bounded(self):
+        from omero_ms_pixel_buffer_tpu.auth.stores import (
+            RedisSessionStore,
+        )
+
+        set_io_timeout(0.05)
+        INJECTOR.install("session_store", faultinject.latency(5.0))
+        store = RedisSessionStore("redis://localhost:59998/0")
+        t0 = time.monotonic()
+        with pytest.raises(asyncio.TimeoutError):
+            await store.get_omero_session_key("sid")
+        assert time.monotonic() - t0 < 1.0
+        assert store.breaker.snapshot()["consecutive_failures"] >= 1
+
+    def test_ice_timeout_follows_configuration(self):
+        from omero_ms_pixel_buffer_tpu.auth.ice import Glacier2Client
+
+        set_io_timeout(0.25)
+        client = Glacier2Client("localhost")
+        assert client.timeout_s == 0.25
+        set_io_timeout(0.0)  # disabled -> conservative default
+        assert client.timeout_s == 10.0
+        pinned = Glacier2Client("localhost", timeout_s=3.0)
+        assert pinned.timeout_s == 3.0
+
+
+# ---------------------------------------------------------------------------
+# config validation
+# ---------------------------------------------------------------------------
+
+class TestCacheConfig:
+    def _base(self, **cache):
+        return Config.from_dict(
+            {"session-store": {"type": "memory"}, "cache": cache}
+        )
+
+    def test_defaults(self):
+        config = self._base()
+        assert config.cache.enabled and config.cache.memory_mb == 256
+        assert config.cache.disk_dir is None
+        assert config.cache.prefetch.enabled
+        assert config.resilience.io_timeout_ms == 5000.0
+
+    def test_rejects_bad_fraction(self):
+        with pytest.raises(ConfigError):
+            self._base(**{"protected-fraction": 1.5})
+        with pytest.raises(ConfigError):
+            self._base(prefetch={"headroom": 2.0})
+
+    def test_rejects_garbage_numbers(self):
+        with pytest.raises(ConfigError):
+            self._base(**{"memory-mb": "lots"})
+        with pytest.raises(ConfigError):
+            Config.from_dict({
+                "session-store": {"type": "memory"},
+                "resilience": {"io-timeout-ms": -1},
+            })
+
+    def test_full_block_parses(self):
+        config = Config.from_dict({
+            "session-store": {"type": "memory"},
+            "cache": {
+                "memory-mb": 64, "disk-dir": "/tmp/spill",
+                "disk-mb": 128, "ttl-s": 30, "max-age-s": 120,
+                "etag-precheck": False,
+                "prefetch": {"enabled": False, "lookahead": 3},
+            },
+            "resilience": {"io-timeout-ms": 1500},
+        })
+        assert config.cache.disk_dir == "/tmp/spill"
+        assert config.cache.ttl_s == 30.0
+        assert not config.cache.etag_precheck
+        assert not config.cache.prefetch.enabled
+        assert config.resilience.io_timeout_ms == 1500.0

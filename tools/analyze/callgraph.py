@@ -38,6 +38,10 @@ class CallSite:
     name: str            # attribute tail or bare name
     line: int
     in_executor: bool
+    # whether the call passes a ``*timeout*``-named keyword — the
+    # marker resilience-coverage's per-call-timeout requirement
+    # accepts alongside asyncio.wait_for
+    has_timeout_kw: bool = False
 
 
 @dataclasses.dataclass
@@ -118,8 +122,13 @@ class _FunctionScanner:
         if isinstance(node, ast.Call):
             base, name = _base_of(node.func)
             if name is not None:
+                has_timeout = any(
+                    kw.arg is not None and "timeout" in kw.arg
+                    for kw in node.keywords
+                )
                 self.fn.calls.append(
-                    CallSite(base, name, node.lineno, in_exec)
+                    CallSite(base, name, node.lineno, in_exec,
+                             has_timeout)
                 )
             arg_exec = in_exec or (name in EXECUTOR_ENTRYPOINTS)
             self._visit(node.func, in_exec)

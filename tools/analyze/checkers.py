@@ -8,7 +8,8 @@ Rule ids:
 
 - ``loop-block``           blocking call reachable from an async def
 - ``lock-discipline``      lock-guarded attribute accessed without it
-- ``resilience-coverage``  naked remote-I/O (no breaker/fault-point)
+- ``resilience-coverage``  naked remote-I/O (no breaker/fault-point/
+                           per-call timeout)
 - ``jax-hotpath``          host sync / per-call jit in device modules
 - ``error-taxonomy``       bare except, swallowed CancelledError,
                            unmapped exception on the request path
@@ -276,9 +277,10 @@ def check_lock_discipline(
                 continue
             # lock-held helpers: methods only ever called with the
             # lock held ("callers hold self._lock" pattern); iterate
-            # so helpers calling helpers converge
+            # to the fixpoint so helper chains of any depth converge
+            # (each round can only ADD one call-graph level)
             held = set()
-            for _ in range(3):
+            for _ in range(len(info.method_names) + 1):
                 new_held = set(held)
                 calls_of: Dict[str, List[bool]] = {}
                 for caller, calls in info.method_calls.items():
@@ -371,6 +373,20 @@ def _has_injection_marker(fn: FunctionInfo) -> bool:
     return False
 
 
+def _has_timeout_marker(fn: FunctionInfo) -> bool:
+    """Per-call timeout evidence: an ``asyncio.wait_for`` (the async
+    edges) or any call passing a ``timeout``-named keyword (the
+    http.client edges, where the timeout rides the constructor)."""
+    for call in fn.calls:
+        if call.name == "wait_for":
+            return True
+        if call.has_timeout_kw:
+            return True
+        if call.name == "_get_with_retry":
+            return True
+    return False
+
+
 def check_resilience_coverage(
     project: Project, indexes: Dict[str, ModuleIndex]
 ) -> List[Finding]:
@@ -382,28 +398,34 @@ def check_resilience_coverage(
             continue
         idx = indexes[sf.path]
         # markers a function *transitively contains* (itself + loose
-        # same-module callees)
-        contains: Dict[str, Tuple[bool, bool]] = {}
+        # same-module callees): (breaker, injection, timeout)
+        contains: Dict[str, Tuple[bool, bool, bool]] = {}
 
-        def markers_of(fn: FunctionInfo, stack: Set[str]) -> Tuple[bool, bool]:
+        def markers_of(
+            fn: FunctionInfo, stack: Set[str]
+        ) -> Tuple[bool, bool, bool]:
             if fn.qualname in contains:
                 return contains[fn.qualname]
             if fn.qualname in stack:
-                return (False, False)
+                return (False, False, False)
             stack.add(fn.qualname)
-            brk, inj = _has_breaker_marker(fn), _has_injection_marker(fn)
-            if not (brk and inj):
+            brk, inj, tmo = (
+                _has_breaker_marker(fn),
+                _has_injection_marker(fn),
+                _has_timeout_marker(fn),
+            )
+            if not (brk and inj and tmo):
                 for call in fn.calls:
                     for callee in idx.resolve_loose(call):
-                        b2, i2 = markers_of(callee, stack)
-                        brk, inj = brk or b2, inj or i2
-                        if brk and inj:
+                        b2, i2, t2 = markers_of(callee, stack)
+                        brk, inj, tmo = brk or b2, inj or i2, tmo or t2
+                        if brk and inj and tmo:
                             break
-                    if brk and inj:
+                    if brk and inj and tmo:
                         break
             stack.discard(fn.qualname)
-            contains[fn.qualname] = (brk, inj)
-            return brk, inj
+            contains[fn.qualname] = (brk, inj, tmo)
+            return brk, inj, tmo
 
         # reverse edges (loose): callee bare name -> caller functions
         callers: Dict[str, Set[str]] = {}
@@ -415,7 +437,11 @@ def check_resilience_coverage(
                         fn.qualname
                     )
 
-        def guarded(fn: FunctionInfo) -> bool:
+        def coverage(fn: FunctionInfo) -> Tuple[bool, bool, bool]:
+            """OR of markers over the function and every caller path
+            (the rule only *admits* guards, so over-connecting is
+            safe)."""
+            brk = inj = tmo = False
             seen: Set[str] = set()
             frontier = [fn.qualname]
             while frontier:
@@ -423,24 +449,35 @@ def check_resilience_coverage(
                 if q in seen:
                     continue
                 seen.add(q)
-                brk, inj = markers_of(by_qual[q], set())
-                if brk and inj:
-                    return True
+                b2, i2, t2 = markers_of(by_qual[q], set())
+                brk, inj, tmo = brk or b2, inj or i2, tmo or t2
+                if brk and inj and tmo:
+                    return brk, inj, tmo
                 frontier.extend(callers.get(q, ()))
-            return False
+            return brk, inj, tmo
 
         for fn in idx.functions:
             for call in fn.calls:
                 desc = _match_blocking(call, _NET_PRIMITIVES)
                 if desc is None:
                     continue
-                if not guarded(fn):
+                brk, inj, tmo = coverage(fn)
+                if not (brk and inj):
                     findings.append(Finding(
                         "resilience-coverage", sf.path, call.line,
                         f"remote I/O ({desc}) in '{fn.name}' has no "
                         "circuit-breaker gate or fault-injection "
                         "point on any caller path — route it through "
                         "the resilience wrappers",
+                    ))
+                elif not tmo:
+                    findings.append(Finding(
+                        "resilience-coverage", sf.path, call.line,
+                        f"remote I/O ({desc}) in '{fn.name}' has no "
+                        "per-call timeout on any caller path — bound "
+                        "the exchange with asyncio.wait_for (or a "
+                        "timeout= argument) so a silent dependency "
+                        "can't park the caller",
                     ))
     return findings
 
