@@ -627,6 +627,22 @@ def main():
         record["cache"] = cache_stats
     if device_stats:
         record["device"] = device_stats
+    # explicit host-vs-device table so the next round can read WHICH
+    # engine/stage moved without diffing nested sections
+    comparison = {
+        "sequential_host": round(host_tps, 2),
+        f"batched_{pipe.engine}": round(tpu_tps, 2),
+    }
+    for k, v in device_stats.items():
+        if k.startswith("tiles_per_sec_"):
+            comparison["device_" + k[len("tiles_per_sec_"):]] = v
+    micro = device_stats.get("micro") or {}
+    for k in ("deflate_gbps", "pack_gbps", "pack_speedup_vs_gather"):
+        if k in micro:
+            comparison[k] = micro[k]
+    if "stage_breakdown" in micro:
+        comparison["device_stage_breakdown"] = micro["stage_breakdown"]
+    record["engine_comparison"] = comparison
     print(json.dumps(record))
 
 

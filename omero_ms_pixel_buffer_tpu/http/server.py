@@ -323,6 +323,7 @@ class PixelBufferApp:
             png_strategy=config.backend.png.strategy,
             max_tile_bytes=config.backend.max_tile_mb << 20,
             device_deflate=config.backend.png.device_deflate,
+            compilation_cache_dir=config.jax.compilation_cache_dir,
         )
         self.worker = BatchingTileWorker(
             self.pipeline,
@@ -439,6 +440,7 @@ class PixelBufferApp:
         if self.result_cache is not None:
             self.result_cache.close()
         await self.worker.close()
+        self.pipeline.close()
         await self.session_store.close()
         self.pixels_service.close()
         resolver = getattr(self.pixels_service, "metadata_resolver", None)

@@ -1,0 +1,283 @@
+"""Async double-buffered device-encode dispatch.
+
+The device encode chain used to run strictly serially per bucket
+group: build the host batch, H2D it, run filter, run deflate, pull the
+streams, frame — each stage waiting on the last, the device idle
+during every host stage and the host idle during every device stage.
+This module overlaps them (the Model-Based Warp Overlapped Tiling
+playbook, arXiv:1909.07190, applied at the dispatch level):
+
+- the SUBMITTING thread (a batcher executor thread) stages group k's
+  host batch, blocks only on its H2D transfer (which the transfer
+  engine runs concurrently with group k-1's compute), then launches
+  the fused filter+deflate program — jax dispatch is async, so the
+  launch returns immediately and the thread moves on to stage group
+  k+1 while the device crunches;
+- a READBACK worker thread blocks on each group's device completion,
+  pulls lengths + compressed streams in one host sync (the adaptive
+  power-of-two cap from the pipeline keeps that a single transfer),
+  and frames the PNGs — overlapping group k's D2H + framing with
+  group k+1's compute.
+
+Two groups are therefore in flight at any moment (the classic double
+buffer); the donated fused program (ops/device_deflate) keeps HBM
+residency flat while they are.
+
+Every stage reports into the ``device_stage_seconds`` histogram
+(stage = stage|h2d|compute|d2h|frame) so BENCH and /metrics can see
+WHICH stage moved when a change lands.
+
+With a serving mesh, the group dispatch routes through
+``parallel.mesh.MeshManager`` + ``parallel.sharding.
+sharded_filter_deflate`` instead: the batch axis shards across chips,
+a sick chip degrades the mesh to the survivors (per-device breakers),
+and per-device lane counts are recorded for the MULTICHIP report.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.metrics import REGISTRY
+
+log = logging.getLogger("omero_ms_pixel_buffer_tpu.device_dispatch")
+
+DEVICE_STAGE_SECONDS = REGISTRY.histogram(
+    "device_stage_seconds",
+    "Device encode pipeline stage durations "
+    "(stage=stage|h2d|compute|d2h|frame)",
+)
+
+
+class DeviceEncodeDispatcher:
+    """Submit encode groups, collect per-group futures.
+
+    One dispatcher per TilePipeline; ``dd_cap`` is the pipeline's
+    shared adaptive compressed-size guess keyed (w, h) — the readback
+    thread both consumes and trains it. ``mesh_manager`` (optional)
+    switches group dispatch to the sharded multi-chip path.
+    """
+
+    def __init__(
+        self,
+        dd_cap: Dict[Tuple[int, int], int],
+        mesh_manager=None,
+        packer: Optional[str] = None,
+    ):
+        self._dd_cap = dd_cap
+        self.mesh_manager = mesh_manager
+        self._packer = packer
+        # ONE worker: readback order == submission order, so group k's
+        # D2H never competes with group k+1's (the pipe stays a pipe)
+        self._readback = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="devenc-readback"
+        )
+        self._donate: Optional[bool] = None
+
+    def close(self) -> None:
+        self._readback.shutdown(wait=False)
+
+    def _donate_ok(self) -> bool:
+        # donation frees the staged input for reuse mid-program on
+        # TPU; CPU/GPU interpret paths warn and ignore it, so only
+        # resolve (and pay the backend query) once
+        if self._donate is None:
+            try:
+                import jax
+
+                self._donate = jax.default_backend() == "tpu"
+            except Exception:  # pragma: no cover
+                self._donate = False
+        return bool(self._donate)
+
+    # ------------------------------------------------------------------
+
+    def submit(
+        self,
+        tiles,
+        rows: int,
+        row_bytes: int,
+        bpp: int,
+        filter_mode: str,
+        deflate_mode: str,
+        lanes: Sequence[int],
+        sizes: Sequence[Tuple[int, int]],
+        bit_depth: int,
+        color_type: int,
+        staged: bool = False,
+    ) -> "concurrent.futures.Future":
+        """Launch one encode group; returns a Future resolving to
+        {lane_index: png_bytes}. ``tiles`` is either a host ndarray
+        (bucket path — staged H2D here) or an already device-resident
+        batch (plane-cache crops, ``staged=True``). All lanes in a
+        group share one real (w, h) — ``rows``/``row_bytes`` describe
+        it — but ``sizes`` still rides along for framing."""
+        import jax
+
+        mesh_mgr = self.mesh_manager
+        if mesh_mgr is not None and not staged:
+            # sharded groups run ENTIRELY on the readback worker: the
+            # dispatch must block on device completion inside
+            # MeshManager.dispatch, or a chip that wedges mid-compute
+            # would surface at a later block_until_ready outside the
+            # breaker/probe/shrink machinery and record a phantom
+            # success; chips supply the parallelism there, so losing
+            # the submit-thread overlap costs nothing
+            return self._readback.submit(
+                self._mesh_group,
+                tiles, rows, row_bytes, bpp, filter_mode, deflate_mode,
+                lanes, sizes, bit_depth, color_type,
+            )
+        from ..ops.device_deflate import fused_filter_deflate_batch
+
+        t0 = time.perf_counter()
+        if staged:
+            batch_dev = tiles
+            t_h2d = time.perf_counter()
+        else:
+            batch_dev = jax.device_put(tiles)
+            # blocking on the INPUT transfer only: the previous
+            # group's compute keeps the device busy meanwhile
+            jax.block_until_ready(batch_dev)  # ompb-lint: disable=jax-hotpath -- H2D stage boundary: waits on the transfer engine, overlapped with the prior group's compute
+            t_h2d = time.perf_counter()
+        streams, lengths = fused_filter_deflate_batch(
+            batch_dev, rows, row_bytes, bpp,
+            filter_mode=filter_mode, mode=deflate_mode,
+            packer=self._packer,
+            donate=(not staged) and self._donate_ok(),
+        )
+        t_dispatch = time.perf_counter()
+        DEVICE_STAGE_SECONDS.observe(t_h2d - t0, stage="h2d")
+        return self._readback.submit(
+            self._readback_group,
+            streams, lengths, t_dispatch, lanes, sizes,
+            bit_depth, color_type,
+        )
+
+    def _mesh_group(
+        self, tiles, rows, row_bytes, bpp, filter_mode, deflate_mode,
+        lanes, sizes, bit_depth, color_type,
+    ):
+        """One sharded group on the readback worker: pad pow2 (the
+        same per-shape jit-specialization cap the single-device path
+        has, then up to the healthy mesh width), shard, run the fused
+        chain, and BLOCK inside the managed dispatch so a sick chip's
+        failure is attributed to the mesh and degrades it."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..parallel.sharding import (
+            shard_batch,
+            sharded_filter_deflate,
+        )
+
+        t0 = time.perf_counter()
+        stamps = {}
+
+        def run(mesh):
+            n = mesh.shape["data"]
+            b = tiles.shape[0]
+            pow2 = 1 << max(b - 1, 0).bit_length()
+            padded_b = -(-pow2 // n) * n
+            batch = jnp.asarray(tiles)
+            if padded_b != b:
+                batch = jnp.pad(
+                    batch,
+                    ((0, padded_b - b),) + ((0, 0),) * (batch.ndim - 1),
+                )
+            sharded = shard_batch(mesh, batch)
+            jax.block_until_ready(sharded)  # ompb-lint: disable=jax-hotpath -- H2D stage boundary on the readback worker
+            stamps["h2d"] = time.perf_counter()
+            out = sharded_filter_deflate(
+                mesh, sharded, rows, row_bytes, bpp,
+                filter_mode=filter_mode, deflate_mode=deflate_mode,
+                packer=self._packer,
+            )
+            # block INSIDE the managed dispatch: a mid-compute chip
+            # failure must raise here, where MeshManager probes and
+            # shrinks, not at a later pull
+            return jax.block_until_ready(out)  # ompb-lint: disable=jax-hotpath -- readback worker: the one thread that waits on device completion
+
+        streams, lengths = self.mesh_manager.dispatch(
+            run, real_lanes=len(lanes)
+        )
+        t_ready = time.perf_counter()
+        DEVICE_STAGE_SECONDS.observe(
+            stamps.get("h2d", t0) - t0, stage="h2d"
+        )
+        DEVICE_STAGE_SECONDS.observe(
+            t_ready - stamps.get("h2d", t0), stage="compute"
+        )
+        return self._pull_and_frame(
+            streams, lengths, t_ready, lanes, sizes, bit_depth,
+            color_type,
+        )
+
+    # ------------------------------------------------------------------
+
+    def _readback_group(
+        self, streams, lengths, t_dispatch, lanes, sizes,
+        bit_depth, color_type,
+    ) -> Dict[int, bytes]:
+        """Runs on the readback worker: wait for the device, pull the
+        compressed bytes in ONE sync, frame the PNGs."""
+        import jax
+
+        # intended stage boundary: this thread EXISTS to absorb the
+        # device wait so submitters never do
+        jax.block_until_ready((streams, lengths))  # ompb-lint: disable=jax-hotpath -- readback worker: the one thread that waits on device completion
+        t_ready = time.perf_counter()
+        DEVICE_STAGE_SECONDS.observe(t_ready - t_dispatch, stage="compute")
+        return self._pull_and_frame(
+            streams, lengths, t_ready, lanes, sizes, bit_depth,
+            color_type,
+        )
+
+    def _pull_and_frame(
+        self, streams, lengths, t_ready, lanes, sizes, bit_depth,
+        color_type,
+    ) -> Dict[int, bytes]:
+        """Shared tail: pull the compressed bytes in ONE sync (the
+        adaptive pow2 cap), frame the PNGs on the host."""
+        import jax
+
+        from ..ops.png import frame_png
+
+        w, h = sizes[0]
+        full_cap = streams.shape[1]
+        guess = min(
+            self._dd_cap.get(
+                (w, h), 1 << max(full_cap // 4, 64).bit_length()
+            ),
+            full_cap,
+        )
+        real = len(lanes)
+        lengths_np, streams_np = jax.device_get(
+            (lengths[:real], streams[:real, :guess])
+        )
+        max_len = int(lengths_np.max()) if real else 0
+        if max_len > guess:
+            cap = min(full_cap, 1 << max(max_len - 1, 0).bit_length())
+            # guess overflow: one extra pull, rare by construction
+            # (the cap tracks the running max)
+            streams_np = np.asarray(streams[:real, :cap])  # ompb-lint: disable=jax-hotpath -- guess-overflow path: a second bounded pull, not a per-lane sync
+        self._dd_cap[(w, h)] = min(
+            full_cap, 1 << max(2 * max_len - 1, 0).bit_length()
+        )
+        t_d2h = time.perf_counter()
+        DEVICE_STAGE_SECONDS.observe(t_d2h - t_ready, stage="d2h")
+        out: Dict[int, bytes] = {}
+        for j, lane in enumerate(lanes):
+            out[lane] = frame_png(
+                streams_np[j, : int(lengths_np[j])].tobytes(),
+                sizes[j][0], sizes[j][1], bit_depth, color_type,
+            )
+        DEVICE_STAGE_SECONDS.observe(
+            time.perf_counter() - t_d2h, stage="frame"
+        )
+        return out

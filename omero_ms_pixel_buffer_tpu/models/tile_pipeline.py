@@ -123,6 +123,7 @@ class TilePipeline:
         use_plane_cache: bool = True,
         max_tile_bytes: int = 256 << 20,
         device_deflate: bool = False,
+        compilation_cache_dir: Optional[str] = None,
     ):
         self.pixels_service = pixels_service
         self.png_filter = png_filter
@@ -146,6 +147,18 @@ class TilePipeline:
         # deflate tail pull lengths AND stream bytes in ONE host sync
         # (tunnel round trips dominate the device path's latency)
         self._dd_cap: Dict[Tuple[int, int], int] = {}
+        # double-buffered device-encode dispatcher (built lazily on
+        # the first device-deflate batch; owns the readback worker)
+        self._dispatcher = None
+        # persistent XLA compilation cache: an explicit configured dir
+        # (config `jax.compilation-cache-dir`) engages at construction
+        # on ANY backend — jax.config updates only, no PJRT init — so
+        # bucket-shape specializations survive restarts
+        self.compilation_cache_dir = compilation_cache_dir
+        if compilation_cache_dir:
+            from ..runtime.jax_cache import enable_persistent_cache
+
+            enable_persistent_cache(compilation_cache_dir)
         self.use_plane_cache = use_plane_cache
         self._plane_cache = None  # built lazily on first device batch
         # serving mesh: "auto" -> built on first device batch when >1
@@ -172,6 +185,14 @@ class TilePipeline:
         self._encode_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=encode_workers, thread_name_prefix="encode"
         )
+
+    def close(self) -> None:
+        """Release owned threads: the encode pool and (if the device
+        path ever ran) the dispatcher's readback worker. Idempotent;
+        the server's cleanup hook calls it."""
+        if self._dispatcher is not None:
+            self._dispatcher.close()
+        self._encode_pool.shutdown(wait=False)
 
     def encode_signature(self) -> str:
         """The 'quality' component of the result-cache key schema
@@ -281,6 +302,34 @@ class TilePipeline:
                 except Exception:
                     log.exception("mesh init failed; single-device serving")
         return self.mesh
+
+    def _get_dispatcher(self):
+        """The double-buffered device-encode dispatcher; with a
+        serving mesh it carries a MeshManager so encode batches shard
+        across chips and a sick chip degrades to the survivors."""
+        if self._dispatcher is None:
+            from .device_dispatch import DeviceEncodeDispatcher
+
+            mesh = self._get_mesh()
+            mgr = None
+            if mesh is not None:
+                from ..parallel.mesh import MeshManager
+
+                mgr = MeshManager(devices=list(mesh.devices.flat))
+            self._dispatcher = DeviceEncodeDispatcher(
+                self._dd_cap, mesh_manager=mgr
+            )
+        return self._dispatcher
+
+    @property
+    def last_mesh_dispatch(self) -> Optional[dict]:
+        """Accounting of the most recent sharded encode dispatch
+        (n_devices, device_ids, lanes_per_device) — what the MULTICHIP
+        record reports as proof of real multi-chip execution."""
+        disp = self._dispatcher
+        if disp is None or disp.mesh_manager is None:
+            return None
+        return disp.mesh_manager.last_dispatch
 
     # ------------------------------------------------------------------
     # resolve / read — the metadata + I/O stages
@@ -462,7 +511,7 @@ class TilePipeline:
             # the device path pays this (host serving never needs jax)
             from ..runtime.jax_cache import enable_persistent_cache
 
-            enable_persistent_cache()
+            enable_persistent_cache(self.compilation_cache_dir)
         mesh = self._get_mesh() if use_device else None
 
         # HBM-resident path: lanes whose plane is (or becomes) device-
@@ -558,7 +607,27 @@ class TilePipeline:
                 log.exception("distributed plane lane failed; host fallback")
                 results[i] = self.encode(ctxs[i], tiles[i])
 
+        # device-deflate groups go through the double-buffered
+        # dispatcher: each group's H2D + fused compute launches while
+        # earlier groups are still in their D2H/framing tail (the
+        # readback worker), so the device never waits on host framing
+        use_fused = use_device and self.device_deflate
+        pending: List[Tuple[List[int], object]] = []
         for ((bh, bw), dtype_str, samples), lanes in png_groups.items():
+            if use_fused:
+                try:
+                    pending.extend(self._submit_bucket_groups(
+                        lanes, tiles, bh, bw, np.dtype(dtype_str),
+                        samples,
+                    ))
+                    continue
+                except Exception:
+                    log.exception(
+                        "device encode dispatch failed; host fallback"
+                    )
+                    for i in lanes:
+                        results[i] = self.encode(ctxs[i], tiles[i])
+                    continue
             try:
                 self._device_png_lanes(
                     lanes, tiles, ctxs, results, bh, bw,
@@ -571,6 +640,19 @@ class TilePipeline:
 
         for key, lanes in plane_groups.items():
             (_, bh, bw, dtype_str) = key[-4:]
+            if use_fused:
+                try:
+                    pending.extend(self._submit_plane_groups(
+                        plane_handles[key], lanes, resolved, bh, bw,
+                        np.dtype(dtype_str),
+                    ))
+                    continue
+                except Exception:
+                    log.exception(
+                        "plane-cache dispatch failed; host fallback"
+                    )
+                    self._plane_fallback(lanes, resolved, ctxs, results)
+                    continue
             try:
                 self._device_plane_png_lanes(
                     plane_handles[key], lanes, resolved, ctxs, results,
@@ -578,14 +660,34 @@ class TilePipeline:
                 )
             except Exception:
                 log.exception("plane-cache PNG batch failed; host fallback")
-                for i in lanes:
+                self._plane_fallback(lanes, resolved, ctxs, results)
+
+        for idxs, fut in pending:
+            try:
+                # audited: handle_batch runs on a BATCHER executor
+                # thread and the future resolves on the dispatcher's
+                # readback pool — distinct pools, no self-deadlock
+                group = fut.result()  # ompb-lint: disable=loop-block -- executor-thread wait on a different pool
+                for i, png in group.items():
+                    results[i] = png
+            except Exception:
+                log.exception("device encode group failed; host fallback")
+                for i in idxs:
                     try:
-                        results[i] = self.encode(
-                            ctxs[i], self.read(resolved[i])
-                        )
+                        tile = tiles[i]
+                        if tile is None:
+                            tile = self.read(resolved[i])
+                        results[i] = self.encode(ctxs[i], tile)
                     except Exception:
                         results[i] = None
         return results
+
+    def _plane_fallback(self, lanes, resolved, ctxs, results) -> None:
+        for i in lanes:
+            try:
+                results[i] = self.encode(ctxs[i], self.read(resolved[i]))
+            except Exception:
+                results[i] = None
 
     def _stage_plane_lanes(self, ctxs, resolved):
         """Group device-eligible PNG lanes by resident plane; stages
@@ -655,15 +757,10 @@ class TilePipeline:
                 rows = to_big_endian_bytes(device_batch)
                 filtered = filter_batch(rows, itemsize, self.png_filter)
         sizes = [(resolved[i].w, resolved[i].h) for i in lanes]
-        if self.device_deflate:
-            self._finish_png_lanes_device(
-                filtered, lanes, sizes, results, itemsize
-            )
-        else:
-            self._finish_png_lanes(
-                # ompb-lint: disable=jax-hotpath -- the ONE intended device->host pull of this path (filtered scanlines for the host deflate tail)
-                np.asarray(filtered), lanes, sizes, results, itemsize
-            )
+        self._finish_png_lanes(
+            # ompb-lint: disable=jax-hotpath -- the ONE intended device->host pull of this path (filtered scanlines for the host deflate tail)
+            np.asarray(filtered), lanes, sizes, results, itemsize
+        )
 
     def _finish_png_lanes(
         self, filtered, lanes, sizes, results, itemsize, samples=1
@@ -720,86 +817,87 @@ class TilePipeline:
                     log.exception("encode failed for lane %d", i)
                     results[i] = None
 
-    def _finish_png_lanes_device(
-        self, filtered, lanes, sizes, results, itemsize, samples=1
-    ):
-        """On-device encode tail: the zlib stream itself is built on the
-        accelerator (ops/device_deflate — lane-parallel RLE match scan +
-        fixed-Huffman bit packing), so only compressed bytes cross the
-        link and the host's role shrinks to PNG chunk framing (CRC over
-        opaque bytes). Lanes group by real (w, h): stream layout is
-        static per payload length, one jit specialization per size.
-        Falls back to the host deflate tail on any device failure."""
-        from ..ops.device_deflate import deflate_filtered_batch
-        from ..ops.png import frame_png
-
+    def _log_device_deflate(self) -> None:
         if not self._device_deflate_logged:
             self._device_deflate_logged = True
             log.info(
                 "device deflate active: PNG lanes compress on the "
-                "accelerator (RLE + fixed Huffman); backend.png.level/"
-                "strategy apply only to host-encoded lanes"
+                "accelerator (RLE + fixed Huffman, fused with the "
+                "filter in one program); backend.png.level/strategy "
+                "apply only to host-encoded lanes"
             )
-        bit_depth = itemsize * 8
-        color_type = 0 if samples == 1 else 2
+
+    def _submit_bucket_groups(
+        self, lanes, tiles, bh, bw, dtype, samples=1
+    ):
+        """Host-staged lanes -> double-buffered fused dispatch. Lanes
+        group by real (w, h) — stream layout is static per payload
+        length, one jit specialization per size — and each group
+        becomes one dispatcher submission: H2D + the single fused
+        byteswap+filter+deflate program + async readback. Returns
+        [(lane_indices, future)] for handle_batch to drain."""
+        self._log_device_deflate()
+        disp = self._get_dispatcher()
+        itemsize = dtype.itemsize
         bpp = samples * itemsize
         groups: Dict[Tuple[int, int], List[int]] = {}
-        for j, wh in enumerate(sizes):
-            groups.setdefault(wh, []).append(j)
-        try:
-            with TRACER.start_span("batch_encode"):
-                for (w, h), js in groups.items():
-                    sub = (
-                        filtered
-                        if len(js) == filtered.shape[0]
-                        else filtered[jnp.asarray(js)]
-                    )
-                    streams, lengths = deflate_filtered_batch(
-                        sub, h, 1 + w * bpp
-                    )
-                    # only the compressed bytes cross the link, and in
-                    # ONE host sync: slice to an adaptive power-of-two
-                    # guess (the slice shape repeats -> jit cache) and
-                    # pull lengths + bytes together; a guess overflow
-                    # (rare: the guess tracks the running max) costs
-                    # one extra fetch
-                    import jax as _jax
+        for i in lanes:
+            t = tiles[i]
+            groups.setdefault((t.shape[1], t.shape[0]), []).append(i)
+        pending = []
+        with TRACER.start_span("batch_device"):
+            for (w, h), idxs in groups.items():
+                shape = (
+                    (len(idxs), bh, bw) if samples == 1
+                    else (len(idxs), bh, bw, samples)
+                )
+                batch = np.zeros(shape, dtype=dtype)
+                for j, i in enumerate(idxs):
+                    t = tiles[i]
+                    batch[j, : t.shape[0], : t.shape[1]] = t
+                fut = disp.submit(
+                    batch, h, 1 + w * bpp, bpp, self.png_filter, "rle",
+                    idxs, [(w, h)] * len(idxs),
+                    itemsize * 8, 0 if samples == 1 else 2,
+                )
+                pending.append((idxs, fut))
+        return pending
 
-                    full_cap = streams.shape[1]
-                    guess = min(
-                        self._dd_cap.get(
-                            (w, h),
-                            1 << max(full_cap // 4, 64).bit_length(),
-                        ),
-                        full_cap,
-                    )
-                    lengths, streams_np = _jax.device_get(
-                        (lengths, streams[:, :guess])
-                    )
-                    max_len = int(lengths.max())
-                    if max_len > guess:
-                        cap = min(
-                            full_cap,
-                            1 << max(max_len - 1, 0).bit_length(),
-                        )
-                        # ompb-lint: disable=jax-hotpath -- guess overflow: one extra pull, rare by construction (cap tracks the running max)
-                        streams_np = np.asarray(streams[:, :cap])
-                    self._dd_cap[(w, h)] = min(
-                        full_cap,
-                        1 << max(2 * max_len - 1, 0).bit_length(),
-                    )
-                    streams = streams_np
-                    for j, stream, length in zip(js, streams, lengths):
-                        results[lanes[j]] = frame_png(
-                            stream[: int(length)].tobytes(),
-                            w, h, bit_depth, color_type,
-                        )
-        except Exception:
-            log.exception("device deflate failed; host deflate tail")
-            self._finish_png_lanes(
-                np.asarray(filtered), lanes, sizes, results, itemsize,
-                samples,
+    def _submit_plane_groups(
+        self, plane, lanes, resolved, bh, bw, dtype
+    ):
+        """HBM-resident lanes -> fused dispatch: crop on device, then
+        the same fused filter+deflate program per (w, h) group — the
+        tiles never exist on the host at all."""
+        self._log_device_deflate()
+        disp = self._get_dispatcher()
+        itemsize = dtype.itemsize
+        coords = [(resolved[i].y, resolved[i].x) for i in lanes]
+        with TRACER.start_span("batch_device"):
+            device_batch = self._plane_cache.crop_batch(
+                plane, coords, bh, bw
             )
+            groups: Dict[Tuple[int, int], List[int]] = {}
+            for j, i in enumerate(lanes):
+                groups.setdefault(
+                    (resolved[i].w, resolved[i].h), []
+                ).append(j)
+            pending = []
+            for (w, h), js in groups.items():
+                sub = (
+                    device_batch
+                    if len(js) == device_batch.shape[0]
+                    else device_batch[jnp.asarray(js)]
+                )
+                idxs = [lanes[j] for j in js]
+                fut = disp.submit(
+                    sub, h, 1 + w * itemsize, itemsize,
+                    self.png_filter, "rle", idxs,
+                    [(w, h)] * len(idxs), itemsize * 8, 0,
+                    staged=True,
+                )
+                pending.append((idxs, fut))
+        return pending
 
     def _host_png_lanes(self, lanes, tiles, ctxs, results) -> None:
         """Host engine: the whole batch in one fused native call
@@ -875,16 +973,11 @@ class TilePipeline:
                     rows, bpp, self.png_filter
                 )  # (B, bh, 1 + bw*bpp)
         sizes = [(tiles[i].shape[1], tiles[i].shape[0]) for i in lanes]
-        if self.device_deflate:
-            self._finish_png_lanes_device(
-                filtered, lanes, sizes, results, itemsize, samples
-            )
-        else:
-            self._finish_png_lanes(
-                # ompb-lint: disable=jax-hotpath -- the ONE intended device->host pull of this path (filtered scanlines for the host deflate tail)
-                np.asarray(filtered), lanes, sizes, results, itemsize,
-                samples,
-            )
+        self._finish_png_lanes(
+            # ompb-lint: disable=jax-hotpath -- the ONE intended device->host pull of this path (filtered scanlines for the host deflate tail)
+            np.asarray(filtered), lanes, sizes, results, itemsize,
+            samples,
+        )
 
     def _distributed_plane_lane(self, mesh, i, tile, results) -> None:
         """Space-parallel path for one plane-sized PNG lane: rows shard

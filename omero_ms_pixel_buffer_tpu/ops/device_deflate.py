@@ -12,11 +12,24 @@ built on device** with static shapes, in two modes:
   short tails literal), found with associative scans (cummax/cummin)
   instead of a serial scan; every token maps through precomputed
   fixed-Huffman tables to a (bits, nbits) pair; token bit offsets are
-  an exclusive cumsum; and the bitstream is packed by a *gather* — for
-  every output bit position, binary-search the token covering it —
-  which XLA/TPU handles far better than a scatter. Up-filtered
-  microscopy tiles are run-heavy, so this genuinely compresses
-  (typically 2-4x) while leaving the host only PNG chunk framing.
+  an exclusive cumsum; and the bitstream is packed by the **carry-free
+  prefix-sum packer** (``_pack_bits_scan``): because tokens occupy
+  disjoint bit ranges, the sum of their word-aligned contributions has
+  no carries, so each output word is an exact difference of wrapping
+  prefix sums — two cumsums over tokens, one monotone ``searchsorted``
+  for word boundaries, two monotone gathers, all dense. O(tokens +
+  words) work with no sort and no wide gather windows; the previous
+  per-bit window packer (kept as ``_pack_bits_gather`` for pinned
+  comparison benches) cost an argsort plus a 24-wide token window per
+  128-bit chunk and measured 0.006 GB/s on TPU. On TPU backends the
+  word emit can also run as a Pallas kernel (ops/pallas/bitpack.py,
+  per-block token->VMEM emit; interpret mode pins bit-exactness on
+  CPU). Up-filtered microscopy tiles are run-heavy, so this genuinely
+  compresses (typically 2-4x) while leaving the host only PNG chunk
+  framing. **Per lane**, if the RLE stream would come out larger than
+  the stored-block encoding (pathological no-run payloads expand past
+  9 bits/byte), the stored stream is emitted instead — every lane's
+  length is bounded by ``stored_stream_len(L)``.
 - ``stored``: BTYPE=00 stored blocks — no compression, but the
   simplest possible spec-valid stream; kept as the paranoia fallback
   and as the reference point in tests.
@@ -32,14 +45,22 @@ compiles once:
     payloads (B, L) uint8 -> streams (B, max_stream_len(L)) uint8,
                              lengths (B,) int32
 
+``fused_filter_deflate_batch`` additionally fuses the byteswap + PNG
+scanline filter into the SAME jit program, so the device encode chain
+is one dispatch from native-dtype tiles to complete zlib streams (and
+``filter_deflate_local`` exposes the un-jitted core for ``shard_map``
+in parallel/sharding.py).
+
 Correctness contract: ``zlib.decompress(bytes(streams[i][:lengths[i]]))``
-equals the input payload for every lane — pinned against the CPU
-backend in tests/test_device_deflate.py.
+equals the input payload for every lane AND ``lengths[i] <=
+stored_stream_len(L)`` — pinned against the CPU backend in
+tests/test_device_deflate.py.
 """
 
 from __future__ import annotations
 
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -217,27 +238,78 @@ def _rle_tokens(payload: jax.Array):
     return bits, nbits
 
 
-# Bit-packing geometry: output bits are cut into chunks; each chunk's
-# covering tokens come from a fixed-size window starting at the last
-# token at or before the chunk start (merge-path partitioning — both
-# sides are sorted). Real tokens are >= 7 bits (header 3, literal 8/9,
-# match >= 12), so a 128-bit chunk intersects at most ~19 tokens; 24
-# gives margin. This keeps ALL heavy work dense (compare + masked
-# reduce over the window) — TPUs crawl on the big arbitrary gathers a
-# per-bit binary search needs, but stream through elementwise+reduce.
+# Maximum significant bits in any token's code value: a match emits
+# rev(code) | extra<<n with n <= 8 and extra < 2^5 (13 bits); its BIT
+# COUNT adds the 5-bit distance code, but those bits are zero (symbol
+# 0 reverses to 0). Literals are 8/9 bits, the header 3.
+_TOKEN_VALUE_BITS = 13
+_TOKEN_MAX_NBITS = 18
+
+
+def _pack_bits_scan(bits: jax.Array, nbits: jax.Array, maxbits: int):
+    """Carry-free prefix-sum bit packer: token (bits, nbits) arrays ->
+    (LSB-first packed bytes, total body bits).
+
+    Token bit ranges are disjoint, so within any output word the sum
+    of token contributions equals their OR — no carries — and wrapping
+    uint32 prefix sums recover exact per-word segment sums by
+    subtraction (mod 2^32 differences of a carry-free segment are
+    exact). Per token: its word-w part ``lo = val << (off & 31)`` and
+    spill ``hi`` into word w+1 (values are <= 13 significant bits, so
+    two words always suffice). Then
+
+        words[w] =  (Tl[c[w]]   - Tl[c[w-1]])    # tokens starting in w
+                 +  (Th[c[w-1]] - Th[c[w-2]])    # spill from w-1
+
+    with Tl/Th the wrapping cumsums and c[w] the token count below
+    each 32-bit boundary (one monotone searchsorted). Everything is a
+    scan, a monotone gather, or elementwise — no sort, no scatter, no
+    per-bit work. Zero-length tokens (run interiors) contribute zero
+    and need no compaction."""
+    ntok = bits.shape[0]
+    offs = jnp.cumsum(nbits) - nbits  # exclusive; non-decreasing
+    total_bits = offs[-1] + nbits[-1]
+    s = (offs & 31).astype(jnp.uint32)
+    val = bits.astype(jnp.uint32)
+    lo = val << s
+    # logical right shift by 32 - s without the s=0 UB: >> (31-s) >> 1
+    hi = (val >> (jnp.uint32(31) - s)) >> jnp.uint32(1)
+    zero = jnp.zeros(1, jnp.uint32)
+    tl = jnp.concatenate([zero, jnp.cumsum(lo)])  # (ntok+1,)
+    th = jnp.concatenate([zero, jnp.cumsum(hi)])
+    nwords = maxbits // 32
+    edges = (jnp.arange(nwords, dtype=jnp.int32) + 1) * 32
+    c = jnp.searchsorted(offs, edges, side="left")  # tokens below edge
+    gl = tl[c]
+    gh = th[c]
+    gl1 = jnp.concatenate([zero, gl[:-1]])  # Tl[c[w-1]]
+    gh1 = jnp.concatenate([zero, gh[:-1]])  # Th[c[w-1]]
+    gh2 = jnp.concatenate([zero, gh1[:-1]])  # Th[c[w-2]]
+    words = (gl - gl1) + (gh1 - gh2)
+    shifts = (jnp.arange(4, dtype=jnp.uint32) * 8)[None, :]
+    packed = ((words[:, None] >> shifts) & 0xFF).astype(jnp.uint8)
+    return packed.reshape(-1), total_bits
+
+
+# Bit-packing geometry of the LEGACY packer (kept only as the pinned
+# reference point for comparison benches/tests — the scan packer above
+# replaced it): output bits are cut into chunks; each chunk's covering
+# tokens come from a fixed-size window starting at the last token at
+# or before the chunk start (merge-path partitioning — both sides are
+# sorted). Real tokens are >= 7 bits (header 3, literal 8/9, match
+# >= 12), so a 128-bit chunk intersects at most ~19 tokens; 24 gives
+# margin.
 _CHUNK_BITS = 128
 _WIN = 24
 
 
-def _pack_bits(bits: jax.Array, nbits: jax.Array, maxbits: int):
-    """Token (bits, nbits) arrays -> LSB-first packed byte array.
-
-    1. Stable-sort zero-bit tokens to the tail (run interiors emit
-       nothing; compaction keeps the chunk windows small).
-    2. Per output chunk, binary-search ONLY the chunk start (tiny),
-       then select each bit's token from the chunk's token window by a
-       dense prefix-compare — one-hot via cmp XOR shifted-cmp — and
-       masked reductions. No per-bit gather anywhere.
+def _pack_bits_gather(bits: jax.Array, nbits: jax.Array, maxbits: int):
+    """LEGACY packer: token (bits, nbits) arrays -> LSB-first packed
+    byte array via an argsort compaction + per-128-bit-chunk token
+    window + dense one-hot reduce. O(maxbits * WIN) work plus a full
+    argsort per lane — measured 0.006 GB/s on TPU, which is why
+    ``_pack_bits_scan`` exists. Kept so the speedup stays measurable
+    (runtime/microbench.py pins scan-vs-gather).
     """
     ntok = bits.shape[0]
     order = jnp.argsort(nbits == 0, stable=True)  # real tokens first
@@ -282,36 +354,102 @@ def _pack_bits(bits: jax.Array, nbits: jax.Array, maxbits: int):
     return packed, total_bits
 
 
-def _encode_lane_rle(payload: jax.Array) -> tuple:
-    """One lane: (L,) uint8 payload -> (max_stream_len(L),) uint8 zlib
-    stream + its true length."""
-    n = payload.shape[0]
+def _lane_tokens(payload: jax.Array) -> tuple:
+    """(L,) payload -> (L+1,) (bits, nbits) token arrays including the
+    block-header token (BFINAL=1, BTYPE=01 -> LSB-first value 3)."""
     tok_bits, tok_nbits = _rle_tokens(payload)
-    # header token: BFINAL=1, BTYPE=01 -> LSB-first bit value 3, 3 bits
     bits = jnp.concatenate([jnp.full(1, 3, jnp.uint32), tok_bits])
     nbits = jnp.concatenate([jnp.full(1, 3, jnp.int32), tok_nbits])
-    maxbits = _packing_maxbits(n)
-    packed, body_bits = _pack_bits(bits, nbits, maxbits)
-    # end-of-block symbol 256: 7-bit code 0 -> contributes no set bits,
-    # only length
+    return bits, nbits
+
+
+def _stored_lane(payload: jax.Array, adler: jax.Array, cap: int):
+    """One lane's stored-block zlib stream, zero-padded to ``cap``
+    bytes — the per-lane fallback when RLE would expand past the
+    stored bound."""
+    n = payload.shape[0]
+    nblocks = max(1, -(-n // _BLOCK))
+    pieces = [jnp.asarray([0x78, 0x01], jnp.uint8)]
+    for i in range(nblocks):
+        start = i * _BLOCK
+        size = min(_BLOCK, n - start)
+        final = 1 if i == nblocks - 1 else 0
+        header = np.array(
+            [final, size & 0xFF, size >> 8,
+             (size & 0xFF) ^ 0xFF, (size >> 8) ^ 0xFF],
+            dtype=np.uint8,
+        )
+        pieces.append(jnp.asarray(header))
+        pieces.append(payload[start : start + size])
+    pieces.append(adler)
+    stream = jnp.concatenate(pieces)
+    return jnp.pad(stream, (0, cap - stream.shape[0]))
+
+
+def _frame_lane(payload: jax.Array, packed: jax.Array, body_bits):
+    """Zlib-frame one lane's packed deflate body, then pick per lane
+    the smaller of the RLE and stored streams (RLE on no-run content
+    expands past 9 bits/byte; the stored bound must hold for every
+    lane): (stream padded to max_stream_len(L), true length)."""
+    n = payload.shape[0]
+    # end-of-block symbol 256: 7-bit code 0 -> contributes no set
+    # bits, only length
     total_bits = body_bits + 7
     deflate_nbytes = (total_bits + 7) // 8
-    maxbytes = maxbits // 8
-    out = jnp.zeros(2 + maxbytes + 4, jnp.uint8)
+    cap = 2 + packed.shape[0] + 4
+    rle_len = 2 + deflate_nbytes + 4
+    adler = _adler_bytes(_adler32_lane(payload))
+    out = jnp.zeros(cap, jnp.uint8)
     out = out.at[0].set(0x78).at[1].set(0x01)
     out = lax.dynamic_update_slice(out, packed, (2,))
-    adler = _adler_bytes(_adler32_lane(payload))
     out = lax.dynamic_update_slice(out, adler, (2 + deflate_nbytes,))
-    return out, (2 + deflate_nbytes + 4).astype(jnp.int32)
+    stored_len = stored_stream_len(n)
+    use_rle = rle_len <= stored_len
+    out = jnp.where(use_rle, out, _stored_lane(payload, adler, cap))
+    length = jnp.where(use_rle, rle_len, stored_len)
+    return out, length.astype(jnp.int32)
 
 
-@jax.jit
-def _zlib_rle(payloads: jax.Array) -> tuple:
-    # vmap, not lax.map: the chunked dense packer fuses into streaming
-    # reductions (nothing per-bit materializes), so batching lanes costs
-    # no extra residency — and the while-loop form compiled ~5x slower
-    # on TPU (measured 126s vs 26s for the 512-tile shape)
-    return jax.vmap(_encode_lane_rle)(payloads)
+@partial(jax.jit, static_argnames=("packer", "interpret"))
+def _zlib_rle(
+    payloads: jax.Array, packer: str = "scan", interpret: bool = False
+) -> tuple:
+    # vmap, not lax.map: the scan packer fuses into streaming scans
+    # and monotone gathers, so batching lanes costs no extra residency
+    # — and the while-loop form compiled ~5x slower on TPU (measured
+    # 126s vs 26s for the 512-tile shape)
+    bits, nbits = jax.vmap(_lane_tokens)(payloads)
+    maxbits = _packing_maxbits(payloads.shape[1])
+    if packer == "pallas":
+        from .pallas.bitpack import pack_tokens
+
+        packed, body_bits = pack_tokens(
+            bits, nbits, maxbits, interpret=interpret
+        )
+    elif packer == "gather":
+        packed, body_bits = jax.vmap(
+            lambda b, nb: _pack_bits_gather(b, nb, maxbits)
+        )(bits, nbits)
+    else:
+        packed, body_bits = jax.vmap(
+            lambda b, nb: _pack_bits_scan(b, nb, maxbits)
+        )(bits, nbits)
+    return jax.vmap(_frame_lane)(payloads, packed, body_bits)
+
+
+def default_packer() -> str:
+    """'pallas' (the per-block VMEM emit kernel) on real TPU backends,
+    'scan' (the XLA prefix-sum packer) everywhere else. Overridable
+    with OMPB_BITPACK=scan|pallas|gather."""
+    import os
+
+    forced = os.environ.get("OMPB_BITPACK")
+    if forced in ("scan", "pallas", "gather"):
+        return forced
+    try:
+        return "pallas" if jax.default_backend() == "tpu" else "scan"
+    except Exception:  # pragma: no cover - backend init failure
+        return "scan"
 
 
 # ---------------------------------------------------------------------------
@@ -361,53 +499,152 @@ def zlib_stored_batch(payloads) -> jax.Array:
     return _zlib_stored(payloads)
 
 
-def zlib_rle_batch(payloads) -> tuple:
-    """Compressive zlib streams (Z_RLE match policy, fixed Huffman) for
-    a batch of equal-length payloads, built on device.
-    (B, L) uint8 -> ((B, max_stream_len(L)) uint8, (B,) int32 lengths).
-    jit-cached per L."""
+def zlib_rle_batch(payloads, packer: Optional[str] = None) -> tuple:
+    """Compressive zlib streams (Z_RLE match policy, fixed Huffman,
+    per-lane stored fallback) for a batch of equal-length payloads,
+    built on device. (B, L) uint8 -> ((B, max_stream_len(L)) uint8,
+    (B,) int32 lengths). jit-cached per L."""
     payloads = jnp.asarray(payloads, dtype=jnp.uint8)
     if payloads.ndim != 2:
         raise ValueError("payloads must be (B, L)")
     if payloads.shape[1] == 0:
         raise ValueError("empty payload")
-    return _zlib_rle(payloads)
+    packer = packer or default_packer()
+    return _zlib_rle(payloads, packer, _interpret_for(packer))
 
 
-@partial(jax.jit, static_argnums=(1, 2, 3))
-def _filtered_to_streams(
-    filtered: jax.Array, rows: int, row_bytes: int, mode: str
+def _interpret_for(packer: str) -> bool:
+    """Pallas runs in interpret mode off-TPU (tests pin bit-exactness
+    on the CPU backend through exactly this path)."""
+    if packer != "pallas":
+        return False
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:  # pragma: no cover
+        return True
+
+
+def _streams_core(
+    flat: jax.Array, mode: str, packer: str, interpret: bool
 ):
-    flat = filtered[:, :rows, :row_bytes].reshape(filtered.shape[0], -1)
     if mode == "stored":
         streams = _zlib_stored(flat)
         lengths = jnp.full(
             flat.shape[0], stored_stream_len(flat.shape[1]), jnp.int32
         )
         return streams, lengths
-    return _zlib_rle(flat)
+    return _zlib_rle(flat, packer, interpret)
+
+
+@partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
+def _filtered_to_streams(
+    filtered: jax.Array, rows: int, row_bytes: int, mode: str,
+    packer: str, interpret: bool,
+):
+    flat = filtered[:, :rows, :row_bytes].reshape(filtered.shape[0], -1)
+    return _streams_core(flat, mode, packer, interpret)
+
+
+def _pad_pow2_lanes(arr: jax.Array):
+    """Pad the lane axis to a power of two: the encode program costs
+    tens of seconds to compile per shape on TPU, and serving batches
+    arrive in every size — pow2 padding caps the specializations at
+    log2(max_batch) per payload length."""
+    b = arr.shape[0]
+    padded_b = 1 << max(b - 1, 0).bit_length()
+    if padded_b != b:
+        arr = jnp.pad(
+            arr, ((0, padded_b - b),) + ((0, 0),) * (arr.ndim - 1)
+        )
+    return arr, b
 
 
 def deflate_filtered_batch(
-    filtered: jax.Array, rows: int, row_bytes: int, mode: str = "rle"
+    filtered: jax.Array, rows: int, row_bytes: int, mode: str = "rle",
+    packer: Optional[str] = None,
 ) -> tuple:
     """Fuse the payload flatten with the stream build: filtered
     scanlines (B, H, 1 + W*itemsize) (device-resident, possibly
     bucket-padded) -> ((B, stream_cap) uint8 complete zlib streams,
     (B,) int32 true lengths) for the leading ``rows`` x ``row_bytes``
-    region of each lane.
-
-    The lane count pads to a power of two before the jit call: the
-    encode program costs tens of seconds to compile per shape on TPU,
-    and serving batches arrive in every size — pow2 padding caps the
-    specializations at log2(max_batch) per payload length."""
+    region of each lane."""
     if mode not in ("rle", "stored"):
         raise ValueError(f"Unknown device deflate mode: {mode}")
-    b = filtered.shape[0]
-    padded_b = 1 << max(b - 1, 0).bit_length()
-    if padded_b != b:
-        filtered = jnp.pad(
-            filtered, ((0, padded_b - b),) + ((0, 0),) * (filtered.ndim - 1)
-        )
-    streams, lengths = _filtered_to_streams(filtered, rows, row_bytes, mode)
+    packer = packer or default_packer()
+    filtered, b = _pad_pow2_lanes(filtered)
+    streams, lengths = _filtered_to_streams(
+        filtered, rows, row_bytes, mode, packer, _interpret_for(packer)
+    )
+    return streams[:b], lengths[:b]
+
+
+# ---------------------------------------------------------------------------
+# Fused filter + deflate — the whole device encode chain in ONE jit
+# ---------------------------------------------------------------------------
+
+
+def filter_deflate_local(
+    tiles: jax.Array, rows: int, row_bytes: int, bpp: int,
+    filter_mode: str, mode: str, packer: str, interpret: bool,
+):
+    """Un-jitted fused core: native-dtype tiles (B, H, W[, S]) ->
+    (streams, lengths). Traceable under jit, vmap, and shard_map —
+    parallel/sharding.py maps exactly this over the mesh, which is
+    what makes multi-chip bytes identical to single-device bytes."""
+    from .convert import to_big_endian_bytes
+    from .png import _filter_batch
+
+    rows_be = to_big_endian_bytes(tiles)
+    if rows_be.ndim == 4:
+        # (B, H, W, S*itemsize) interleaved sample bytes -> scanrows
+        rows_be = rows_be.reshape(*rows_be.shape[:2], -1)
+    filtered = _filter_batch(rows_be, bpp, filter_mode)
+    flat = filtered[:, :rows, :row_bytes].reshape(filtered.shape[0], -1)
+    return _streams_core(flat, mode, packer, interpret)
+
+
+@partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7))
+def _fused_filter_deflate(
+    tiles, rows, row_bytes, bpp, filter_mode, mode, packer, interpret
+):
+    return filter_deflate_local(
+        tiles, rows, row_bytes, bpp, filter_mode, mode, packer, interpret
+    )
+
+
+@partial(
+    jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7), donate_argnums=(0,)
+)
+def _fused_filter_deflate_donated(
+    tiles, rows, row_bytes, bpp, filter_mode, mode, packer, interpret
+):
+    # identical program; the staged input buffer is donated so the
+    # filter's big-endian intermediate reuses it instead of doubling
+    # HBM residency per in-flight bucket (the double-buffered
+    # dispatcher keeps two buckets in flight)
+    return filter_deflate_local(
+        tiles, rows, row_bytes, bpp, filter_mode, mode, packer, interpret
+    )
+
+
+def fused_filter_deflate_batch(
+    tiles: jax.Array, rows: int, row_bytes: int, bpp: int,
+    filter_mode: str = "up", mode: str = "rle",
+    packer: Optional[str] = None, donate: bool = False,
+) -> tuple:
+    """The device encode chain as ONE dispatched program: byteswap +
+    PNG scanline filter + deflate, nothing surfacing between stages.
+    tiles (B, H, W[, S]) native dtype -> ((B, cap) uint8 zlib streams,
+    (B,) int32 lengths) for the leading ``rows`` x ``row_bytes``
+    region. ``donate=True`` donates the input buffer (TPU; XLA ignores
+    donation on backends that can't honor it)."""
+    if mode not in ("rle", "stored"):
+        raise ValueError(f"Unknown device deflate mode: {mode}")
+    packer = packer or default_packer()
+    tiles, b = _pad_pow2_lanes(tiles)
+    fn = _fused_filter_deflate_donated if donate else _fused_filter_deflate
+    streams, lengths = fn(
+        tiles, rows, row_bytes, bpp, filter_mode, mode, packer,
+        _interpret_for(packer),
+    )
     return streams[:b], lengths[:b]

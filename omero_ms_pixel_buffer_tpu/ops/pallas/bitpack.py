@@ -1,0 +1,139 @@
+"""Pallas TPU kernel: deflate token bit-packing by per-block VMEM emit.
+
+The scan packer (ops/device_deflate._pack_bits_scan) expresses bit
+packing as cumsums + a monotone searchsorted + gathers — all XLA ops.
+This kernel is the TPU-native alternative: one lane's packed words
+stay RESIDENT in VMEM across a sequential grid walk over fixed-size
+token blocks, so the emit is a chain of small dense block computations
+with zero HBM traffic for intermediates.
+
+Per grid step (lane b, token block i):
+
+1. exclusive local cumsum of the block's token bit counts (log-step
+   doubling with ``pltpu.roll`` — 8 shifted adds for 256 tokens);
+2. global bit offsets = local offsets + the lane's running bit offset,
+   carried across blocks in SMEM scratch (grid iterations over the
+   minor axis execute sequentially on one core, so the carry is just
+   a scalar read-modify-write);
+3. word-aligned split: token value ``v`` at bit offset ``o``
+   contributes ``v << (o & 31)`` to word ``o >> 5`` and the spill to
+   the next word (token values are <= 13 significant bits, so two
+   words always suffice);
+4. dense one-hot emit: block tokens cover at most ``_SPAN``
+   consecutive words (a 256-token block is <= 4608 bits), so the
+   block's words are two (SPAN, TB) compare-mask reductions — carry-
+   free sums, because token bit ranges are disjoint;
+5. the SPAN-word strip ORs into the lane's VMEM-resident output at
+   the (dynamic) word offset — ``pl.store`` with a dynamic slice
+   start, the "token block -> VMEM emit" this module is named for.
+
+``interpret=True`` runs the same kernel on CPU; tier-1 tests pin its
+streams bit-exact against the XLA scan packer and ``zlib.decompress``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Tokens per block. Smaller blocks shrink the dense compare (total
+# work is ntok * SPAN), larger blocks amortize per-step overhead.
+_TB = 256
+# Max deflate token bit count: match = 8 code + 5 extra + 5 distance.
+_MAX_TOKEN_BITS = 18
+# Words one block can touch: TB tokens * 18 bits, +31 bits of initial
+# misalignment, +1 spill word.
+_SPAN = (_TB * _MAX_TOKEN_BITS + 31) // 32 + 2
+
+
+def _shift_right(v, by: int):
+    """Values ``by`` lanes earlier along the last axis (zero fill) —
+    the doubling step of the in-kernel prefix sum. ``pltpu.roll``
+    wraps, so the leading lanes are re-zeroed with an iota mask."""
+    rolled = pltpu.roll(v, by, 1)
+    idx = jax.lax.broadcasted_iota(jnp.int32, v.shape, 1)
+    return jnp.where(idx < by, 0, rolled)
+
+
+def _kernel(bits_ref, nbits_ref, out_ref, off_ref):
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _():
+        # fresh lane: zero the resident output strip and the carry
+        out_ref[...] = jnp.zeros_like(out_ref)
+        off_ref[0] = 0
+
+    nb = nbits_ref[...]  # (1, TB) int32
+    val = bits_ref[...].astype(jnp.int32)  # <= 13 significant bits
+    inc = nb
+    k = 1
+    while k < _TB:
+        inc = inc + _shift_right(inc, k)
+        k *= 2
+    base = off_ref[0]
+    offs = base + inc - nb  # global exclusive bit offsets
+    s = offs & 31
+    lo = val << s  # int32 left shift wraps mod 2^32: exact bit pattern
+    # logical right shift by 32-s without s=0 UB; val is non-negative
+    hi = (val >> (31 - s)) >> 1
+    wstart = base >> 5
+    rel = (offs >> 5) - wstart  # in [0, SPAN-2]
+    widx = jax.lax.broadcasted_iota(jnp.int32, (_SPAN, _TB), 0)
+    relb = jnp.broadcast_to(rel.reshape(1, _TB), (_SPAN, _TB))
+    # carry-free: token bit ranges are disjoint, so + == | per word
+    acc = (
+        jnp.where(relb == widx, jnp.broadcast_to(lo, (_SPAN, _TB)), 0)
+        .sum(axis=1)
+        + jnp.where(
+            relb + 1 == widx, jnp.broadcast_to(hi, (_SPAN, _TB)), 0
+        ).sum(axis=1)
+    )
+    strip = (slice(0, 1), pl.ds(wstart, _SPAN))
+    cur = pl.load(out_ref, strip)
+    pl.store(out_ref, strip, cur | acc.reshape(1, _SPAN))
+    off_ref[0] = base + jnp.sum(nb)
+
+
+@partial(jax.jit, static_argnames=("maxbits", "interpret"))
+def pack_tokens(
+    bits: jax.Array, nbits: jax.Array, maxbits: int,
+    interpret: bool = False,
+):
+    """Batched token arrays (B, ntok) -> ((B, maxbits // 8) uint8
+    LSB-first packed bytes, (B,) int32 body bit totals). Zero-length
+    tokens contribute nothing and need no compaction; the token axis
+    pads to the block size with zero tokens (which also leave the
+    carry unchanged)."""
+    b, ntok = bits.shape
+    pad = (-ntok) % _TB
+    if pad:
+        widths = ((0, 0), (0, pad))
+        bits = jnp.pad(bits, widths)
+        nbits = jnp.pad(nbits, widths)
+    nblocks = (ntok + pad) // _TB
+    nwords = maxbits // 32
+    nw_pad = nwords + _SPAN  # headroom so the last strip stays in-bounds
+    words = pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((b, nw_pad), jnp.int32),
+        grid=(b, nblocks),
+        in_specs=[
+            pl.BlockSpec((1, _TB), lambda lb, i: (lb, i)),
+            pl.BlockSpec((1, _TB), lambda lb, i: (lb, i)),
+        ],
+        out_specs=pl.BlockSpec((1, nw_pad), lambda lb, i: (lb, 0)),
+        scratch_shapes=[pltpu.SMEM((1,), jnp.int32)],
+        interpret=interpret,
+    )(bits, nbits)
+    shifts = (jnp.arange(4, dtype=jnp.int32) * 8)[None, None, :]
+    packed = (
+        ((words[:, :nwords, None] >> shifts) & 0xFF)
+        .astype(jnp.uint8)
+        .reshape(b, nwords * 4)
+    )
+    return packed, jnp.sum(nbits, axis=1, dtype=jnp.int32)

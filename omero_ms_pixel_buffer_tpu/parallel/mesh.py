@@ -17,11 +17,15 @@ builder just consumes it, so the same code scales DCN-wide.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+import logging
+import threading
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+log = logging.getLogger("omero_ms_pixel_buffer_tpu.mesh")
 
 
 def make_mesh(
@@ -51,3 +55,170 @@ def row_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def lane_counts(real: int, n_devices: int) -> List[int]:
+    """How many REAL lanes each mesh device serves when ``real`` lanes
+    pad to a multiple of ``n_devices`` and shard contiguously — the
+    per-device accounting the MULTICHIP record reports."""
+    if n_devices <= 0:
+        return []
+    per = (max(real, 0) + n_devices - 1) // n_devices
+    out = []
+    for d in range(n_devices):
+        lo, hi = d * per, (d + 1) * per
+        out.append(max(0, min(real, hi) - lo))
+    return out
+
+
+class MeshHealthError(RuntimeError):
+    """Every device in the serving mesh is breaker-open — the caller
+    must fall back to the single-device or host path."""
+
+
+class MeshManager:
+    """Owns the serving mesh and its health — the multi-chip analog of
+    the per-dependency circuit breakers on remote-I/O edges.
+
+    A sick chip (wedged ICI link, ECC storm, runtime crash) surfaces
+    as the WHOLE sharded dispatch raising, because shard_map runs one
+    program over every device. Without isolation that converts each
+    coalesced batch into a full failure for as long as the chip is
+    down. This manager:
+
+    - keeps a per-device circuit breaker (``device:<id>``, the shared
+      BreakerBoard, so chip state shows in /healthz with everything
+      else);
+    - on dispatch failure, probes every chip individually (a tiny
+      device_put + add, wrapped in the ``device.chip:<id>`` fault
+      point so the chaos suite can fail exactly one chip
+      deterministically), records outcomes on the breakers, rebuilds
+      the mesh from the survivors, and retries the dispatch ONCE on
+      the shrunken mesh;
+    - heals automatically: an open breaker's half-open window readmits
+      the chip at the next dispatch after ``open-duration-ms``.
+
+    The ``device.mesh-dispatch`` fault point fires before each
+    dispatch attempt so tests can fail the first attempt without
+    touching jax internals."""
+
+    def __init__(self, devices=None, axes: Tuple[str, ...] = ("data",)):
+        self._devices = list(
+            devices if devices is not None else jax.devices()
+        )
+        self._axes = axes
+        self._lock = threading.Lock()
+        self._breakers: dict = {}
+        self._mesh_cache: Optional[Tuple[tuple, Mesh]] = None
+        #: record of the most recent successful sharded dispatch —
+        #: {"n_devices", "device_ids", "lanes_per_device", "executed"}
+        self.last_dispatch: Optional[dict] = None
+
+    def _breaker(self, dev):
+        key = f"device:{getattr(dev, 'id', dev)}"
+        with self._lock:
+            br = self._breakers.get(key)
+            if br is None:
+                from ..resilience.breaker import for_dependency
+
+                # one failed liveness probe is definitive (probes only
+                # run after a dispatch already failed), so the breaker
+                # opens immediately; the half-open window readmits the
+                # chip after open-duration-ms as usual
+                br = for_dependency(key, failure_threshold=1)
+                self._breakers[key] = br
+        return br
+
+    def healthy_devices(self) -> list:
+        out = []
+        for dev in self._devices:
+            try:
+                self._breaker(dev).allow()
+            except Exception:
+                continue  # open: excluded until the half-open window
+            out.append(dev)
+        return out
+
+    def mesh(self) -> Mesh:
+        """A mesh over the currently-healthy devices (1-D over the
+        first axis). Raises ``MeshHealthError`` when none remain."""
+        devs = self.healthy_devices()
+        if not devs:
+            raise MeshHealthError(
+                "all mesh devices are breaker-open"
+            )
+        key = tuple(getattr(d, "id", id(d)) for d in devs)
+        with self._lock:
+            if self._mesh_cache is not None and self._mesh_cache[0] == key:
+                return self._mesh_cache[1]
+        mesh = make_mesh(self._axes, devices=devs)
+        with self._lock:
+            self._mesh_cache = (key, mesh)
+        return mesh
+
+    def probe_device(self, dev) -> bool:
+        """One chip's liveness: a tiny transfer + add, blocked on.
+        Records the outcome on the chip's breaker."""
+        from ..resilience.faultinject import INJECTOR
+
+        br = self._breaker(dev)
+        try:
+            INJECTOR.fire(f"device.chip:{getattr(dev, 'id', dev)}")
+            x = jax.device_put(np.arange(8, dtype=np.int32), dev)
+            jax.block_until_ready(x + 1)
+        except Exception:
+            log.warning(
+                "mesh device %s failed its probe; excluding it",
+                getattr(dev, "id", dev),
+            )
+            br.record_failure()
+            return False
+        br.record_success()
+        return True
+
+    def probe_all(self) -> list:
+        return [d for d in self._devices if self.probe_device(d)]
+
+    def dispatch(self, fn, real_lanes: Optional[int] = None):
+        """Run ``fn(mesh)`` on the healthy mesh; on failure, probe the
+        chips, shrink to the survivors, and retry once. Successful
+        dispatches record per-device lane accounting in
+        ``last_dispatch`` and a success on every participating
+        breaker."""
+        from ..resilience.faultinject import INJECTOR
+
+        mesh = self.mesh()
+        try:
+            INJECTOR.fire("device.mesh-dispatch")
+            out = fn(mesh)
+        except Exception:
+            log.exception(
+                "sharded dispatch failed on %d devices; probing chips",
+                mesh.devices.size,
+            )
+            self.probe_all()
+            mesh = self.mesh()  # survivors only (raises when empty)
+            INJECTOR.fire("device.mesh-dispatch")
+            out = fn(mesh)
+        n = mesh.shape[self._axes[0]]
+        for dev in mesh.devices.flat:
+            self._breaker(dev).record_success()
+        self.last_dispatch = {
+            "executed": True,
+            "n_devices": int(n),
+            "device_ids": [
+                getattr(d, "id", None) for d in mesh.devices.flat
+            ],
+            "lanes_per_device": (
+                lane_counts(real_lanes, int(n))
+                if real_lanes is not None else None
+            ),
+        }
+        return out
+
+    def snapshot(self) -> dict:
+        return {
+            "devices": len(self._devices),
+            "healthy": len(self.healthy_devices()),
+            "last_dispatch": self.last_dispatch,
+        }

@@ -4,54 +4,117 @@ The device encode programs cost tens of seconds to compile per shape on
 TPU (the RLE deflate's dense packer alone is ~20 s). A serving process
 pays that once — but deploy restarts and bench child processes would
 pay it again, so compiled executables persist on disk and reload in
-milliseconds. ``OMPB_JAX_CACHE_DIR`` overrides the location; empty
-disables.
+milliseconds.
+
+Two ways in:
+
+- config key ``jax.compilation-cache-dir`` (validated in
+  utils/config.py, passed through ``TilePipeline``): an EXPLICIT
+  operator opt-in, so it engages on any backend — jax.config updates
+  only, no PJRT init — and caches every compile (min-compile-time 0),
+  which is what lets a test observe that a second pipeline
+  construction reuses the dir. Sharing an explicit CPU cache dir
+  across machines with different vector-feature sets is on the
+  operator (XLA warns of SIGILL for mismatched AOT entries).
+- env ``OMPB_JAX_CACHE_DIR`` (or the default ~/.cache location): the
+  ambient path, TPU-only — TPU compiles are the tens-of-seconds
+  problem this cache solves, and implicit CPU caching would risk the
+  cross-machine AOT mismatch silently.
+
+Empty path disables.
 """
 
 from __future__ import annotations
 
 import logging
 import os
+from typing import Optional
 
 log = logging.getLogger("omero_ms_pixel_buffer_tpu.jax_cache")
 
-_enabled = False
+_enabled_path: Optional[str] = None
+#: an enable call actually ENGAGED the cache (pins the dir for the
+#: process); a declined ambient attempt must NOT set this, or it
+#: would block a later explicit config opt-in in the same process
+_done = False
+#: the ambient (env/default) path was evaluated and declined — cached
+#: so per-batch enable_persistent_cache(None) calls stay one branch
+_ambient_declined = False
 
 
-def enable_persistent_cache() -> None:
-    """Idempotent; call before the first device compile."""
-    global _enabled
-    if _enabled:
+def enable_persistent_cache(path: Optional[str] = None) -> None:
+    """Idempotent; call before the first device compile. ``path`` is
+    the explicit configured dir (``jax.compilation-cache-dir``); None
+    falls back to the env/default TPU-only behavior. The first call
+    that ENGAGES wins — a later call with a different path logs and
+    is ignored (jax's cache dir is process-global)."""
+    global _done, _enabled_path, _ambient_declined
+    explicit = bool(path)
+    if _done:
+        if explicit and path != _enabled_path:
+            log.warning(
+                "persistent compile cache already pinned to %r; "
+                "ignoring %r", _enabled_path, path,
+            )
         return
-    _enabled = True
-    path = os.environ.get(
-        "OMPB_JAX_CACHE_DIR",
-        os.path.join(
-            os.path.expanduser("~"), ".cache", "ompb-jax-cache"
-        ),
-    )
+    if not explicit:
+        if _ambient_declined:
+            return
+        path = os.environ.get(
+            "OMPB_JAX_CACHE_DIR",
+            os.path.join(
+                os.path.expanduser("~"), ".cache", "ompb-jax-cache"
+            ),
+        )
     if not path:
+        _ambient_declined = True
         return
     try:
         import jax
 
-        if jax.default_backend() != "tpu":
+        if not explicit and jax.default_backend() != "tpu":
             # TPU compiles are the tens-of-seconds problem this cache
             # solves; CPU AOT entries also reload across processes
             # with mismatched machine-feature sets (XLA warns of
-            # SIGILL), so CPU backends stay uncached
+            # SIGILL), so CPU backends stay uncached unless the
+            # operator opted in via the config key
+            _ambient_declined = True
             if os.environ.get("OMPB_JAX_CACHE_DIR"):
                 log.info(
                     "OMPB_JAX_CACHE_DIR set but backend is %s; the "
-                    "persistent compile cache only engages on TPU",
+                    "persistent compile cache only engages on TPU "
+                    "(use jax.compilation-cache-dir to force)",
                     jax.default_backend(),
                 )
             return
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
-        # cache every compile that took >1s — the probe-sized programs
-        # stay out, the encode/filter programs all qualify
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        # ambient mode caches every compile that took >1s — the
+        # probe-sized programs stay out, the encode/filter programs
+        # all qualify; explicit mode caches everything so restarts
+        # (and tests) hit the dir deterministically
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            0.0 if explicit else 1.0,
+        )
+        # jax latches the cache backend at its first compile: a dir
+        # configured AFTER any jit ran (explicit mode in a warm
+        # process) silently never engages unless the cache module is
+        # re-pointed. Best-effort private API, fully guarded.
+        try:  # pragma: no cover - exercised indirectly
+            from jax._src import compilation_cache as _cc
+
+            if hasattr(_cc, "reset_cache"):
+                _cc.reset_cache()  # re-initializes lazily at next compile
+        except Exception:
+            pass
+        _enabled_path = path
+        _done = True
     except Exception:  # pragma: no cover - best-effort acceleration
         log.debug("persistent compilation cache unavailable", exc_info=True)
+
+
+def enabled_path() -> Optional[str]:
+    """The pinned cache dir, or None when the cache never engaged."""
+    return _enabled_path

@@ -15,10 +15,15 @@ COMPUTE side by itself so the TPU-first design is judgeable anywhere:
 
 Emitted by ``bench.py --device-sub`` into BENCH's ``device`` section:
 ``filter_gbps`` (Pallas and XLA-fusion variants), ``deflate_gbps``,
-``deflate_ratio_vs_host`` (device RLE+fixed-Huffman stream bytes vs
-the host's dynamic-Huffman zlib level 6 on identical payloads), and
-``batch_ms_steady`` for the full resident-plane chain
-(crop → filter → deflate). ``project_throughput`` then folds the
+``pack_gbps`` (the bit packer in isolation, plus the pinned
+``pack_speedup_vs_gather`` comparison against the legacy gather
+packer this round replaced), ``deflate_ratio_vs_host`` (device
+RLE+fixed-Huffman stream bytes vs the host's dynamic-Huffman zlib
+level 6 on identical payloads), ``batch_ms_steady`` for the full
+resident-plane chain (crop → filter → deflate), and
+``stage_breakdown`` — per-stage ``h2d_ms`` / ``compute_ms`` /
+``d2h_ms`` of one host-staged fused encode batch, so the next round
+can see WHICH stage moved. ``project_throughput`` then folds the
 measured link bandwidth in: tiles/s = 1 / (compute + transfer), for
 both the measured tunnel and an assumed co-located host↔device link.
 """
@@ -144,6 +149,80 @@ def run_microbench(
     )
     out["deflate_gbps"] = _sig(payload_bytes / dt / 1e9)
     out["deflate_ms_per_batch"] = round(dt * 1e3, 2)
+
+    # --- (b2) the bit packer in isolation: scan vs legacy gather ------
+    # tokens precomputed outside the timing, so this is the PACKER's
+    # throughput alone; the gather comparison pins the replacement's
+    # speedup (BENCH_PACK_COMPARE=0 skips the slow legacy run).
+    import os as _os
+
+    from ..ops.device_deflate import (
+        _lane_tokens,
+        _pack_bits_gather,
+        _pack_bits_scan,
+        _packing_maxbits,
+    )
+
+    payloads = filtered[:, :tile, :row_bytes].reshape(batch, -1)
+    tok_bits, tok_nbits = jax.jit(jax.vmap(_lane_tokens))(payloads)
+    jax.block_until_ready((tok_bits, tok_nbits))
+    maxbits = _packing_maxbits(payloads.shape[1])
+    pack_scan = jax.jit(
+        jax.vmap(lambda b, n: _pack_bits_scan(b, n, maxbits))
+    )
+    dt = _time_steady(
+        lambda: jax.block_until_ready(pack_scan(tok_bits, tok_nbits)),
+        iters_deflate,
+    )
+    out["pack_gbps"] = _sig(payload_bytes / dt / 1e9)
+    if _os.environ.get("BENCH_PACK_COMPARE", "1") != "0":
+        pack_gather = jax.jit(
+            jax.vmap(lambda b, n: _pack_bits_gather(b, n, maxbits))
+        )
+        dt_g = _time_steady(
+            lambda: jax.block_until_ready(
+                pack_gather(tok_bits, tok_nbits)
+            ),
+            max(2, iters_deflate // 2),
+        )
+        out["pack_gbps_gather"] = _sig(payload_bytes / dt_g / 1e9)
+        out["pack_speedup_vs_gather"] = _sig(dt_g / dt)
+
+    # --- (b3) stage breakdown of one host-staged fused batch ----------
+    # what the double-buffered dispatcher overlaps: H2D of the native
+    # tiles, the single fused byteswap+filter+deflate program, and the
+    # compressed-stream pull (sliced to a serving-like pow2 cap).
+    from ..ops.device_deflate import fused_filter_deflate_batch
+
+    warm_s, warm_l = fused_filter_deflate_batch(
+        jax.device_put(tiles_np), tile, row_bytes, itemsize
+    )
+    jax.block_until_ready((warm_s, warm_l))
+    cap = min(
+        warm_s.shape[1],
+        1 << max(int(np.asarray(warm_l).max()) - 1, 63).bit_length(),
+    )
+    stages: dict = {"h2d": [], "compute": [], "d2h": []}
+    for _ in range(iters_deflate):
+        t0 = time.perf_counter()
+        dev = jax.device_put(tiles_np)
+        jax.block_until_ready(dev)
+        t1 = time.perf_counter()
+        s, length = fused_filter_deflate_batch(
+            dev, tile, row_bytes, itemsize
+        )
+        jax.block_until_ready((s, length))
+        t2 = time.perf_counter()
+        jax.device_get((length, s[:, :cap]))
+        t3 = time.perf_counter()
+        stages["h2d"].append(t1 - t0)
+        stages["compute"].append(t2 - t1)
+        stages["d2h"].append(t3 - t2)
+    out["stage_breakdown"] = {
+        f"{k}_ms": round(sorted(v)[len(v) // 2] * 1e3, 3)
+        for k, v in stages.items()
+    }
+    out["stage_breakdown"]["pack_gbps"] = out["pack_gbps"]
 
     # --- (c) full chain from an HBM-resident plane --------------------
     # crop (dynamic_slice gather) → filter → deflate, nothing crossing

@@ -216,6 +216,19 @@ class CacheConfig:
 
 
 @dataclasses.dataclass
+class JaxConfig:
+    """The jax: block — runtime knobs for the accelerator toolchain.
+
+    ``compilation-cache-dir`` pins jax's persistent XLA compilation
+    cache (runtime/jax_cache.py) so the device encode programs' tens-
+    of-seconds TPU compiles survive process restarts; an explicit dir
+    engages on ANY backend (operator opt-in), unlike the TPU-only
+    ``OMPB_JAX_CACHE_DIR`` env fallback."""
+
+    compilation_cache_dir: Optional[str] = None
+
+
+@dataclasses.dataclass
 class LoggingConfig:
     """Reference logging (src/dist/conf/logback.xml): stdout by
     default; with a file, daily rolling with 7-day retention."""
@@ -260,6 +273,7 @@ class Config:
         default_factory=ResilienceConfig
     )
     cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
+    jax: JaxConfig = dataclasses.field(default_factory=JaxConfig)
     logging: LoggingConfig = dataclasses.field(default_factory=LoggingConfig)
     # Filesystem image registry (stands in for the OMERO Postgres
     # metadata plane when running without a server; see io.pixels_service).
@@ -425,6 +439,25 @@ class Config:
             ),
         )
 
+    @staticmethod
+    def _parse_jax(raw: dict) -> JaxConfig:
+        """Validate the jax: block — same posture as resilience/cache:
+        typos and nonsense fail at startup, never silently default."""
+        jx = raw.get("jax") or {}
+        cache_dir = jx.get("compilation-cache-dir")
+        if cache_dir is not None:
+            if not isinstance(cache_dir, str) or not cache_dir:
+                raise ConfigError(
+                    "Invalid value for 'jax.compilation-cache-dir': "
+                    f"{cache_dir!r} (expected a non-empty path)"
+                )
+        unknown = set(jx) - {"compilation-cache-dir"}
+        if unknown:
+            raise ConfigError(
+                f"Unknown keys in 'jax' block: {sorted(unknown)}"
+            )
+        return JaxConfig(compilation_cache_dir=cache_dir)
+
     @classmethod
     def from_dict(cls, raw: dict) -> "Config":
         raw = dict(raw or {})
@@ -512,6 +545,7 @@ class Config:
             backend=backend,
             resilience=cls._parse_resilience(raw),
             cache=cls._parse_cache(raw),
+            jax=cls._parse_jax(raw),
             logging=LoggingConfig(
                 file=log_raw.get("file"),
                 level=str(log_raw.get("level", "INFO")),

@@ -28,3 +28,22 @@ def single_pull(x):
     y = jnp.abs(x)
     total, host = jax.device_get((y.sum(), y))
     return int(total), host
+
+
+def batched_pull_then_loop(batch):
+    """The loop-safe shape: ONE device_get outside the loop, host-side
+    per-lane work inside it."""
+    y = jnp.abs(batch)
+    host = jax.device_get(y)
+    out = []
+    for lane in range(4):
+        out.append(float(host[lane].sum()))
+    return out
+
+
+def host_item_in_loop(lengths):
+    lengths_np = jax.device_get(jnp.cumsum(lengths))
+    total = 0
+    while total < 10:
+        total += lengths_np.item()
+    return total

@@ -1,0 +1,32 @@
+# ompb-lint: scope=jax-hotpath
+"""Seeded jax-hotpath loop violations: per-iteration host syncs on
+device values inside ``for``/``while`` bodies — each one a full device
+round trip per lane (the dispatcher-code pattern the r9 rule
+extension exists to catch)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def per_lane_pull(batch):
+    y = jnp.abs(batch)
+    out = []
+    for i in range(4):
+        out.append(np.asarray(y))  # SEEDED: jax-hotpath (asarray in loop)
+    return out
+
+
+def per_lane_item(lengths):
+    y = jnp.cumsum(lengths)
+    total = 0
+    while total < 10:
+        total += y.item()  # SEEDED: jax-hotpath (.item() in loop)
+    return total
+
+
+def per_lane_float(x):
+    y = jnp.abs(x)
+    acc = 0.0
+    for _ in range(2):
+        acc += float(y)  # SEEDED: jax-hotpath (float() in loop)
+    return acc

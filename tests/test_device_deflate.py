@@ -9,6 +9,7 @@ backend (conftest); the same XLA program serves the TPU.
 """
 
 import io
+import os
 import zlib
 
 import numpy as np
@@ -17,6 +18,7 @@ from PIL import Image
 
 from omero_ms_pixel_buffer_tpu.ops.device_deflate import (
     deflate_filtered_batch,
+    fused_filter_deflate_batch,
     max_stream_len,
     stored_stream_len,
     zlib_rle_batch,
@@ -24,6 +26,20 @@ from omero_ms_pixel_buffer_tpu.ops.device_deflate import (
 )
 
 rng = np.random.default_rng(41)
+
+
+def _payload_families(n: int = 1500):
+    """The payload shapes that break packers: runs, noise, no-runs,
+    constants, run/match boundary tails."""
+    return np.stack([
+        np.zeros(n, np.uint8),
+        rng.integers(0, 256, n).astype(np.uint8),
+        np.repeat(rng.integers(0, 9, (n + 19) // 20), 20)[:n].astype(
+            np.uint8
+        ),
+        np.tile(np.array([200, 201], np.uint8), (n + 1) // 2)[:n],
+        np.full(n, 7, np.uint8),
+    ])
 
 
 def _roundtrip_rle(payloads: np.ndarray):
@@ -81,6 +97,115 @@ class TestRleStreams:
             ]
         )
         _roundtrip_rle(payloads)
+
+
+class TestMinStreamSelection:
+    """Per-lane min(rle, stored): RLE on no-run content expands past
+    9 bits/byte, and before r9 the stream could exceed the stored
+    bound; now every lane's length is <= stored_stream_len(L)."""
+
+    def test_pathological_no_runs_takes_stored(self):
+        # alternating high-value bytes: every byte a 9-bit literal, no
+        # matches -> RLE would expand ~12.5%; the stored stream wins
+        n = 4096
+        payloads = np.tile(np.array([200, 201], np.uint8), n // 2)[None]
+        streams, lengths = (
+            np.asarray(a) for a in zlib_rle_batch(payloads)
+        )
+        assert lengths[0] == stored_stream_len(n)
+        assert zlib.decompress(bytes(streams[0][: lengths[0]])) == \
+            payloads[0].tobytes()
+
+    def test_randomized_lanes_never_exceed_stored_bound(self):
+        local = np.random.default_rng(97)
+        n = 2048
+        payloads = np.stack([
+            local.integers(0, 256, n).astype(np.uint8),
+            local.integers(128, 256, n).astype(np.uint8),
+            np.repeat(local.integers(0, 4, n // 16), 16).astype(np.uint8),
+            (local.integers(0, 2, n) + 180).astype(np.uint8),
+        ])
+        streams, lengths = (
+            np.asarray(a) for a in zlib_rle_batch(payloads)
+        )
+        bound = stored_stream_len(n)
+        for lane in range(payloads.shape[0]):
+            assert lengths[lane] <= bound, f"lane {lane}"
+            got = zlib.decompress(bytes(streams[lane][: lengths[lane]]))
+            assert got == payloads[lane].tobytes()
+
+    def test_compressible_lanes_still_beat_stored(self):
+        payloads = np.repeat(
+            rng.integers(0, 4, (2, 128)), 20, axis=1
+        ).astype(np.uint8)
+        _, lengths = (np.asarray(a) for a in zlib_rle_batch(payloads))
+        assert (lengths < stored_stream_len(payloads.shape[1]) // 2).all()
+
+
+class TestPackerEquivalence:
+    """The scan packer replaced the gather packer; both must emit
+    byte-identical streams (same zero padding, same framing)."""
+
+    @pytest.mark.parametrize("n", [1, 258, 777, 4096])
+    def test_scan_matches_gather(self, n):
+        payloads = _payload_families(n)
+        s1, l1 = (
+            np.asarray(a) for a in zlib_rle_batch(payloads, packer="scan")
+        )
+        s2, l2 = (
+            np.asarray(a)
+            for a in zlib_rle_batch(payloads, packer="gather")
+        )
+        np.testing.assert_array_equal(l1, l2)
+        np.testing.assert_array_equal(s1, s2)
+
+
+class TestPallasBitpack:
+    """The Pallas per-block VMEM-emit kernel, interpret mode on CPU:
+    streams must decompress to the input AND be bit-exact against the
+    XLA scan packer (identical zero padding included)."""
+
+    @pytest.mark.parametrize("n", [1, 5, 258, 1500, 70000])
+    def test_bit_exact_lanes(self, n):
+        payloads = _payload_families(n)
+        ps, pl_ = (
+            np.asarray(a)
+            for a in zlib_rle_batch(payloads, packer="pallas")
+        )
+        ss, sl = (
+            np.asarray(a) for a in zlib_rle_batch(payloads, packer="scan")
+        )
+        np.testing.assert_array_equal(pl_, sl)
+        np.testing.assert_array_equal(ps, ss)
+        bound = stored_stream_len(n)
+        for lane in range(payloads.shape[0]):
+            assert pl_[lane] <= bound
+            got = zlib.decompress(bytes(ps[lane][: pl_[lane]]))
+            assert got == payloads[lane].tobytes(), f"lane {lane}"
+
+    def test_fused_chain_with_pallas_packer(self):
+        import jax.numpy as jnp
+
+        tiles = rng.integers(0, 60000, (3, 48, 48), dtype=np.uint16)
+        streams, lengths = (
+            np.asarray(a)
+            for a in fused_filter_deflate_batch(
+                jnp.asarray(tiles), 48, 1 + 48 * 2, 2, packer="pallas"
+            )
+        )
+        from omero_ms_pixel_buffer_tpu.ops.convert import (
+            to_big_endian_bytes,
+        )
+        from omero_ms_pixel_buffer_tpu.ops.png import filter_batch
+
+        ref = np.asarray(
+            filter_batch(to_big_endian_bytes(jnp.asarray(tiles)), 2, "up")
+        )
+        for lane in range(3):
+            got = zlib.decompress(
+                bytes(streams[lane][: lengths[lane]])
+            )
+            assert got == ref[lane].tobytes()
 
 
 class TestStoredStreams:
@@ -287,3 +412,268 @@ class TestPipelineDeviceDeflate:
         assert config_off.backend.png.device_deflate is False
         app_off = PixelBufferApp(config_off)
         assert app_off.pipeline.device_deflate is False
+
+
+class TestShardedEncode:
+    """Real multi-chip dispatch: the fused filter+deflate chain
+    shard_mapped over the 8-way CPU host-platform mesh must produce
+    BYTE-identical streams to the single-device program."""
+
+    def test_shard_map_roundtrip_byte_identical(self):
+        import jax
+        import jax.numpy as jnp
+
+        from omero_ms_pixel_buffer_tpu.parallel.mesh import make_mesh
+        from omero_ms_pixel_buffer_tpu.parallel.sharding import (
+            pad_batch,
+            shard_batch,
+            sharded_filter_deflate,
+        )
+
+        assert len(jax.devices()) == 8
+        mesh = make_mesh(("data",))
+        tiles = rng.integers(0, 60000, (13, 32, 32), dtype=np.uint16)
+        padded, real = pad_batch(jnp.asarray(tiles), 8)
+        sharded = shard_batch(mesh, padded)
+        s_mesh, l_mesh = (
+            np.asarray(a)
+            for a in sharded_filter_deflate(mesh, sharded, 32, 65, 2)
+        )
+        s_one, l_one = (
+            np.asarray(a)
+            for a in fused_filter_deflate_batch(
+                jnp.asarray(tiles), 32, 65, 2
+            )
+        )
+        np.testing.assert_array_equal(l_mesh[:real], l_one)
+        np.testing.assert_array_equal(s_mesh[:real], s_one)
+        for lane in range(real):
+            got = zlib.decompress(
+                bytes(s_mesh[lane][: l_mesh[lane]])
+            )
+            assert len(got) == 32 * 65
+
+    def test_per_device_lane_counts(self):
+        from omero_ms_pixel_buffer_tpu.parallel.mesh import lane_counts
+
+        assert lane_counts(13, 8) == [2, 2, 2, 2, 2, 2, 1, 0]
+        assert lane_counts(16, 8) == [2] * 8
+        assert lane_counts(3, 8) == [1, 1, 1, 0, 0, 0, 0, 0]
+        assert sum(lane_counts(9, 8)) == 9
+
+
+@pytest.mark.resilience
+class TestMeshDegradation:
+    """Chaos: one mesh chip's fault point fires; the batch completes
+    on the surviving chips instead of failing the requests."""
+
+    @pytest.fixture(autouse=True)
+    def _clean(self):
+        from omero_ms_pixel_buffer_tpu.resilience import BOARD, INJECTOR
+
+        yield
+        INJECTOR.clear()
+        BOARD.reset()
+        BOARD.configure(enabled=True)
+
+    def test_sick_chip_degrades_to_survivors(self):
+        import jax
+        import jax.numpy as jnp
+
+        from omero_ms_pixel_buffer_tpu.models.device_dispatch import (
+            DeviceEncodeDispatcher,
+        )
+        from omero_ms_pixel_buffer_tpu.parallel.mesh import MeshManager
+        from omero_ms_pixel_buffer_tpu.resilience import INJECTOR
+        from omero_ms_pixel_buffer_tpu.resilience.faultinject import (
+            always,
+            first_n,
+        )
+
+        devices = jax.devices()
+        assert len(devices) == 8
+        sick = devices[3]
+        # the first sharded dispatch blows up (a wedged chip surfaces
+        # as the whole program failing)...
+        INJECTOR.install(
+            "device.mesh-dispatch", first_n(1, RuntimeError("ICI wedge"))
+        )
+        # ...and the probe pass finds exactly chip 3 dead
+        INJECTOR.install(
+            f"device.chip:{sick.id}", always(RuntimeError("chip down"))
+        )
+        mgr = MeshManager(devices=devices)
+        disp = DeviceEncodeDispatcher({}, mesh_manager=mgr)
+        tiles = rng.integers(0, 60000, (16, 32, 32), dtype=np.uint16)
+        fut = disp.submit(
+            tiles, 32, 65, 2, "up", "rle",
+            lanes=list(range(16)), sizes=[(32, 32)] * 16,
+            bit_depth=16, color_type=0,
+        )
+        out = fut.result(timeout=120)
+        assert sorted(out) == list(range(16))
+        assert mgr.last_dispatch["executed"] is True
+        assert mgr.last_dispatch["n_devices"] == 7
+        assert sick.id not in mgr.last_dispatch["device_ids"]
+        assert sum(mgr.last_dispatch["lanes_per_device"]) == 16
+        # byte-identical to the single-device encode of the same lanes
+        s_one, l_one = (
+            np.asarray(a)
+            for a in fused_filter_deflate_batch(
+                jnp.asarray(tiles), 32, 65, 2
+            )
+        )
+        from omero_ms_pixel_buffer_tpu.ops.png import frame_png
+
+        for lane in range(16):
+            assert out[lane] == frame_png(
+                bytes(s_one[lane][: l_one[lane]]), 32, 32, 16, 0
+            )
+        disp.close()
+
+    def test_all_chips_down_raises(self):
+        import jax
+
+        from omero_ms_pixel_buffer_tpu.parallel.mesh import (
+            MeshHealthError,
+            MeshManager,
+        )
+        from omero_ms_pixel_buffer_tpu.resilience import INJECTOR
+        from omero_ms_pixel_buffer_tpu.resilience.faultinject import always
+
+        INJECTOR.install(
+            "device.mesh-dispatch", always(RuntimeError("bus fire"))
+        )
+        for dev in jax.devices():
+            INJECTOR.install(
+                f"device.chip:{dev.id}", always(RuntimeError("down"))
+            )
+        mgr = MeshManager()
+        with pytest.raises((MeshHealthError, RuntimeError)):
+            mgr.dispatch(lambda mesh: mesh)
+
+    def test_pipeline_batch_survives_sick_chip(self, tmp_path):
+        """End-to-end: handle_batch with a serving mesh completes (and
+        stays pixel-exact) while one chip is injected dead."""
+        import jax
+
+        from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
+        from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+            ImageRegistry,
+            PixelsService,
+        )
+        from omero_ms_pixel_buffer_tpu.models.tile_pipeline import (
+            TilePipeline,
+        )
+        from omero_ms_pixel_buffer_tpu.resilience import INJECTOR
+        from omero_ms_pixel_buffer_tpu.resilience.faultinject import (
+            always,
+            first_n,
+        )
+        from omero_ms_pixel_buffer_tpu.tile_ctx import RegionDef, TileCtx
+
+        img = rng.integers(0, 60000, (1, 1, 1, 128, 128), dtype=np.uint16)
+        path = str(tmp_path / "chaos.ome.tiff")
+        write_ome_tiff(path, img, tile_size=(32, 32))
+        registry = ImageRegistry()
+        registry.add(1, path)
+        svc = PixelsService(registry)
+        try:
+            pipe = TilePipeline(
+                svc, engine="device", device_deflate=True,
+                use_plane_cache=False,
+            )
+            assert pipe._get_mesh() is not None
+            sick = jax.devices()[5]
+            INJECTOR.install(
+                "device.mesh-dispatch",
+                first_n(1, RuntimeError("ICI wedge")),
+            )
+            INJECTOR.install(
+                f"device.chip:{sick.id}", always(RuntimeError("down"))
+            )
+            ctxs = [
+                TileCtx(image_id=1, z=0, c=0, t=0,
+                        region=RegionDef(32 * (i % 4), 32 * (i // 4),
+                                         32, 32),
+                        format="png", omero_session_key="k")
+                for i in range(16)
+            ]
+            results = pipe.handle_batch(ctxs)
+            assert all(isinstance(r, bytes) for r in results)
+            assert pipe.last_mesh_dispatch["n_devices"] == 7
+            for ctx, png in zip(ctxs, results):
+                decoded = np.array(Image.open(io.BytesIO(png)))
+                r = ctx.region
+                np.testing.assert_array_equal(
+                    decoded,
+                    img[0, 0, 0, r.y : r.y + r.height,
+                        r.x : r.x + r.width],
+                )
+        finally:
+            svc.close()
+
+
+class TestCompilationCache:
+    """config `jax.compilation-cache-dir` -> runtime/jax_cache: the
+    explicit dir engages on any backend, device programs land in it,
+    and a second TilePipeline construction reuses the same dir."""
+
+    def test_config_key_validated(self):
+        from omero_ms_pixel_buffer_tpu.utils.config import (
+            Config,
+            ConfigError,
+        )
+
+        cfg = Config.from_dict(
+            {"session-store": {"type": "memory"},
+             "jax": {"compilation-cache-dir": "/tmp/x"}}
+        )
+        assert cfg.jax.compilation_cache_dir == "/tmp/x"
+        with pytest.raises(ConfigError):
+            Config.from_dict(
+                {"session-store": {"type": "memory"},
+                 "jax": {"compilation-cache-dir": 17}}
+            )
+        with pytest.raises(ConfigError):
+            Config.from_dict(
+                {"session-store": {"type": "memory"},
+                 "jax": {"compilation-cache-dirr": "/tmp/x"}}
+            )
+
+    def test_second_pipeline_hits_cache_dir(self, tmp_path, monkeypatch):
+        import jax
+
+        from omero_ms_pixel_buffer_tpu.runtime import jax_cache
+
+        cache_dir = str(tmp_path / "xla-cache")
+        # the module pins the dir process-globally once; reset for the
+        # test (and restore after)
+        monkeypatch.setattr(jax_cache, "_done", False)
+        monkeypatch.setattr(jax_cache, "_enabled_path", None)
+        from omero_ms_pixel_buffer_tpu.models.tile_pipeline import (
+            TilePipeline,
+        )
+
+        class _Svc:  # construction needs only the signature probe
+            def get_pixel_buffer(self, image_id):
+                return None
+
+        TilePipeline(_Svc(), compilation_cache_dir=cache_dir)
+        assert jax_cache.enabled_path() == cache_dir
+        assert jax.config.jax_compilation_cache_dir == cache_dir
+        # a device encode program persists into the dir...
+        payload = np.zeros((1, 513), np.uint8)
+        zlib_rle_batch(payload)
+        entries = set(os.listdir(cache_dir))
+        assert entries, "no compile-cache entries written"
+        # ...and a second pipeline construction reuses the SAME dir
+        # (idempotent enable), so a re-jit after dropping the in-
+        # memory caches reloads from disk instead of recompiling
+        TilePipeline(_Svc(), compilation_cache_dir=cache_dir)
+        assert jax_cache.enabled_path() == cache_dir
+        jax.clear_caches()
+        zlib_rle_batch(payload)
+        assert set(os.listdir(cache_dir)) == entries, (
+            "second run recompiled instead of hitting the cache dir"
+        )

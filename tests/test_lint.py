@@ -86,6 +86,19 @@ class TestSeededViolations:
         assert "block_until_ready" in messages     # full sync
         assert "re-traces" in messages             # per-call jit
 
+    def test_jax_hotpath_loop_sinks(self, seeded):
+        # r9 extension: np.asarray / .item() / float() on device
+        # values INSIDE loops — the per-iteration round trip the
+        # double-buffered dispatcher code must never reintroduce
+        found = seeded["hotpath_loop_sync.py"]
+        assert all(f.rule == "jax-hotpath" for f in found)
+        assert len(found) == 3
+        messages = " | ".join(f.message for f in found)
+        assert messages.count("inside a loop") == 3
+        assert "np.asarray(...)" in messages
+        assert ".item() on device value" in messages
+        assert "float(...)" in messages
+
     def test_error_taxonomy(self, seeded):
         found = seeded["bad_errors.py"]
         assert all(f.rule == "error-taxonomy" for f in found)

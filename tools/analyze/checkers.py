@@ -488,6 +488,7 @@ def check_resilience_coverage(
 
 _JAX_SYNC_SCOPE = (
     "omero_ms_pixel_buffer_tpu/models/tile_pipeline.py",
+    "omero_ms_pixel_buffer_tpu/models/device_dispatch.py",
     "omero_ms_pixel_buffer_tpu/ops/",
 )
 _JAX_JIT_SCOPE = _JAX_SYNC_SCOPE + (
@@ -510,7 +511,9 @@ _DEVICE_PRODUCER_NAMES = {
 # ...except these, which return host values
 _HOST_RETURNING = {"device_get", "devices", "default_backend"}
 
-_SYNC_SINKS = {"asarray", "array", "float", "int", "bytes", "tobytes"}
+_SYNC_SINKS = {
+    "asarray", "array", "float", "int", "bytes", "tobytes", "item",
+}
 
 
 def _device_names_flow(fn: FunctionInfo) -> Dict[int, Set[str]]:
@@ -519,9 +522,16 @@ def _device_names_flow(fn: FunctionInfo) -> Dict[int, Set[str]]:
     producers join the device set, names reassigned from anything else
     (``jax.device_get`` included) leave it. Sinks are evaluated with
     the device set AS OF their statement, so a post-``device_get``
-    ``int(lengths.max())`` is correctly host-side."""
+    ``int(lengths.max())`` is correctly host-side.
+
+    Sinks reached INSIDE a loop body (``for``/``while``) are tagged
+    distinctly: a per-iteration ``np.asarray``/``.item()``/``float()``
+    on a device value pays one full device round trip per lane, the
+    exact pattern the double-buffered dispatcher exists to avoid —
+    batch the pull through one ``jax.device_get`` outside the loop."""
     device: Set[str] = set()
     sinks: Dict[int, Set[str]] = {}
+    loop_depth = 0
 
     def call_is_producer(call: ast.Call) -> Optional[bool]:
         base, name = _base_of(call.func)
@@ -564,6 +574,8 @@ def _device_names_flow(fn: FunctionInfo) -> Dict[int, Set[str]]:
     def scan_sinks(expr: Optional[ast.AST]) -> None:
         if expr is None:
             return
+        in_loop = " inside a loop (per-iteration device round trip)" \
+            if loop_depth else ""
         for node in ast.walk(expr):
             if not isinstance(node, ast.Call):
                 continue
@@ -572,20 +584,21 @@ def _device_names_flow(fn: FunctionInfo) -> Dict[int, Set[str]]:
                 continue
             if name in ("asarray", "array") and base not in ("np", "numpy"):
                 continue
-            if name == "tobytes":
+            if name in ("tobytes", "item"):
                 target = node.func.value  # type: ignore[union-attr]
                 if expr_device(target):
                     sinks.setdefault(node.lineno, set()).add(
-                        ".tobytes() on device value"
+                        f".{name}() on device value{in_loop}"
                     )
                 continue
             if any(expr_device(a) for a in node.args):
                 label = f"{base + '.' if base else ''}{name}(...)"
                 sinks.setdefault(node.lineno, set()).add(
-                    f"{label} on device value"
+                    f"{label} on device value{in_loop}"
                 )
 
     def process(node: ast.AST) -> None:
+        nonlocal loop_depth
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             return  # nested defs analyzed as their own scope? no — skip
         if isinstance(node, ast.Assign):
@@ -596,6 +609,19 @@ def _device_names_flow(fn: FunctionInfo) -> Dict[int, Set[str]]:
             return
         if isinstance(node, ast.AugAssign):
             scan_sinks(node.value)
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            # a for's iterable evaluates ONCE (scan at the current
+            # depth); a while's test re-evaluates per iteration
+            scan_sinks(getattr(node, "iter", None))
+            loop_depth += 1
+            try:
+                scan_sinks(getattr(node, "test", None))
+                for part in (node.body, node.orelse):
+                    for stmt in part:
+                        process(stmt)
+            finally:
+                loop_depth -= 1
             return
         # evaluate the statement's own expressions with the current
         # set, then walk child statements in order (branch sets flow
