@@ -387,6 +387,93 @@ def bench_cache(
     return asyncio.run(run())
 
 
+def build_render_fixture(root: str, size: int = 2048):
+    """3-channel uint16 fixture for the rendered-tile section."""
+    from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
+
+    path = os.path.join(root, f"bench_render_{size}.ome.tiff")
+    if os.path.exists(path):
+        return path
+    log(f"writing {size}x{size} 3-channel render fixture...")
+    rng = np.random.default_rng(31)
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32)
+    chans = []
+    for phase in (0.0, 1.1, 2.3):
+        base = (
+            1800
+            + 1200 * np.sin(xx / 89.0 + phase)
+            + 1200 * np.cos(yy / 127.0 + phase)
+        )
+        chans.append(
+            (base + rng.normal(0, 90, (size, size))).clip(0, 4095)
+        )
+    data = np.stack(chans).astype(np.uint16)[None, :, None]
+    write_ome_tiff(path, data, tile_size=(512, 512), compression="zlib")
+    return path
+
+
+def bench_render(
+    cache_dir: str, engine: str, size: int = 2048, n: int = 96
+) -> dict:
+    """Rendered-tile serving (render/): 3 channels window/leveled,
+    colored, and composited per tile — p50/p99 per-tile latency plus
+    coalesced tiles/s, host engine vs the headline engine (identical
+    bytes by the engine contract, so only the clock differs)."""
+    import time as _t
+
+    from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+        ImageRegistry,
+        PixelsService,
+    )
+    from omero_ms_pixel_buffer_tpu.models.tile_pipeline import TilePipeline
+    from omero_ms_pixel_buffer_tpu.render.model import RenderSpec
+    from omero_ms_pixel_buffer_tpu.tile_ctx import RegionDef, TileCtx
+
+    path = build_render_fixture(cache_dir, size)
+    registry = ImageRegistry()
+    registry.add(1, path)
+    spec = RenderSpec.from_params({
+        "c": "1|0:4095$FF0000,2|0:4095$00FF00,3|0:4095$0000FF",
+    })
+    rng = np.random.default_rng(37)
+    ctxs = []
+    for _ in range(n):
+        x = int(rng.integers(0, (size - 512) // 64)) * 64
+        y = int(rng.integers(0, (size - 512) // 64)) * 64
+        ctxs.append(TileCtx(
+            image_id=1, z=0, c=0, t=0,
+            region=RegionDef(x, y, 512, 512), format="png",
+            omero_session_key="bench", render=spec,
+        ))
+    out = {}
+    engines = ["host"] if engine == "host" else ["host", engine]
+    for label in engines:
+        service = PixelsService(registry)
+        try:
+            pipe = TilePipeline(service, engine=label, buckets=(512,))
+            pipe.handle_batch(ctxs[:16])  # warm reads + tables + jit
+            lat = []
+            for ctx in ctxs[:32]:
+                t0 = _t.perf_counter()
+                assert pipe.handle(ctx) is not None
+                lat.append(_t.perf_counter() - t0)
+            tps = run_batched(pipe, ctxs, 16)
+            lat_ms = np.array(lat) * 1000.0
+            out[label] = {
+                "tiles_per_sec": round(tps, 2),
+                "p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+                "p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
+            }
+            log(f"[render] {label}: {out[label]}")
+            pipe.close()
+        except Exception as e:
+            out[label] = {"error": f"{type(e).__name__}: {e}"}
+            log(f"[render] {label} failed: {e!r}")
+        finally:
+            service.close()
+    return out
+
+
 def bench_device(path: str, size: int, probe_info: dict) -> dict:
     """Accelerator-engine sub-run, recorded even when slower than host
     (over a tunneled chip the link dominates; BENCH tail carries the
@@ -464,6 +551,35 @@ def device_sub_main():
         except Exception as e:
             out[f"error_{label}"] = f"{type(e).__name__}: {e}"
             log(f"[device] {label} path failed: {e!r}")
+    # rendered-tile lanes: the fused render->filter->deflate chain as
+    # ONE device dispatch per bucket group
+    try:
+        from omero_ms_pixel_buffer_tpu.render.model import RenderSpec
+        from omero_ms_pixel_buffer_tpu.tile_ctx import RegionDef, TileCtx
+
+        spec = RenderSpec.from_params({"c": "1|0:65535$FF0000"})
+        pipe = TilePipeline(
+            service, engine="device", buckets=(512,),
+            use_plane_cache=False, device_deflate=True,
+        )
+        pipe.mesh = None
+        rctxs = []
+        rng = np.random.default_rng(41)
+        for _ in range(n):
+            x = int(rng.integers(0, (size - 512) // 64)) * 64
+            y = int(rng.integers(0, (size - 512) // 64)) * 64
+            rctxs.append(TileCtx(
+                image_id=1, z=0, c=0, t=0,
+                region=RegionDef(x, y, 512, 512), format="png",
+                omero_session_key="bench", render=spec,
+            ))
+        pipe.handle_batch(rctxs[:32])
+        tps = run_batched(pipe, rctxs, 32)
+        out["tiles_per_sec_render"] = round(tps, 2)
+        log(f"[device] render path: {tps:.1f} tiles/s")
+    except Exception as e:
+        out["error_render"] = f"{type(e).__name__}: {e}"
+        log(f"[device] render path failed: {e!r}")
     service.close()
     # kernel-only compute metrics: over the tunneled chip the serving
     # numbers above measure the LINK; these measure the TPU itself
@@ -596,6 +712,15 @@ def main():
             cache_stats = {"error": f"{type(e).__name__}: {e}"}
             log(f"cache bench failed: {e!r}")
 
+    # --- rendered-tile serving (render/): host vs headline engine ----
+    render_stats: dict = {}
+    if os.environ.get("BENCH_RENDER", "1") != "0":
+        try:
+            render_stats = bench_render(cache_dir, pipe.engine)
+        except Exception as e:
+            render_stats = {"error": f"{type(e).__name__}: {e}"}
+            log(f"render bench failed: {e!r}")
+
     if os.environ.get("BENCH_SUBS", "1") != "0":
         try:
             sub_benches(pipe, service, size, cache_dir)
@@ -625,6 +750,8 @@ def main():
     )
     if cache_stats:
         record["cache"] = cache_stats
+    if render_stats:
+        record["render"] = render_stats
     if device_stats:
         record["device"] = device_stats
     # explicit host-vs-device table so the next round can read WHICH
@@ -636,6 +763,9 @@ def main():
     for k, v in device_stats.items():
         if k.startswith("tiles_per_sec_"):
             comparison["device_" + k[len("tiles_per_sec_"):]] = v
+    for label, stats in render_stats.items():
+        if isinstance(stats, dict) and "tiles_per_sec" in stats:
+            comparison[f"render_{label}"] = stats["tiles_per_sec"]
     micro = device_stats.get("micro") or {}
     for k in ("deflate_gbps", "pack_gbps", "pack_speedup_vs_gather"):
         if k in micro:

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 from collections import OrderedDict
 from typing import Awaitable, Callable, List, Optional, Tuple
 
@@ -72,6 +73,7 @@ class ViewportPrefetcher:
         budget_s: float = 2.0,
         lookahead: int = 2,
         max_streams: int = 1024,
+        extent_fn=None,
     ):
         self._fetch = fetch
         self._cache = cache
@@ -86,9 +88,19 @@ class ViewportPrefetcher:
         self._streams: "OrderedDict[tuple, _Stream]" = OrderedDict()
         self._max_streams = max_streams
         self._worker: Optional[asyncio.Task] = None
+        # extent_fn(image_id, resolution) -> (size_x, size_y) | None:
+        # a NON-BLOCKING cache peek (PixelsService.peek_extent) that
+        # lets predictions prune against the plane bounds at
+        # prediction time — an off-image guess dies in arithmetic here
+        # instead of costing the pipeline a resolve and a 404
+        self._extent_fn = extent_fn
+        self._extents: "OrderedDict[tuple, tuple]" = OrderedDict()
+        # invalidation arrives from the resolver's refresh thread
+        self._extents_lock = threading.Lock()
         self._stats = {
             "observed": 0, "enqueued": 0, "warmed": 0, "shed": 0,
             "already_cached": 0, "dropped_queue_full": 0, "failed": 0,
+            "pruned_off_image": 0,
         }
 
     # -- lifecycle -----------------------------------------------------
@@ -122,6 +134,10 @@ class ViewportPrefetcher:
         stream_key = (
             ctx.omero_session_key, ctx.image_id, ctx.z, ctx.c, ctx.t,
             ctx.resolution, ctx.format,
+            # render streams are their own motion streams, and their
+            # predictions must warm RENDER cache keys — a raw /tile
+            # pan and a /render pan over the same plane never mix
+            None if ctx.render is None else ctx.render.signature(),
         )
         stream = self._streams.get(stream_key)
         if stream is None:
@@ -136,19 +152,46 @@ class ViewportPrefetcher:
         for region, resolution in self._predict(ctx, dx, dy):
             self._enqueue(ctx, region, resolution)
 
+    def _extent(self, image_id: int, resolution) -> Optional[tuple]:
+        """Memoized plane extent per (image, level); None = unknown
+        (no pruning — the pipeline stays the backstop)."""
+        if self._extent_fn is None:
+            return None
+        key = (image_id, resolution)
+        with self._extents_lock:
+            hit = self._extents.get(key)
+        if hit is None:
+            hit = self._extent_fn(image_id, resolution)
+            if hit is not None:
+                with self._extents_lock:
+                    self._extents[key] = hit
+                    while len(self._extents) > self._max_streams:
+                        self._extents.popitem(last=False)
+        return hit
+
     def _predict(
         self, ctx: TileCtx, dx: int, dy: int
     ) -> List[Tuple[RegionDef, Optional[int]]]:
         """Continuation tiles along the motion vector, the next step's
         perpendicular neighbors, and the next-zoom tile under the new
-        center. Off-image predictions are pruned by the pipeline (404
-        -> counted, ignored)."""
+        center. Off-image predictions are pruned HERE with bounds math
+        (the extent resolves from the open-buffer cache the stream's
+        first tile populated); without a known extent the pipeline's
+        404 stays the backstop."""
         r = ctx.region
         out: List[Tuple[RegionDef, Optional[int]]] = []
 
         def add(x: int, y: int, w: int, h: int, res) -> None:
-            if x >= 0 and y >= 0:
-                out.append((RegionDef(x, y, w, h), res))
+            if x < 0 or y < 0:
+                return
+            extent = self._extent(ctx.image_id, res)
+            if extent is not None and (
+                x + w > extent[0] or y + h > extent[1]
+            ):
+                self._stats["pruned_off_image"] += 1
+                PREFETCH.inc(outcome="pruned_off_image")
+                return
+            out.append((RegionDef(x, y, w, h), res))
 
         if dx or dy:
             for i in range(1, self.lookahead + 1):
@@ -180,6 +223,7 @@ class ViewportPrefetcher:
             t=origin.t, region=region, resolution=resolution,
             format=origin.format,
             omero_session_key=origin.omero_session_key,
+            render=origin.render,
         )
         key = ctx.cache_key(self._quality)
         if self._cache is not None and self._cache.contains(key):
@@ -191,6 +235,14 @@ class ViewportPrefetcher:
         except asyncio.QueueFull:
             self._stats["dropped_queue_full"] += 1
             PREFETCH.inc(outcome="dropped_queue_full")
+
+    def invalidate_image(self, image_id: int) -> None:
+        """Metadata-change hook: drop memoized extents (a re-imported
+        image can change size; a stale extent would mis-prune).
+        Called from the resolver's refresh thread."""
+        with self._extents_lock:
+            for key in [k for k in self._extents if k[0] == image_id]:
+                del self._extents[key]
 
     # -- the low-priority worker ---------------------------------------
 

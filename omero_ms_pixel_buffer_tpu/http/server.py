@@ -65,6 +65,7 @@ CONTENT_TYPES = {
     None: "application/octet-stream",
     "png": "image/png",
     "tif": "image/tiff",
+    "jpeg": "image/jpeg",
 }
 
 
@@ -162,15 +163,15 @@ def _retry_after(seconds: float) -> str:
 
 def admission_middleware(admission: AdmissionController):
     """Load shedding at the door (resilience/admission): beyond the
-    in-flight bound, tile requests answer 503 + Retry-After
+    in-flight bound, tile/render requests answer 503 + Retry-After
     immediately instead of queueing toward a bus timeout. Only the
-    tile lanes are gated — discovery, metrics, and health must stay
+    serving lanes are gated — discovery, metrics, and health must stay
     reachable precisely when the service is saturated."""
 
     @web.middleware
     async def middleware(request: web.Request, handler):
         if (
-            not request.path.startswith("/tile/")
+            not request.path.startswith(("/tile/", "/render/"))
             or request.method == "OPTIONS"  # discovery/CORS preflight
         ):
             return await handler(request)
@@ -324,7 +325,26 @@ class PixelBufferApp:
             max_tile_bytes=config.backend.max_tile_mb << 20,
             device_deflate=config.backend.png.device_deflate,
             compilation_cache_dir=config.jax.compilation_cache_dir,
+            lut_dir=config.render.lut_dir,
         )
+        if config.render.enabled:
+            # build the LUT registry NOW (directory scan + file reads,
+            # render.lut-dir may sit on slow storage) — never lazily
+            # on the serving loop inside the first /render request
+            self.pipeline.lut_registry
+        # background mesh health probe (config mesh.probe-interval-ms):
+        # re-probes breaker-open chips on a cadence so a recovered chip
+        # rejoins the serving mesh BEFORE the next dispatch failure
+        # (reactive probing alone only runs after a batch already
+        # failed). Built here, started at app startup.
+        self.mesh_prober = None
+        if config.mesh.probe_interval_ms > 0:
+            from ..parallel.mesh import MeshProber
+
+            self.mesh_prober = MeshProber(
+                self._mesh_manager,
+                interval_s=config.mesh.probe_interval_ms / 1000.0,
+            )
         self.worker = BatchingTileWorker(
             self.pipeline,
             self.session_validator,
@@ -363,6 +383,14 @@ class PixelBufferApp:
                         or self.request_budget_s
                     ),
                     lookahead=cc.prefetch.lookahead,
+                    # bounds math at prediction time: the motion
+                    # stream's first tile already opened the image's
+                    # buffer, so its level extent answers from cache —
+                    # off-image predictions die here instead of
+                    # wasting a pipeline resolve each
+                    extent_fn=self.pixels_service.peek_extent
+                    if hasattr(self.pixels_service, "peek_extent")
+                    else None,
                 )
         # authorization-verdict TTL cache for the hit path: a session
         # that just took the FULL path for an image (session join +
@@ -419,9 +447,20 @@ class PixelBufferApp:
         app.router.add_get(
             "/tile/{imageId}/{z}/{c}/{t}", self.handle_get_tile
         )
+        if self.config.render.enabled:
+            app.router.add_get(
+                "/render/{imageId}/{z}/{c}/{t}", self.handle_get_render
+            )
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         return app
+
+    def _mesh_manager(self):
+        """The live MeshManager, when the device path has built one
+        (the prober's lookup hook — the dispatcher is lazy, so this
+        resolves per probe tick, never caches None)."""
+        disp = self.pipeline._dispatcher
+        return None if disp is None else disp.mesh_manager
 
     async def _on_startup(self, app) -> None:
         if self.watchdog is not None:
@@ -429,12 +468,16 @@ class PixelBufferApp:
         await self.worker.start()
         if self.prefetcher is not None:
             self.prefetcher.start()
+        if self.mesh_prober is not None:
+            self.mesh_prober.start()
 
     async def _on_cleanup(self, app) -> None:
         # stop() analog (:298-308): worker, session store, pixel
         # buffers, then the span reporter/sender
         if self.watchdog is not None:
             self.watchdog.stop()
+        if self.mesh_prober is not None:
+            self.mesh_prober.stop()
         if self.prefetcher is not None:
             await self.prefetcher.close()
         if self.result_cache is not None:
@@ -477,6 +520,12 @@ class PixelBufferApp:
             if self.prefetcher is not None
             else {"enabled": False}
         )
+        render_health = {"enabled": self.config.render.enabled}
+        if self.config.render.enabled:
+            render_health.update(self.pipeline.render_snapshot())
+        mesh_mgr = self._mesh_manager()
+        if mesh_mgr is not None:
+            render_health["mesh"] = mesh_mgr.snapshot()
         degraded = (
             any(b["state"] == "open" for b in breakers.values())
             or admission["inflight"] >= admission["max_inflight"]
@@ -492,6 +541,7 @@ class PixelBufferApp:
                 "loop": loop_health,
                 "cache": cache_health,
                 "prefetch": prefetch_health,
+                "render": render_health,
                 "request_budget_ms": self.request_budget_s * 1000.0,
             }
         )
@@ -634,8 +684,62 @@ class PixelBufferApp:
         the open buffer, and device planes."""
         if self.result_cache is not None:
             self.result_cache.invalidate_image(image_id)
+        if self.prefetcher is not None:
+            self.prefetcher.invalidate_image(image_id)
         self._authz_purge(image_id)
         self.pipeline.invalidate_image(image_id)
+
+    def _full_plane_extent(self, ctx: TileCtx):
+        """(size_x, size_y) of the ctx's plane at its resolution
+        level, or None — the w/h=0 normalization lookup. Answers from
+        the pixels service's caches (metadata + open-buffer LRU), so
+        repeated full-plane requests cost dict probes."""
+        svc = self.pixels_service
+        try:
+            if ctx.resolution in (None, 0):
+                meta = svc.get_pixels(
+                    ctx.image_id, session_key=ctx.omero_session_key
+                )
+                return (
+                    None if meta is None
+                    else (meta.size_x, meta.size_y)
+                )
+            buf = svc.get_pixel_buffer(
+                ctx.image_id, session_key=ctx.omero_session_key
+            )
+            if buf is None or not (
+                0 <= ctx.resolution < buf.resolution_levels
+            ):
+                return None
+            return buf.level_size(ctx.resolution)
+        except Exception:
+            log.debug("full-plane extent lookup failed", exc_info=True)
+            return None
+
+    async def _normalize_region(self, ctx: TileCtx) -> None:
+        """Rewrite w/h=0 full-plane defaulting to the explicit
+        spelling BEFORE any key derives from the region, so both
+        spellings of the same tile share one cache entry, one
+        single-flight, and one batch lane (the KNOWN_GAPS
+        duplicate-bytes item). The rewrite is EXACTLY the pipeline's
+        ``resolve_region`` defaulting (w==0 -> sizeX, h==0 -> sizeY,
+        regardless of x/y) — so an out-of-bounds spelling like
+        ``x=100&w=0`` normalizes to the same region the pipeline
+        rejects with 404, and cache on/off cannot change a status. A
+        failed lookup leaves the region untouched — the pipeline
+        resolves it as before, and the two spellings merely cache
+        separately like they always did."""
+        if ctx.region.width > 0 and ctx.region.height > 0:
+            return
+        extent = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self._full_plane_extent(ctx)
+        )
+        if extent is None:
+            return
+        if ctx.region.width == 0:
+            ctx.region.width = extent[0]
+        if ctx.region.height == 0:
+            ctx.region.height = extent[1]
 
     async def handle_get_tile(self, request: web.Request) -> web.Response:
         log.info("Get tile")
@@ -647,8 +751,54 @@ class PixelBufferApp:
             )
         except TileError as e:
             return web.Response(status=400, text=e.message)
+        return await self._serve(request, ctx)
 
+    async def handle_get_render(self, request: web.Request) -> web.Response:
+        """The rendered-tile surface: same path shape, auth, deadline,
+        admission, cache, and conditional-GET semantics as /tile —
+        plus a RenderSpec parsed from the query (render/model.py).
+        Spec grammar errors are 400s; the ``c`` QUERY param (channel
+        selection) never collides with the ``c`` PATH segment, which
+        stays the default channel when no selection narrows it."""
+        log.info("Get render")
+        from ..render.model import RenderSpec
+
+        try:
+            ctx = TileCtx.from_params(
+                dict(request.match_info), request.get("omero.session_key")
+            )
+            spec = RenderSpec.from_params(
+                request.query,
+                default_channel=ctx.c,
+                default_quality=self.config.render.jpeg_quality,
+            )
+        except TileError as e:
+            return web.Response(status=400, text=e.message)
+        for ch in spec.channels:
+            if ch.lut is not None and (
+                ch.lut not in self.pipeline.lut_registry
+            ):
+                return web.Response(
+                    status=400, text=f"Unknown LUT: {ch.lut}"
+                )
+        ctx.render = spec
+        ctx.format = spec.format  # drives Content-Type + filename
+        # query x/y/w/h/resolution ride along exactly like /tile's
+        try:
+            ctx.region.x = int(request.query.get("x", 0))
+            ctx.region.y = int(request.query.get("y", 0))
+            ctx.region.width = int(request.query.get("w", 0))
+            ctx.region.height = int(request.query.get("h", 0))
+            res = request.query.get("resolution")
+            ctx.resolution = None if res is None else int(res)
+        except (TypeError, ValueError) as e:
+            return web.Response(status=400, text=str(e))
+        return await self._serve(request, ctx)
+
+    async def _serve(self, request: web.Request, ctx: TileCtx) -> web.Response:
         cache = self.result_cache
+        if cache is not None:
+            await self._normalize_region(ctx)
         inm = request.headers.get("If-None-Match", "")
         key = None
         if cache is not None:

@@ -256,6 +256,26 @@ class PixelsService:
                 self._cache.popitem(last=False)
         return buf
 
+    def peek_extent(self, image_id: int, resolution=None):
+        """(size_x, size_y) at ``resolution`` answered ONLY from the
+        open-buffer cache — never opens, never resolves, never blocks
+        on I/O. None when the image has no open buffer (or the level
+        is out of range). The prefetcher's bounds-math hook: by a
+        motion stream's second access the first tile has already
+        opened the buffer, so predictions prune against the real
+        extent without costing a resolver call."""
+        with self._lock:
+            buf = self._cache.get(int(image_id))
+        if buf is None:
+            return None
+        try:
+            level = 0 if resolution is None else int(resolution)
+            if not 0 <= level < buf.resolution_levels:
+                return None
+            return buf.level_size(level)
+        except Exception:
+            return None
+
     def invalidate(self, image_id: int) -> Optional[int]:
         """Drop the image's cached buffer (cache-invalidation hook: a
         changed ``pixels`` row makes the parsed IFD/zarr structure

@@ -159,6 +159,105 @@ class DeviceEncodeDispatcher:
             bit_depth, color_type,
         )
 
+    def submit_render(
+        self,
+        planes,
+        index_tables,
+        color_luts,
+        rows: int,
+        row_bytes: int,
+        filter_mode: str,
+        deflate_mode: str,
+        lanes: Sequence[int],
+        sizes: Sequence[Tuple[int, int]],
+    ) -> "concurrent.futures.Future":
+        """Launch one RENDER group (render/engine): ``planes`` is a
+        host (B, C, H, W) unsigned channel batch; the fused composite
+        + filter + deflate program runs as ONE dispatch and the
+        readback worker frames RGB8 PNGs. Same double-buffer shape as
+        ``submit``; with a serving mesh the group shards across chips
+        through ``sharded_render_filter_deflate`` instead."""
+        import jax
+
+        if self.mesh_manager is not None:
+            # same rationale as the raw-tile mesh path: block inside
+            # the managed dispatch so a sick chip degrades the mesh
+            return self._readback.submit(
+                self._mesh_render_group,
+                planes, index_tables, color_luts, rows, row_bytes,
+                filter_mode, deflate_mode, lanes, sizes,
+            )
+        from ..render.engine import fused_render_filter_deflate_batch
+
+        t0 = time.perf_counter()
+        batch_dev = jax.device_put(planes)
+        jax.block_until_ready(batch_dev)  # ompb-lint: disable=jax-hotpath -- H2D stage boundary: waits on the transfer engine, overlapped with the prior group's compute
+        t_h2d = time.perf_counter()
+        streams, lengths = fused_render_filter_deflate_batch(
+            batch_dev, index_tables, color_luts, rows, row_bytes,
+            filter_mode=filter_mode, mode=deflate_mode,
+            packer=self._packer,
+        )
+        t_dispatch = time.perf_counter()
+        DEVICE_STAGE_SECONDS.observe(t_h2d - t0, stage="h2d")
+        return self._readback.submit(
+            self._readback_group,
+            streams, lengths, t_dispatch, lanes, sizes, 8, 2,
+        )
+
+    def _mesh_render_group(
+        self, planes, index_tables, color_luts, rows, row_bytes,
+        filter_mode, deflate_mode, lanes, sizes,
+    ):
+        """One sharded render group on the readback worker (same
+        pow2-then-mesh-width lane padding and blocking-dispatch
+        semantics as ``_mesh_group``)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..parallel.sharding import (
+            shard_batch,
+            sharded_render_filter_deflate,
+        )
+
+        t0 = time.perf_counter()
+        stamps = {}
+
+        def run(mesh):
+            n = mesh.shape["data"]
+            b = planes.shape[0]
+            pow2 = 1 << max(b - 1, 0).bit_length()
+            padded_b = -(-pow2 // n) * n
+            batch = jnp.asarray(planes)
+            if padded_b != b:
+                batch = jnp.pad(
+                    batch,
+                    ((0, padded_b - b),) + ((0, 0),) * (batch.ndim - 1),
+                )
+            sharded = shard_batch(mesh, batch)
+            jax.block_until_ready(sharded)  # ompb-lint: disable=jax-hotpath -- H2D stage boundary on the readback worker
+            stamps["h2d"] = time.perf_counter()
+            out = sharded_render_filter_deflate(
+                mesh, sharded, index_tables, color_luts, rows,
+                row_bytes, filter_mode=filter_mode,
+                deflate_mode=deflate_mode, packer=self._packer,
+            )
+            return jax.block_until_ready(out)  # ompb-lint: disable=jax-hotpath -- readback worker: the one thread that waits on device completion
+
+        streams, lengths = self.mesh_manager.dispatch(
+            run, real_lanes=len(lanes)
+        )
+        t_ready = time.perf_counter()
+        DEVICE_STAGE_SECONDS.observe(
+            stamps.get("h2d", t0) - t0, stage="h2d"
+        )
+        DEVICE_STAGE_SECONDS.observe(
+            t_ready - stamps.get("h2d", t0), stage="compute"
+        )
+        return self._pull_and_frame(
+            streams, lengths, t_ready, lanes, sizes, 8, 2
+        )
+
     def _mesh_group(
         self, tiles, rows, row_bytes, bpp, filter_mode, deflate_mode,
         lanes, sizes, bit_depth, color_type,

@@ -124,6 +124,7 @@ class TilePipeline:
         max_tile_bytes: int = 256 << 20,
         device_deflate: bool = False,
         compilation_cache_dir: Optional[str] = None,
+        lut_dir: Optional[str] = None,
     ):
         self.pixels_service = pixels_service
         self.png_filter = png_filter
@@ -185,6 +186,12 @@ class TilePipeline:
         self._encode_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=encode_workers, thread_name_prefix="encode"
         )
+        # rendering engine state (render/): LUT registry (built lazily
+        # — host-only raw-tile serving never touches it) and the
+        # per-(spec, dtype) quantization-table cache
+        self.lut_dir = lut_dir
+        self._lut_registry = None
+        self._render_tables: Dict[Tuple[str, str], tuple] = {}
 
     def close(self) -> None:
         """Release owned threads: the encode pool and (if the device
@@ -219,6 +226,50 @@ class TilePipeline:
         path hasn't staged anything (host serving never builds it)."""
         cache = self._plane_cache
         return None if cache is None else cache.snapshot()
+
+    @property
+    def lut_registry(self):
+        """The LUT registry (render/luts), built on first render."""
+        if self._lut_registry is None:
+            from ..render.luts import LutRegistry
+
+            self._lut_registry = LutRegistry(self.lut_dir)
+        return self._lut_registry
+
+    def _render_tables_for(self, spec, dtype) -> tuple:
+        """(index_tables, color_luts) for a (spec, pixel type) pair,
+        memoized — table construction is the render model's float
+        math and must not re-run per tile."""
+        key = (spec.signature(), np.dtype(dtype).str)
+        hit = self._render_tables.get(key)
+        if hit is None:
+            from ..render.engine import build_tables
+
+            hit = build_tables(spec, np.dtype(dtype), self.lut_registry)
+            if len(self._render_tables) >= 256:
+                self._render_tables.clear()  # coarse but bounded
+            self._render_tables[key] = hit
+        return hit
+
+    def _render_filter_mode(self) -> str:
+        """Render lanes use the configured PNG filter when the device
+        program supports it; 'adaptive' (host-only, and its per-row
+        cost would read the padded bytes) pins to 'up' so the host
+        fallback and device path stay byte-identical."""
+        if self.png_filter in ("none", "sub", "up", "average", "paeth"):
+            return self.png_filter
+        return "up"
+
+    def render_snapshot(self) -> dict:
+        """/healthz view of the rendering engine."""
+        return {
+            "specs_cached": len(self._render_tables),
+            "luts": (
+                len(self._lut_registry)
+                if self._lut_registry is not None else None
+            ),
+            "lut_dir": self.lut_dir,
+        }
 
     @property
     def engine(self) -> str:
@@ -419,6 +470,11 @@ class TilePipeline:
         ``ServiceUnavailableError`` marker (-> 503, dependency breaker
         open). Broad-catch like the reference
         (TileRequestHandler.java:133-137)."""
+        if ctx.render is not None:
+            # render lanes always take the batched machinery (multi-
+            # channel plane fetch, grouped device encode, host
+            # fallback); a singleton batch is the same code path
+            return self.handle_batch([ctx])[0]
         with TRACER.start_span("get_tile"):
             try:
                 rt = self.resolve(ctx)
@@ -514,6 +570,18 @@ class TilePipeline:
             enable_persistent_cache(self.compilation_cache_dir)
         mesh = self._get_mesh() if use_device else None
 
+        # render lanes (ctx.render set) split off here: they fetch one
+        # plane per active channel (x z-range under projection) and
+        # composite on device, so the single-plane read grouping and
+        # the PNG bucket split below never see them
+        render_idx = [
+            i for i, ctx in enumerate(ctxs)
+            if ctx.render is not None
+            and resolved[i] is not None
+            and results[i] is None
+        ]
+        render_set = set(render_idx)
+
         # HBM-resident path: lanes whose plane is (or becomes) device-
         # resident skip the host read entirely — crop + filter happen
         # on the accelerator and only filtered bytes come back. With a
@@ -531,7 +599,7 @@ class TilePipeline:
         with TRACER.start_span("batch_stage"):
             by_image: Dict[Tuple[int, int], List[int]] = {}
             for i, rt in enumerate(resolved):
-                if rt is not None and i not in in_plane:
+                if rt is not None and i not in in_plane and i not in render_set:
                     by_image.setdefault(
                         (rt.meta.image_id, rt.level), []
                     ).append(i)
@@ -662,6 +730,14 @@ class TilePipeline:
                 log.exception("plane-cache PNG batch failed; host fallback")
                 self._plane_fallback(lanes, resolved, ctxs, results)
 
+        render_pending: List[Tuple[List[int], object]] = []
+        render_stacks: Dict[int, np.ndarray] = {}
+        if render_idx:
+            render_pending, render_stacks = self._render_batch_lanes(
+                render_idx, resolved, ctxs, results,
+                use_fused=use_fused,
+            )
+
         for idxs, fut in pending:
             try:
                 # audited: handle_batch runs on a BATCHER executor
@@ -680,6 +756,28 @@ class TilePipeline:
                         results[i] = self.encode(ctxs[i], tile)
                     except Exception:
                         results[i] = None
+
+        for idxs, fut in render_pending:
+            try:
+                # audited: same two-pool shape as the drain above
+                group = fut.result()  # ompb-lint: disable=loop-block -- executor-thread wait on a different pool
+                for i, png in group.items():
+                    results[i] = png
+                from ..render.engine import RENDER_TILES
+
+                RENDER_TILES.inc(
+                    len(group), path="device", format="png"
+                )
+            except Exception:
+                log.exception("device render group failed; host fallback")
+                from ..render.engine import RENDER_FALLBACK
+
+                RENDER_FALLBACK.inc(len(idxs))
+                for i in idxs:
+                    self._render_host_lane(
+                        i, ctxs[i], resolved[i], render_stacks.get(i),
+                        results,
+                    )
         return results
 
     def _plane_fallback(self, lanes, resolved, ctxs, results) -> None:
@@ -688,6 +786,182 @@ class TilePipeline:
                 results[i] = self.encode(ctxs[i], self.read(resolved[i]))
             except Exception:
                 results[i] = None
+
+    # ------------------------------------------------------------------
+    # render lanes (render/): multi-channel fetch -> projection ->
+    # fused device composite+filter+deflate, host mirror fallback
+    # ------------------------------------------------------------------
+
+    def _render_batch_lanes(
+        self, idxs, resolved, ctxs, results, use_fused: bool
+    ):
+        """Plan and read every render lane's channel planes (grouped
+        per image like the raw path), z-project, then either submit
+        fused device render groups (returned as [(lanes, future)] for
+        handle_batch's drain) or encode on the host in place. Per-lane
+        failures degrade to None (404) without failing the batch;
+        dependency-down reads become 503 markers like raw lanes."""
+        from ..render.engine import (
+            RENDER_FALLBACK,
+            RENDER_TILES,
+            renderable_dtype,
+            unsigned_view,
+        )
+        from ..render.projection import project
+        from ..resilience.faultinject import INJECTOR
+
+        pending: List[Tuple[List[int], object]] = []
+        stacks: Dict[int, np.ndarray] = {}
+        plans: Dict[int, tuple] = {}
+        by_image: Dict[Tuple[int, int], List[int]] = {}
+        for i in idxs:
+            rt, ctx = resolved[i], ctxs[i]
+            spec = ctx.render
+            try:
+                chans = spec.resolve_channels(rt.meta.size_c)
+                zs = spec.z_range(ctx.z, rt.meta.size_z)
+            except Exception:
+                log.debug("unrenderable spec for image %d",
+                          ctx.image_id, exc_info=True)
+                continue  # lane -> 404
+            if not renderable_dtype(rt.meta.dtype):
+                log.debug("unrenderable pixel type %s", rt.meta.dtype)
+                continue  # lane -> 404
+            coords = [
+                (z, ch.index, ctx.t, rt.x, rt.y, rt.w, rt.h)
+                for ch in chans for z in zs
+            ]
+            plans[i] = (chans, zs, coords)
+            by_image.setdefault(
+                (rt.meta.image_id, rt.level), []
+            ).append(i)
+
+        with TRACER.start_span("render_stage"):
+            for (image_id, level), lanes in by_image.items():
+                buf = resolved[lanes[0]].buffer
+                flat = [c for i in lanes for c in plans[i][2]]
+                try:
+                    planes = buf.read_tiles(flat, level=level)
+                except _UNAVAILABLE as e:
+                    log.warning(
+                        "store unavailable for image %d: %s", image_id, e
+                    )
+                    marker = _lane_unavailable(e)
+                    for i in lanes:
+                        results[i] = marker  # lanes -> 503
+                    continue
+                except Exception:
+                    log.exception(
+                        "render read failed for image %d; lanes -> 404",
+                        image_id,
+                    )
+                    continue
+                pos = 0
+                for i in lanes:
+                    chans, zs, coords = plans[i]
+                    lane_planes = planes[pos : pos + len(coords)]
+                    pos += len(coords)
+                    rt = resolved[i]
+                    try:
+                        stack = np.stack(lane_planes).reshape(
+                            len(chans), len(zs), rt.h, rt.w
+                        )
+                        spec = ctxs[i].render
+                        if spec.projection is not None:
+                            stack = project(
+                                stack, spec.projection,
+                                device=use_fused,
+                            )
+                        else:
+                            stack = stack[:, 0]
+                        stacks[i] = unsigned_view(
+                            np.ascontiguousarray(stack)
+                        )
+                    except Exception:
+                        log.exception(
+                            "render staging failed for lane %d", i
+                        )
+
+        # encode groups: (spec signature, pixel type, real size,
+        # bucket) — one fused dispatch per group, one jit
+        # specialization per (shape, C)
+        groups: Dict[Tuple, List[int]] = {}
+        for i, stack in stacks.items():
+            rt, spec = resolved[i], ctxs[i].render
+            bucket = (
+                self._bucket(rt.w, rt.h)
+                if use_fused and spec.format == "png" else None
+            )
+            if bucket is None:
+                self._render_host_lane(
+                    i, ctxs[i], rt, stack, results
+                )
+                continue
+            groups.setdefault(
+                (
+                    spec.signature(), rt.meta.dtype.str,
+                    (rt.w, rt.h), bucket,
+                ),
+                [],
+            ).append(i)
+
+        fmode = self._render_filter_mode()
+        for (sig, dtype_str, (w, h), (bw, bh)), lanes in groups.items():
+            spec = ctxs[lanes[0]].render
+            try:
+                # the chaos seam: failing `render.engine` here proves
+                # the host mirror serves byte-identical tiles
+                INJECTOR.fire("render.engine")
+                tables, luts = self._render_tables_for(
+                    spec, np.dtype(dtype_str)
+                )
+                c = tables.shape[0]
+                batch = np.zeros(
+                    (len(lanes), c, bh, bw), dtype=stacks[lanes[0]].dtype
+                )
+                for j, i in enumerate(lanes):
+                    batch[j, :, :h, :w] = stacks[i]
+                disp = self._get_dispatcher()
+                with TRACER.start_span("render_device"):
+                    fut = disp.submit_render(
+                        batch, tables, luts, h, 1 + w * 3, fmode,
+                        "rle", lanes, [(w, h)] * len(lanes),
+                    )
+                pending.append((lanes, fut))
+            except Exception:
+                log.exception(
+                    "render device dispatch failed; host fallback"
+                )
+                RENDER_FALLBACK.inc(len(lanes))
+                for i in lanes:
+                    self._render_host_lane(
+                        i, ctxs[i], resolved[i], stacks[i], results
+                    )
+        return pending, stacks
+
+    def _render_host_lane(self, i, ctx, rt, stack, results) -> None:
+        """One lane through the host mirror: numpy composite + the
+        numpy twin of the device stream builder (PNG bytes identical
+        to the fused device chain) or Pillow JPEG."""
+        from ..render import engine as rengine
+
+        if stack is None:
+            results[i] = None
+            return
+        spec = ctx.render
+        try:
+            tables, luts = self._render_tables_for(spec, rt.meta.dtype)
+            if spec.format == "png":
+                results[i] = rengine.render_png_host(
+                    stack, tables, luts, self._render_filter_mode()
+                )
+            else:
+                rgb = rengine.render_host(stack, tables, luts)
+                results[i] = rengine.encode_jpeg(rgb, spec.quality)
+            rengine.RENDER_TILES.inc(path="host", format=spec.format)
+        except Exception:
+            log.exception("host render failed for lane %d", i)
+            results[i] = None
 
     def _stage_plane_lanes(self, ctxs, resolved):
         """Group device-eligible PNG lanes by resident plane; stages
@@ -705,7 +979,10 @@ class TilePipeline:
         planes: Dict[Tuple, object] = {}
         attempted: set = set()
         for i, (ctx, rt) in enumerate(zip(ctxs, resolved)):
-            if rt is None or ctx.format != "png":
+            if rt is None or ctx.format != "png" or ctx.render is not None:
+                # render lanes (format is also "png") have their own
+                # multi-channel path — staging them here would encode
+                # the RAW plane into their result slot
                 continue
             meta_dtype = rt.meta.dtype
             if (

@@ -648,3 +648,110 @@ def fused_filter_deflate_batch(
         _interpret_for(packer),
     )
     return streams[:b], lengths[:b]
+
+
+# ---------------------------------------------------------------------------
+# Host (numpy) mirror of the RLE + fixed-Huffman stream — byte-identical
+# ---------------------------------------------------------------------------
+
+
+def _rle_tokens_np(payload: np.ndarray):
+    """Numpy port of ``_rle_tokens`` (same run decomposition, same
+    tables, same token order) — the host half of the byte-identity
+    contract ``zlib_rle_np`` provides."""
+    n = payload.shape[0]
+    arange = np.arange(n, dtype=np.int64)
+    same = np.concatenate(
+        [np.zeros(1, bool), payload[1:] == payload[:-1]]
+    )
+    run_start = ~same
+    start_pos = np.maximum.accumulate(np.where(run_start, arange, -1))
+    p_in_run = arange - start_pos
+    starts = np.where(run_start, arange, n)
+    after = np.concatenate([starts[1:], np.full(1, n, np.int64)])
+    next_start = np.minimum.accumulate(after[::-1])[::-1]
+    rem = next_start - arange
+    q = p_in_run - 1
+    qmod = q % _MAX_MATCH
+    chunk_size = np.minimum(_MAX_MATCH, rem + qmod)
+    is_lit = (p_in_run == 0) | (chunk_size < 3)
+    is_match = (p_in_run >= 1) & (qmod == 0) & (chunk_size >= 3)
+    mlen = np.clip(np.minimum(_MAX_MATCH, rem), 0, _MAX_MATCH)
+    bits = np.where(
+        is_lit, _LIT_BITS[payload],
+        np.where(is_match, _MATCH_BITS[mlen], 0),
+    ).astype(np.uint32)
+    nbits = np.where(
+        is_lit, _LIT_NBITS[payload],
+        np.where(is_match, _MATCH_NBITS[mlen], 0),
+    ).astype(np.int64)
+    return bits, nbits
+
+
+def _pack_bits_scan_np(bits: np.ndarray, nbits: np.ndarray, maxbits: int):
+    """Numpy port of the carry-free prefix-sum packer: identical word
+    math on wrapping uint32 cumsums, so the packed bytes are identical
+    to the device packer's (and, transitively, to the Pallas kernel's,
+    which is pinned bit-exact against the scan packer)."""
+    offs = np.cumsum(nbits) - nbits
+    total_bits = int(offs[-1] + nbits[-1])
+    s = (offs & 31).astype(np.uint32)
+    val = bits.astype(np.uint32)
+    lo = val << s
+    hi = (val >> (np.uint32(31) - s)) >> np.uint32(1)
+    zero = np.zeros(1, np.uint32)
+    tl = np.concatenate([zero, np.cumsum(lo, dtype=np.uint32)])
+    th = np.concatenate([zero, np.cumsum(hi, dtype=np.uint32)])
+    nwords = maxbits // 32
+    edges = (np.arange(nwords, dtype=np.int64) + 1) * 32
+    c = np.searchsorted(offs, edges, side="left")
+    gl, gh = tl[c], th[c]
+    gl1 = np.concatenate([zero, gl[:-1]])
+    gh1 = np.concatenate([zero, gh[:-1]])
+    gh2 = np.concatenate([zero, gh1[:-1]])
+    words = (gl - gl1) + (gh1 - gh2)
+    return words.astype("<u4").tobytes(), total_bits
+
+
+def zlib_rle_np(payload) -> bytes:
+    """Host (numpy) build of EXACTLY the stream the device encoder
+    emits for one lane: Z_RLE tokenization + fixed Huffman + the
+    carry-free packer + per-lane min(rle, stored) selection. This is
+    what lets a host fallback stay byte-identical to the device path
+    (the render engine's contract) instead of merely decoded-equal."""
+    import zlib as _zlib
+
+    data = np.frombuffer(payload, dtype=np.uint8) if isinstance(
+        payload, (bytes, bytearray, memoryview)
+    ) else np.ascontiguousarray(payload, dtype=np.uint8).ravel()
+    n = data.shape[0]
+    if n == 0:
+        raise ValueError("empty payload")
+    tok_bits, tok_nbits = _rle_tokens_np(data)
+    bits = np.concatenate([np.full(1, 3, np.uint32), tok_bits])
+    nbits = np.concatenate([np.full(1, 3, np.int64), tok_nbits])
+    packed, body_bits = _pack_bits_scan_np(
+        bits, nbits, _packing_maxbits(n)
+    )
+    total_bits = body_bits + 7  # + the 7-bit all-zero EOB code
+    deflate_nbytes = (total_bits + 7) // 8
+    rle_len = 2 + deflate_nbytes + 4
+    stored_len = stored_stream_len(n)
+    adler = (_zlib.adler32(data.tobytes()) & 0xFFFFFFFF).to_bytes(
+        4, "big"
+    )
+    if rle_len <= stored_len:
+        return b"\x78\x01" + packed[:deflate_nbytes] + adler
+    out = bytearray(b"\x78\x01")
+    nblocks = max(1, -(-n // _BLOCK))
+    for i in range(nblocks):
+        start = i * _BLOCK
+        size = min(_BLOCK, n - start)
+        final = 1 if i == nblocks - 1 else 0
+        out += bytes(
+            [final, size & 0xFF, size >> 8,
+             (size & 0xFF) ^ 0xFF, (size >> 8) ^ 0xFF]
+        )
+        out += data[start : start + size].tobytes()
+    out += adler
+    return bytes(out)

@@ -158,7 +158,10 @@ class MeshManager:
 
     def probe_device(self, dev) -> bool:
         """One chip's liveness: a tiny transfer + add, blocked on.
-        Records the outcome on the chip's breaker."""
+        Records the outcome on the chip's breaker; a SUCCESSFUL probe
+        also heals an open breaker outright — the probe genuinely
+        exercised the chip, so there is nothing left for a half-open
+        trial to learn."""
         from ..resilience.faultinject import INJECTOR
 
         br = self._breaker(dev)
@@ -174,10 +177,30 @@ class MeshManager:
             br.record_failure()
             return False
         br.record_success()
+        if getattr(br, "heal", None) is not None:
+            br.heal()  # readmit NOW, not after the open window
         return True
 
     def probe_all(self) -> list:
         return [d for d in self._devices if self.probe_device(d)]
+
+    def probe_open(self) -> int:
+        """Background-health pass: probe ONLY the chips whose breaker
+        is currently excluding them (open/half-open), so a recovered
+        chip rejoins the mesh before the next dispatch has to fail.
+        Healthy chips are never touched — the pass is free when the
+        mesh is whole. Returns how many chips were readmitted."""
+        healed = 0
+        for dev in self._devices:
+            if self._breaker(dev).state == "closed":
+                continue
+            if self.probe_device(dev):
+                healed += 1
+                log.info(
+                    "mesh device %s recovered; rejoining the mesh",
+                    getattr(dev, "id", dev),
+                )
+        return healed
 
     def dispatch(self, fn, real_lanes: Optional[int] = None):
         """Run ``fn(mesh)`` on the healthy mesh; on failure, probe the
@@ -222,3 +245,42 @@ class MeshManager:
             "healthy": len(self.healthy_devices()),
             "last_dispatch": self.last_dispatch,
         }
+
+
+class MeshProber:
+    """Background mesh health (config ``mesh.probe-interval-ms``): a
+    daemon thread that periodically runs ``MeshManager.probe_open``
+    so a recovered chip rejoins the serving mesh without waiting for
+    (a) the breaker's open window AND (b) the next dispatch — closing
+    the KNOWN_GAPS reactive-only degradation item. The probe is
+    blocking jax work, which is why this is a thread and not a loop
+    task; ``manager_fn`` re-resolves per tick because the dispatcher
+    (and its MeshManager) is built lazily on the first device batch."""
+
+    def __init__(self, manager_fn, interval_s: float):
+        self._manager_fn = manager_fn
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="mesh-prober", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                mgr = self._manager_fn()
+                if mgr is not None:
+                    mgr.probe_open()
+            except Exception:
+                log.exception("background mesh probe failed")

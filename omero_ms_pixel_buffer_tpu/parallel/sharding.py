@@ -181,6 +181,59 @@ def sharded_filter_deflate(
     )
 
 
+@partial(jax.jit, static_argnums=(0, 4, 5, 6, 7, 8, 9))
+def _sharded_render_filter_deflate(
+    mesh, planes, index_tables, color_luts, rows, row_bytes,
+    filter_mode, deflate_mode, packer, axis,
+):
+    from ..ops.device_deflate import _interpret_for
+    from ..render.engine import render_filter_deflate_local
+
+    interpret = _interpret_for(packer)
+    fn = shard_map(
+        lambda blk, tab, lut: render_filter_deflate_local(
+            blk, tab, lut, rows, row_bytes, filter_mode, deflate_mode,
+            packer, interpret,
+        ),
+        mesh=mesh,
+        in_specs=(P(axis), P(), P()),  # tables replicate to every chip
+        out_specs=(P(axis), P(axis)),
+    )
+    return fn(planes, index_tables, color_luts)
+
+
+def sharded_render_filter_deflate(
+    mesh: Mesh,
+    planes: jax.Array,
+    index_tables,
+    color_luts,
+    rows: int,
+    row_bytes: int,
+    filter_mode: str = "up",
+    deflate_mode: str = "rle",
+    packer: Optional[str] = None,
+    axis: str = "data",
+) -> tuple:
+    """The multi-chip RENDER dispatch: the fused composite + filter +
+    deflate chain (render/engine.render_filter_deflate_local) mapped
+    over the mesh — each chip renders and compresses its slice of the
+    lane batch, with the per-channel tables replicated over ICI. The
+    per-lane math is integer-only and chip-independent, so sharded
+    bytes are identical to single-device bytes on the same lanes.
+
+    planes (B, C, H, W) unsigned with B divisible by the mesh axis
+    (pad with ``pad_batch``) -> ((B, cap) uint8 streams, (B,) int32
+    lengths), both batch-sharded."""
+    from ..ops.device_deflate import default_packer
+
+    packer = packer or default_packer()
+    return _sharded_render_filter_deflate(
+        mesh, planes, jnp.asarray(index_tables),
+        jnp.asarray(color_luts), rows, row_bytes, filter_mode,
+        deflate_mode, packer, axis,
+    )
+
+
 def shard_batch(mesh: Mesh, tiles, axis: str = "data"):
     """Place a host batch onto the mesh with its batch dim sharded."""
     return jax.device_put(tiles, NamedSharding(mesh, P(axis)))

@@ -1,0 +1,33 @@
+"""TPU-native rendering engine.
+
+The ``/render`` serving surface: per-channel window/level, gamma and
+reverse-intensity quantization, LUT / solid-color application,
+additive multi-channel compositing, and intensity z-projection —
+OMERO's ``omero-ms-image-region`` rendering model rebuilt on the
+device encode chain, so a rendered multi-channel PNG tile is ONE fused
+device dispatch (render -> filter -> deflate) with a byte-identical
+host fallback.
+
+Modules:
+
+- ``model``      — ``RenderSpec``: canonical, hashable parse of the
+                   render query dialect (signature keys caches and
+                   batch buckets)
+- ``luts``       — built-in colormaps + the ImageJ ``.lut`` loader
+- ``engine``     — table builder + fused device program + host mirror
+- ``projection`` — on-device max/mean z-projection with an integer-
+                   identical host mirror
+"""
+
+from .engine import RenderError, build_tables
+from .luts import LutError, LutRegistry
+from .model import ChannelSpec, RenderSpec
+
+__all__ = [
+    "ChannelSpec",
+    "LutError",
+    "LutRegistry",
+    "RenderError",
+    "RenderSpec",
+    "build_tables",
+]

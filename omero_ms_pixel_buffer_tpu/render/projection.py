@@ -1,0 +1,62 @@
+"""Intensity z-projection — the ``p=intmax|intmean`` reduction.
+
+A projection collapses a z-range of planes into one before windowing:
+``intmax`` is the elementwise maximum, ``intmean`` the elementwise
+mean. Both are defined in INTEGER arithmetic (mean = floor(sum / n))
+so the device reduction, the host mirror, and the shard_map path
+produce identical pixels — the render engine's byte-identity contract
+starts here.
+
+The device form is one jitted reduction over the stacked planes (the
+kind of bandwidth-bound elementwise work the accelerator eats);
+the numpy mirror serves the host engine and any lane the device
+declines. ``project`` picks per call.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MODES = ("intmax", "intmean")
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _project_device(stack: jax.Array, mode: str) -> jax.Array:
+    """(..., Z, H, W) -> (..., H, W), native dtype preserved."""
+    if mode == "intmax":
+        return stack.max(axis=-3)
+    # intmean: int32 sums (Z * 65535 stays far from the int32 edge for
+    # any plausible stack depth) + floor division, matching the mirror
+    n = stack.shape[-3]
+    return (stack.astype(jnp.int32).sum(axis=-3) // n).astype(stack.dtype)
+
+
+def project_np(stack: np.ndarray, mode: str) -> np.ndarray:
+    """Host mirror: identical integer semantics."""
+    if mode not in MODES:
+        raise ValueError(f"Unknown projection mode: {mode}")
+    if mode == "intmax":
+        return stack.max(axis=-3)
+    n = stack.shape[-3]
+    return (
+        stack.astype(np.int64).sum(axis=-3) // n
+    ).astype(stack.dtype)
+
+
+def project(stack: np.ndarray, mode: str, device: bool = False) -> np.ndarray:
+    """Project a host-staged stack; ``device=True`` runs the jitted
+    reduction on the accelerator (pixels identical either way — the
+    choice is purely where the bandwidth is spent)."""
+    if mode not in MODES:
+        raise ValueError(f"Unknown projection mode: {mode}")
+    if stack.shape[-3] == 1:  # single plane: nothing to reduce
+        return np.ascontiguousarray(stack[..., 0, :, :])
+    if device:
+        out = _project_device(jnp.asarray(stack), mode)
+        # ompb-lint: disable=jax-hotpath -- the ONE intended pull: the projected plane returns once per lane
+        return np.asarray(out)
+    return project_np(stack, mode)

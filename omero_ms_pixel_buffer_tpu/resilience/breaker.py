@@ -218,6 +218,16 @@ class CircuitBreaker:
                 if rate >= self.failure_rate_threshold:
                     self._transition(OPEN)
 
+    def heal(self) -> None:
+        """Out-of-band recovery confirmation: an ACTIVE health probe
+        (not a gated call) verified the dependency answers, so close
+        immediately instead of waiting out the open window. Only
+        probers that genuinely exercised the dependency may call this
+        — it bypasses the half-open ramp by design (the probe IS the
+        half-open trial, just driven by a clock instead of traffic)."""
+        with self._lock:
+            self._transition(CLOSED)
+
     # -- conveniences --------------------------------------------------
 
     def call(self, fn, *args, **kwargs):
@@ -361,6 +371,9 @@ class NullBreaker:
         pass
 
     def record_failure(self) -> None:
+        pass
+
+    def heal(self) -> None:
         pass
 
     def call(self, fn, *args, **kwargs):

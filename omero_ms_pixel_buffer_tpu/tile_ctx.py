@@ -13,10 +13,13 @@ PixelBufferMicroserviceVerticle.java:349).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Mapping, Optional
+from typing import TYPE_CHECKING, Any, Mapping, Optional
 
 from .errors import BadRequestError
 from .resilience.deadline import Deadline
+
+if TYPE_CHECKING:  # pragma: no cover - import cycle guard (render -> errors)
+    from .render.model import RenderSpec
 
 
 @dataclasses.dataclass
@@ -54,6 +57,14 @@ def _optional_int(params: Mapping[str, Any], key: str, default=None):
         raise BadRequestError(f'For input string: "{value}"') from None
 
 
+def _render_from_json(obj: Any) -> Optional["RenderSpec"]:
+    if obj is None:
+        return None
+    from .render.model import RenderSpec  # deferred: avoids a cycle
+
+    return RenderSpec.from_json(obj)
+
+
 @dataclasses.dataclass
 class TileCtx:
     """Parsed /tile request (TileCtx.java:36-54,67-90)."""
@@ -71,6 +82,12 @@ class TileCtx:
     # every layer below decrements this one clock; None = unbounded
     # (tests and direct pipeline callers)
     deadline: Optional[Deadline] = None
+    # /render requests carry the parsed RenderSpec (render/model.py);
+    # None = a raw /tile request. The spec's signature() joins every
+    # key below so rendered tiles never alias raw tiles (and two specs
+    # never alias each other) in the cache, the single-flight registry,
+    # or the batcher's dedupe
+    render: Optional["RenderSpec"] = None
 
     @classmethod
     def from_params(
@@ -122,6 +139,9 @@ class TileCtx:
             "deadline": (
                 None if self.deadline is None else self.deadline.to_json()
             ),
+            "render": (
+                None if self.render is None else self.render.to_json()
+            ),
         }
 
     @classmethod
@@ -147,6 +167,7 @@ class TileCtx:
                 omero_session_key=obj.get("omeroSessionKey"),
                 trace_context=dict(obj.get("traceContext") or {}),
                 deadline=Deadline.from_json(obj.get("deadline")),
+                render=_render_from_json(obj.get("render")),
             )
         except BadRequestError:
             raise
@@ -165,14 +186,17 @@ class TileCtx:
     # tile cache separately (a documented, harmless split).
 
     def cache_key(self, quality: str = "") -> str:
-        """Canonical result-cache key:
-        (image, z, c, t, region, resolution, format, quality)."""
+        """Canonical result-cache key: (image, z, c, t, region,
+        resolution, format, quality[, render signature])."""
         r = self.region
-        return (
+        base = (
             f"img={self.image_id}|z={self.z}|c={self.c}|t={self.t}"
             f"|x={r.x}|y={r.y}|w={r.width}|h={r.height}"
             f"|res={self.resolution}|fmt={self.format}|q={quality}"
         )
+        if self.render is not None:
+            base += f"|render={self.render.signature()}"
+        return base
 
     def dedupe_key(self, quality: str = "") -> str:
         """Single-flight key: the content key scoped to the caller's
@@ -182,12 +206,16 @@ class TileCtx:
 
     def lane_key(self) -> tuple:
         """Hashable batch-dedupe key (dispatch/batcher): lanes equal
-        under it produce byte-identical tiles for the same caller."""
+        under it produce byte-identical tiles for the same caller.
+        The render signature joins it so the batcher buckets render
+        lanes by (shape, render-signature) and never collapses two
+        different renderings of one region."""
         r = self.region
         return (
             self.image_id, self.z, self.c, self.t,
             r.x, r.y, r.width, r.height,
             self.resolution, self.format, self.omero_session_key,
+            None if self.render is None else self.render.signature(),
         )
 
     def filename(self) -> str:

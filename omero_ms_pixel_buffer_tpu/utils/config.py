@@ -216,6 +216,29 @@ class CacheConfig:
 
 
 @dataclasses.dataclass
+class RenderConfig:
+    """The render: block — the /render serving surface (render/
+    package). ``lut_dir`` points at a directory of ImageJ ``.lut``
+    files loaded into the LUT registry at startup; ``jpeg_quality``
+    is the default when a request carries no ``q``."""
+
+    enabled: bool = True
+    lut_dir: Optional[str] = None
+    jpeg_quality: int = 90
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """The mesh: block — serving-mesh health. ``probe_interval_ms``
+    > 0 runs MeshManager's chip probe on a background cadence so a
+    recovered chip rejoins the mesh BEFORE the next dispatch failure
+    (the reactive-only degradation gap); 0 (default) keeps probing
+    purely reactive."""
+
+    probe_interval_ms: float = 0.0
+
+
+@dataclasses.dataclass
 class JaxConfig:
     """The jax: block — runtime knobs for the accelerator toolchain.
 
@@ -273,6 +296,8 @@ class Config:
         default_factory=ResilienceConfig
     )
     cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
+    render: RenderConfig = dataclasses.field(default_factory=RenderConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     jax: JaxConfig = dataclasses.field(default_factory=JaxConfig)
     logging: LoggingConfig = dataclasses.field(default_factory=LoggingConfig)
     # Filesystem image registry (stands in for the OMERO Postgres
@@ -440,6 +465,61 @@ class Config:
         )
 
     @staticmethod
+    def _parse_render(raw: dict) -> RenderConfig:
+        """Validate the render: block — same posture as the others:
+        typos and nonsense fail at startup, never silently default."""
+        rd = raw.get("render") or {}
+        unknown = set(rd) - {"enabled", "lut-dir", "jpeg-quality"}
+        if unknown:
+            raise ConfigError(
+                f"Unknown keys in 'render' block: {sorted(unknown)}"
+            )
+        lut_dir = rd.get("lut-dir")
+        if lut_dir is not None and (
+            not isinstance(lut_dir, str) or not lut_dir
+        ):
+            raise ConfigError(
+                f"Invalid value for 'render.lut-dir': {lut_dir!r} "
+                "(expected a non-empty path)"
+            )
+        try:
+            quality = int(rd.get("jpeg-quality", 90))
+        except (TypeError, ValueError):
+            raise ConfigError(
+                "Invalid value for 'render.jpeg-quality': "
+                f"{rd.get('jpeg-quality')!r}"
+            ) from None
+        if not 1 <= quality <= 100:
+            raise ConfigError(
+                "'render.jpeg-quality' must be in [1, 100]"
+            )
+        return RenderConfig(
+            enabled=bool(rd.get("enabled", True)),
+            lut_dir=lut_dir,
+            jpeg_quality=quality,
+        )
+
+    @staticmethod
+    def _parse_mesh(raw: dict) -> MeshConfig:
+        """Validate the mesh: block."""
+        ms = raw.get("mesh") or {}
+        unknown = set(ms) - {"probe-interval-ms"}
+        if unknown:
+            raise ConfigError(
+                f"Unknown keys in 'mesh' block: {sorted(unknown)}"
+            )
+        try:
+            interval = float(ms.get("probe-interval-ms", 0.0))
+        except (TypeError, ValueError):
+            raise ConfigError(
+                "Invalid value for 'mesh.probe-interval-ms': "
+                f"{ms.get('probe-interval-ms')!r}"
+            ) from None
+        if interval < 0:
+            raise ConfigError("'mesh.probe-interval-ms' must be >= 0")
+        return MeshConfig(probe_interval_ms=interval)
+
+    @staticmethod
     def _parse_jax(raw: dict) -> JaxConfig:
         """Validate the jax: block — same posture as resilience/cache:
         typos and nonsense fail at startup, never silently default."""
@@ -545,6 +625,8 @@ class Config:
             backend=backend,
             resilience=cls._parse_resilience(raw),
             cache=cls._parse_cache(raw),
+            render=cls._parse_render(raw),
+            mesh=cls._parse_mesh(raw),
             jax=cls._parse_jax(raw),
             logging=LoggingConfig(
                 file=log_raw.get("file"),

@@ -120,3 +120,137 @@ class TestMeshServing:
         out_multi = multi.handle_batch(list(ctxs))
         out_single = single.handle_batch(list(ctxs))
         assert out_multi == out_single
+
+
+# ---------------------------------------------------------------------------
+# background mesh health probe (config mesh.probe-interval-ms)
+# ---------------------------------------------------------------------------
+
+class TestBackgroundMeshProbe:
+    """A recovered chip must rejoin the mesh BEFORE the next dispatch
+    has to fail — probe_open/MeshProber close the reactive-only
+    degradation gap."""
+
+    def _run(self, mesh):
+        import jax
+        import jax.numpy as jnp
+
+        from omero_ms_pixel_buffer_tpu.parallel.sharding import (
+            shard_batch,
+        )
+
+        n = mesh.shape["data"]
+        x = jnp.arange(n * 4, dtype=jnp.int32).reshape(n, 4)
+        return jax.block_until_ready(shard_batch(mesh, x) + 1)
+
+    @pytest.mark.resilience
+    def test_recovered_chip_rejoins_without_a_failed_batch(self):
+        import jax
+
+        from omero_ms_pixel_buffer_tpu.parallel.mesh import MeshManager
+        from omero_ms_pixel_buffer_tpu.resilience.breaker import BOARD
+        from omero_ms_pixel_buffer_tpu.resilience.faultinject import (
+            INJECTOR,
+            first_n,
+        )
+
+        devices = jax.devices()
+        assert len(devices) == 8
+        sick = devices[3]
+        INJECTOR.clear()
+        try:
+            # one dispatch failure triggers the reactive probe; the
+            # sick chip fails exactly that one probe, then heals
+            INJECTOR.install(
+                "device.mesh-dispatch",
+                first_n(1, RuntimeError("ICI wedged")),
+            )
+            INJECTOR.install(
+                f"device.chip:{sick.id}",
+                first_n(1, RuntimeError("chip down")),
+            )
+            mgr = MeshManager(devices=devices)
+            mgr.dispatch(self._run)  # degrades to the 7 survivors
+            assert mgr.last_dispatch["n_devices"] == 7
+            assert mgr.mesh().devices.size == 7
+
+            # the background pass probes ONLY the excluded chip,
+            # which now answers -> breaker heals -> full width again,
+            # and no serving batch ever saw the recovery
+            healed = mgr.probe_open()
+            assert healed == 1
+            assert mgr.mesh().devices.size == 8
+            mgr.dispatch(self._run)
+            assert mgr.last_dispatch["n_devices"] == 8
+        finally:
+            INJECTOR.clear()
+            BOARD.reset()
+
+    @pytest.mark.resilience
+    def test_probe_open_skips_healthy_chips(self):
+        import jax
+
+        from omero_ms_pixel_buffer_tpu.parallel.mesh import MeshManager
+        from omero_ms_pixel_buffer_tpu.resilience.breaker import BOARD
+        from omero_ms_pixel_buffer_tpu.resilience.faultinject import (
+            INJECTOR,
+        )
+
+        INJECTOR.clear()
+        try:
+            mgr = MeshManager(devices=jax.devices())
+            assert mgr.probe_open() == 0  # whole mesh: free no-op
+            for dev in mgr._devices:
+                assert INJECTOR.calls(
+                    f"device.chip:{dev.id}"
+                ) == 0  # no probe traffic touched healthy chips
+        finally:
+            INJECTOR.clear()
+            BOARD.reset()
+
+    @pytest.mark.resilience
+    def test_prober_thread_restores_width(self):
+        import time
+
+        import jax
+
+        from omero_ms_pixel_buffer_tpu.parallel.mesh import (
+            MeshManager,
+            MeshProber,
+        )
+        from omero_ms_pixel_buffer_tpu.resilience.breaker import BOARD
+        from omero_ms_pixel_buffer_tpu.resilience.faultinject import (
+            INJECTOR,
+            first_n,
+        )
+
+        devices = jax.devices()
+        sick = devices[5]
+        INJECTOR.clear()
+        try:
+            INJECTOR.install(
+                "device.mesh-dispatch", first_n(1, RuntimeError("down"))
+            )
+            INJECTOR.install(
+                f"device.chip:{sick.id}",
+                first_n(1, RuntimeError("down")),
+            )
+            mgr = MeshManager(devices=devices)
+            mgr.dispatch(self._run)
+            assert mgr.mesh().devices.size == 7
+            prober = MeshProber(lambda: mgr, interval_s=0.02)
+            prober.start()
+            try:
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline:
+                    if len(mgr.healthy_devices()) == 8:
+                        break
+                    time.sleep(0.02)
+                assert len(mgr.healthy_devices()) == 8
+            finally:
+                prober.stop()
+            mgr.dispatch(self._run)
+            assert mgr.last_dispatch["n_devices"] == 8
+        finally:
+            INJECTOR.clear()
+            BOARD.reset()

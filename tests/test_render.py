@@ -1,0 +1,910 @@
+"""Rendering engine suite (render/ package + the /render surface).
+
+Covers: RenderSpec parsing (incl. malformed params -> 400 over HTTP),
+LUT registry + ImageJ .lut round-trips, the engine against an
+INDEPENDENT per-pixel float reference across a (window, gamma,
+reverse, model) grid, z-projection correctness, the byte-identity
+contract (fused device chain == host mirror == 8-way CPU-mesh
+shard_map, and the numpy RLE stream == the device stream), cache-key
+isolation between specs, and — under ``-m resilience`` — the
+``render.engine`` chaos lane proving the host fallback serves
+byte-identical tiles.
+"""
+
+import asyncio
+import io
+import os
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+from PIL import Image
+
+from omero_ms_pixel_buffer_tpu.auth.stores import MemorySessionStore
+from omero_ms_pixel_buffer_tpu.errors import BadRequestError
+from omero_ms_pixel_buffer_tpu.http.server import PixelBufferApp
+from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
+from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+    ImageRegistry,
+    PixelsService,
+)
+from omero_ms_pixel_buffer_tpu.models.tile_pipeline import TilePipeline
+from omero_ms_pixel_buffer_tpu.ops.device_deflate import (
+    zlib_rle_batch,
+    zlib_rle_np,
+)
+from omero_ms_pixel_buffer_tpu.ops.png import decode_png, frame_png
+from omero_ms_pixel_buffer_tpu.render import engine as rengine
+from omero_ms_pixel_buffer_tpu.render import projection
+from omero_ms_pixel_buffer_tpu.render.luts import (
+    LutRegistry,
+    builtin_luts,
+    load_imagej_lut,
+    write_imagej_lut,
+)
+from omero_ms_pixel_buffer_tpu.render.model import RenderSpec
+from omero_ms_pixel_buffer_tpu.resilience.breaker import BOARD
+from omero_ms_pixel_buffer_tpu.resilience.faultinject import (
+    INJECTOR,
+    always,
+)
+from omero_ms_pixel_buffer_tpu.tile_ctx import RegionDef, TileCtx
+from omero_ms_pixel_buffer_tpu.utils.config import Config, ConfigError
+
+rng = np.random.default_rng(17)
+AUTH = {"Cookie": "sessionid=ck"}
+
+# (T, C, Z, Y, X) multi-channel fixture shared by the pipeline/HTTP
+# tests (written per-test-dir by _write_fixture)
+IMG = rng.integers(0, 4096, (1, 3, 4, 96, 128), dtype=np.uint16)
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    INJECTOR.clear()
+    yield
+    INJECTOR.clear()
+    BOARD.reset()
+
+
+def _write_fixture(tmp_path):
+    path = str(tmp_path / "img.ome.tiff")
+    write_ome_tiff(path, IMG, tile_size=(64, 64))
+    registry = ImageRegistry()
+    registry.add(1, path)
+    return registry
+
+
+def _ctx(spec, z=0, c=0, t=0, x=0, y=0, w=64, h=48, session="k"):
+    return TileCtx(
+        image_id=1, z=z, c=c, t=t, region=RegionDef(x, y, w, h),
+        format=spec.format, omero_session_key=session, render=spec,
+    )
+
+
+# ---------------------------------------------------------------------------
+# RenderSpec parsing
+# ---------------------------------------------------------------------------
+
+
+class TestRenderSpecParsing:
+    def test_full_channel_dialect(self):
+        spec = RenderSpec.from_params({
+            "c": "1|100:600$FF0000,-2,3|0:4095$00FF00",
+            "m": "c",
+        })
+        assert [ch.index for ch in spec.channels] == [0, 2]
+        assert spec.channels[0].window == (100.0, 600.0)
+        assert spec.channels[0].color == "FF0000"
+        assert spec.channels[1].color == "00FF00"
+        assert spec.model == "c" and spec.format == "png"
+
+    def test_lut_suffix_and_negative_window(self):
+        spec = RenderSpec.from_params({"c": "1|-100:200$fire"})
+        assert spec.channels[0].lut == "fire"
+        assert spec.channels[0].window == (-100.0, 200.0)
+
+    def test_eight_digit_hex_is_color_not_lut(self):
+        spec = RenderSpec.from_params({"c": "1$FF0000AA"})
+        assert spec.channels[0].color == "FF0000"
+        assert spec.channels[0].lut is None
+
+    def test_maps_reverse_and_gamma(self):
+        spec = RenderSpec.from_params({
+            "c": "1,2",
+            "maps": '[{"reverse": {"enabled": true}},'
+                    ' {"quantization": {"family": "exponential",'
+                    ' "coefficient": 1.5}}]',
+        })
+        assert spec.channels[0].reverse is True
+        assert spec.channels[1].family == "exponential"
+        assert spec.channels[1].coefficient == 1.5
+
+    def test_defaults_from_path_channel(self):
+        spec = RenderSpec.from_params({}, default_channel=2)
+        assert [ch.index for ch in spec.channels] == [2]
+        assert spec.channels[0].window is None
+
+    def test_projection_parse(self):
+        spec = RenderSpec.from_params({"p": "intmax|2:5"})
+        assert (spec.projection, spec.proj_start, spec.proj_end) == (
+            "intmax", 2, 5
+        )
+        spec2 = RenderSpec.from_params({"p": "intmean"})
+        assert spec2.projection == "intmean"
+        assert spec2.proj_start is None and spec2.proj_end is None
+
+    def test_quality_and_format(self):
+        spec = RenderSpec.from_params({"format": "jpg", "q": "0.75"})
+        assert spec.format == "jpeg" and spec.quality == 75
+
+    def test_signature_canonical_under_channel_order(self):
+        a = RenderSpec.from_params({"c": "2|0:10$00FF00,1|0:20$FF0000"})
+        b = RenderSpec.from_params({"c": "1|0:20$FF0000,2|0:10$00FF00"})
+        assert a.signature() == b.signature()
+
+    def test_signature_distinguishes_specs(self):
+        base = RenderSpec.from_params({"c": "1|0:255$FF0000"})
+        for other_params in (
+            {"c": "1|0:254$FF0000"},
+            {"c": "1|0:255$FF0001"},
+            {"c": "1|0:255$FF0000", "m": "g"},
+            {"c": "1|0:255$FF0000", "p": "intmax"},
+            {"c": "1|0:255$FF0000",
+             "maps": '[{"reverse": {"enabled": true}}]'},
+        ):
+            assert base.signature() != RenderSpec.from_params(
+                other_params
+            ).signature()
+
+    def test_json_round_trip(self):
+        spec = RenderSpec.from_params({
+            "c": "1|5:99$cool-lut,3|0:10$0000FF",
+            "m": "g", "p": "intmean|0:2", "format": "jpeg", "q": "0.5",
+            "maps": '[{"reverse": {"enabled": true}}]',
+        })
+        again = RenderSpec.from_json(spec.to_json())
+        assert again == spec
+        assert again.signature() == spec.signature()
+
+    @pytest.mark.parametrize("params", [
+        {"c": "xx"},
+        {"c": "0"},  # 1-based dialect: 0 is malformed
+        {"c": "1|9:1"},  # min >= max
+        {"c": "1,1"},  # duplicate
+        {"c": "1", "maps": "{not json"},
+        {"c": "1", "maps": '[{"quantization": {"family": "poly"}}]'},
+        {"c": "1", "maps":
+         '[{"quantization": {"coefficient": -1}}]'},
+        {"m": "z"},
+        {"p": "wat"},
+        {"p": "intmax|5:2"},
+        {"q": "2"},
+        {"q": "0"},
+        {"format": "bmp"},
+        {"c": "-1,-2"},  # nothing active
+    ])
+    def test_malformed_raises_bad_request(self, params):
+        with pytest.raises(BadRequestError):
+            RenderSpec.from_params(params)
+
+    def test_resolve_channels_validates_size_c(self):
+        spec = RenderSpec.from_params({"c": "1,4"})
+        with pytest.raises(ValueError):
+            spec.resolve_channels(3)
+        assert len(spec.resolve_channels(4)) == 2
+
+    def test_z_range(self):
+        spec = RenderSpec.from_params({"p": "intmax|1:9"})
+        assert spec.z_range(0, 4) == [1, 2, 3]  # clipped to the stack
+        plain = RenderSpec.from_params({})
+        assert plain.z_range(2, 4) == [2]
+
+
+# ---------------------------------------------------------------------------
+# LUTs
+# ---------------------------------------------------------------------------
+
+
+class TestLuts:
+    def test_builtins_present(self):
+        reg = LutRegistry()
+        for name in ("grey", "red", "green", "blue", "fire", "ice",
+                     "spectrum"):
+            assert name in reg
+            assert reg.get(name).shape == (256, 3)
+
+    def test_grey_is_identity_ramp(self):
+        grey = builtin_luts()["grey"]
+        np.testing.assert_array_equal(
+            grey, np.stack([np.arange(256)] * 3, axis=1)
+        )
+
+    def test_lut_file_round_trip(self, tmp_path):
+        table = rng.integers(0, 256, (256, 3), dtype=np.uint8)
+        path = str(tmp_path / "custom.lut")
+        write_imagej_lut(path, table)
+        np.testing.assert_array_equal(load_imagej_lut(path), table)
+
+    def test_icol_header_variant(self, tmp_path):
+        table = rng.integers(0, 256, (256, 3), dtype=np.uint8)
+        path = str(tmp_path / "nih.lut")
+        with open(path, "wb") as f:
+            f.write(b"ICOL" + bytes(28) + table.T.tobytes())
+        np.testing.assert_array_equal(load_imagej_lut(path), table)
+
+    def test_registry_loads_dir_case_insensitive(self, tmp_path):
+        table = rng.integers(0, 256, (256, 3), dtype=np.uint8)
+        write_imagej_lut(str(tmp_path / "Cool.lut"), table)
+        with open(tmp_path / "bad.lut", "wb") as f:
+            f.write(b"short")  # must be skipped, not fatal
+        reg = LutRegistry(str(tmp_path))
+        assert "cool" in reg and "COOL.lut" in reg
+        np.testing.assert_array_equal(reg.get("cool.lut"), table)
+        assert "bad" not in reg
+
+
+# ---------------------------------------------------------------------------
+# Engine vs an independent per-pixel float reference
+# ---------------------------------------------------------------------------
+
+
+def _reference_render(stack, specs, model="c"):
+    """Straight per-pixel float implementation of the rendering model
+    (window -> reverse -> gamma -> 8-bit level -> color ramp ->
+    additive composite), independent of the engine's table approach.
+    ``specs``: [(lo, hi, (r, g, b), reverse, gamma), ...]."""
+    chans = range(1 if model == "g" else len(specs))
+    out = np.zeros(stack.shape[1:] + (3,), np.int64)
+    for pos in chans:
+        lo, hi, color, reverse, gamma = specs[pos]
+        x = np.clip(
+            (stack[pos].astype(np.float64) - lo) / (hi - lo), 0.0, 1.0
+        )
+        if reverse:
+            x = 1.0 - x
+        if gamma != 1.0:
+            x = np.power(x, gamma)
+        level = np.floor(x * 255.0 + 0.5).astype(np.int64)
+        col = (255, 255, 255) if model == "g" else color
+        for k in range(3):
+            out[..., k] += np.floor(
+                level * col[k] / 255.0 + 0.5
+            ).astype(np.int64)
+    return np.minimum(out, 255).astype(np.uint8)
+
+
+_GRID = [
+    # (dtype, window, reverse, gamma, model)
+    (np.uint8, (0, 255), False, 1.0, "c"),
+    (np.uint8, (10, 200), False, 1.0, "c"),
+    (np.uint8, (10, 200), True, 1.0, "c"),
+    (np.uint8, (0, 255), False, 2.2, "c"),
+    (np.uint16, (100, 4000), False, 1.0, "c"),
+    (np.uint16, (100, 4000), True, 0.5, "c"),
+    (np.uint16, (0, 65535), False, 1.0, "g"),
+    (np.int16, (-500, 500), False, 1.0, "c"),
+    (np.int16, (-500, 500), True, 1.5, "g"),
+]
+
+
+class TestEngineVsReference:
+    @pytest.mark.parametrize("dtype,window,reverse,gamma,model", _GRID)
+    def test_host_and_device_match_reference(
+        self, dtype, window, reverse, gamma, model
+    ):
+        dtype = np.dtype(dtype)
+        info = np.iinfo(dtype)
+        stack = rng.integers(
+            info.min, info.max + 1, (2, 24, 32), dtype=dtype
+        )
+        colors = [(255, 0, 0), (0, 255, 0)]
+        maps = []
+        for _ in range(2):
+            entry = {}
+            if reverse:
+                entry["reverse"] = {"enabled": True}
+            if gamma != 1.0:
+                entry["quantization"] = {
+                    "family": "exponential", "coefficient": gamma,
+                }
+            maps.append(entry)
+        import json
+
+        spec = RenderSpec.from_params({
+            "c": f"1|{window[0]}:{window[1]}$FF0000,"
+                 f"2|{window[0]}:{window[1]}$00FF00",
+            "m": model,
+            "maps": json.dumps(maps),
+        })
+        tables, luts = rengine.build_tables(spec, dtype, LutRegistry())
+        ref = _reference_render(
+            stack,
+            [(window[0], window[1], colors[i], reverse, gamma)
+             for i in range(2)],
+            model=model,
+        )
+        stack_u = rengine.unsigned_view(stack)
+        host = rengine.render_host(stack_u, tables, luts)
+        np.testing.assert_array_equal(host, ref)
+        device = np.asarray(
+            rengine.render_batch(stack_u[None], tables, luts)
+        )[0]
+        np.testing.assert_array_equal(device, ref)
+
+    def test_named_lut_applies(self):
+        spec = RenderSpec.from_params({"c": "1|0:255$fire"})
+        tables, luts = rengine.build_tables(
+            spec, np.dtype(np.uint8), LutRegistry()
+        )
+        fire = builtin_luts()["fire"]
+        stack = np.arange(256, dtype=np.uint8).reshape(1, 16, 16)
+        out = rengine.render_host(stack, tables, luts)
+        np.testing.assert_array_equal(
+            out, fire[np.arange(256)].reshape(16, 16, 3)
+        )
+
+    def test_unrenderable_dtypes_rejected(self):
+        spec = RenderSpec.from_params({"c": "1"})
+        for dtype in (np.float32, np.uint32, np.int32):
+            with pytest.raises(rengine.RenderError):
+                rengine.build_tables(spec, np.dtype(dtype), LutRegistry())
+
+    def test_unknown_lut_raises(self):
+        spec = RenderSpec.from_params({"c": "1$nosuch"})
+        with pytest.raises(rengine.RenderError):
+            rengine.build_tables(
+                spec, np.dtype(np.uint8), LutRegistry()
+            )
+
+
+# ---------------------------------------------------------------------------
+# Projection
+# ---------------------------------------------------------------------------
+
+
+class TestProjection:
+    @pytest.mark.parametrize("dtype", [np.uint8, np.uint16, np.int16])
+    @pytest.mark.parametrize("mode", ["intmax", "intmean"])
+    def test_device_matches_host_matches_numpy(self, dtype, mode):
+        dtype = np.dtype(dtype)
+        info = np.iinfo(dtype)
+        stack = rng.integers(
+            info.min, info.max + 1, (2, 5, 12, 16), dtype=dtype
+        )
+        host = projection.project(stack, mode, device=False)
+        device = projection.project(stack, mode, device=True)
+        np.testing.assert_array_equal(host, device)
+        if mode == "intmax":
+            np.testing.assert_array_equal(host, stack.max(axis=-3))
+        else:
+            np.testing.assert_array_equal(
+                host,
+                (stack.astype(np.int64).sum(axis=-3) // 5).astype(dtype),
+            )
+        assert host.dtype == dtype
+
+    def test_single_plane_passthrough(self):
+        stack = rng.integers(0, 255, (1, 1, 8, 8), dtype=np.uint8)
+        np.testing.assert_array_equal(
+            projection.project(stack, "intmean"), stack[:, 0]
+        )
+
+    def test_unknown_mode_rejected(self):
+        with pytest.raises(ValueError):
+            projection.project(
+                np.zeros((1, 2, 4, 4), np.uint8), "sum"
+            )
+
+
+# ---------------------------------------------------------------------------
+# Byte pinning: device chain == host mirror == shard_map
+# ---------------------------------------------------------------------------
+
+
+class TestBytePinning:
+    def test_numpy_stream_matches_device_stream(self):
+        payloads = [
+            np.zeros(400, np.uint8),
+            rng.integers(0, 256, 513, dtype=np.uint8),
+            np.repeat(
+                rng.integers(0, 256, 7, dtype=np.uint8),
+                rng.integers(1, 700, 7),
+            ),
+        ]
+        for p in payloads:
+            streams, lengths = zlib_rle_batch(p[None])
+            dev = bytes(np.asarray(streams[0][: int(lengths[0])]))
+            assert zlib_rle_np(p) == dev
+
+    def test_fused_device_chain_matches_host_mirror(self):
+        spec = RenderSpec.from_params(
+            {"c": "1|0:4095$FF0000,2|0:4095$00FF00"}
+        )
+        tables, luts = rengine.build_tables(
+            spec, np.dtype(np.uint16), LutRegistry()
+        )
+        planes = rng.integers(0, 4096, (3, 2, 24, 32), dtype=np.uint16)
+        streams, lengths = rengine.fused_render_filter_deflate_batch(
+            planes, tables, luts, 24, 1 + 32 * 3
+        )
+        for lane in range(3):
+            dev_png = frame_png(
+                bytes(np.asarray(streams[lane][: int(lengths[lane])])),
+                32, 24, 8, 2,
+            )
+            host_png = rengine.render_png_host(
+                planes[lane], tables, luts
+            )
+            assert dev_png == host_png
+            np.testing.assert_array_equal(
+                decode_png(dev_png),
+                rengine.render_host(planes[lane], tables, luts),
+            )
+
+    def test_bucket_padding_never_leaks_into_real_bytes(self):
+        spec = RenderSpec.from_params(
+            {"c": "1|0:255$FF0000",
+             "maps": '[{"reverse": {"enabled": true}}]'}
+        )  # reverse: padded zeros render to 255 — the worst case
+        tables, luts = rengine.build_tables(
+            spec, np.dtype(np.uint8), LutRegistry()
+        )
+        plane = rng.integers(0, 256, (1, 1, 20, 28), dtype=np.uint8)
+        padded = np.zeros((1, 1, 64, 64), np.uint8)
+        padded[:, :, :20, :28] = plane
+        s1, l1 = rengine.fused_render_filter_deflate_batch(
+            padded, tables, luts, 20, 1 + 28 * 3
+        )
+        host = rengine.render_png_host(plane[0], tables, luts)
+        assert frame_png(
+            bytes(np.asarray(s1[0][: int(l1[0])])), 28, 20, 8, 2
+        ) == host
+
+    def test_eight_way_mesh_bytes_identical(self):
+        import jax
+
+        from omero_ms_pixel_buffer_tpu.parallel.mesh import make_mesh
+        from omero_ms_pixel_buffer_tpu.parallel.sharding import (
+            shard_batch,
+            sharded_render_filter_deflate,
+        )
+
+        assert len(jax.devices()) == 8
+        mesh = make_mesh(("data",))
+        spec = RenderSpec.from_params(
+            {"c": "1|50:3000$FF00FF,2|0:4095$ice"}
+        )
+        tables, luts = rengine.build_tables(
+            spec, np.dtype(np.uint16), LutRegistry()
+        )
+        planes = rng.integers(0, 4096, (8, 2, 16, 24), dtype=np.uint16)
+        single_s, single_l = rengine.fused_render_filter_deflate_batch(
+            planes, tables, luts, 16, 1 + 24 * 3
+        )
+        import jax.numpy as jnp
+
+        sharded = shard_batch(mesh, jnp.asarray(planes))
+        mesh_s, mesh_l = sharded_render_filter_deflate(
+            mesh, sharded, tables, luts, 16, 1 + 24 * 3
+        )
+        np.testing.assert_array_equal(
+            np.asarray(single_l), np.asarray(mesh_l)
+        )
+        for lane in range(8):
+            n = int(single_l[lane])
+            assert bytes(np.asarray(mesh_s[lane][:n])) == bytes(
+                np.asarray(single_s[lane][:n])
+            )
+
+
+# ---------------------------------------------------------------------------
+# Pipeline integration: device dispatch vs host engine, projection,
+# chaos fallback
+# ---------------------------------------------------------------------------
+
+
+class TestPipelineRender:
+    @pytest.fixture
+    def service(self, tmp_path):
+        svc = PixelsService(_write_fixture(tmp_path))
+        yield svc
+        svc.close()
+
+    def _spec(self):
+        return RenderSpec.from_params(
+            {"c": "1|0:4095$FF0000,2|0:4095$00FF00"}
+        )
+
+    def test_device_pipeline_matches_host_pipeline_bytes(self, service):
+        spec = self._spec()
+        host_pipe = TilePipeline(service, engine="host")
+        dev_pipe = TilePipeline(
+            service, engine="device", device_deflate=True
+        )
+        dev_pipe.mesh = None
+        try:
+            host_png = host_pipe.handle(_ctx(spec, z=1, x=8, y=4))
+            dev_png = dev_pipe.handle_batch([_ctx(spec, z=1, x=8, y=4)])[0]
+            assert host_png is not None and host_png == dev_png
+            decoded = decode_png(host_png)
+            tables, luts = rengine.build_tables(
+                spec, np.dtype(np.uint16), LutRegistry()
+            )
+            ref = rengine.render_host(
+                np.stack([
+                    IMG[0, 0, 1, 4:52, 8:72], IMG[0, 1, 1, 4:52, 8:72]
+                ]),
+                tables, luts,
+            )
+            np.testing.assert_array_equal(decoded, ref)
+        finally:
+            host_pipe.close()
+            dev_pipe.close()
+
+    def test_mesh_pipeline_matches_host_bytes(self, service):
+        """The 8-way CPU-mesh shard_map path through the FULL pipeline
+        (dispatcher mesh lane) pins byte-identical to the host
+        engine."""
+        spec = self._spec()
+        host_pipe = TilePipeline(service, engine="host")
+        mesh_pipe = TilePipeline(
+            service, engine="device", device_deflate=True
+        )
+        try:
+            ctxs = [
+                _ctx(spec, z=z, x=8 * z, y=4, session="k")
+                for z in range(4)
+            ]
+            mesh_out = mesh_pipe.handle_batch(ctxs)
+            assert mesh_pipe.last_mesh_dispatch is not None
+            assert mesh_pipe.last_mesh_dispatch["executed"]
+            host_out = [
+                host_pipe.handle(_ctx(spec, z=z, x=8 * z, y=4))
+                for z in range(4)
+            ]
+            assert mesh_out == host_out
+        finally:
+            host_pipe.close()
+            mesh_pipe.close()
+
+    def test_projection_through_pipeline(self, service):
+        spec = RenderSpec.from_params(
+            {"c": "1|0:4095$FF0000", "p": "intmax|0:3"}
+        )
+        pipe = TilePipeline(service, engine="host")
+        try:
+            png = pipe.handle(_ctx(spec, z=0, w=64, h=48))
+            assert png is not None
+            decoded = decode_png(png)
+            tables, luts = rengine.build_tables(
+                spec, np.dtype(np.uint16), LutRegistry()
+            )
+            projected = IMG[0, 0, :, :48, :64].max(axis=0)
+            ref = rengine.render_host(projected[None], tables, luts)
+            np.testing.assert_array_equal(decoded, ref)
+        finally:
+            pipe.close()
+
+    def test_channel_out_of_range_is_none(self, service):
+        spec = RenderSpec.from_params({"c": "7"})
+        pipe = TilePipeline(service, engine="host")
+        try:
+            assert pipe.handle(_ctx(spec)) is None  # -> 404
+        finally:
+            pipe.close()
+
+    def test_jpeg_lane(self, service):
+        spec = RenderSpec.from_params(
+            {"c": "1|0:4095$FF0000", "format": "jpeg", "q": "0.9"}
+        )
+        pipe = TilePipeline(service, engine="host")
+        try:
+            body = pipe.handle(_ctx(spec))
+            assert body is not None and body[:2] == b"\xff\xd8"
+            img = np.array(Image.open(io.BytesIO(body)))
+            assert img.shape == (48, 64, 3)
+        finally:
+            pipe.close()
+
+    def test_plane_cache_never_claims_render_lanes(self, service):
+        """Regression: with the HBM plane path active (device engine,
+        single chip, bucket-fitting region) a render lane must NOT be
+        staged as a raw plane lane — a degraded spec must answer None
+        (404), never a stale raw-tile PNG, and a good lane must carry
+        RENDERED bytes."""
+        pipe = TilePipeline(
+            service, engine="device", buckets=(64,),
+            use_plane_cache=True, device_deflate=False,
+        )
+        pipe.mesh = None
+        host_pipe = TilePipeline(service, engine="host")
+        try:
+            bad = RenderSpec.from_params({"c": "7"})  # SizeC is 3
+            good = self._spec()
+            out = pipe.handle_batch([
+                _ctx(bad, x=0, y=0, w=64, h=32),
+                _ctx(good, x=0, y=0, w=64, h=32),
+            ])
+            assert out[0] is None  # -> 404, not raw bytes
+            assert out[1] == host_pipe.handle(
+                _ctx(good, x=0, y=0, w=64, h=32)
+            )
+        finally:
+            pipe.close()
+            host_pipe.close()
+
+    def test_prefetch_predictions_carry_render_spec(self):
+        """Regression: a /render pan warms RENDER cache keys (the
+        spec rides every prediction), and its motion stream never
+        mixes with a raw /tile stream over the same plane."""
+        from omero_ms_pixel_buffer_tpu.cache.prefetch import (
+            ViewportPrefetcher,
+        )
+
+        enqueued = []
+
+        class _Admission:
+            def has_headroom(self, fraction=0.5):
+                return True
+
+        pre = ViewportPrefetcher(
+            lambda ctx, key: None, cache=None, admission=_Admission(),
+            lookahead=1,
+        )
+        spec = self._spec()
+        pre._enqueue = lambda origin, region, res: enqueued.append(
+            (origin.render, region)
+        )
+        pre.observe(_ctx(spec, x=0, y=0, w=64, h=48))
+        pre.observe(_ctx(spec, x=64, y=0, w=64, h=48))
+        assert enqueued and all(r is spec for r, _ in enqueued)
+        # and for real (no stubbed _enqueue): keys carry the signature
+        pre2 = ViewportPrefetcher(
+            lambda ctx, key: None, cache=None, admission=_Admission(),
+            lookahead=1,
+        )
+        pre2.observe(_ctx(spec, x=0, y=0, w=64, h=48))
+        pre2.observe(_ctx(spec, x=64, y=0, w=64, h=48))
+        keys = [key for _, key in pre2._queue._queue]
+        assert keys and all("render=" in key for key in keys)
+
+    @pytest.mark.resilience
+    def test_render_engine_fault_falls_back_byte_identical(self, service):
+        """The chaos lane: render.engine down -> every lane serves
+        from the host mirror, byte-identical to the device bytes."""
+        spec = self._spec()
+        pipe = TilePipeline(
+            service, engine="device", device_deflate=True
+        )
+        pipe.mesh = None
+        try:
+            clean = pipe.handle_batch([_ctx(spec, z=2)])[0]
+            assert clean is not None
+            INJECTOR.install(
+                "render.engine", always(RuntimeError("engine down"))
+            )
+            faulted = pipe.handle_batch([_ctx(spec, z=2)])[0]
+            assert faulted == clean
+            assert INJECTOR.calls("render.engine") >= 1
+        finally:
+            pipe.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP: /render end to end
+# ---------------------------------------------------------------------------
+
+
+async def _make_app(tmp_path, config_extra=None):
+    registry = _write_fixture(tmp_path)
+    raw = {
+        "session-store": {"type": "memory"},
+        "backend": {"batching": {"coalesce-window-ms": 1.0}},
+    }
+    if config_extra:
+        raw.update(config_extra)
+    config = Config.from_dict(raw)
+    app_obj = PixelBufferApp(
+        config,
+        pixels_service=PixelsService(registry),
+        session_store=MemorySessionStore({"ck": "omero-key-1"}),
+    )
+    client = TestClient(
+        TestServer(app_obj.make_app()), loop=asyncio.get_running_loop()
+    )
+    await client.start_server()
+    return app_obj, client
+
+
+class TestRenderHttp:
+    async def test_end_to_end_rendered_png(self, tmp_path, loop):
+        app_obj, client = await _make_app(tmp_path)
+        try:
+            r = await client.get(
+                "/render/1/1/0/0?c=1|0:4095$FF0000,2|0:4095$00FF00"
+                "&w=64&h=48", headers=AUTH,
+            )
+            assert r.status == 200
+            assert r.headers["Content-Type"] == "image/png"
+            assert "ETag" in r.headers
+            body = await r.read()
+            decoded = np.array(Image.open(io.BytesIO(body)))
+            spec = RenderSpec.from_params(
+                {"c": "1|0:4095$FF0000,2|0:4095$00FF00"}
+            )
+            tables, luts = rengine.build_tables(
+                spec, np.dtype(np.uint16), LutRegistry()
+            )
+            ref = rengine.render_host(
+                np.stack([IMG[0, 0, 1, :48, :64], IMG[0, 1, 1, :48, :64]]),
+                tables, luts,
+            )
+            np.testing.assert_array_equal(decoded, ref)
+        finally:
+            await client.close()
+
+    async def test_cache_key_isolation_between_specs(self, tmp_path, loop):
+        app_obj, client = await _make_app(tmp_path)
+        try:
+            url_a = "/render/1/0/0/0?c=1|0:4095$FF0000&w=64&h=48"
+            url_b = "/render/1/0/0/0?c=1|0:4095$00FF00&w=64&h=48"
+            ra = await client.get(url_a, headers=AUTH)
+            rb = await client.get(url_b, headers=AUTH)
+            assert ra.headers["X-Cache"] == "miss"
+            assert rb.headers["X-Cache"] == "miss"  # not A's entry
+            body_a, body_b = await ra.read(), await rb.read()
+            assert body_a != body_b
+            assert ra.headers["ETag"] != rb.headers["ETag"]
+            # replays hit their own entries
+            ra2 = await client.get(url_a, headers=AUTH)
+            assert ra2.headers["X-Cache"] == "hit"
+            assert await ra2.read() == body_a
+            # and a raw /tile of the same region is yet another entry
+            rt = await client.get(
+                "/tile/1/0/0/0?w=64&h=48&format=png", headers=AUTH
+            )
+            assert rt.status == 200
+            assert await rt.read() != body_a
+        finally:
+            await client.close()
+
+    async def test_conditional_get_304(self, tmp_path, loop):
+        app_obj, client = await _make_app(tmp_path)
+        try:
+            url = "/render/1/0/0/0?c=1|0:4095$FF0000&w=32&h=32"
+            r = await client.get(url, headers=AUTH)
+            etag = r.headers["ETag"]
+            r2 = await client.get(
+                url, headers={**AUTH, "If-None-Match": etag}
+            )
+            assert r2.status == 304
+        finally:
+            await client.close()
+
+    async def test_greyscale_and_projection_over_http(self, tmp_path, loop):
+        app_obj, client = await _make_app(tmp_path)
+        try:
+            r = await client.get(
+                "/render/1/0/1/0?m=g&p=intmean|0:3&w=32&h=32",
+                headers=AUTH,
+            )
+            assert r.status == 200
+            decoded = np.array(Image.open(io.BytesIO(await r.read())))
+            projected = (
+                IMG[0, 1, :, :32, :32].astype(np.int64).sum(axis=0) // 4
+            ).astype(np.uint16)
+            spec = RenderSpec.from_params(
+                {"m": "g", "p": "intmean|0:3"}, default_channel=1
+            )
+            tables, luts = rengine.build_tables(
+                spec, np.dtype(np.uint16), LutRegistry()
+            )
+            ref = rengine.render_host(projected[None], tables, luts)
+            np.testing.assert_array_equal(decoded, ref)
+        finally:
+            await client.close()
+
+    async def test_errors_over_http(self, tmp_path, loop):
+        app_obj, client = await _make_app(tmp_path)
+        try:
+            for bad in (
+                "c=1|9:1$FF0000", "c=zz", "m=q", "p=no", "q=7",
+                "format=gif", "c=1$not-a-lut",
+            ):
+                r = await client.get(
+                    f"/render/1/0/0/0?{bad}&w=32&h=32", headers=AUTH
+                )
+                assert r.status == 400, (bad, r.status)
+            # channel out of range / unknown image -> 404
+            r = await client.get(
+                "/render/1/0/0/0?c=9&w=32&h=32", headers=AUTH
+            )
+            assert r.status == 404
+            r = await client.get(
+                "/render/77/0/0/0?w=32&h=32", headers=AUTH
+            )
+            assert r.status == 404
+            # no cookie -> 403 (same auth gate as /tile)
+            r = await client.get("/render/1/0/0/0?w=32&h=32")
+            assert r.status == 403
+        finally:
+            await client.close()
+
+    async def test_custom_lut_dir_over_http(self, tmp_path, loop):
+        table = np.zeros((256, 3), np.uint8)
+        table[:, 2] = np.arange(256)  # blue ramp
+        lut_dir = tmp_path / "luts"
+        lut_dir.mkdir()
+        write_imagej_lut(str(lut_dir / "bluez.lut"), table)
+        app_obj, client = await _make_app(
+            tmp_path, {"render": {"lut-dir": str(lut_dir)}}
+        )
+        try:
+            r = await client.get(
+                "/render/1/0/0/0?c=1|0:4095$bluez.lut&w=32&h=32",
+                headers=AUTH,
+            )
+            assert r.status == 200
+            decoded = np.array(Image.open(io.BytesIO(await r.read())))
+            assert decoded[..., 0].max() == 0  # red never set
+            assert decoded[..., 2].max() > 0
+        finally:
+            await client.close()
+
+    async def test_render_disabled_404(self, tmp_path, loop):
+        app_obj, client = await _make_app(
+            tmp_path, {"render": {"enabled": False}}
+        )
+        try:
+            r = await client.get(
+                "/render/1/0/0/0?w=32&h=32", headers=AUTH
+            )
+            # no GET route registered: aiohttp answers 405 (the
+            # OPTIONS catch-all still matches the path) — either way,
+            # the surface is off
+            assert r.status in (404, 405)
+            # /tile unaffected
+            r2 = await client.get(
+                "/tile/1/0/0/0?w=32&h=32", headers=AUTH
+            )
+            assert r2.status == 200
+        finally:
+            await client.close()
+
+    async def test_healthz_render_snapshot(self, tmp_path, loop):
+        app_obj, client = await _make_app(tmp_path)
+        try:
+            await client.get(
+                "/render/1/0/0/0?w=32&h=32", headers=AUTH
+            )
+            body = await (await client.get("/healthz")).json()
+            assert body["render"]["enabled"] is True
+            assert body["render"]["specs_cached"] >= 1
+            assert body["render"]["luts"] >= 10
+            text = await (await client.get("/metrics")).text()
+            assert "render_tiles_total" in text
+        finally:
+            await client.close()
+
+
+class TestRenderConfig:
+    def test_defaults(self):
+        config = Config.from_dict({"session-store": {"type": "memory"}})
+        assert config.render.enabled is True
+        assert config.render.lut_dir is None
+        assert config.render.jpeg_quality == 90
+        assert config.mesh.probe_interval_ms == 0.0
+
+    @pytest.mark.parametrize("block", [
+        {"render": {"jpeg-quality": 0}},
+        {"render": {"jpeg-quality": "xx"}},
+        {"render": {"lut-dir": ""}},
+        {"render": {"typo-key": 1}},
+        {"mesh": {"probe-interval-ms": -5}},
+        {"mesh": {"typo": 1}},
+    ])
+    def test_invalid_blocks_fail_at_startup(self, block):
+        raw = {"session-store": {"type": "memory"}, **block}
+        with pytest.raises(ConfigError):
+            Config.from_dict(raw)

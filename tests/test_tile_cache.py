@@ -942,3 +942,226 @@ class TestCacheConfig:
         assert not config.cache.etag_precheck
         assert not config.cache.prefetch.enabled
         assert config.resilience.io_timeout_ms == 1500.0
+
+
+# ---------------------------------------------------------------------------
+# w/h=0 full-plane normalization: both spellings share ONE cache entry
+# ---------------------------------------------------------------------------
+
+class TestFullPlaneNormalization:
+    async def test_defaulted_then_explicit_share_one_entry(
+        self, tmp_path, loop
+    ):
+        app_obj, client = await _make_app(tmp_path)
+        try:
+            r0 = await client.get("/tile/1/0/0/0", headers=AUTH)
+            assert r0.status == 200
+            assert r0.headers["X-Cache"] == "miss"
+            body = await r0.read()
+            assert len(app_obj.result_cache.memory) == 1
+            # the explicit spelling of the same full plane HITS the
+            # defaulted request's entry — no duplicate bytes
+            r1 = await client.get(
+                "/tile/1/0/0/0?w=256&h=256", headers=AUTH
+            )
+            assert r1.status == 200
+            assert r1.headers["X-Cache"] == "hit"
+            assert await r1.read() == body
+            assert len(app_obj.result_cache.memory) == 1
+        finally:
+            await client.close()
+
+    async def test_explicit_then_defaulted_share_one_entry(
+        self, tmp_path, loop
+    ):
+        app_obj, client = await _make_app(tmp_path)
+        try:
+            r0 = await client.get(
+                "/tile/1/1/0/0?w=256&h=256&format=png", headers=AUTH
+            )
+            assert r0.status == 200
+            body0 = await r0.read()
+            r1 = await client.get(
+                "/tile/1/1/0/0?format=png", headers=AUTH
+            )
+            assert r1.status == 200
+            assert r1.headers["X-Cache"] == "hit"
+            assert len(app_obj.result_cache.memory) == 1
+            assert await r1.read() == body0
+        finally:
+            await client.close()
+
+    async def test_pyramid_level_normalizes_to_level_extent(
+        self, tmp_path, loop
+    ):
+        """w/h=0 at resolution=1 must rewrite to the LEVEL's extent
+        (128x128 here), not the full-resolution plane's."""
+        app_obj, client = await _make_app(tmp_path)
+        try:
+            r0 = await client.get(
+                "/tile/1/0/0/0?resolution=1", headers=AUTH
+            )
+            assert r0.status == 200
+            body0 = await r0.read()
+            r1 = await client.get(
+                "/tile/1/0/0/0?resolution=1&w=128&h=128", headers=AUTH
+            )
+            assert r1.status == 200
+            assert r1.headers["X-Cache"] == "hit"
+            assert await r1.read() == body0
+        finally:
+            await client.close()
+
+    async def test_unknown_image_leaves_region_untouched(
+        self, tmp_path, loop
+    ):
+        app_obj, client = await _make_app(tmp_path)
+        try:
+            r = await client.get("/tile/99/0/0/0", headers=AUTH)
+            assert r.status == 404  # normalization failure never 500s
+        finally:
+            await client.close()
+
+    async def test_offset_defaulted_spelling_still_404s(
+        self, tmp_path, loop
+    ):
+        """Regression: w=0 defaults to the FULL sizeX regardless of x
+        (the resolve_region contract), so x>0&w=0 is out of bounds —
+        normalization must reproduce that 404, not invent a clamped
+        remainder tile that only exists when the cache is on."""
+        app_obj, client = await _make_app(tmp_path)
+        try:
+            r = await client.get(
+                "/tile/1/0/0/0?x=100&w=0&h=64", headers=AUTH
+            )
+            assert r.status == 404
+            assert len(app_obj.result_cache.memory) == 0
+        finally:
+            await client.close()
+
+
+# ---------------------------------------------------------------------------
+# prefetch bounds pruning: off-image predictions die in arithmetic,
+# not in a pipeline resolve
+# ---------------------------------------------------------------------------
+
+class TestPrefetchBoundsPruning:
+    async def test_off_image_predictions_never_reach_the_fetcher(self):
+        """The fetch hook IS the pipeline-resolve path; with a known
+        extent, off-image predictions must never invoke it."""
+        fetched = []
+
+        async def fetch(ctx, key):
+            fetched.append((ctx.region.x, ctx.region.y))
+
+        extent_calls = []
+
+        def extent_fn(image_id, resolution):
+            extent_calls.append((image_id, resolution))
+            return (256, 128)
+
+        pre = ViewportPrefetcher(
+            fetch, cache=None, admission=_FakeAdmission(),
+            lookahead=2, extent_fn=extent_fn,
+        )
+        pre.start()
+        try:
+            # pan right along the bottom edge: x=64 -> x=128 (w=64)
+            pre.observe(_ctx(x=64, y=64, w=64, h=64))
+            pre.observe(_ctx(x=128, y=64, w=64, h=64))
+            await asyncio.sleep(0.05)
+            # continuation x=192 fits; x=256 is off-image (256+64 >
+            # 256); perpendicular y=128 is off-image (128+64 > 128)
+            assert (192, 64) in fetched
+            assert all(x + 64 <= 256 and y + 64 <= 128
+                       for x, y in fetched), fetched
+            assert pre.snapshot()["pruned_off_image"] >= 2
+            # extent lookups are memoized per (image, level): the
+            # second access answered from the prefetcher's own cache
+            assert len(extent_calls) == 1
+        finally:
+            await pre.close()
+
+    async def test_unknown_extent_keeps_pipeline_backstop(self):
+        fetched = []
+
+        async def fetch(ctx, key):
+            fetched.append((ctx.region.x, ctx.region.y))
+
+        pre = ViewportPrefetcher(
+            fetch, cache=None, admission=_FakeAdmission(),
+            lookahead=1, extent_fn=lambda image_id, res: None,
+        )
+        pre.start()
+        try:
+            pre.observe(_ctx(x=0, y=0, w=64, h=64))
+            pre.observe(_ctx(x=64, y=0, w=64, h=64))
+            await asyncio.sleep(0.05)
+            assert fetched  # predictions still flow without an extent
+            assert pre.snapshot()["pruned_off_image"] == 0
+        finally:
+            await pre.close()
+
+    async def test_peek_extent_answers_only_from_open_buffers(
+        self, tmp_path
+    ):
+        """The extent hook never opens or resolves: before the first
+        real tile it answers None; after (buffer cached) it answers
+        the level extent without touching the metadata plane."""
+        write_ome_tiff(
+            str(tmp_path / "img.ome.tiff"), IMG, tile_size=(64, 64),
+            pyramid_levels=2,
+        )
+        registry = ImageRegistry()
+        registry.add(1, str(tmp_path / "img.ome.tiff"))
+
+        class CountingRegistry:
+            def __init__(self, inner):
+                self._inner = inner
+                self.resolves = 0
+
+            def get_pixels(self, image_id):
+                self.resolves += 1
+                return self._inner.get_pixels(image_id)
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        counting = CountingRegistry(registry)
+        svc = PixelsService(counting)
+        try:
+            assert svc.peek_extent(1) is None  # nothing open yet
+            svc.get_pixel_buffer(1)  # the stream's first real tile
+            before = counting.resolves
+            assert svc.peek_extent(1) == (256, 256)
+            assert svc.peek_extent(1, 1) == (128, 128)
+            assert svc.peek_extent(1, 9) is None  # bad level
+            assert svc.peek_extent(42) is None  # unknown image
+            assert counting.resolves == before  # ZERO resolver calls
+        finally:
+            svc.close()
+
+    async def test_app_wires_extent_pruning_end_to_end(
+        self, tmp_path, loop
+    ):
+        """Through the real app: pan toward the image edge; the
+        prefetcher must record pruned predictions (bounds math), not
+        pipeline-resolved 404s."""
+        app_obj, client = await _make_app(tmp_path)
+        try:
+            # 256-wide image: x=128 then x=192 (w=64) — continuation
+            # x=256 is off-image
+            for x in (128, 192):
+                r = await client.get(
+                    f"/tile/1/0/0/0?x={x}&y=0&w=64&h=64&format=png",
+                    headers=AUTH,
+                )
+                assert r.status == 200
+            for _ in range(100):
+                snap = app_obj.prefetcher.snapshot()
+                if snap["pruned_off_image"] >= 1:
+                    break
+                await asyncio.sleep(0.01)
+            assert snap["pruned_off_image"] >= 1, snap
+        finally:
+            await client.close()

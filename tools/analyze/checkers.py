@@ -490,6 +490,7 @@ _JAX_SYNC_SCOPE = (
     "omero_ms_pixel_buffer_tpu/models/tile_pipeline.py",
     "omero_ms_pixel_buffer_tpu/models/device_dispatch.py",
     "omero_ms_pixel_buffer_tpu/ops/",
+    "omero_ms_pixel_buffer_tpu/render/",
 )
 _JAX_JIT_SCOPE = _JAX_SYNC_SCOPE + (
     "omero_ms_pixel_buffer_tpu/models/device_cache.py",
@@ -507,6 +508,8 @@ _DEVICE_PRODUCER_NAMES = {
     "deflate_filtered_batch", "shard_batch", "shard_rows",
     "sharded_batch_filter", "distributed_filter_plane",
     "to_big_endian_bytes", "device_put", "crop_batch", "pad_batch",
+    "render_batch", "render_local", "fused_render_filter_deflate_batch",
+    "sharded_render_filter_deflate", "render_filter_deflate_local",
 }
 # ...except these, which return host values
 _HOST_RETURNING = {"device_get", "devices", "default_backend"}
