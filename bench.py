@@ -387,6 +387,216 @@ def bench_cache(
     return asyncio.run(run())
 
 
+def bench_cache_plane(path: str, cache_dir: str) -> dict:
+    """Cache plane (r11) section — three pins:
+
+    - ``warm_restart``: fill a disk-spilling result cache, close it,
+      reopen, and measure the hit rate of the first 100 requests with
+      the manifest journal vs the legacy sweep (which is 0 by
+      construction);
+    - ``l2``: round-trip p50/p99 against the in-memory RESP stub
+      (the protocol + framing cost floor — a real Redis adds wire
+      latency on top);
+    - ``two_replica``: TWO in-process app replicas with a shared ring
+      + L2 serve a shared unique-tile workload; pins the render-once
+      acceptance number (total renders across both processes ==
+      unique tiles) and that both replicas answered with one ETag per
+      tile.
+    """
+    import hashlib  # noqa: F401  (parity with bench_cache imports)
+    import socket
+
+    from aiohttp import ClientSession, web
+
+    from omero_ms_pixel_buffer_tpu.auth.stores import MemorySessionStore
+    from omero_ms_pixel_buffer_tpu.cache.plane.l2 import RedisL2Tier
+    from omero_ms_pixel_buffer_tpu.cache.plane.resp_stub import (
+        InMemoryRespServer,
+    )
+    from omero_ms_pixel_buffer_tpu.cache.result_cache import (
+        CachedTile,
+        TileResultCache,
+    )
+    from omero_ms_pixel_buffer_tpu.http.server import PixelBufferApp
+    from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+        ImageRegistry,
+        PixelsService,
+    )
+    from omero_ms_pixel_buffer_tpu.utils.config import Config
+
+    out: dict = {}
+
+    # -- warm restart (manifest on vs off) -----------------------------
+    def restart_hit_rate(manifest: bool, tag: str) -> float:
+        spill = os.path.join(cache_dir, f"plane_spill_{tag}")
+        body = os.urandom(4096)
+        cache = TileResultCache(
+            memory_bytes=64 << 10, disk_dir=spill,
+            disk_bytes=64 << 20, manifest=manifest,
+        )
+
+        async def fill():
+            for i in range(150):
+                await cache.put(
+                    f"img=1|z=0|c=0|t=0|x={i}|q=bench",
+                    CachedTile(body, filename="b.png"),
+                )
+
+        asyncio.run(fill())
+        cache._io.submit(lambda: None).result()  # drain spills
+        cache.close()
+        reborn = TileResultCache(
+            memory_bytes=64 << 10, disk_dir=spill,
+            disk_bytes=64 << 20, manifest=manifest,
+        )
+
+        async def probe() -> int:
+            hits = 0
+            for i in range(100):
+                key = f"img=1|z=0|c=0|t=0|x={i}|q=bench"
+                if await reborn.get(key) is not None:
+                    hits += 1
+            return hits
+
+        hits = asyncio.run(probe())
+        reborn.close()
+        return hits / 100.0
+
+    out["warm_restart"] = {
+        "first_100_hit_rate_manifest": restart_hit_rate(True, "on"),
+        "first_100_hit_rate_sweep": restart_hit_rate(False, "off"),
+    }
+
+    # -- L2 round trip -------------------------------------------------
+    async def l2_round_trip() -> dict:
+        server = InMemoryRespServer()
+        await server.start()
+        tier = RedisL2Tier(server.uri)
+        body = os.urandom(32 << 10)  # a typical encoded-tile size
+        entry = CachedTile(body, filename="b.png")
+        lat = []
+        try:
+            for i in range(50):
+                await tier.put(f"img=9|x={i}|q=bench", entry)
+            for _ in range(4):  # warm
+                await tier.get("img=9|x=0|q=bench")
+            for i in range(200):
+                t0 = time.perf_counter()
+                got = await tier.get(f"img=9|x={i % 50}|q=bench")
+                lat.append(time.perf_counter() - t0)
+                assert got is not None and got.body == body
+        finally:
+            await tier.close()
+            await server.close()
+        ms = np.array(lat) * 1000.0
+        return {
+            "round_trips": len(lat),
+            "p50_ms": round(float(np.percentile(ms, 50)), 3),
+            "p99_ms": round(float(np.percentile(ms, 99)), 3),
+        }
+
+    out["l2"] = asyncio.run(l2_round_trip())
+
+    # -- two-replica render-once ---------------------------------------
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    async def two_replica() -> dict:
+        resp = InMemoryRespServer()
+        await resp.start()
+        ports = [free_port(), free_port()]
+        members = [f"http://127.0.0.1:{p}" for p in ports]
+        replicas, runners, renders = [], [], []
+        for i, port in enumerate(ports):
+            registry = ImageRegistry()
+            registry.add(1, path)
+            config = Config.from_dict({
+                "session-store": {"type": "memory"},
+                "backend": {"engine": "host",
+                            "batching": {"coalesce-window-ms": 1.0}},
+                "cache": {"prefetch": {"enabled": False}},
+                "cluster": {
+                    "members": members, "self": members[i],
+                    "peer-timeout-ms": 5000,
+                    "l2": {"uri": resp.uri},
+                },
+            })
+            app_obj = PixelBufferApp(
+                config,
+                pixels_service=PixelsService(registry),
+                session_store=MemorySessionStore(
+                    {"bench-cookie": "bench-key"}
+                ),
+            )
+            counter: list = []
+
+            def wrap(app=app_obj, counter=counter):
+                inner_h, inner_b = (
+                    app.pipeline.handle, app.pipeline.handle_batch
+                )
+                app.pipeline.handle = lambda c: (
+                    counter.append(1), inner_h(c)
+                )[1]
+                app.pipeline.handle_batch = lambda cs: (
+                    counter.extend([1] * len(cs)), inner_b(cs)
+                )[1]
+
+            wrap()
+            renders.append(counter)
+            runner = web.AppRunner(app_obj.make_app(), access_log=None)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", port)
+            await site.start()
+            replicas.append(app_obj)
+            runners.append(runner)
+        size = int(os.environ.get("BENCH_IMAGE_SIZE", "8192"))
+        n_tiles = 24
+        urls = [
+            f"/tile/1/0/0/0?x={(i % 8) * 512}&y={(i // 8) * 512}"
+            "&w=512&h=512&format=png"
+            for i in range(n_tiles)
+        ]
+        assert (max(8, n_tiles // 8) * 512) <= size
+        etags: dict = {}
+        identical = True
+        headers = {"Cookie": "sessionid=bench-cookie"}
+        try:
+            async with ClientSession() as http:
+                for i, url in enumerate(urls):
+                    first = members[i % 2]
+                    second = members[(i + 1) % 2]
+                    async with http.get(
+                        first + url, headers=headers
+                    ) as r1:
+                        assert r1.status == 200, await r1.text()
+                        etag1 = r1.headers["ETag"]
+                    async with http.get(
+                        second + url, headers=headers
+                    ) as r2:
+                        assert r2.status == 200
+                        etag2 = r2.headers["ETag"]
+                    identical = identical and (etag1 == etag2)
+                    etags[url] = etag1
+        finally:
+            for runner in runners:
+                await runner.cleanup()
+            await resp.close()
+        total = sum(len(c) for c in renders)
+        return {
+            "unique_tiles": n_tiles,
+            "total_renders": total,
+            "render_once": total == n_tiles,
+            "identical_etags": identical,
+        }
+
+    out["two_replica"] = asyncio.run(two_replica())
+    return out
+
+
 def build_render_fixture(root: str, size: int = 2048):
     """3-channel uint16 fixture for the rendered-tile section."""
     from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
@@ -712,6 +922,17 @@ def main():
             cache_stats = {"error": f"{type(e).__name__}: {e}"}
             log(f"cache bench failed: {e!r}")
 
+    # --- cache plane (r11): warm-restart hit rate, L2 round trip,
+    # two-replica render-once ------------------------------------------
+    plane_stats: dict = {}
+    if os.environ.get("BENCH_CACHE_PLANE", "1") != "0":
+        try:
+            plane_stats = bench_cache_plane(path, cache_dir)
+            log(f"cache plane: {plane_stats}")
+        except Exception as e:
+            plane_stats = {"error": f"{type(e).__name__}: {e}"}
+            log(f"cache plane bench failed: {e!r}")
+
     # --- rendered-tile serving (render/): host vs headline engine ----
     render_stats: dict = {}
     if os.environ.get("BENCH_RENDER", "1") != "0":
@@ -750,6 +971,8 @@ def main():
     )
     if cache_stats:
         record["cache"] = cache_stats
+    if plane_stats:
+        record["cache_plane"] = plane_stats
     if render_stats:
         record["render"] = render_stats
     if device_stats:

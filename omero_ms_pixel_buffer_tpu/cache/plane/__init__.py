@@ -1,0 +1,37 @@
+"""Distributed cache plane (r11) — the cluster behind the result cache.
+
+Four layers, each independently optional and each behind the
+breaker/fault-point/degrade-to-pass-through contract the local cache
+established:
+
+- ``manifest``  — crash-consistent disk-tier journal: warm restarts.
+- ``l2``        — shared RESP (Redis) tier: render once per cluster
+  *lifetime*, not once per process.
+- ``ring``/``peer`` — consistent-hash ownership + bounded owner
+  fetch: render once per cluster *moment* (cross-process
+  single-flight).
+- ``tinylfu``   — frequency-sketch admission in front of the SLRU:
+  robot sweeps stop evicting the viewer working set.
+
+``coordinator.CachePlane`` is the object the HTTP app wires in;
+``resp_stub`` is the dev/bench/test RESP server (no Redis ships in
+this environment).
+"""
+
+from .coordinator import CachePlane
+from .l2 import RedisL2Tier
+from .manifest import DiskManifest, fsync_dir
+from .peer import PEER_HEADER, PeerClient
+from .ring import HashRing
+from .tinylfu import TinyLFU
+
+__all__ = [
+    "CachePlane",
+    "DiskManifest",
+    "HashRing",
+    "PEER_HEADER",
+    "PeerClient",
+    "RedisL2Tier",
+    "TinyLFU",
+    "fsync_dir",
+]

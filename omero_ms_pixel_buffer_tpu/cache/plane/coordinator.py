@@ -1,0 +1,190 @@
+"""CachePlane — the cluster-facing coordinator over L2 + the peer ring.
+
+Sits between the process-local result cache and the render pipeline in
+the serving path (http/server._serve):
+
+    RAM -> disk -> [plane: L2 -> peer(owner)] -> render
+
+and owns the outbound half of cluster invalidation (best-effort L2
+DELs + peer purge fan-out). Construction is pure wiring from the
+validated ``cluster:`` config block; either half is optional — L2
+alone shares results through Redis, the ring alone gives render-once
+ownership without any external service.
+
+The whole object inherits the cache contract: no operation here may
+fail a request. ``fetch`` returns ``(None, None)`` on every failure
+path; ``publish`` and ``invalidate_image`` are fire-and-forget.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional, Tuple
+
+from ...utils.metrics import REGISTRY
+from ..result_cache import CachedTile
+from .l2 import RedisL2Tier
+from .peer import PEER_HEADER, PeerClient, filename_from_disposition
+from .ring import HashRing
+
+log = logging.getLogger("omero_ms_pixel_buffer_tpu.cache.plane")
+
+PLANE_PURGES = REGISTRY.counter(
+    "tile_cache_plane_purges_total",
+    "Cluster invalidation fan-outs by target and outcome",
+)
+
+
+class CachePlane:
+    def __init__(
+        self,
+        members: tuple = (),
+        self_url: Optional[str] = None,
+        virtual_nodes: int = 64,
+        peer_timeout_s: float = 0.5,
+        l2_uri: Optional[str] = None,
+        l2_ttl_s: float = 3600.0,
+    ):
+        self.self_url = self_url
+        self.l2 = RedisL2Tier(l2_uri, ttl_s=l2_ttl_s) if l2_uri else None
+        self.ring: Optional[HashRing] = None
+        self.peers: Optional[PeerClient] = None
+        if members and self_url:
+            self.ring = HashRing(members, virtual_nodes)
+            self.peers = PeerClient(self_url, timeout_s=peer_timeout_s)
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._tasks: set = set()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self, loop: asyncio.AbstractEventLoop) -> None:
+        """Capture the serving loop (invalidation listeners fire from
+        resolver threads and need somewhere to schedule the fan-out)."""
+        self._loop = loop
+
+    async def close(self) -> None:
+        for task in list(self._tasks):
+            task.cancel()
+        if self.l2 is not None:
+            await self.l2.close()
+
+    def _spawn(self, coro) -> None:
+        """Fire-and-forget on the serving loop, exceptions consumed
+        (every coroutine here is already internally degrading — this
+        guards only against 'Task exception was never retrieved')."""
+        task = asyncio.get_running_loop().create_task(coro)
+        self._tasks.add(task)
+
+        def _done(t):
+            self._tasks.discard(t)
+            if not t.cancelled():
+                t.exception()
+
+        task.add_done_callback(_done)
+
+    # -- serving path --------------------------------------------------
+
+    async def fetch(
+        self,
+        key: str,
+        path_qs: str,
+        session_cookie: Optional[str],
+        peer_originated: bool,
+    ) -> Tuple[Optional[CachedTile], Optional[str]]:
+        """The between-miss-and-render consult: L2 first (cheapest
+        shared copy), then one bounded GET to the key's owner — unless
+        this request already IS a peer hop (the ``X-OMPB-Peer`` loop
+        guard makes forwarding terminal, and the requester consulted
+        L2 microseconds ago, so re-checking here would spend a wasted
+        Redis round trip inside the requester's peer-timeout window)
+        or this replica owns the key (owners render; that's what
+        ownership means)."""
+        if peer_originated:
+            return None, None
+        if self.l2 is not None:
+            entry = await self.l2.get(key)
+            if entry is not None:
+                return entry, "l2-hit"
+        if self.ring is not None:
+            owner = self.ring.owner(key)
+            if owner != self.self_url:
+                result = await self.peers.fetch(
+                    owner, path_qs, session_cookie
+                )
+                if result is not None and result[0] == 200:
+                    status, headers, body = result
+                    entry = CachedTile(
+                        body,
+                        etag=headers.get("etag"),
+                        filename=filename_from_disposition(
+                            headers.get("content-disposition", "")
+                        ),
+                    )
+                    return entry, "peer-hit"
+        return None, None
+
+    def publish(self, key: str, entry: CachedTile) -> None:
+        """Write-through to the shared tier after a local render
+        completes (called from the single-flight fill hook, so once
+        per flight no matter how many requests coalesced). Best-effort
+        and never awaited by the response path."""
+        if self.l2 is None:
+            return
+        self._spawn(self.l2.put(key, entry))
+
+    # -- invalidation --------------------------------------------------
+
+    def invalidate_image(self, image_id: int) -> None:
+        """Cluster half of an image purge: L2 DELs + peer purge
+        fan-out, scheduled on the serving loop (callable from any
+        thread — the metadata resolver's refresh thread fires
+        listeners). The caller's LOCAL purge has already happened
+        synchronously; nothing here can delay or fail it."""
+        loop = self._loop
+        if loop is None or loop.is_closed():
+            return
+        try:
+            asyncio.run_coroutine_threadsafe(
+                self._invalidate_async(image_id), loop
+            )
+        except RuntimeError:
+            pass  # loop shutting down: local purge already done
+
+    async def _invalidate_async(self, image_id: int) -> None:
+        ops = []
+        labels = []
+        if self.l2 is not None:
+            ops.append(self.l2.delete_image(image_id))
+            labels.append("l2")
+        if self.ring is not None:
+            for member in self.ring.members:
+                if member == self.self_url:
+                    continue
+                ops.append(self.peers.purge(member, image_id))
+                labels.append("peer")
+        if not ops:
+            return
+        # each op is internally bounded (breaker + per-call timeout);
+        # gather with return_exceptions so one dead peer cannot stop
+        # the DELs — or surface anything to anyone
+        results = await asyncio.gather(*ops, return_exceptions=True)
+        for label, result in zip(labels, results):
+            failed = isinstance(result, Exception) or result is False
+            PLANE_PURGES.inc(
+                target=label, outcome="error" if failed else "ok"
+            )
+
+    # -- observability -------------------------------------------------
+
+    def snapshot(self) -> dict:
+        out: dict = {"self": self.self_url}
+        if self.l2 is not None:
+            out["l2"] = self.l2.snapshot()
+        if self.ring is not None:
+            out["ring"] = self.ring.snapshot()
+            out["peer_breakers"] = self.peers.snapshot()
+        return out
+
+
+__all__ = ["CachePlane", "PEER_HEADER"]

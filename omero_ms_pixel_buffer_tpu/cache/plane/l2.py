@@ -1,0 +1,288 @@
+"""Shared L2 cache tier — RESP (Redis) between the local tiers and render.
+
+The reference ecosystem shares rendered tiles across replicas through
+Redis (omero-ms-image-region's rendered-tile cache); this tier does
+the same for this service's encoded tile bodies, keyed by the exact
+result-cache key schema (``img=..|..|q=<encode-signature>``) so a
+config change on any replica keys fresh entries cluster-wide.
+
+Protocol: the same minimal asyncio RESP2 client machinery as the auth
+store (auth/stores.RedisSessionStore — no redis package exists in this
+environment): one connection, commands serialized under a lock,
+reconnect-once on transport error. Values are framed as
+``OMPB1 | u32 header-length | json{etag, fn, wall} | body`` so a hit
+reconstructs the complete ``CachedTile`` (validator included — both
+replicas must serve byte-identical ETags).
+
+The resilience contract matches the disk tier: a sick Redis must never
+fail a request. Every operation is gated by the ``cache:l2`` breaker,
+carries the ``cache.l2`` fault point, and is bounded by the per-call
+io timeout; any failure reads as a miss (get), a no-op (put/delete),
+and a breaker input. TTLs (``cluster.l2.ttl-s``) bound staleness for
+entries written by replicas that die before an invalidation reaches
+Redis.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import List, Optional
+from urllib.parse import urlparse
+
+from ...resilience.breaker import BreakerOpenError, for_dependency
+from ...resilience.faultinject import INJECTOR
+from ...resilience.timeouts import io_timeout_s
+from ...utils.metrics import REGISTRY
+from ..result_cache import CachedTile
+
+log = logging.getLogger("omero_ms_pixel_buffer_tpu.cache.plane")
+
+L2_REQUESTS = REGISTRY.counter(
+    "tile_cache_l2_requests_total",
+    "Shared L2 (Redis) tier operations by op and outcome",
+)
+
+_MAGIC = b"OMPB1"
+KEY_PREFIX = "ompb:tile:"
+
+
+def encode_entry(entry: CachedTile) -> bytes:
+    header = json.dumps(
+        {
+            "etag": entry.etag,
+            "fn": entry.filename,
+            "wall": time.time() - max(
+                0.0, time.monotonic() - entry.stored_at
+            ),
+        },
+        separators=(",", ":"),
+    ).encode()
+    return _MAGIC + len(header).to_bytes(4, "big") + header + entry.body
+
+
+def decode_entry(raw: bytes) -> Optional[CachedTile]:
+    """None on any framing problem — a corrupt L2 value is a miss,
+    never an error (and never served)."""
+    try:
+        if not raw.startswith(_MAGIC):
+            return None
+        hlen = int.from_bytes(raw[5:9], "big")
+        header = json.loads(raw[9:9 + hlen])
+        body = bytes(raw[9 + hlen:])
+        stored_at = time.monotonic() - max(
+            0.0, time.time() - float(header.get("wall") or 0.0)
+        )
+        return CachedTile(
+            body, etag=header.get("etag"),
+            filename=header.get("fn") or "", stored_at=stored_at,
+        )
+    except Exception:
+        return None
+
+
+class RedisL2Tier:
+    """One RESP2 connection to the shared tier. All public operations
+    degrade: they return a miss/no-op on breaker-open, fault, timeout,
+    or transport error — the caller never sees an exception."""
+
+    def __init__(
+        self,
+        uri: str,
+        ttl_s: float = 3600.0,
+        key_prefix: str = KEY_PREFIX,
+    ):
+        parsed = urlparse(uri)
+        self.host = parsed.hostname or "localhost"
+        self.port = parsed.port or 6379
+        self.db = int(parsed.path.lstrip("/") or 0) if parsed.path else 0
+        self.password = parsed.password
+        self.ttl_s = ttl_s
+        self.key_prefix = key_prefix
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._lock = asyncio.Lock()
+        self.breaker = for_dependency("cache:l2")
+
+    # -- RESP2 plumbing (the auth-store client shape) ------------------
+
+    async def _connect(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port
+        )
+        if self.password:
+            await self._command(b"AUTH", self.password.encode())
+        if self.db:
+            await self._command(b"SELECT", str(self.db).encode())
+
+    async def _command(self, *parts: bytes):
+        w, r = self._writer, self._reader
+        out = b"*%d\r\n" % len(parts)
+        for p in parts:
+            out += b"$%d\r\n%s\r\n" % (len(p), p)
+        w.write(out)
+        await w.drain()
+        return await self._read_reply(r)
+
+    async def _read_reply(self, r: asyncio.StreamReader):
+        line = (await r.readline()).rstrip(b"\r\n")
+        if not line:
+            raise ConnectionError("redis connection closed")
+        marker, rest = line[:1], line[1:]
+        if marker in (b"+", b":"):
+            return rest
+        if marker == b"-":
+            raise RuntimeError(f"redis error: {rest.decode()}")
+        if marker == b"$":
+            n = int(rest)
+            if n == -1:
+                return None
+            data = await r.readexactly(n + 2)
+            return data[:-2]
+        if marker == b"*":
+            n = int(rest)
+            return [await self._read_reply(r) for _ in range(n)]
+        raise RuntimeError(f"unexpected redis reply: {line!r}")
+
+    async def _reset(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+        await self._connect()
+
+    async def _exchange(self, *parts: bytes):
+        """One serialized command with reconnect-once semantics."""
+        async with self._lock:
+            if self._writer is None:
+                await self._connect()
+            try:
+                return await self._command(*parts)
+            except (ConnectionError, EOFError, OSError,
+                    asyncio.IncompleteReadError):
+                await self._reset()
+                return await self._command(*parts)
+
+    async def _guarded(self, *parts: bytes):
+        """The full resilience wrapper: breaker gate, fault point,
+        per-call timeout, slow-call accounting. Raises to the caller
+        methods below, which translate every failure into a miss."""
+        self.breaker.allow()
+        t0 = time.monotonic()
+        try:
+            await INJECTOR.fire_async("cache.l2")
+            timeout = io_timeout_s()
+            if timeout > 0:
+                result = await asyncio.wait_for(
+                    self._exchange(*parts), timeout
+                )
+            else:
+                result = await self._exchange(*parts)
+        except asyncio.TimeoutError:
+            # mid-protocol connection is desynced: drop it so the next
+            # call starts clean instead of reading a stale reply
+            async with self._lock:
+                if self._writer is not None:
+                    self._writer.close()
+                    self._writer = None
+            self.breaker.record_failure()
+            raise
+        except (ConnectionError, EOFError, OSError,
+                asyncio.IncompleteReadError):
+            self.breaker.record_failure()
+            raise
+        except RuntimeError:
+            # a redis ERROR reply is an answer — the store is up
+            self.breaker.record_success(
+                duration_s=time.monotonic() - t0
+            )
+            raise
+        self.breaker.record_success(duration_s=time.monotonic() - t0)
+        return result
+
+    def _key(self, key: str) -> bytes:
+        return (self.key_prefix + key).encode()
+
+    # -- tier operations (never raise) ---------------------------------
+
+    async def get(self, key: str) -> Optional[CachedTile]:
+        try:
+            raw = await self._guarded(b"GET", self._key(key))
+        except BreakerOpenError:
+            L2_REQUESTS.inc(op="get", outcome="breaker_open")
+            return None
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            L2_REQUESTS.inc(op="get", outcome="error")
+            return None
+        if raw is None:
+            L2_REQUESTS.inc(op="get", outcome="miss")
+            return None
+        entry = decode_entry(raw)
+        if entry is None:
+            L2_REQUESTS.inc(op="get", outcome="corrupt")
+            return None
+        L2_REQUESTS.inc(op="get", outcome="hit")
+        return entry
+
+    async def put(self, key: str, entry: CachedTile) -> bool:
+        parts: List[bytes] = [
+            b"SET", self._key(key), encode_entry(entry),
+        ]
+        if self.ttl_s > 0:
+            parts += [b"PX", str(int(self.ttl_s * 1000)).encode()]
+        try:
+            await self._guarded(*parts)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            L2_REQUESTS.inc(op="put", outcome="error")
+            return False
+        L2_REQUESTS.inc(op="put", outcome="stored")
+        return True
+
+    async def delete_image(self, image_id: int) -> int:
+        """Best-effort purge of every L2 key of one image: cursor SCAN
+        with a MATCH on the key schema's image prefix, DEL in batches.
+        Returns how many keys went (0 on any failure)."""
+        pattern = (self.key_prefix + f"img={int(image_id)}|*").encode()
+        removed = 0
+        cursor = b"0"
+        try:
+            for _ in range(1024):  # hard bound on SCAN round trips
+                reply = await self._guarded(
+                    b"SCAN", cursor, b"MATCH", pattern,
+                    b"COUNT", b"512",
+                )
+                cursor, keys = reply[0], reply[1]
+                if keys:
+                    await self._guarded(b"DEL", *keys)
+                    removed += len(keys)
+                if cursor == b"0":
+                    break
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            L2_REQUESTS.inc(op="purge", outcome="error")
+            return removed
+        L2_REQUESTS.inc(op="purge", outcome="done")
+        return removed
+
+    async def close(self) -> None:
+        if self._writer is not None:  # ompb-lint: disable=lock-discipline -- teardown path: taking the op lock here could park close() behind a wedged exchange (the auth-store close precedent)
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except Exception:
+                pass
+            self._writer = None
+
+    def snapshot(self) -> dict:
+        return {
+            "host": self.host,
+            "port": self.port,
+            "ttl_s": self.ttl_s,
+            "breaker": self.breaker.state,
+        }

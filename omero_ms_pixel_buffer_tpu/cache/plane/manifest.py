@@ -1,0 +1,296 @@
+"""Crash-consistent disk-tier manifest — warm restarts for the spill tier.
+
+The disk tier's index lived in RAM and leftover files were swept at
+startup, so every restart began cold (KNOWN_GAPS "Tile cache", the
+volatile-disk-tier item). This journal makes the tier restartable
+without making the hot path pay for durability:
+
+- **Append-only journal** (``manifest.journal`` in the spill dir): one
+  checksummed record per admission/eviction —
+  ``<crc32-hex> <compact-json>\\n``. Appends are buffered writes with
+  no per-record fsync: losing the tail of the journal in a crash just
+  means a slightly colder restart, never corruption.
+- **Replay at startup**: records apply in order (an admit overwrites,
+  an evict deletes). Replay stops at the first record whose checksum
+  or framing fails — a *torn tail* from a crash mid-append — and
+  truncates the journal there, so one bad byte never poisons the
+  records before it.
+- **Reconcile against the directory**: journal entries whose file is
+  missing or size-mismatched are dropped (the admit record raced a
+  crash before the data hit disk); ``.tile``/``.tmp`` files the journal
+  doesn't claim are orphans from a crash between ``os.replace`` and
+  the append — deleted, with a directory fsync afterwards so a crash
+  mid-*cleanup* cannot resurrect half-deleted entries on the next
+  replay (the startup-sweep satellite).
+- **Compaction**: when the journal grows past ``compact_bytes`` it is
+  rewritten as pure admits of the live index (tmp + fsync + rename +
+  dir fsync), bounding replay time. Startup always compacts after
+  reconcile so each boot starts from a clean prefix.
+
+Timestamps are journaled as wall-clock and rebased onto the new
+process's monotonic clock at replay (``stored_at`` feeds the TTL rule,
+which uses ``time.monotonic``).
+
+Everything here runs on the cache's single I/O executor thread (the
+DiskTier contract) or at construction time — blocking file I/O is the
+point.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+import zlib
+from collections import OrderedDict
+from typing import Callable, List, Tuple
+
+log = logging.getLogger("omero_ms_pixel_buffer_tpu.cache.plane")
+
+JOURNAL_NAME = "manifest.journal"
+
+
+def fsync_dir(path: str) -> None:
+    """Durably commit directory-entry operations (rename/unlink) the
+    way the files themselves are committed with fsync. Best-effort on
+    platforms/filesystems that refuse O_DIRECTORY semantics."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _frame(payload: bytes) -> bytes:
+    return b"%08x %s\n" % (zlib.crc32(payload), payload)
+
+
+class DiskManifest:
+    """The journal for one spill directory. The owner (DiskTier) calls
+    ``restore()`` once at construction and ``record_admit`` /
+    ``record_evict`` from its I/O thread afterwards; ``maybe_compact``
+    runs opportunistically after appends."""
+
+    def __init__(self, directory: str, compact_bytes: int = 1 << 20):
+        self.directory = directory
+        self.path = os.path.join(directory, JOURNAL_NAME)
+        self.compact_bytes = compact_bytes
+        self._fh = None
+        self._closed = False
+        self._journal_bytes = 0
+        self.replayed = 0
+        self.torn = False
+        self.orphans_removed = 0
+        self.dropped_missing = 0
+
+    # -- startup: replay + reconcile -----------------------------------
+
+    def restore(
+        self, fname_of: Callable[[str], str]
+    ) -> List[Tuple[str, int, str, str, float]]:
+        """Replay the journal and reconcile it against the directory.
+        Returns the live entries as ``(key, nbytes, etag, filename,
+        stored_at_monotonic)`` in admission order; leaves the journal
+        compacted and the append handle open."""
+        index = self._replay()
+        live: "OrderedDict[str, tuple]" = OrderedDict()
+        claimed = set()
+        for key, meta in index.items():
+            nbytes = meta["n"]
+            file_name = fname_of(key)
+            path = os.path.join(self.directory, file_name)
+            try:
+                actual = os.path.getsize(path)
+            except OSError:
+                actual = -1
+            if actual != nbytes:
+                # the admit record outran the data (or the file was
+                # truncated): drop the entry; the orphan pass below
+                # removes any partial file
+                self.dropped_missing += 1
+                continue
+            claimed.add(file_name)
+            live[key] = meta
+        # orphan pass: data files the journal does not claim (crash
+        # between os.replace and the admit append, or entries dropped
+        # above) and stale tmp files
+        removed = False
+        for name in os.listdir(self.directory):
+            if name == JOURNAL_NAME:
+                continue
+            if not name.endswith((".tile", ".tmp")):
+                continue
+            if name in claimed:
+                continue
+            try:
+                os.unlink(os.path.join(self.directory, name))
+                self.orphans_removed += 1
+                removed = True
+            except OSError:
+                pass
+        if removed:
+            fsync_dir(self.directory)
+        self.replayed = len(live)
+        # start every boot from a clean, bounded prefix
+        self.compact(
+            [(k, m["n"], m["etag"], m["fn"], m["wall"])
+             for k, m in live.items()],
+            raw_wall=True,
+        )
+        now_mono, now_wall = time.monotonic(), time.time()
+        return [
+            (
+                k, m["n"], m["etag"], m["fn"],
+                now_mono - max(0.0, now_wall - m["wall"]),
+            )
+            for k, m in live.items()
+        ]
+
+    def _replay(self) -> "OrderedDict[str, dict]":
+        index: "OrderedDict[str, dict]" = OrderedDict()
+        try:
+            fh = open(self.path, "rb")
+        except FileNotFoundError:
+            return index
+        with fh:
+            good_offset = 0
+            while True:
+                line = fh.readline()
+                if not line:
+                    break
+                record = self._parse(line)
+                if record is None:
+                    # torn tail (crash mid-append) or corruption:
+                    # everything before this offset is intact —
+                    # truncate here and keep it
+                    self.torn = True
+                    break
+                good_offset += len(line)
+                op = record.get("op")
+                key = record.get("key")
+                if op == "admit" and isinstance(key, str):
+                    index[key] = {
+                        "n": int(record["n"]),
+                        "etag": record.get("etag") or "",
+                        "fn": record.get("fn") or "",
+                        "wall": float(record.get("wall") or 0.0),
+                    }
+                    index.move_to_end(key)
+                elif op == "evict" and isinstance(key, str):
+                    index.pop(key, None)
+        if self.torn:
+            try:
+                with open(self.path, "rb+") as fh:
+                    fh.truncate(good_offset)
+            except OSError:
+                pass
+        return index
+
+    @staticmethod
+    def _parse(line: bytes):
+        if not line.endswith(b"\n"):
+            return None  # torn: the final append never finished
+        body = line[:-1]
+        if len(body) < 10 or body[8:9] != b" ":
+            return None
+        payload = body[9:]
+        try:
+            if int(body[:8], 16) != zlib.crc32(payload):
+                return None
+            record = json.loads(payload)
+        except (ValueError, UnicodeDecodeError):
+            return None
+        return record if isinstance(record, dict) else None
+
+    # -- runtime appends (DiskTier I/O thread) -------------------------
+
+    def _append(self, record: dict) -> None:
+        if self._closed:
+            # a spill racing close() (shutdown(wait=False)) must not
+            # silently reopen the journal: an in-process successor may
+            # already be compacting this path. The dropped record's
+            # file reconciles as an orphan at the next boot.
+            raise OSError("manifest journal closed")
+        if self._fh is None:
+            self._fh = open(self.path, "ab")
+            self._journal_bytes = self._fh.tell()
+        framed = _frame(
+            json.dumps(record, separators=(",", ":")).encode()
+        )
+        self._fh.write(framed)
+        self._fh.flush()  # buffered -> OS; no fsync (see module doc)
+        self._journal_bytes += len(framed)
+
+    def record_admit(
+        self, key: str, nbytes: int, etag: str, filename: str,
+        stored_at_monotonic: float,
+    ) -> None:
+        wall = time.time() - max(
+            0.0, time.monotonic() - stored_at_monotonic
+        )
+        self._append({
+            "op": "admit", "key": key, "n": nbytes, "etag": etag,
+            "fn": filename, "wall": wall,
+        })
+
+    def record_evict(self, key: str) -> None:
+        self._append({"op": "evict", "key": key})
+
+    @property
+    def needs_compaction(self) -> bool:
+        return self._journal_bytes > self.compact_bytes
+
+    def compact(
+        self, live: List[tuple], raw_wall: bool = False
+    ) -> None:
+        """Atomically rewrite the journal as pure admits of ``live``
+        entries ``(key, nbytes, etag, filename, stored_at)``. The tmp
+        file is fsynced before the rename and the directory after it —
+        a crash leaves either the old journal or the new one, never a
+        mix."""
+        if self._closed:
+            return  # post-close race: a successor owns the path now
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        tmp = self.path + ".compact"
+        now_mono, now_wall = time.monotonic(), time.time()
+        with open(tmp, "wb") as fh:
+            for key, nbytes, etag, filename, stored_at in live:
+                wall = stored_at if raw_wall else (
+                    now_wall - max(0.0, now_mono - stored_at)
+                )
+                fh.write(_frame(json.dumps(
+                    {"op": "admit", "key": key, "n": nbytes,
+                     "etag": etag, "fn": filename, "wall": wall},
+                    separators=(",", ":"),
+                ).encode()))
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self.path)
+        fsync_dir(self.directory)
+        self._journal_bytes = os.path.getsize(self.path)
+
+    def close(self) -> None:
+        self._closed = True
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+
+    def snapshot(self) -> dict:
+        return {
+            "journal_bytes": self._journal_bytes,
+            "replayed": self.replayed,
+            "torn_tail": self.torn,
+            "orphans_removed": self.orphans_removed,
+            "dropped_missing": self.dropped_missing,
+        }

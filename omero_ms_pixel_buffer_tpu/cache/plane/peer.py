@@ -1,0 +1,203 @@
+"""Peer fetch — one bounded internal GET to a tile's owner.
+
+A replica that misses locally (and in L2) on a tile it does NOT own
+asks the owner once before rendering locally. The owner serves from
+its cache or renders exactly once (its local single-flight coalesces
+concurrent peer fetches with its own traffic), which makes the
+single-flight dedupe effectively cross-process: a popular tile going
+cold cluster-wide is rendered by one process, not N.
+
+The client is a deliberately minimal HTTP/1.1 GET over asyncio streams
+(the RESP/Postgres wire-client precedent — and it keeps the whole
+exchange inside one ``asyncio.wait_for`` window):
+
+- ``X-OMPB-Peer: <self-url>`` marks the hop; the receiving server
+  treats any request carrying it as terminal (serve locally, never
+  re-forward), so ownership disagreements between replicas mid-config-
+  change cost one extra render, never a forwarding loop;
+- the browser's ``sessionid`` cookie is forwarded verbatim, so the
+  owner applies the same session auth + ACL path it applies to direct
+  traffic — peer fetch grants nothing the caller could not get itself;
+- the deadline is short (``cluster.peer-timeout-ms``) and the whole
+  exchange — connect, request, response — sits under it;
+- each member gets its own ``cache:peer:<host:port>`` breaker (one
+  dead peer must not stop fetches to the others) and the shared
+  ``cache.peer`` fault point drives the chaos suite.
+
+Every failure degrades to "render locally" — exactly today's
+single-process behavior.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import re
+import time
+from typing import Dict, Optional, Tuple
+from urllib.parse import urlparse
+
+from ...resilience.breaker import BreakerOpenError, for_dependency
+from ...resilience.faultinject import INJECTOR
+from ...utils.metrics import REGISTRY
+
+log = logging.getLogger("omero_ms_pixel_buffer_tpu.cache.plane")
+
+PEER_REQUESTS = REGISTRY.counter(
+    "tile_cache_peer_requests_total",
+    "Peer-fetch attempts by outcome",
+)
+
+PEER_HEADER = "X-OMPB-Peer"
+_MAX_BODY = 64 << 20  # hard bound on a peer reply body
+_FILENAME_RE = re.compile(r'filename="([^"]*)"')
+
+
+def filename_from_disposition(value: str) -> str:
+    m = _FILENAME_RE.search(value or "")
+    return m.group(1) if m else ""
+
+
+class PeerClient:
+    """Issues the bounded internal GETs. One instance per process;
+    connections are per-call (Connection: close) — peer fetches are
+    rare (only non-owner cold misses) so a pool would be dead weight."""
+
+    def __init__(self, self_url: str, timeout_s: float = 0.5):
+        self.self_url = self_url
+        self.timeout_s = timeout_s
+        self._breakers: Dict[str, object] = {}
+
+    def _breaker(self, member: str):
+        b = self._breakers.get(member)
+        if b is None:
+            netloc = urlparse(member).netloc or member
+            b = for_dependency(f"cache:peer:{netloc}")
+            self._breakers[member] = b
+        return b
+
+    async def fetch(
+        self,
+        member: str,
+        path_qs: str,
+        session_cookie: Optional[str],
+    ) -> Optional[Tuple[int, Dict[str, str], bytes]]:
+        """GET ``path_qs`` from ``member``; ``(status, headers, body)``
+        on an HTTP-complete exchange, None on any transport failure,
+        timeout, or open breaker (the caller renders locally)."""
+        breaker = self._breaker(member)
+        try:
+            breaker.allow()
+        except BreakerOpenError:
+            PEER_REQUESTS.inc(outcome="breaker_open")
+            return None
+        t0 = time.monotonic()
+        try:
+            await INJECTOR.fire_async("cache.peer")
+            result = await asyncio.wait_for(
+                self._exchange(member, "GET", path_qs, session_cookie),
+                self.timeout_s,
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            breaker.record_failure()
+            PEER_REQUESTS.inc(outcome="error")
+            return None
+        breaker.record_success(duration_s=time.monotonic() - t0)
+        return result
+
+    async def purge(self, member: str, image_id: int) -> bool:
+        """Best-effort invalidation fan-out: POST the internal purge
+        endpoint on one peer. False (never an exception) on failure —
+        a dead peer must not block anyone's local purge."""
+        breaker = self._breaker(member)
+        try:
+            breaker.allow()
+        except BreakerOpenError:
+            PEER_REQUESTS.inc(outcome="purge_breaker_open")
+            return False
+        t0 = time.monotonic()
+        try:
+            await INJECTOR.fire_async("cache.peer")
+            result = await asyncio.wait_for(
+                self._exchange(
+                    member, "POST", f"/internal/purge/{int(image_id)}",
+                    None,
+                ),
+                self.timeout_s,
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            breaker.record_failure()
+            PEER_REQUESTS.inc(outcome="purge_error")
+            return False
+        breaker.record_success(duration_s=time.monotonic() - t0)
+        ok = result is not None and result[0] == 200
+        PEER_REQUESTS.inc(
+            outcome="purge_ok" if ok else "purge_rejected"
+        )
+        return ok
+
+    async def _exchange(
+        self,
+        member: str,
+        method: str,
+        path_qs: str,
+        session_cookie: Optional[str],
+    ) -> Tuple[int, Dict[str, str], bytes]:
+        parsed = urlparse(member)
+        host = parsed.hostname or "localhost"
+        port = parsed.port or 80
+        reader, writer = await asyncio.open_connection(host, port)
+        try:
+            lines = [
+                f"{method} {path_qs} HTTP/1.1",
+                f"Host: {parsed.netloc}",
+                f"{PEER_HEADER}: {self.self_url}",
+                "Connection: close",
+                "Accept-Encoding: identity",
+                "Content-Length: 0",
+            ]
+            if session_cookie:
+                lines.append(f"Cookie: sessionid={session_cookie}")
+            writer.write(
+                ("\r\n".join(lines) + "\r\n\r\n").encode()
+            )
+            await writer.drain()
+            status_line = await reader.readline()
+            parts = status_line.decode("latin-1").split(None, 2)
+            if len(parts) < 2 or not parts[1].isdigit():
+                raise ConnectionError(
+                    f"malformed peer status line: {status_line!r}"
+                )
+            status = int(parts[1])
+            headers: Dict[str, str] = {}
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                name, _, value = line.decode("latin-1").partition(":")
+                headers[name.strip().lower()] = value.strip()
+            length = headers.get("content-length")
+            if length is not None:
+                n = int(length)
+                if n > _MAX_BODY:
+                    raise ConnectionError("peer reply too large")
+                body = await reader.readexactly(n) if n else b""
+            else:
+                body = await reader.read(_MAX_BODY)
+            return status, headers, body
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    def snapshot(self) -> dict:
+        return {
+            member: getattr(b, "state", "closed")
+            for member, b in sorted(self._breakers.items())
+        }

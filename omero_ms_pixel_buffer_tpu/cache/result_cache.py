@@ -150,7 +150,10 @@ class SegmentedLRU:
     threads). ``put`` returns the evicted ``(key, entry)`` pairs so
     the owner can spill them to the disk tier."""
 
-    def __init__(self, max_bytes: int, protected_fraction: float = 0.8):
+    def __init__(
+        self, max_bytes: int, protected_fraction: float = 0.8,
+        admission=None,
+    ):
         self.max_bytes = max_bytes
         self.protected_max = int(max_bytes * protected_fraction)
         self._probation: "OrderedDict[str, CachedTile]" = OrderedDict()
@@ -160,8 +163,16 @@ class SegmentedLRU:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        # TinyLFU admission policy (cache/plane/tinylfu), or None for
+        # plain SLRU. Accesses are recorded on reads AND writes (the
+        # Caffeine convention); the filter only speaks at eviction
+        # time, when a full cache must choose between the candidate
+        # and the probation victim.
+        self.admission = admission
 
     def get(self, key: str) -> Optional[CachedTile]:
+        if self.admission is not None:
+            self.admission.record(key)
         with self._lock:
             entry = self._protected.get(key)
             if entry is not None:
@@ -196,6 +207,8 @@ class SegmentedLRU:
         evicted: List[Tuple[str, CachedTile]] = []
         if entry.nbytes > self.max_bytes:
             return evicted  # can never fit; not admitted
+        if self.admission is not None:
+            self.admission.record(key)
         with self._lock:
             old = self._probation.pop(key, None)
             if old is None:
@@ -208,6 +221,23 @@ class SegmentedLRU:
             self._bytes += entry.nbytes
             while self._bytes > self.max_bytes:
                 if self._probation:
+                    # TinyLFU gate: the candidate must beat the
+                    # probation victim's frequency to displace it; a
+                    # losing candidate leaves ITSELF (to the disk
+                    # tier, via the evicted list) and the victim keeps
+                    # its residency — this is what stops a one-pass
+                    # robot sweep from churning out the viewer set
+                    victim_key = next(iter(self._probation))
+                    if (
+                        self.admission is not None
+                        and victim_key != key
+                        and key in self._probation
+                        and not self.admission.admit(key, victim_key)
+                    ):
+                        e = self._probation.pop(key)
+                        self._bytes -= e.nbytes
+                        evicted.append((key, e))
+                        break
                     k, e = self._probation.popitem(last=False)
                 elif self._protected:
                     k, e = self._protected.popitem(last=False)
@@ -275,13 +305,20 @@ class SegmentedLRU:
 class DiskTier:
     """Spill directory with an in-memory LRU index. All methods run on
     the cache's I/O executor thread — blocking file I/O is the point.
-    Entries do not survive a restart (the index is authoritative and
-    process-local); leftover files from a previous run are swept at
-    startup."""
 
-    def __init__(self, directory: str, max_bytes: int):
+    With a manifest (cache/plane/manifest, config ``cache.manifest``,
+    default on) the tier is *restartable*: admissions/evictions are
+    journaled and replayed at startup, so a restart begins warm. With
+    the manifest off the pre-r11 behavior holds — the index is
+    process-local and leftover files are swept at startup (now with a
+    directory fsync after the sweep, so a crash mid-cleanup cannot
+    resurrect half-deleted entries for a later manifest run to
+    replay)."""
+
+    def __init__(self, directory: str, max_bytes: int, manifest=None):
         self.directory = directory
         self.max_bytes = max_bytes
+        self.manifest = manifest
         # key -> (path, nbytes, etag, filename, stored_at)
         self._index: "OrderedDict[str, tuple]" = OrderedDict()
         self._bytes = 0
@@ -289,10 +326,46 @@ class DiskTier:
         self.hits = 0
         self.misses = 0
         os.makedirs(directory, exist_ok=True)
+        if manifest is not None:
+            self._restore(manifest)
+            return
+        swept = False
         for stale in os.listdir(directory):
             if stale.endswith((".tile", ".tmp")):
                 try:
                     os.unlink(os.path.join(directory, stale))
+                    swept = True
+                except OSError:
+                    pass
+        if swept:
+            # durably commit the unlinks: without this, a crash after
+            # the sweep can bring the swept entries BACK (the unlinks
+            # lived only in the page cache), and a manifest enabled on
+            # the next boot would replay/reconcile against ghosts
+            from .plane.manifest import fsync_dir
+
+            fsync_dir(directory)
+
+    def _restore(self, manifest) -> None:
+        """Warm start: replay the journal, reconcile against the
+        directory, rebuild the index in admission order. A shrunken
+        ``max_bytes`` (config change across the restart) evicts from
+        the replayed LRU end like any overflow."""
+        with self._lock:  # construction-time, but keep the discipline
+            for key, nbytes, etag, filename, stored_at in (
+                manifest.restore(self._fname)
+            ):
+                path = os.path.join(self.directory, self._fname(key))
+                self._index[key] = (
+                    path, nbytes, etag, filename, stored_at
+                )
+                self._bytes += nbytes
+            while self._bytes > self.max_bytes and len(self._index) > 1:
+                key, meta = self._index.popitem(last=False)
+                self._bytes -= meta[1]
+                manifest.record_evict(key)
+                try:
+                    os.unlink(meta[0])
                 except OSError:
                     pass
 
@@ -324,7 +397,7 @@ class DiskTier:
         with open(tmp, "wb") as fh:
             fh.write(entry.body)
         os.replace(tmp, path)
-        victims: List[str] = []
+        victims: List[Tuple[str, str]] = []  # (key, path)
         with self._lock:
             old = self._index.pop(key, None)
             if old is not None:
@@ -335,15 +408,27 @@ class DiskTier:
             )
             self._bytes += entry.nbytes
             while self._bytes > self.max_bytes and len(self._index) > 1:
-                _, meta = self._index.popitem(last=False)
+                k, meta = self._index.popitem(last=False)
                 self._bytes -= meta[1]
-                victims.append(meta[0])
-        for victim in victims:
+                victims.append((k, meta[0]))
+        for _k, victim_path in victims:
             CACHE_EVICTIONS.inc(tier="disk")
             try:
-                os.unlink(victim)
+                os.unlink(victim_path)
             except OSError:
                 pass
+        if self.manifest is not None:
+            # journal AFTER the data ops: a crash between os.replace
+            # and this append leaves an orphan file, which startup
+            # reconcile removes (the safe direction — an admit record
+            # without data would be a ghost entry instead)
+            self.manifest.record_admit(
+                key, entry.nbytes, entry.etag, entry.filename,
+                entry.stored_at,
+            )
+            for k, _p in victims:
+                self.manifest.record_evict(k)
+            self._maybe_compact()
 
     def remove(self, key: str) -> None:
         with self._lock:
@@ -355,6 +440,9 @@ class DiskTier:
                 os.unlink(meta[0])
             except OSError:
                 pass
+            if self.manifest is not None:
+                self.manifest.record_evict(key)
+                self._maybe_compact()
 
     def remove_prefix(self, prefix: str) -> int:
         with self._lock:
@@ -370,7 +458,25 @@ class DiskTier:
                 os.unlink(meta[0])
             except OSError:
                 pass
+        if self.manifest is not None and victims:
+            for k, _meta in victims:
+                self.manifest.record_evict(k)
+            self._maybe_compact()
         return len(victims)
+
+    def _maybe_compact(self) -> None:
+        """Rewrite a grown journal down to the live index (runs on the
+        I/O thread like every caller). The index snapshot is taken
+        under the lock; the rewrite itself is the manifest's atomic
+        tmp+fsync+rename."""
+        if not self.manifest.needs_compaction:
+            return
+        with self._lock:
+            live = [
+                (k, meta[1], meta[2], meta[3], meta[4])
+                for k, meta in self._index.items()
+            ]
+        self.manifest.compact(live)
 
     @property
     def nbytes(self) -> int:
@@ -405,8 +511,12 @@ class TileResultCache:
         disk_bytes: int = 1 << 30,
         ttl_s: float = 0.0,
         max_entry_bytes: int = 4 << 20,
+        manifest: bool = True,
+        admission=None,
     ):
-        self.memory = SegmentedLRU(memory_bytes, protected_fraction)
+        self.memory = SegmentedLRU(
+            memory_bytes, protected_fraction, admission=admission
+        )
         self.ttl_s = ttl_s  # 0 = no expiry (DB invalidation handles it)
         self.max_entry_bytes = max_entry_bytes
         # invalidation generation: bumped on every purge. A fill whose
@@ -424,7 +534,14 @@ class TileResultCache:
         self._disk_error_logged = False
         if disk_dir:
             try:
-                self.disk = DiskTier(disk_dir, disk_bytes)
+                disk_manifest = None
+                if manifest:
+                    from .plane.manifest import DiskManifest
+
+                    disk_manifest = DiskManifest(disk_dir)
+                self.disk = DiskTier(
+                    disk_dir, disk_bytes, manifest=disk_manifest
+                )
                 self._io = concurrent.futures.ThreadPoolExecutor(
                     max_workers=1, thread_name_prefix="tile-cache-io"
                 )
@@ -497,8 +614,15 @@ class TileResultCache:
                     CACHE_REQUESTS.inc(tier="disk", outcome="miss")
                     return None
                 # re-admission displaces like any insert: spill the
-                # victims, don't silently drop them from both tiers
-                self._spill_evicted(evicted)
+                # victims, don't silently drop them from both tiers.
+                # EXCEPT the just-read key itself — when the TinyLFU
+                # gate refuses to re-admit it, the bytes are already
+                # on disk (disk hits don't remove), and re-spilling
+                # would rewrite an identical file + journal record on
+                # every read of every below-the-frequency-bar key
+                self._spill_evicted(
+                    [(k, e) for k, e in evicted if k != key]
+                )
                 CACHE_REQUESTS.inc(tier="disk", outcome="hit")
                 return entry
             CACHE_REQUESTS.inc(tier="disk", outcome="miss")
@@ -660,13 +784,24 @@ class TileResultCache:
 
     def snapshot(self) -> dict:
         out = {"enabled": True, "memory": self.memory.snapshot()}
+        if self.memory.admission is not None:
+            out["admission"] = self.memory.admission.snapshot()
         if self.disk is not None:
             disk = self.disk.snapshot()
             disk["breaker"] = self._disk_breaker.state
+            if self.disk.manifest is not None:
+                disk["manifest"] = self.disk.manifest.snapshot()
             out["disk"] = disk
         return out
 
     def close(self) -> None:
         _LIVE_CACHES.discard(self)
         if self._io is not None:
+            # wait=False: a hung disk (the NFS D-state case) must not
+            # wedge app cleanup. A spill racing close() may hit the
+            # closed manifest handle — that reads as a disk failure
+            # (pass-through), and startup reconcile absorbs the
+            # unjournaled file as an orphan.
             self._io.shutdown(wait=False)
+        if self.disk is not None and self.disk.manifest is not None:
+            self.disk.manifest.close()

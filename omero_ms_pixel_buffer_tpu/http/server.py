@@ -35,6 +35,7 @@ from ..auth.omero_session import (
     SessionValidator,
 )
 from ..auth.stores import OmeroWebSessionStore, make_session_store
+from ..cache.plane.peer import PEER_HEADER
 from ..cache.prefetch import ViewportPrefetcher
 from ..cache.result_cache import (
     CachedTile,
@@ -124,8 +125,14 @@ def session_middleware(store: OmeroWebSessionStore, synchronicity: str = "async"
     @web.middleware
     async def middleware(request: web.Request, handler):
         if request.path in ("/metrics", "/healthz") or (
-            request.method == "OPTIONS"
+            request.path.startswith("/internal/")
+            or request.method == "OPTIONS"
         ):
+            # /internal/* is the peer-to-peer surface (cache plane
+            # purge fan-out): peers carry no browser session, and the
+            # handlers only drop caches (re-renders produce identical
+            # bytes) — deploy-time network policy, not session auth,
+            # is the trust boundary there (deploy/nginx.conf.sample)
             return await handler(request)
         session_id = request.cookies.get("sessionid")
         if not session_id:
@@ -358,7 +365,16 @@ class PixelBufferApp:
         cc = config.cache
         self.result_cache: Optional[TileResultCache] = None
         self.prefetcher: Optional[ViewportPrefetcher] = None
+        self.cache_plane = None
         if cc.enabled:
+            admission = None
+            if cc.tinylfu.enabled:
+                from ..cache.plane.tinylfu import TinyLFU
+
+                admission = TinyLFU(
+                    counters=cc.tinylfu.counters,
+                    sample_size=cc.tinylfu.sample_size,
+                )
             self.result_cache = TileResultCache(
                 memory_bytes=cc.memory_mb << 20,
                 protected_fraction=cc.protected_fraction,
@@ -366,7 +382,25 @@ class PixelBufferApp:
                 disk_bytes=cc.disk_mb << 20,
                 ttl_s=cc.ttl_s,
                 max_entry_bytes=cc.max_entry_kb << 10,
+                manifest=cc.manifest,
+                admission=admission,
             )
+            # distributed cache plane (cache/plane/): the shared L2
+            # tier and/or the consistent-hash peer ring — the cluster
+            # layers only make sense over a live local cache (they
+            # fill and are filled by it)
+            cl = config.cluster
+            if cl.plane_enabled:
+                from ..cache.plane import CachePlane
+
+                self.cache_plane = CachePlane(
+                    members=cl.members,
+                    self_url=cl.self_url,
+                    virtual_nodes=cl.virtual_nodes,
+                    peer_timeout_s=cl.peer_timeout_ms / 1000.0,
+                    l2_uri=cl.l2.uri,
+                    l2_ttl_s=cl.l2.ttl_s,
+                )
             if cc.prefetch.enabled:
                 self.prefetcher = ViewportPrefetcher(
                     self._prefetch_fetch,
@@ -447,6 +481,10 @@ class PixelBufferApp:
         app.router.add_get(
             "/tile/{imageId}/{z}/{c}/{t}", self.handle_get_tile
         )
+        if self.cache_plane is not None:
+            app.router.add_post(
+                "/internal/purge/{imageId}", self.handle_internal_purge
+            )
         if self.config.render.enabled:
             app.router.add_get(
                 "/render/{imageId}/{z}/{c}/{t}", self.handle_get_render
@@ -470,6 +508,10 @@ class PixelBufferApp:
             self.prefetcher.start()
         if self.mesh_prober is not None:
             self.mesh_prober.start()
+        if self.cache_plane is not None:
+            # the plane needs the serving loop: invalidation listeners
+            # fire from resolver threads and schedule their fan-out here
+            self.cache_plane.start(asyncio.get_running_loop())
 
     async def _on_cleanup(self, app) -> None:
         # stop() analog (:298-308): worker, session store, pixel
@@ -480,6 +522,8 @@ class PixelBufferApp:
             self.mesh_prober.stop()
         if self.prefetcher is not None:
             await self.prefetcher.close()
+        if self.cache_plane is not None:
+            await self.cache_plane.close()
         if self.result_cache is not None:
             self.result_cache.close()
         await self.worker.close()
@@ -515,6 +559,8 @@ class PixelBufferApp:
         planes = self.pipeline.plane_cache_snapshot()
         if planes is not None:
             cache_health["device_planes"] = planes
+        if self.cache_plane is not None:
+            cache_health["plane"] = self.cache_plane.snapshot()
         prefetch_health = (
             self.prefetcher.snapshot()
             if self.prefetcher is not None
@@ -650,6 +696,10 @@ class PixelBufferApp:
             )
             msg.headers["etag"] = entry.etag
             await cache.put(key, entry, generation=generation)
+            if self.cache_plane is not None:
+                # write-through to the shared L2 tier, once per flight
+                # (fire-and-forget: Redis must never cost the reply)
+                self.cache_plane.publish(key, entry)
 
         return fill
 
@@ -677,17 +727,41 @@ class PixelBufferApp:
         dedupe against concurrent real requests."""
         await self._fetch_tile(ctx, key)
 
-    def _invalidate_image(self, image_id: int) -> None:
-        """Metadata-change listener: purge every cached artifact of
-        the image (called from the resolver's refresh thread) — tiles,
-        authorization verdicts (the row change may BE an ACL change),
-        the open buffer, and device planes."""
+    def _invalidate_local(self, image_id: int) -> None:
+        """Purge every PROCESS-LOCAL cached artifact of one image —
+        tiles, authorization verdicts (the row change may BE an ACL
+        change), the open buffer, and device planes. Callable from any
+        thread; also the inbound target of a peer purge (which must
+        NOT re-fan-out, or two replicas would purge-ping-pong)."""
         if self.result_cache is not None:
             self.result_cache.invalidate_image(image_id)
         if self.prefetcher is not None:
             self.prefetcher.invalidate_image(image_id)
         self._authz_purge(image_id)
         self.pipeline.invalidate_image(image_id)
+
+    def _invalidate_image(self, image_id: int) -> None:
+        """Metadata-change listener (the resolver's refresh thread):
+        local purge first — synchronous, unconditional — then the
+        best-effort cluster fan-out (L2 DELs + peer purges), which is
+        scheduled on the serving loop and can never block or fail the
+        local purge."""
+        self._invalidate_local(image_id)
+        if self.cache_plane is not None:
+            self.cache_plane.invalidate_image(image_id)
+
+    async def handle_internal_purge(self, request: web.Request) -> web.Response:
+        """Inbound half of the purge fan-out. Requires the peer
+        header (the same loop guard as tile forwarding: a peer-
+        originated purge is terminal here)."""
+        if PEER_HEADER not in request.headers:
+            return web.Response(status=403, text="peer requests only")
+        try:
+            image_id = int(request.match_info["imageId"])
+        except (TypeError, ValueError):
+            return web.Response(status=400, text="bad image id")
+        self._invalidate_local(image_id)
+        return web.json_response({"purged": image_id})
 
     def _full_plane_extent(self, ctx: TileCtx):
         """(size_x, size_y) of the ctx's plane at its resolution
@@ -801,9 +875,45 @@ class PixelBufferApp:
             await self._normalize_region(ctx)
         inm = request.headers.get("If-None-Match", "")
         key = None
+        plane_entry = plane_source = None
         if cache is not None:
             key = ctx.cache_key(self.pipeline.encode_signature())
             entry = await cache.get(key)
+            if entry is None and self.cache_plane is not None:
+                # the cluster consult, between local miss and render:
+                # shared L2 first, then one bounded GET to the key's
+                # owner. Generation snapshot BEFORE the network hop —
+                # an invalidation racing the fetch must block the
+                # local re-admission (the disk-tier precedent).
+                generation = cache.generation()
+                plane_entry, plane_source = await self.cache_plane.fetch(
+                    key,
+                    request.path_qs,
+                    request.cookies.get("sessionid"),
+                    peer_originated=PEER_HEADER in request.headers,
+                )
+                if plane_entry is not None:
+                    if await self._authorize_cached(ctx):
+                        await cache.put(
+                            key, plane_entry, generation=generation
+                        )
+                        if self.prefetcher is not None:
+                            self.prefetcher.observe(ctx)
+                        if inm and etag_matches(inm, plane_entry.etag):
+                            return web.Response(
+                                status=304,
+                                headers=self._cache_headers(
+                                    plane_entry.etag
+                                ),
+                            )
+                        return self._tile_response(
+                            ctx, plane_entry.body, plane_entry.filename,
+                            plane_entry.etag, x_cache=plane_source,
+                        )
+                    # authorization didn't confirm: full path below
+                    # maps 403/404/503 properly (and never admits the
+                    # fetched bytes under an unverified session)
+                    plane_entry = None
             if entry is not None:
                 if inm and etag_matches(inm, entry.etag) and (
                     self.config.cache.etag_precheck

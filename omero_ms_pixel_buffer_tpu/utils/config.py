@@ -192,6 +192,19 @@ class PrefetchConfig:
 
 
 @dataclasses.dataclass
+class TinyLfuConfig:
+    """TinyLFU admission for the memory tier (cache.tinylfu —
+    cache/plane/tinylfu.py). ``counters`` sizes the 4-bit count-min
+    sketch (and the doorkeeper bloom bits); ``sample_size`` is the
+    aging period in recorded accesses, 0 = 10x counters (the Caffeine
+    default shape)."""
+
+    enabled: bool = True
+    counters: int = 16384
+    sample_size: int = 0
+
+
+@dataclasses.dataclass
 class CacheConfig:
     """The cache: block — the tiered rendered-tile result cache
     (cache/ package). ``disk_dir`` None disables the spill tier;
@@ -199,7 +212,8 @@ class CacheConfig:
     still purges); ``etag_precheck`` answers If-None-Match 304s from
     the cache before the per-request OMERO session join (safe: a
     matching strong content ETag proves the client already holds
-    those exact bytes)."""
+    those exact bytes); ``manifest`` journals the disk tier so
+    restarts begin warm (cache/plane/manifest.py)."""
 
     enabled: bool = True
     memory_mb: int = 256
@@ -210,9 +224,48 @@ class CacheConfig:
     max_entry_kb: int = 4096
     max_age_s: float = 60.0
     etag_precheck: bool = True
+    manifest: bool = True
     prefetch: PrefetchConfig = dataclasses.field(
         default_factory=PrefetchConfig
     )
+    tinylfu: TinyLfuConfig = dataclasses.field(
+        default_factory=TinyLfuConfig
+    )
+
+
+@dataclasses.dataclass
+class ClusterL2Config:
+    """The shared L2 tier (cluster.l2 — cache/plane/l2.py): a Redis
+    consulted between local miss and render. ``uri`` None disables;
+    ``ttl_s`` bounds staleness for entries whose writer died before
+    an invalidation reached Redis (0 = no expiry)."""
+
+    uri: Optional[str] = None
+    ttl_s: float = 3600.0
+
+
+@dataclasses.dataclass
+class ClusterConfig:
+    """The cluster: block — the distributed cache plane
+    (cache/plane/). ``members`` is the STATIC replica list (every
+    replica must configure the identical list — the consistent-hash
+    ring is computed locally from it); ``self_url`` identifies this
+    replica in that list and enables the ownership ring + peer fetch.
+    An empty block (the default) keeps the service single-process."""
+
+    members: tuple = ()
+    self_url: Optional[str] = None
+    virtual_nodes: int = 64
+    peer_timeout_ms: float = 500.0
+    l2: ClusterL2Config = dataclasses.field(
+        default_factory=ClusterL2Config
+    )
+
+    @property
+    def plane_enabled(self) -> bool:
+        return bool(self.l2.uri) or (
+            bool(self.members) and self.self_url is not None
+        )
 
 
 @dataclasses.dataclass
@@ -296,6 +349,9 @@ class Config:
         default_factory=ResilienceConfig
     )
     cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
+    cluster: ClusterConfig = dataclasses.field(
+        default_factory=ClusterConfig
+    )
     render: RenderConfig = dataclasses.field(default_factory=RenderConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     jax: JaxConfig = dataclasses.field(default_factory=JaxConfig)
@@ -445,6 +501,17 @@ class Config:
             raise ConfigError(
                 "'cache.prefetch.headroom' must be in [0, 1]"
             )
+        tl = cc.get("tinylfu") or {}
+        unknown = set(tl) - {"enabled", "counters", "sample-size"}
+        if unknown:
+            raise ConfigError(
+                f"Unknown keys in 'cache.tinylfu' block: {sorted(unknown)}"
+            )
+        tinylfu = TinyLfuConfig(
+            enabled=bool(tl.get("enabled", True)),
+            counters=_num(tl, "counters", 16384, 2, int),
+            sample_size=_num(tl, "sample-size", 0, 0, int),
+        )
         return CacheConfig(
             enabled=bool(cc.get("enabled", True)),
             memory_mb=_num(cc, "memory-mb", 256, 1, int),
@@ -455,12 +522,102 @@ class Config:
             max_entry_kb=_num(cc, "max-entry-kb", 4096, 1, int),
             max_age_s=_num(cc, "max-age-s", 60.0, 0.0),
             etag_precheck=bool(cc.get("etag-precheck", True)),
+            manifest=bool(cc.get("manifest", True)),
+            tinylfu=tinylfu,
             prefetch=PrefetchConfig(
                 enabled=bool(pf.get("enabled", True)),
                 queue_size=_num(pf, "queue-size", 256, 1, int),
                 headroom=headroom,
                 budget_ms=_num(pf, "budget-ms", 0.0, 0.0),
                 lookahead=_num(pf, "lookahead", 2, 1, int),
+            ),
+        )
+
+    @staticmethod
+    def _parse_cluster(raw: dict) -> ClusterConfig:
+        """Validate the cluster: block — the same posture as the
+        other blocks: typos and nonsense fail at startup. A cluster
+        whose ring members disagree about the member list would
+        silently double-render (never corrupt — keys carry the full
+        encode signature), but a ``self`` not present in ``members``
+        is ALWAYS a config error and fails loudly."""
+        cl = raw.get("cluster") or {}
+        unknown = set(cl) - {
+            "members", "self", "virtual-nodes", "peer-timeout-ms", "l2",
+        }
+        if unknown:
+            raise ConfigError(
+                f"Unknown keys in 'cluster' block: {sorted(unknown)}"
+            )
+        members_raw = cl.get("members") or []
+        if isinstance(members_raw, str):
+            members_raw = [members_raw]
+        if not isinstance(members_raw, (list, tuple)):
+            raise ConfigError(
+                "'cluster.members' must be a list of replica URLs"
+            )
+        members = []
+        for m in members_raw:
+            if not isinstance(m, str) or not m.strip():
+                raise ConfigError(
+                    f"Invalid 'cluster.members' entry: {m!r}"
+                )
+            members.append(m.strip().rstrip("/"))
+        if len(set(members)) != len(members):
+            raise ConfigError("'cluster.members' has duplicate entries")
+        self_url = cl.get("self")
+        if self_url is not None:
+            if not isinstance(self_url, str) or not self_url.strip():
+                raise ConfigError(
+                    f"Invalid value for 'cluster.self': {self_url!r}"
+                )
+            self_url = self_url.strip().rstrip("/")
+        if members and self_url is None:
+            raise ConfigError(
+                "'cluster.members' set without 'cluster.self' — this "
+                "replica cannot locate itself on the ownership ring"
+            )
+        if self_url is not None and members and self_url not in members:
+            raise ConfigError(
+                f"'cluster.self' ({self_url}) is not one of "
+                "'cluster.members'"
+            )
+
+        def _num(block: dict, key: str, default, minimum, cast=float):
+            try:
+                value = cast(block.get(key, default))
+            except (TypeError, ValueError):
+                raise ConfigError(
+                    f"Invalid value for 'cluster...{key}': "
+                    f"{block.get(key)!r}"
+                ) from None
+            if value < minimum:
+                raise ConfigError(
+                    f"'cluster...{key}' must be >= {minimum}"
+                )
+            return value
+
+        l2_raw = cl.get("l2") or {}
+        unknown = set(l2_raw) - {"uri", "ttl-s"}
+        if unknown:
+            raise ConfigError(
+                f"Unknown keys in 'cluster.l2' block: {sorted(unknown)}"
+            )
+        l2_uri = l2_raw.get("uri")
+        if l2_uri is not None and (
+            not isinstance(l2_uri, str) or not l2_uri
+        ):
+            raise ConfigError(
+                f"Invalid value for 'cluster.l2.uri': {l2_uri!r}"
+            )
+        return ClusterConfig(
+            members=tuple(members),
+            self_url=self_url,
+            virtual_nodes=_num(cl, "virtual-nodes", 64, 1, int),
+            peer_timeout_ms=_num(cl, "peer-timeout-ms", 500.0, 1.0),
+            l2=ClusterL2Config(
+                uri=l2_uri,
+                ttl_s=_num(l2_raw, "ttl-s", 3600.0, 0.0),
             ),
         )
 
@@ -625,6 +782,7 @@ class Config:
             backend=backend,
             resilience=cls._parse_resilience(raw),
             cache=cls._parse_cache(raw),
+            cluster=cls._parse_cluster(raw),
             render=cls._parse_render(raw),
             mesh=cls._parse_mesh(raw),
             jax=cls._parse_jax(raw),

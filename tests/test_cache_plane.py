@@ -1,0 +1,991 @@
+"""Distributed cache plane (cache/plane/): the r11 cluster layers.
+
+Covers the four layers end to end:
+
+- **manifest** — disk-tier journal replay (warm restart), torn tails,
+  orphan/missing-file reconcile, checksum corruption, compaction;
+- **tinylfu** — sketch estimates vs exact counts on a Zipfian trace,
+  halving decay, doorkeeper behavior, and an SLRU A/B asserting the
+  viewer working set survives a robot sweep only WITH admission;
+- **ring** — determinism, balance, consistent-hash stability;
+- **l2** — RESP framing round trips against the in-memory stub, TTL,
+  and (under ``-m resilience``) fault/timeout/dead-server degradation;
+- **cluster** — TWO in-process app replicas on real sockets + the
+  RESP stub: render-once cluster-wide with byte-identical ETags,
+  cross-process single-flight, the X-OMPB-Peer loop guard, purge
+  fan-out, and the chaos contract (dead Redis / dead peer / torn
+  journal degrade to single-process behavior; a dead peer never
+  blocks a local purge).
+"""
+
+import asyncio
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+from aiohttp import ClientSession, web
+
+from omero_ms_pixel_buffer_tpu.auth.stores import MemorySessionStore
+from omero_ms_pixel_buffer_tpu.cache.plane.l2 import (
+    RedisL2Tier,
+    decode_entry,
+    encode_entry,
+)
+from omero_ms_pixel_buffer_tpu.cache.plane.manifest import (
+    DiskManifest,
+    JOURNAL_NAME,
+)
+from omero_ms_pixel_buffer_tpu.cache.plane.resp_stub import (
+    InMemoryRespServer,
+)
+from omero_ms_pixel_buffer_tpu.cache.plane.ring import HashRing
+from omero_ms_pixel_buffer_tpu.cache.plane.tinylfu import TinyLFU
+from omero_ms_pixel_buffer_tpu.cache.result_cache import (
+    CachedTile,
+    DiskTier,
+    SegmentedLRU,
+    TileResultCache,
+)
+from omero_ms_pixel_buffer_tpu.http.server import PixelBufferApp
+from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
+from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+    ImageRegistry,
+    PixelsService,
+)
+from omero_ms_pixel_buffer_tpu.resilience import faultinject
+from omero_ms_pixel_buffer_tpu.resilience.breaker import BOARD
+from omero_ms_pixel_buffer_tpu.resilience.faultinject import INJECTOR
+from omero_ms_pixel_buffer_tpu.resilience.timeouts import set_io_timeout
+from omero_ms_pixel_buffer_tpu.utils.config import Config, ConfigError
+
+rng = np.random.default_rng(11)
+IMG = rng.integers(0, 60000, (1, 1, 2, 256, 256), dtype=np.uint16)
+AUTH = {"Cookie": "sessionid=ck"}
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    INJECTOR.clear()
+    yield
+    INJECTOR.clear()
+    BOARD.reset()
+    set_io_timeout(5.0)
+
+
+def _entry(body: bytes, filename: str = "f.png") -> CachedTile:
+    return CachedTile(body, filename=filename)
+
+
+# ---------------------------------------------------------------------------
+# TinyLFU: sketch, doorkeeper, halving, SLRU A/B
+# ---------------------------------------------------------------------------
+
+class TestTinyLFU:
+    def test_doorkeeper_absorbs_first_touch(self):
+        lfu = TinyLFU(counters=1024)
+        assert lfu.estimate("k") == 0
+        lfu.record("k")
+        # first occurrence lives in the doorkeeper only (membership
+        # contributes 1); the sketch is untouched
+        assert lfu.estimate("k") == 1
+        assert lfu.sketch.estimate(
+            __import__(
+                "omero_ms_pixel_buffer_tpu.cache.plane.tinylfu",
+                fromlist=["_hashes"],
+            )._hashes("k")
+        ) == 0
+        lfu.record("k")
+        assert lfu.estimate("k") == 2
+
+    def test_estimates_track_exact_counts_on_zipf_trace(self):
+        lfu = TinyLFU(counters=8192, sample_size=10_000_000)  # no aging
+        trace_rng = np.random.default_rng(42)
+        draws = trace_rng.zipf(1.2, size=20000) % 500
+        exact = {}
+        for d in draws:
+            key = f"tile-{int(d)}"
+            exact[key] = exact.get(key, 0) + 1
+            lfu.record(key)
+        for key, count in exact.items():
+            est = lfu.estimate(key)
+            # count-min never under-estimates (doorkeeper folds the
+            # first touch back in as +1; counters saturate at 15)
+            assert est >= min(count, 16), (key, count, est)
+            # and over-estimation from collisions stays small at this
+            # load factor (500 keys on 8192x4 counters)
+            assert est <= min(count, 16) + 3, (key, count, est)
+
+    def test_halving_decays_history(self):
+        lfu = TinyLFU(counters=256, sample_size=300)
+        for _ in range(40):
+            lfu.record("hot")
+        sat = lfu.estimate("hot")
+        assert sat >= 15
+        # push unrelated traffic until the sample period rolls over
+        for i in range(300):
+            lfu.record(f"noise-{i % 150}")
+        assert lfu.resets >= 1
+        decayed = lfu.estimate("hot")
+        # counters halved and the doorkeeper bit cleared
+        assert decayed <= sat // 2 + 1
+        assert decayed >= 1  # history decays, it doesn't vanish
+
+    def test_admit_prefers_frequent_victim(self):
+        lfu = TinyLFU(counters=1024)
+        for _ in range(8):
+            lfu.record("viewer")
+        lfu.record("robot")
+        assert not lfu.admit("robot", "viewer")
+        assert lfu.admit("viewer", "robot")
+        # ties admit (recency wins — speculative fills survive a cold
+        # sketch; see the module docstring)
+        assert lfu.admit("fresh-a", "fresh-b")
+
+    def _viewer_hits(self, admission) -> tuple:
+        """Mixed workload: 16 viewer tiles looped slowly while a robot
+        sweeps thousands of distinct tiles, touching each TWICE in
+        quick succession. The double touch defeats plain SLRU's scan
+        resistance (sweep keys promote into protected and the churn
+        between two touches of one viewer tile exceeds the whole
+        byte budget); TinyLFU admission compares frequencies at
+        eviction time and refuses to let a twice-seen sweep key
+        displace a many-times-seen viewer tile."""
+        lru = SegmentedLRU(max_bytes=48, admission=admission)
+        viewers = [f"v-{i}" for i in range(16)]
+        hits = 0
+
+        def access(key):
+            nonlocal hits
+            found = lru.get(key) is not None
+            if key.startswith("v-") and found:
+                hits += 1
+            if not found:
+                lru.put(key, _entry(b"x"))
+
+        # warm the viewer set (twice: land them in protected — and in
+        # the sketch, which sees reads and writes)
+        for _ in range(4):
+            for v in viewers:
+                access(v)
+        robot = 0
+        for step in range(600):
+            access(viewers[step % 16])
+            for _ in range(4):  # 4 fresh sweep tiles per viewer touch
+                key = f"r-{robot}"
+                robot += 1
+                access(key)
+                access(key)  # the promoting second touch
+        return hits, 600
+
+    def test_slru_ab_admission_protects_viewer_set(self):
+        plain, touches = self._viewer_hits(admission=None)
+        filtered, _ = self._viewer_hits(
+            admission=TinyLFU(counters=4096, sample_size=10_000_000)
+        )
+        # the filter must be a strict, large improvement under this
+        # workload: the viewer loop should essentially never miss
+        # once the sketch has seen a few loops, while plain SLRU
+        # loses the set to the sweep between touches
+        assert filtered > plain * 1.5, (plain, filtered)
+        assert filtered >= touches * 0.8, (plain, filtered)
+
+
+# ---------------------------------------------------------------------------
+# manifest: journal replay, torn tails, reconcile, compaction
+# ---------------------------------------------------------------------------
+
+def _disk_tier(tmp_path, max_bytes=1 << 20):
+    d = str(tmp_path)
+    return DiskTier(d, max_bytes, manifest=DiskManifest(d))
+
+
+class TestManifest:
+    def test_warm_restart_replays_entries(self, tmp_path):
+        tier = _disk_tier(tmp_path)
+        bodies = {}
+        for i in range(5):
+            body = f"tile-{i}".encode() * 10
+            entry = _entry(body, filename=f"t{i}.png")
+            tier.put(f"img={i}|z=0", entry)
+            bodies[f"img={i}|z=0"] = (body, entry.etag)
+        tier.manifest.close()
+
+        reborn = _disk_tier(tmp_path)
+        assert len(reborn) == 5
+        for key, (body, etag) in bodies.items():
+            got = reborn.get(key)
+            assert got is not None
+            assert got.body == body
+            assert got.etag == etag  # validators survive the restart
+            assert got.filename.endswith(".png")
+
+    def test_evictions_replay(self, tmp_path):
+        tier = _disk_tier(tmp_path)
+        for i in range(5):
+            tier.put(f"img={i}|z=0", _entry(b"x" * 50))
+        tier.remove("img=1|z=0")
+        tier.remove("img=3|z=0")
+        tier.manifest.close()
+        reborn = _disk_tier(tmp_path)
+        assert len(reborn) == 3
+        assert reborn.get("img=1|z=0") is None
+        assert reborn.get("img=0|z=0") is not None
+
+    @pytest.mark.resilience
+    def test_torn_tail_tolerated(self, tmp_path):
+        tier = _disk_tier(tmp_path)
+        for i in range(4):
+            tier.put(f"img={i}|z=0", _entry(b"y" * 30))
+        tier.manifest.close()
+        journal = tmp_path / JOURNAL_NAME
+        with open(journal, "ab") as fh:
+            fh.write(b'deadbeef {"op":"admit","key":"img=9')  # torn
+        reborn = _disk_tier(tmp_path)
+        assert reborn.manifest.torn
+        assert len(reborn) == 4  # everything before the tear survives
+        # and the journal was truncated + compacted: a THIRD boot is
+        # clean
+        reborn.manifest.close()
+        third = _disk_tier(tmp_path)
+        assert not third.manifest.torn
+        assert len(third) == 4
+
+    @pytest.mark.resilience
+    def test_corrupt_record_reads_as_tail(self, tmp_path):
+        tier = _disk_tier(tmp_path)
+        for i in range(5):
+            tier.put(f"img={i}|z=0", _entry(b"z" * 20))
+        tier.manifest.close()
+        journal = tmp_path / JOURNAL_NAME
+        lines = journal.read_bytes().splitlines(keepends=True)
+        lines[2] = b"ffffffff" + lines[2][8:]  # break line 3's crc
+        journal.write_bytes(b"".join(lines))
+        reborn = _disk_tier(tmp_path)
+        # replay stops at the corrupt record; the two intact prefix
+        # entries survive, the rest reconcile away as orphans
+        assert len(reborn) == 2
+        assert reborn.get("img=0|z=0") is not None
+        assert reborn.get("img=3|z=0") is None
+        leftovers = [
+            f for f in os.listdir(tmp_path) if f.endswith(".tile")
+        ]
+        assert len(leftovers) == 2  # orphan data files removed
+
+    def test_orphan_files_removed(self, tmp_path):
+        tier = _disk_tier(tmp_path)
+        tier.put("img=1|z=0", _entry(b"a" * 10))
+        tier.manifest.close()
+        (tmp_path / "feedface.tile").write_bytes(b"stray")
+        (tmp_path / "feedface.tile.tmp").write_bytes(b"stray")
+        reborn = _disk_tier(tmp_path)
+        assert reborn.manifest.orphans_removed >= 2
+        names = set(os.listdir(tmp_path))
+        assert "feedface.tile" not in names
+        assert "feedface.tile.tmp" not in names
+
+    def test_missing_file_drops_entry(self, tmp_path):
+        tier = _disk_tier(tmp_path)
+        tier.put("img=1|z=0", _entry(b"a" * 10))
+        tier.put("img=2|z=0", _entry(b"b" * 10))
+        victim = os.path.join(str(tmp_path), tier._fname("img=1|z=0"))
+        tier.manifest.close()
+        os.unlink(victim)
+        reborn = _disk_tier(tmp_path)
+        assert len(reborn) == 1
+        assert reborn.manifest.dropped_missing == 1
+        assert reborn.get("img=2|z=0") is not None
+
+    def test_compaction_bounds_journal(self, tmp_path):
+        d = str(tmp_path)
+        tier = DiskTier(
+            d, 1 << 20, manifest=DiskManifest(d, compact_bytes=2048)
+        )
+        for round_ in range(40):
+            for i in range(6):
+                tier.put(f"img={i}|r={round_}", _entry(b"c" * 10))
+            for i in range(6):
+                tier.remove(f"img={i}|r={round_}")
+        tier.put("img=keep|z=0", _entry(b"k" * 10))
+        size = os.path.getsize(tmp_path / JOURNAL_NAME)
+        assert size < 8192  # ~40x6 admit+evict pairs would be >40 KiB
+        tier.manifest.close()
+        reborn = _disk_tier(tmp_path)
+        assert len(reborn) == 1
+        assert reborn.get("img=keep|z=0").body == b"k" * 10
+
+    async def test_result_cache_restart_is_warm(self, tmp_path):
+        """The integration shape of the acceptance criterion: spill
+        through the real TileResultCache, close, reopen, hit."""
+        disk = str(tmp_path / "spill")
+        cache = TileResultCache(
+            memory_bytes=256, disk_dir=disk, disk_bytes=1 << 20,
+        )
+        # entries larger than the RAM budget spill on displacement
+        for i in range(4):
+            await cache.put(f"img={i}|z=0|q=s", _entry(b"B" * 200))
+        cache._io.submit(lambda: None).result()  # drain the spill
+        cache.close()
+
+        reborn = TileResultCache(
+            memory_bytes=256, disk_dir=disk, disk_bytes=1 << 20,
+        )
+        try:
+            hits = 0
+            for i in range(4):
+                if await reborn.get(f"img={i}|z=0|q=s") is not None:
+                    hits += 1
+            assert hits >= 3  # warm: at worst the last unspilled entry
+        finally:
+            reborn.close()
+
+    async def test_disk_hit_rejected_by_admission_not_respilled(
+        self, tmp_path
+    ):
+        """A disk hit the TinyLFU gate refuses to re-admit to RAM must
+        NOT rewrite its (identical) bytes + journal record on every
+        read — the file is already on disk."""
+        lfu = TinyLFU(counters=1024, sample_size=10_000_000)
+        cache = TileResultCache(
+            memory_bytes=400, disk_dir=str(tmp_path / "s"),
+            admission=lfu,
+        )
+        try:
+            for i in range(4):  # hot set fills RAM exactly
+                for _ in range(10):
+                    lfu.record(f"hot{i}")
+                await cache.put(f"hot{i}", _entry(b"H" * 100))
+            cache.disk.put("cold", CachedTile(b"C" * 100))
+            jb0 = cache.disk.manifest._journal_bytes
+            for _ in range(3):
+                got = await cache.get("cold")
+                assert got is not None and got.body == b"C" * 100
+                # the hot set kept its RAM residency
+                assert cache.memory.peek("hot0") is not None
+            cache._io.submit(lambda: None).result()
+            assert cache.disk.manifest._journal_bytes == jb0
+        finally:
+            cache.close()
+
+    async def test_manifest_off_restores_cold_sweep(self, tmp_path):
+        disk = str(tmp_path / "spill")
+        cache = TileResultCache(
+            memory_bytes=256, disk_dir=disk, disk_bytes=1 << 20,
+            manifest=False,
+        )
+        for i in range(4):
+            await cache.put(f"img={i}|z=0|q=s", _entry(b"B" * 200))
+        cache._io.submit(lambda: None).result()
+        cache.close()
+        reborn = TileResultCache(
+            memory_bytes=256, disk_dir=disk, disk_bytes=1 << 20,
+            manifest=False,
+        )
+        try:
+            for i in range(4):
+                assert await reborn.get(f"img={i}|z=0|q=s") is None
+            assert not any(
+                f.endswith(".tile") for f in os.listdir(disk)
+            )
+        finally:
+            reborn.close()
+
+
+# ---------------------------------------------------------------------------
+# consistent-hash ring
+# ---------------------------------------------------------------------------
+
+class TestHashRing:
+    MEMBERS = [
+        "http://replica-a:8082",
+        "http://replica-b:8082",
+        "http://replica-c:8082",
+    ]
+
+    def test_deterministic_across_instances(self):
+        r1 = HashRing(self.MEMBERS, virtual_nodes=64)
+        r2 = HashRing(list(self.MEMBERS), virtual_nodes=64)
+        for i in range(200):
+            key = f"img={i}|z=0|c=0|t=0"
+            assert r1.owner(key) == r2.owner(key)
+
+    def test_balance(self):
+        ring = HashRing(self.MEMBERS, virtual_nodes=64)
+        counts = {m: 0 for m in self.MEMBERS}
+        for i in range(3000):
+            counts[ring.owner(f"img={i}|z={i % 7}")] += 1
+        for member, n in counts.items():
+            assert n > 3000 * 0.15, counts  # no starved member
+
+    def test_consistency_on_member_removal(self):
+        full = HashRing(self.MEMBERS, virtual_nodes=64)
+        reduced = HashRing(self.MEMBERS[:2], virtual_nodes=64)
+        moved = stayed = 0
+        for i in range(2000):
+            key = f"img={i}|z=0"
+            before = full.owner(key)
+            after = reduced.owner(key)
+            if before == self.MEMBERS[2]:
+                continue  # the removed member's keys must remap
+            if before == after:
+                stayed += 1
+            else:
+                moved += 1
+        assert moved == 0  # survivors keep every key they owned
+        assert stayed > 0
+
+    def test_duplicate_members_rejected(self):
+        with pytest.raises(ValueError):
+            HashRing(["http://a", "http://a"])
+
+
+# ---------------------------------------------------------------------------
+# L2 tier against the RESP stub
+# ---------------------------------------------------------------------------
+
+class TestL2Tier:
+    def test_value_framing_round_trip(self):
+        entry = CachedTile(b"PNG-BYTES", filename="tile.png")
+        decoded = decode_entry(encode_entry(entry))
+        assert decoded.body == entry.body
+        assert decoded.etag == entry.etag
+        assert decoded.filename == "tile.png"
+        assert decode_entry(b"garbage") is None
+        assert decode_entry(b"OMPB1\xff\xff\xff\xffrest") is None
+
+    async def test_put_get_delete_against_stub(self):
+        server = InMemoryRespServer()
+        await server.start()
+        tier = RedisL2Tier(server.uri)
+        try:
+            entry = _entry(b"tile-bytes", filename="t.png")
+            assert await tier.put("img=1|z=0|q=s", entry)
+            got = await tier.get("img=1|z=0|q=s")
+            assert got.body == b"tile-bytes"
+            assert got.etag == entry.etag
+            assert await tier.get("img=1|z=9|q=s") is None
+            # image-scoped purge removes only that image's keys
+            await tier.put("img=2|z=0|q=s", _entry(b"other"))
+            removed = await tier.delete_image(1)
+            assert removed == 1
+            assert await tier.get("img=1|z=0|q=s") is None
+            assert (await tier.get("img=2|z=0|q=s")).body == b"other"
+        finally:
+            await tier.close()
+            await server.close()
+
+    async def test_ttl_expires(self):
+        server = InMemoryRespServer()
+        await server.start()
+        tier = RedisL2Tier(server.uri, ttl_s=0.05)
+        try:
+            await tier.put("img=1|z=0", _entry(b"x"))
+            assert (await tier.get("img=1|z=0")) is not None
+            await asyncio.sleep(0.08)
+            assert await tier.get("img=1|z=0") is None
+        finally:
+            await tier.close()
+            await server.close()
+
+    @pytest.mark.resilience
+    async def test_dead_server_degrades_and_opens_breaker(self):
+        # grab a port nothing listens on
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        tier = RedisL2Tier(f"redis://127.0.0.1:{port}/0")
+        for _ in range(6):
+            assert await tier.get("img=1|z=0") is None  # never raises
+        assert tier.breaker.state == "open"
+        # breaker-open short-circuits without touching the socket
+        assert await tier.get("img=1|z=0") is None
+        assert not await tier.put("img=1|z=0", _entry(b"x"))
+        await tier.close()
+
+    @pytest.mark.resilience
+    async def test_fault_point_degrades(self):
+        server = InMemoryRespServer()
+        await server.start()
+        tier = RedisL2Tier(server.uri)
+        try:
+            await tier.put("img=1|z=0", _entry(b"x"))
+            INJECTOR.install(
+                "cache.l2", faultinject.always(ConnectionError("chaos"))
+            )
+            assert await tier.get("img=1|z=0") is None
+            INJECTOR.clear()
+            assert (await tier.get("img=1|z=0")).body == b"x"
+        finally:
+            await tier.close()
+            await server.close()
+
+    @pytest.mark.resilience
+    async def test_hung_server_bounded_by_io_timeout(self):
+        server = InMemoryRespServer()
+        await server.start()
+        server.fail_mode = "hang"
+        set_io_timeout(0.1)
+        tier = RedisL2Tier(server.uri)
+        try:
+            t0 = time.monotonic()
+            assert await tier.get("img=1|z=0") is None
+            assert time.monotonic() - t0 < 1.0
+        finally:
+            await tier.close()
+            await server.close()
+
+
+# ---------------------------------------------------------------------------
+# two-replica cluster over real sockets
+# ---------------------------------------------------------------------------
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _Replica:
+    def __init__(self, app_obj, url, runner):
+        self.app = app_obj
+        self.url = url
+        self.runner = runner
+        self.renders = []
+
+    def count_renders(self):
+        inner_handle = self.app.pipeline.handle
+        inner_batch = self.app.pipeline.handle_batch
+
+        def handle(ctx):
+            self.renders.append(1)
+            return inner_handle(ctx)
+
+        def handle_batch(ctxs):
+            self.renders.extend([1] * len(ctxs))
+            return inner_batch(ctxs)
+
+        self.app.pipeline.handle = handle
+        self.app.pipeline.handle_batch = handle_batch
+
+
+async def _make_cluster(
+    tmp_path, n=2, l2=True, dead_members=(), peer_timeout_ms=2000,
+    cache_overrides=None,
+):
+    """Boot ``n`` real replicas (aiohttp TCPSite on loopback) sharing
+    one image fixture and, optionally, one RESP stub; ``dead_members``
+    adds ring members nobody listens on."""
+    img_path = str(tmp_path / "img.ome.tiff")
+    write_ome_tiff(img_path, IMG, tile_size=(64, 64), pyramid_levels=2)
+    resp = None
+    l2_block = {}
+    if l2:
+        resp = InMemoryRespServer()
+        await resp.start()
+        l2_block = {"l2": {"uri": resp.uri}}
+    ports = [_free_port() for _ in range(n)]
+    members = [f"http://127.0.0.1:{p}" for p in ports] + list(
+        dead_members
+    )
+    replicas = []
+    for i, port in enumerate(ports):
+        registry = ImageRegistry()
+        registry.add(1, img_path)
+        config = Config.from_dict({
+            "session-store": {"type": "memory"},
+            "backend": {"batching": {"coalesce-window-ms": 1.0}},
+            # prefetch off: speculative warming renders tiles beyond
+            # the scripted workload, which would blur the render-once
+            # accounting these tests pin
+            "cache": {
+                "prefetch": {"enabled": False},
+                **(cache_overrides or {}),
+            },
+            "cluster": {
+                "members": members,
+                "self": members[i],
+                "peer-timeout-ms": peer_timeout_ms,
+                **l2_block,
+            },
+        })
+        app_obj = PixelBufferApp(
+            config,
+            pixels_service=PixelsService(registry),
+            session_store=MemorySessionStore({"ck": "omero-key-1"}),
+        )
+        runner = web.AppRunner(app_obj.make_app())
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        await site.start()
+        replica = _Replica(app_obj, members[i], runner)
+        replica.count_renders()
+        replicas.append(replica)
+
+    async def cleanup():
+        for r in replicas:
+            await r.runner.cleanup()
+        if resp is not None:
+            await resp.close()
+
+    return replicas, resp, cleanup
+
+
+def _tile_paths(n):
+    return [
+        f"/tile/1/0/0/0?x={64 * (i % 4)}&y={64 * (i // 4)}&w=64&h=64"
+        f"&format=png"
+        for i in range(n)
+    ]
+
+
+class TestClusterServing:
+    @pytest.mark.resilience
+    async def test_render_once_and_identical_etags(self, tmp_path):
+        """The acceptance pin: a shared workload over two replicas
+        renders each unique tile exactly once cluster-wide, and both
+        replicas answer with byte-identical bodies and ETags."""
+        replicas, resp, cleanup = await _make_cluster(tmp_path, n=2)
+        try:
+            paths = _tile_paths(8)
+            seen = {}
+            async with ClientSession() as http:
+                for i, path in enumerate(paths):
+                    first = replicas[i % 2]
+                    second = replicas[(i + 1) % 2]
+                    async with http.get(
+                        first.url + path, headers=AUTH
+                    ) as r1:
+                        assert r1.status == 200
+                        body1 = await r1.read()
+                        etag1 = r1.headers["ETag"]
+                    async with http.get(
+                        second.url + path, headers=AUTH
+                    ) as r2:
+                        assert r2.status == 200
+                        body2 = await r2.read()
+                        etag2 = r2.headers["ETag"]
+                        assert r2.headers["X-Cache"] in (
+                            "l2-hit", "peer-hit", "hit"
+                        )
+                    assert body1 == body2
+                    assert etag1 == etag2
+                    seen[path] = etag1
+            total = sum(len(r.renders) for r in replicas)
+            assert total == len(paths)  # rendered ONCE cluster-wide
+            assert len(set(seen.values())) == len(paths)
+        finally:
+            await cleanup()
+
+    @pytest.mark.resilience
+    async def test_cross_process_single_flight(self, tmp_path):
+        """Concurrent cold misses for ONE tile on BOTH replicas: the
+        non-owner peer-fetches the owner, joins the owner's local
+        flight, and the cluster renders once."""
+        replicas, resp, cleanup = await _make_cluster(
+            tmp_path, n=2, l2=False
+        )
+        try:
+            for r in replicas:
+                inner = r.app.pipeline.handle_batch
+
+                def slow_batch(ctxs, _inner=inner, _r=r):
+                    time.sleep(0.05)  # hold the flight open
+                    return _inner(ctxs)
+
+                r.app.pipeline.handle_batch = slow_batch
+            path = _tile_paths(1)[0]
+            async with ClientSession() as http:
+                async def fetch(url):
+                    async with http.get(url + path, headers=AUTH) as r:
+                        return r.status, await r.read(), (
+                            r.headers["ETag"]
+                        )
+
+                results = await asyncio.gather(*(
+                    fetch(replicas[i % 2].url) for i in range(6)
+                ))
+            assert all(s == 200 for s, _b, _e in results)
+            assert len({b for _s, b, _e in results}) == 1
+            assert len({e for _s, _b, e in results}) == 1
+            total = sum(len(r.renders) for r in replicas)
+            assert total == 1, total
+        finally:
+            await cleanup()
+
+    async def test_peer_header_is_terminal(self, tmp_path):
+        """The X-OMPB-Peer loop guard: a request carrying the header
+        renders locally even when the ring says another member owns
+        the key — forwarding is one hop, never a loop."""
+        replicas, resp, cleanup = await _make_cluster(
+            tmp_path, n=2, l2=False
+        )
+        try:
+            paths = _tile_paths(8)
+            async with ClientSession() as http:
+                target = replicas[0]
+                for path in paths:
+                    async with http.get(
+                        target.url + path,
+                        headers={**AUTH, "X-OMPB-Peer": "test-origin"},
+                    ) as r:
+                        assert r.status == 200
+            # every tile rendered by the targeted replica itself;
+            # the other replica saw nothing
+            assert len(replicas[0].renders) == len(paths)
+            assert len(replicas[1].renders) == 0
+        finally:
+            await cleanup()
+
+    async def test_healthz_reports_plane(self, tmp_path):
+        replicas, resp, cleanup = await _make_cluster(tmp_path, n=2)
+        try:
+            async with ClientSession() as http:
+                async with http.get(
+                    replicas[0].url + "/healthz"
+                ) as r:
+                    health = await r.json()
+            plane = health["cache"]["plane"]
+            assert plane["self"] == replicas[0].url
+            assert len(plane["ring"]["members"]) == 2
+            assert "l2" in plane
+            assert "manifest" not in health["cache"].get("disk", {})
+        finally:
+            await cleanup()
+
+
+class TestClusterChaos:
+    @pytest.mark.resilience
+    async def test_dead_redis_degrades_to_local(self, tmp_path):
+        """Killing Redis mid-run: requests keep succeeding (rendered
+        locally), the l2 breaker opens, and X-Cache provenance shows
+        plain misses/hits — today's single-process behavior."""
+        replicas, resp, cleanup = await _make_cluster(
+            tmp_path, n=1, l2=True
+        )
+        try:
+            path = _tile_paths(1)[0]
+            async with ClientSession() as http:
+                async with http.get(
+                    replicas[0].url + path, headers=AUTH
+                ) as r:
+                    assert r.status == 200
+                await resp.close()  # Redis dies
+                for i in range(8):
+                    async with http.get(
+                        replicas[0].url + _tile_paths(8)[i],
+                        headers=AUTH,
+                    ) as r:
+                        assert r.status == 200
+                        assert r.headers["X-Cache"] in ("miss", "hit")
+            board = BOARD.snapshot()
+            assert board.get("cache:l2", {}).get("state") in (
+                "open", "half_open", "closed",
+            )
+        finally:
+            await cleanup()
+
+    @pytest.mark.resilience
+    async def test_dead_peer_renders_locally(self, tmp_path):
+        """A ring member nobody runs: tiles it owns are peer-fetch
+        misses and render locally — no request fails, latency bounded
+        by the peer timeout."""
+        dead = f"http://127.0.0.1:{_free_port()}"
+        replicas, resp, cleanup = await _make_cluster(
+            tmp_path, n=1, l2=False, dead_members=[dead],
+            peer_timeout_ms=200,
+        )
+        try:
+            paths = _tile_paths(8)
+            async with ClientSession() as http:
+                for path in paths:
+                    async with http.get(
+                        replicas[0].url + path, headers=AUTH
+                    ) as r:
+                        assert r.status == 200
+                        assert r.headers["X-Cache"] == "miss"
+            assert len(replicas[0].renders) == len(paths)
+        finally:
+            await cleanup()
+
+    @pytest.mark.resilience
+    async def test_peer_fault_point_degrades(self, tmp_path):
+        INJECTOR.install(
+            "cache.peer", faultinject.always(ConnectionError("chaos"))
+        )
+        replicas, resp, cleanup = await _make_cluster(
+            tmp_path, n=2, l2=False
+        )
+        try:
+            paths = _tile_paths(6)
+            async with ClientSession() as http:
+                for i, path in enumerate(paths):
+                    async with http.get(
+                        replicas[i % 2].url + path, headers=AUTH
+                    ) as r:
+                        assert r.status == 200
+        finally:
+            await cleanup()
+
+    @pytest.mark.resilience
+    async def test_torn_journal_still_boots_warm_prefix(self, tmp_path):
+        """A journal torn mid-run degrades the RESTART to (at worst)
+        a colder cache — the app boots and serves either way."""
+        disk = str(tmp_path / "spill")
+        replicas, resp, cleanup = await _make_cluster(
+            tmp_path, n=1, l2=False,
+            cache_overrides={"disk-dir": disk, "memory-mb": 1},
+        )
+        try:
+            async with ClientSession() as http:
+                for path in _tile_paths(4):
+                    async with http.get(
+                        replicas[0].url + path, headers=AUTH
+                    ) as r:
+                        assert r.status == 200
+        finally:
+            await cleanup()
+        with open(os.path.join(disk, JOURNAL_NAME), "ab") as fh:
+            fh.write(b"xxxx torn")
+        cache = TileResultCache(
+            memory_bytes=1 << 20, disk_dir=disk, disk_bytes=1 << 30
+        )
+        try:
+            assert cache.disk is not None  # boots despite the tear
+        finally:
+            cache.close()
+
+    @pytest.mark.resilience
+    async def test_purge_fan_out_and_dead_peer_never_blocks(
+        self, tmp_path
+    ):
+        """The invalidation satellite: a purge clears the local tiers
+        IMMEDIATELY and fans out to L2 + peers best-effort; a dead
+        peer in the member list cannot delay or fail the local purge."""
+        dead = f"http://127.0.0.1:{_free_port()}"
+        replicas, resp, cleanup = await _make_cluster(
+            tmp_path, n=2, l2=True, dead_members=[dead],
+            peer_timeout_ms=200,
+        )
+        try:
+            path = _tile_paths(1)[0]
+            async with ClientSession() as http:
+                # warm both replicas + L2
+                for r in replicas:
+                    async with http.get(
+                        r.url + path, headers=AUTH
+                    ) as resp_:
+                        assert resp_.status == 200
+                await asyncio.sleep(0.05)  # let the L2 publish land
+                assert any(
+                    k.startswith(b"ompb:tile:img=1|")
+                    for k in resp.live_keys()
+                )
+                # purge from replica 0 (the resolver-thread entry
+                # point); the local purge must return promptly
+                t0 = time.monotonic()
+                replicas[0].app._invalidate_image(1)
+                local_purge_s = time.monotonic() - t0
+                assert local_purge_s < 0.15  # dead peer didn't block
+                assert len(replicas[0].app.result_cache.memory) == 0
+                # the fan-out drains in the background: L2 keys go and
+                # the live peer's local cache empties
+                for _ in range(40):
+                    l2_clear = not any(
+                        k.startswith(b"ompb:tile:img=1|")
+                        for k in resp.live_keys()
+                    )
+                    peer_clear = (
+                        len(replicas[1].app.result_cache.memory) == 0
+                    )
+                    if l2_clear and peer_clear:
+                        break
+                    await asyncio.sleep(0.05)
+                assert l2_clear and peer_clear
+                # and the tile re-renders fresh afterwards
+                async with http.get(
+                    replicas[0].url + path, headers=AUTH
+                ) as r2:
+                    assert r2.status == 200
+        finally:
+            await cleanup()
+
+    async def test_internal_purge_requires_peer_header(self, tmp_path):
+        replicas, resp, cleanup = await _make_cluster(tmp_path, n=1)
+        try:
+            async with ClientSession() as http:
+                async with http.post(
+                    replicas[0].url + "/internal/purge/1"
+                ) as r:
+                    assert r.status == 403
+                async with http.post(
+                    replicas[0].url + "/internal/purge/1",
+                    headers={"X-OMPB-Peer": "tester"},
+                ) as r:
+                    assert r.status == 200
+        finally:
+            await cleanup()
+
+
+# ---------------------------------------------------------------------------
+# config validation
+# ---------------------------------------------------------------------------
+
+class TestClusterConfig:
+    BASE = {"session-store": {"type": "memory"}}
+
+    def test_self_must_be_member(self):
+        with pytest.raises(ConfigError):
+            Config.from_dict({
+                **self.BASE,
+                "cluster": {
+                    "members": ["http://a:1"], "self": "http://b:2",
+                },
+            })
+
+    def test_members_require_self(self):
+        with pytest.raises(ConfigError):
+            Config.from_dict({
+                **self.BASE, "cluster": {"members": ["http://a:1"]},
+            })
+
+    def test_unknown_keys_rejected(self):
+        with pytest.raises(ConfigError):
+            Config.from_dict({
+                **self.BASE, "cluster": {"membres": ["http://a:1"]},
+            })
+        with pytest.raises(ConfigError):
+            Config.from_dict({
+                **self.BASE,
+                "cluster": {"l2": {"url": "redis://x"}},
+            })
+        with pytest.raises(ConfigError):
+            Config.from_dict({
+                **self.BASE, "cache": {"tinylfu": {"counter": 5}},
+            })
+
+    def test_l2_only_cluster_is_valid(self):
+        config = Config.from_dict({
+            **self.BASE,
+            "cluster": {"l2": {"uri": "redis://localhost:6379/2"}},
+        })
+        assert config.cluster.plane_enabled
+        assert config.cluster.members == ()
+
+    def test_trailing_slashes_normalized(self):
+        config = Config.from_dict({
+            **self.BASE,
+            "cluster": {
+                "members": ["http://a:1/", "http://b:2"],
+                "self": "http://a:1",
+            },
+        })
+        assert config.cluster.members == ("http://a:1", "http://b:2")
+        assert config.cluster.self_url == "http://a:1"
+
+    def test_empty_block_disables_plane(self):
+        config = Config.from_dict(self.BASE)
+        assert not config.cluster.plane_enabled

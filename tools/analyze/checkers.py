@@ -340,6 +340,10 @@ _RESILIENCE_SCOPE = (
     "omero_ms_pixel_buffer_tpu/db/postgres.py",
     "omero_ms_pixel_buffer_tpu/auth/stores.py",
     "omero_ms_pixel_buffer_tpu/auth/ice.py",
+    # the cache plane's network call sites (r11): the RESP L2 client
+    # and the peer-fetch HTTP client must carry breaker gate + fault
+    # point + per-call timeout like every other remote edge
+    "omero_ms_pixel_buffer_tpu/cache/plane/",
 )
 
 _NET_PRIMITIVES: List[Tuple[Optional[str], str, str]] = [
