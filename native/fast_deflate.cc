@@ -10,6 +10,7 @@
 #include "fast_deflate.h"
 
 #include <algorithm>
+#include <cstdlib>
 #include <cstring>
 #include <vector>
 
@@ -18,6 +19,9 @@
 #if defined(__x86_64__) || defined(__i386__)
 #include <immintrin.h>
 #define OMPB_X86 1
+#elif defined(__aarch64__)
+#include <arm_neon.h>
+#define OMPB_NEON 1
 #endif
 
 namespace ompb {
@@ -195,6 +199,124 @@ __attribute__((target("avx2"))) static size_t LiteralSweepAvx2(
 }
 #endif
 
+// Runtime gate for every vector path: CPU capability plus the
+// OMPB_NO_SIMD=1 escape hatch (read per call — tests flip it to pin
+// the scalar path byte-identical against the vector one).
+inline bool SimdEnabled() {
+  const char* off = std::getenv("OMPB_NO_SIMD");
+  if (off && off[0] == '1') return false;
+#if defined(OMPB_X86)
+  return HasAvx2();
+#elif defined(OMPB_NEON)
+  return true;
+#else
+  return false;
+#endif
+}
+
+// -- SIMD literal emit (fpnge-style packed Huffman concatenation) -------
+//
+// Pass 2's literal spans dominate the emit on filtered noisy samples.
+// The vector path processes 8 literals per step: gather their
+// (code | len << 24) table entries, concatenate PAIRS of codes inside
+// 64-bit lanes with variable shifts (code_lo | code_hi << len_lo — the
+// fpnge trick: a Huffman code concatenation is just a shift + or), then
+// merge the four pair lanes through the 56-bit wide writer exactly as
+// the scalar quad loop does. The BITSTREAM is the in-order code
+// concatenation either way, so vector and scalar paths are
+// byte-identical by construction (and pinned so in tests/CI).
+
+#if defined(OMPB_X86)
+__attribute__((target("avx2"))) static size_t EmitLiteralsAvx2(
+    BitWriter& bw, const uint32_t* packed, const uint8_t* p, size_t m) {
+  const __m256i mask24 = _mm256_set1_epi32(0xFFFFFF);
+  const __m256i mask32 = _mm256_set1_epi64x(0xFFFFFFFFll);
+  size_t k = 0;
+  for (; k + 8 <= m; k += 8) {
+    const __m128i bytes =
+        _mm_loadl_epi64(reinterpret_cast<const __m128i*>(p + k));
+    const __m256i idx = _mm256_cvtepu8_epi32(bytes);
+    const __m256i e = _mm256_i32gather_epi32(
+        reinterpret_cast<const int*>(packed), idx, 4);
+    const __m256i code = _mm256_and_si256(e, mask24);
+    const __m256i len = _mm256_srli_epi32(e, 24);
+    // concatenate lane pairs (0,1)(2,3)(4,5)(6,7) inside u64 lanes
+    const __m256i code_even = _mm256_and_si256(code, mask32);
+    const __m256i code_odd = _mm256_srli_epi64(code, 32);
+    const __m256i len_even = _mm256_and_si256(len, mask32);
+    const __m256i len_odd = _mm256_srli_epi64(len, 32);
+    const __m256i pair =
+        _mm256_or_si256(code_even, _mm256_sllv_epi64(code_odd, len_even));
+    const __m256i plen = _mm256_add_epi64(len_even, len_odd);
+    const uint64_t c01 = _mm256_extract_epi64(pair, 0);
+    const uint64_t c23 = _mm256_extract_epi64(pair, 1);
+    const uint64_t c45 = _mm256_extract_epi64(pair, 2);
+    const uint64_t c67 = _mm256_extract_epi64(pair, 3);
+    const int n01 = static_cast<int>(_mm256_extract_epi64(plen, 0));
+    const int n23 = static_cast<int>(_mm256_extract_epi64(plen, 1));
+    const int n45 = static_cast<int>(_mm256_extract_epi64(plen, 2));
+    const int n67 = static_cast<int>(_mm256_extract_epi64(plen, 3));
+    // a pair is <= 30 bits; a quad can exceed the 56-bit writer
+    // budget only with >= 14-bit average codes (rare) — split then
+    if (n01 + n23 <= 56) {
+      bw.Put56(c01 | (c23 << n01), n01 + n23);
+    } else {
+      bw.Put56(c01, n01);
+      bw.Put56(c23, n23);
+    }
+    if (n45 + n67 <= 56) {
+      bw.Put56(c45 | (c67 << n45), n45 + n67);
+    } else {
+      bw.Put56(c45, n45);
+      bw.Put56(c67, n67);
+    }
+  }
+  return k;
+}
+#endif
+
+#if defined(OMPB_NEON)
+static size_t EmitLiteralsNeon(
+    BitWriter& bw, const uint32_t* packed, const uint8_t* p, size_t m) {
+  size_t k = 0;
+  for (; k + 8 <= m; k += 8) {
+    uint32_t e[8];
+    for (int j = 0; j < 8; ++j) e[j] = packed[p[k + j]];
+    const uint64x2_t ce0 = {e[0] & 0xFFFFFFu, e[2] & 0xFFFFFFu};
+    const uint64x2_t co0 = {e[1] & 0xFFFFFFu, e[3] & 0xFFFFFFu};
+    const int64x2_t ne0 = {static_cast<int64_t>(e[0] >> 24),
+                           static_cast<int64_t>(e[2] >> 24)};
+    const uint64x2_t pr0 = vorrq_u64(ce0, vshlq_u64(co0, ne0));
+    const uint64x2_t ce1 = {e[4] & 0xFFFFFFu, e[6] & 0xFFFFFFu};
+    const uint64x2_t co1 = {e[5] & 0xFFFFFFu, e[7] & 0xFFFFFFu};
+    const int64x2_t ne1 = {static_cast<int64_t>(e[4] >> 24),
+                           static_cast<int64_t>(e[6] >> 24)};
+    const uint64x2_t pr1 = vorrq_u64(ce1, vshlq_u64(co1, ne1));
+    const uint64_t c01 = vgetq_lane_u64(pr0, 0);
+    const uint64_t c23 = vgetq_lane_u64(pr0, 1);
+    const uint64_t c45 = vgetq_lane_u64(pr1, 0);
+    const uint64_t c67 = vgetq_lane_u64(pr1, 1);
+    const int n01 = static_cast<int>((e[0] >> 24) + (e[1] >> 24));
+    const int n23 = static_cast<int>((e[2] >> 24) + (e[3] >> 24));
+    const int n45 = static_cast<int>((e[4] >> 24) + (e[5] >> 24));
+    const int n67 = static_cast<int>((e[6] >> 24) + (e[7] >> 24));
+    if (n01 + n23 <= 56) {
+      bw.Put56(c01 | (c23 << n01), n01 + n23);
+    } else {
+      bw.Put56(c01, n01);
+      bw.Put56(c23, n23);
+    }
+    if (n45 + n67 <= 56) {
+      bw.Put56(c45 | (c67 << n45), n45 + n67);
+    } else {
+      bw.Put56(c45, n45);
+      bw.Put56(c67, n67);
+    }
+  }
+  return k;
+}
+#endif
+
 inline uint32_t Reverse(uint32_t code, int len) {
   uint32_t r = 0;
   for (int i = 0; i < len; ++i) {
@@ -364,7 +486,7 @@ size_t FastDeflate(const uint8_t* in, size_t n, uint8_t* out, size_t cap) {
   bool any_run = false;
   {
 #if defined(OMPB_X86)
-    const bool use_avx2 = HasAvx2();
+    const bool use_avx2 = HasAvx2() && SimdEnabled();
 #endif
     size_t i = 0;
     size_t scalar_until = 0;  // backoff after a failed run candidate
@@ -461,8 +583,19 @@ size_t FastDeflate(const uint8_t* in, size_t n, uint8_t* out, size_t cap) {
       packed[s] =
           lit_code[s] | (static_cast<uint32_t>(lit_len[s]) << 24);
     }
+    const bool simd = SimdEnabled();
     auto emit_literals = [&](const uint8_t* p, size_t m) {
       size_t k = 0;
+      if (simd) {
+        // vector fast path: 8 literals per step; the scalar loop
+        // below finishes the (< 8) tail — identical bitstream either
+        // way (in-order code concatenation)
+#if defined(OMPB_X86)
+        k = EmitLiteralsAvx2(bw, packed, p, m);
+#elif defined(OMPB_NEON)
+        k = EmitLiteralsNeon(bw, packed, p, m);
+#endif
+      }
       for (; k + 4 <= m; k += 4) {
         const uint32_t e0 = packed[p[k]], e1 = packed[p[k + 1]];
         const uint32_t e2 = packed[p[k + 2]], e3 = packed[p[k + 3]];

@@ -331,6 +331,8 @@ class PixelBufferApp:
             png_strategy=config.backend.png.strategy,
             max_tile_bytes=config.backend.max_tile_mb << 20,
             device_deflate=config.backend.png.device_deflate,
+            device_deflate_mode=config.backend.png.device_deflate_mode,
+            queue_depth=config.backend.png.queue_depth,
             compilation_cache_dir=config.jax.compilation_cache_dir,
             lut_dir=config.render.lut_dir,
         )
@@ -572,6 +574,7 @@ class PixelBufferApp:
         mesh_mgr = self._mesh_manager()
         if mesh_mgr is not None:
             render_health["mesh"] = mesh_mgr.snapshot()
+        device_queue = self.pipeline.device_queue_snapshot()
         degraded = (
             any(b["state"] == "open" for b in breakers.values())
             or admission["inflight"] >= admission["max_inflight"]
@@ -588,6 +591,7 @@ class PixelBufferApp:
                 "cache": cache_health,
                 "prefetch": prefetch_health,
                 "render": render_health,
+                "device_queue": device_queue,
                 "request_budget_ms": self.request_budget_s * 1000.0,
             }
         )

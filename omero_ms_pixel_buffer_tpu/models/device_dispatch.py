@@ -1,43 +1,59 @@
-"""Async double-buffered device-encode dispatch.
+"""Streaming cross-batch device-encode queue.
 
-The device encode chain used to run strictly serially per bucket
-group: build the host batch, H2D it, run filter, run deflate, pull the
-streams, frame — each stage waiting on the last, the device idle
-during every host stage and the host idle during every device stage.
-This module overlaps them (the Model-Based Warp Overlapped Tiling
-playbook, arXiv:1909.07190, applied at the dispatch level):
+The r9 dispatcher double-buffered groups WITHIN one ``handle_batch``
+call: the batcher thread staged + launched each group and a readback
+worker absorbed the device wait — but the batcher drained every future
+before returning, so consecutive batches serialized at the batcher
+boundary and the TPU sat idle between flushes. This module makes the
+dispatcher a PERSISTENT queue (the PATCHEDSERVE keep-the-queue-fed
+framing, applied to the encode pipe):
 
-- the SUBMITTING thread (a batcher executor thread) stages group k's
-  host batch, blocks only on its H2D transfer (which the transfer
-  engine runs concurrently with group k-1's compute), then launches
-  the fused filter+deflate program — jax dispatch is async, so the
-  launch returns immediately and the thread moves on to stage group
-  k+1 while the device crunches;
-- a READBACK worker thread blocks on each group's device completion,
-  pulls lengths + compressed streams in one host sync (the adaptive
-  power-of-two cap from the pipeline keeps that a single transfer),
-  and frames the PNGs — overlapping group k's D2H + framing with
-  group k+1's compute.
+- callers (``TilePipeline.handle_batch``, any batch, any thread) get a
+  Future back immediately; a long-lived SUBMIT thread stages each
+  group's host batch, blocks only on its H2D transfer (which the
+  transfer engine runs concurrently with earlier groups' compute),
+  then launches the fused program — jax dispatch is async, so the
+  submit thread moves straight on to the next group, INCLUDING groups
+  of a batch that arrived while the previous batch was still in
+  flight;
+- a READBACK worker blocks on each group's device completion in
+  submission order, pulls lengths + streams in one host sync, and
+  frames the PNGs — overlapping group k's D2H + framing with group
+  k+1's (and batch N+1's) compute;
+- a semaphore bounds the in-flight groups to ``queue_depth`` (config
+  ``backend.png.queue-depth``, default 2 = the classic double buffer);
+  staging backpressures on the SUBMIT thread, never on callers.
 
-Two groups are therefore in flight at any moment (the classic double
-buffer); the donated fused program (ops/device_deflate) keeps HBM
-residency flat while they are.
+The queue records, per group, whether its launch OVERLAPPED the
+previous group's compute (launch before the previous compute-done
+stamp) or left a device idle gap — ``snapshot()`` reports steady-state
+occupancy, the idle-gap distribution, and mean compute time so BENCH
+can assert the cross-batch overlap instead of describing it.
 
-Every stage reports into the ``device_stage_seconds`` histogram
-(stage = stage|h2d|compute|d2h|frame) so BENCH and /metrics can see
-WHICH stage moved when a change lands.
+Dynamic-Huffman groups (deflate mode "dynamic") pipeline their two
+passes across the threads: the submit thread launches pass 1 (filter +
+histogram, one program), the readback worker pulls the (B, 286) counts
+— absorbing pass 1's wait — builds the canonical code tables on host,
+launches pass 2 (emit), and blocks on it; other groups' passes
+interleave on device between the two.
 
-With a serving mesh, the group dispatch routes through
-``parallel.mesh.MeshManager`` + ``parallel.sharding.
-sharded_filter_deflate`` instead: the batch axis shards across chips,
-a sick chip degrades the mesh to the survivors (per-device breakers),
-and per-device lane counts are recorded for the MULTICHIP report.
+Failure contract (unchanged from r9, now chaos-pinned): any failure in
+staging, dispatch, or readback resolves THAT group's future with the
+exception — the pipeline degrades those lanes to the host encoder —
+and never stalls or reorders other groups; the ``device.encode-group``
+fault point injects exactly that. With a serving mesh, groups run
+blocking on the readback worker through ``parallel.mesh.MeshManager``
+(per-chip breakers, probe-shrink-retry), and the dispatcher pre-warms
+jit specializations for recently-seen group shapes on a background
+thread whenever the healthy mesh WIDTH changes, so the first dispatch
+after a shrink or heal doesn't pay the recompile inline.
 """
 
 from __future__ import annotations
 
 import concurrent.futures
 import logging
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -50,17 +66,40 @@ log = logging.getLogger("omero_ms_pixel_buffer_tpu.device_dispatch")
 DEVICE_STAGE_SECONDS = REGISTRY.histogram(
     "device_stage_seconds",
     "Device encode pipeline stage durations "
-    "(stage=stage|h2d|compute|d2h|frame)",
+    "(stage=stage|h2d|compute|hist|emit|d2h|frame)",
 )
+DEVICE_QUEUE_IDLE_SECONDS = REGISTRY.histogram(
+    "device_queue_idle_seconds",
+    "Device idle gap between one encode group's compute finishing and "
+    "the next group's launch (0-bucketed when the launch overlapped)",
+)
+
+# how many distinct mesh group shapes the width-change warmup replays
+_WARM_SHAPES = 16
+
+
+def _pow2_lanes(b: int) -> int:
+    """The pow2 lane bucket (the per-shape jit-specialization cap)."""
+    return 1 << max(b - 1, 0).bit_length()
+
+
+def _mesh_padded_lanes(b: int, width: int) -> int:
+    """Mesh group lane padding: pow2 first (specialization cap), then
+    up to a multiple of the healthy mesh width. ONE definition shared
+    by the serving dispatch AND the width-change warmup — they must
+    compile the same batch shape or the warmup is a lie."""
+    return -(-_pow2_lanes(b) // width) * width
 
 
 class DeviceEncodeDispatcher:
-    """Submit encode groups, collect per-group futures.
+    """Submit encode groups into the persistent queue; collect
+    per-group futures.
 
     One dispatcher per TilePipeline; ``dd_cap`` is the pipeline's
     shared adaptive compressed-size guess keyed (w, h) — the readback
     thread both consumes and trains it. ``mesh_manager`` (optional)
     switches group dispatch to the sharded multi-chip path.
+    ``queue_depth`` bounds concurrently in-flight groups.
     """
 
     def __init__(
@@ -68,19 +107,84 @@ class DeviceEncodeDispatcher:
         dd_cap: Dict[Tuple[int, int], int],
         mesh_manager=None,
         packer: Optional[str] = None,
+        queue_depth: int = 2,
     ):
         self._dd_cap = dd_cap
         self.mesh_manager = mesh_manager
         self._packer = packer
-        # ONE worker: readback order == submission order, so group k's
-        # D2H never competes with group k+1's (the pipe stays a pipe)
+        self.queue_depth = max(1, int(queue_depth))
+        # ONE submit thread: groups stage + launch in FIFO order across
+        # batches; ONE readback worker: readback order == submission
+        # order, so group k's D2H never competes with group k+1's (the
+        # pipe stays a pipe)
+        self._submit_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="devenc-submit"
+        )
         self._readback = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="devenc-readback"
         )
+        self._slots = threading.Semaphore(self.queue_depth)
         self._donate: Optional[bool] = None
+        self._closed = False
+        # outstanding caller futures: close() drains against these
+        # with a deadline, so a wedged device program can't hold
+        # server shutdown hostage
+        self._pending_lock = threading.Lock()
+        self._pending: set = set()
+        # queue telemetry (all guarded by _stats_lock): in-flight count,
+        # occupancy samples, idle-gap vs overlap accounting, compute time
+        self._stats_lock = threading.Lock()
+        self._inflight = 0
+        self._groups = 0
+        self._occupancy_sum = 0
+        self._idle_gap_sum = 0.0
+        self._idle_gap_max = 0.0
+        self._idle_gaps = 0
+        self._overlapped = 0
+        self._compute_sum = 0.0
+        self._computes = 0
+        self._last_compute_done: Optional[float] = None
+        # mesh warmup state: recently-seen raw-tile group shapes +
+        # widths already warmed (tests read _warmed)
+        self._seen_mesh: Dict[tuple, None] = {}
+        self._warmed: set = set()
+        self._warm_lock = threading.Lock()
+        if mesh_manager is not None and hasattr(
+            mesh_manager, "add_width_listener"
+        ):
+            mesh_manager.add_width_listener(self._on_mesh_width)
 
-    def close(self) -> None:
-        self._readback.shutdown(wait=False)
+    def close(self, drain_timeout: float = 30.0) -> None:
+        """Drain the queue: stop accepting groups, wait up to
+        ``drain_timeout`` seconds for every staged group to finish
+        (their futures resolve), then release the threads. The
+        deadline matters: a wedged device program (a dropped TPU
+        tunnel mid-compute) holds ``block_until_ready`` forever, and
+        an unbounded drain would hang server shutdown — past the
+        deadline the leftover futures resolve exceptionally (callers
+        host-fall-back) and the stuck worker threads are abandoned.
+        Idempotent; TilePipeline.close() calls it."""
+        self._closed = True
+        self._submit_pool.shutdown(wait=False)
+        with self._pending_lock:
+            pending = list(self._pending)
+        _, not_done = concurrent.futures.wait(
+            pending, timeout=drain_timeout
+        )
+        for fut in not_done:
+            try:
+                fut.set_exception(
+                    TimeoutError("device encode queue drain timed out")
+                )
+            except concurrent.futures.InvalidStateError:
+                pass  # resolved in the race window: nothing to do
+        self._readback.shutdown(wait=not not_done)
+        if not_done:
+            log.warning(
+                "device encode queue: %d group(s) unresolved after "
+                "%.0fs drain; abandoning the worker threads",
+                len(not_done), drain_timeout,
+            )
 
     def _donate_ok(self) -> bool:
         # donation frees the staged input for reuse mid-program on
@@ -95,7 +199,71 @@ class DeviceEncodeDispatcher:
                 self._donate = False
         return bool(self._donate)
 
-    # ------------------------------------------------------------------
+    # -- queue telemetry ------------------------------------------------
+
+    def _note_launch(self, t_launch: float) -> None:
+        """Called as a group's device program is dispatched: samples
+        occupancy and classifies the launch as overlapped (the device
+        was still computing the previous group) or post-idle-gap."""
+        with self._stats_lock:
+            self._groups += 1
+            self._occupancy_sum += self._inflight
+            last = self._last_compute_done
+            if last is None:
+                return
+            gap = t_launch - last
+            if gap <= 0:
+                self._overlapped += 1
+                DEVICE_QUEUE_IDLE_SECONDS.observe(0.0)
+            else:
+                self._idle_gaps += 1
+                self._idle_gap_sum += gap
+                self._idle_gap_max = max(self._idle_gap_max, gap)
+                DEVICE_QUEUE_IDLE_SECONDS.observe(gap)
+
+    def _note_compute_done(self, t_done: float, dt: float) -> None:
+        with self._stats_lock:
+            self._last_compute_done = t_done
+            self._compute_sum += dt
+            self._computes += 1
+
+    def snapshot(self) -> dict:
+        """Steady-state queue health for /healthz and BENCH: occupancy,
+        the inter-group idle-gap distribution, and mean compute time —
+        cross-batch overlap holds when overlapped_fraction is high and
+        idle_gap_mean_ms stays below compute_ms_mean."""
+        with self._stats_lock:
+            groups = self._groups
+            out = {
+                "queue_depth": self.queue_depth,
+                "inflight": self._inflight,
+                "groups": groups,
+                "mean_occupancy": (
+                    round(self._occupancy_sum / groups, 3) if groups else None
+                ),
+                "overlapped": self._overlapped,
+                "idle_gaps": self._idle_gaps,
+                "overlapped_fraction": (
+                    round(
+                        self._overlapped
+                        / max(self._overlapped + self._idle_gaps, 1),
+                        3,
+                    )
+                    if (self._overlapped + self._idle_gaps) else None
+                ),
+                "idle_gap_mean_ms": (
+                    round(self._idle_gap_sum / self._idle_gaps * 1e3, 3)
+                    if self._idle_gaps else 0.0
+                ),
+                "idle_gap_max_ms": round(self._idle_gap_max * 1e3, 3),
+                "compute_ms_mean": (
+                    round(self._compute_sum / self._computes * 1e3, 3)
+                    if self._computes else None
+                ),
+            }
+        return out
+
+    # -- submission -----------------------------------------------------
 
     def submit(
         self,
@@ -111,52 +279,18 @@ class DeviceEncodeDispatcher:
         color_type: int,
         staged: bool = False,
     ) -> "concurrent.futures.Future":
-        """Launch one encode group; returns a Future resolving to
+        """Enqueue one encode group; returns a Future resolving to
         {lane_index: png_bytes}. ``tiles`` is either a host ndarray
-        (bucket path — staged H2D here) or an already device-resident
-        batch (plane-cache crops, ``staged=True``). All lanes in a
-        group share one real (w, h) — ``rows``/``row_bytes`` describe
-        it — but ``sizes`` still rides along for framing."""
-        import jax
-
-        mesh_mgr = self.mesh_manager
-        if mesh_mgr is not None and not staged:
-            # sharded groups run ENTIRELY on the readback worker: the
-            # dispatch must block on device completion inside
-            # MeshManager.dispatch, or a chip that wedges mid-compute
-            # would surface at a later block_until_ready outside the
-            # breaker/probe/shrink machinery and record a phantom
-            # success; chips supply the parallelism there, so losing
-            # the submit-thread overlap costs nothing
-            return self._readback.submit(
-                self._mesh_group,
-                tiles, rows, row_bytes, bpp, filter_mode, deflate_mode,
-                lanes, sizes, bit_depth, color_type,
-            )
-        from ..ops.device_deflate import fused_filter_deflate_batch
-
-        t0 = time.perf_counter()
-        if staged:
-            batch_dev = tiles
-            t_h2d = time.perf_counter()
-        else:
-            batch_dev = jax.device_put(tiles)
-            # blocking on the INPUT transfer only: the previous
-            # group's compute keeps the device busy meanwhile
-            jax.block_until_ready(batch_dev)  # ompb-lint: disable=jax-hotpath -- H2D stage boundary: waits on the transfer engine, overlapped with the prior group's compute
-            t_h2d = time.perf_counter()
-        streams, lengths = fused_filter_deflate_batch(
-            batch_dev, rows, row_bytes, bpp,
-            filter_mode=filter_mode, mode=deflate_mode,
-            packer=self._packer,
-            donate=(not staged) and self._donate_ok(),
-        )
-        t_dispatch = time.perf_counter()
-        DEVICE_STAGE_SECONDS.observe(t_h2d - t0, stage="h2d")
-        return self._readback.submit(
-            self._readback_group,
-            streams, lengths, t_dispatch, lanes, sizes,
-            bit_depth, color_type,
+        (bucket path — staged H2D on the submit thread) or an already
+        device-resident batch (plane-cache crops, ``staged=True``).
+        All lanes in a group share one real (w, h) — ``rows``/
+        ``row_bytes`` describe it — but ``sizes`` still rides along
+        for framing. Returns immediately: staging happens on the
+        queue's submit thread, bounded by ``queue_depth``."""
+        return self._enqueue(
+            self._stage_group,
+            tiles, rows, row_bytes, bpp, filter_mode, deflate_mode,
+            lanes, sizes, bit_depth, color_type, staged,
         )
 
     def submit_render(
@@ -171,12 +305,167 @@ class DeviceEncodeDispatcher:
         lanes: Sequence[int],
         sizes: Sequence[Tuple[int, int]],
     ) -> "concurrent.futures.Future":
-        """Launch one RENDER group (render/engine): ``planes`` is a
+        """Enqueue one RENDER group (render/engine): ``planes`` is a
         host (B, C, H, W) unsigned channel batch; the fused composite
         + filter + deflate program runs as ONE dispatch and the
-        readback worker frames RGB8 PNGs. Same double-buffer shape as
+        readback worker frames RGB8 PNGs. Same queue semantics as
         ``submit``; with a serving mesh the group shards across chips
         through ``sharded_render_filter_deflate`` instead."""
+        return self._enqueue(
+            self._stage_render_group,
+            planes, index_tables, color_luts, rows, row_bytes,
+            filter_mode, deflate_mode, lanes, sizes,
+        )
+
+    def _enqueue(self, stage_fn, *args) -> "concurrent.futures.Future":
+        if self._closed:
+            raise RuntimeError("device encode queue is closed")
+        fut: "concurrent.futures.Future" = concurrent.futures.Future()
+        with self._pending_lock:
+            self._pending.add(fut)
+        fut.add_done_callback(self._discard_pending)
+        try:
+            self._submit_pool.submit(self._run_stage, stage_fn, fut, args)
+        except RuntimeError as e:
+            # close() raced the _closed check and shut the pool down:
+            # resolve THIS group's future exceptionally (the pipeline
+            # host-falls-back those lanes) instead of raising past
+            # already-submitted groups' futures
+            self._resolve_exc(fut, e)
+        return fut
+
+    def _discard_pending(self, fut) -> None:
+        with self._pending_lock:
+            self._pending.discard(fut)
+
+    @staticmethod
+    def _resolve_exc(fut, exc) -> None:
+        # close()'s drain deadline may have resolved the future first;
+        # losing that race is fine — the caller already host-fell-back
+        try:
+            fut.set_exception(exc)
+        except concurrent.futures.InvalidStateError:
+            pass
+
+    def _run_stage(self, stage_fn, fut, args) -> None:
+        """Submit-thread trampoline: acquire an in-flight slot, stage +
+        launch, chain the readback future into the caller's. Any
+        failure resolves the caller future exceptionally (the pipeline
+        host-falls-back that group) without touching other groups."""
+        from ..resilience.faultinject import INJECTOR
+
+        acquired = False
+        try:
+            INJECTOR.fire("device.encode-group")
+            # bounded in-flight groups: backpressure lands HERE (the
+            # submit thread), keeping callers non-blocking and the
+            # device at most queue_depth groups ahead of readback
+            self._slots.acquire()
+            acquired = True
+            with self._stats_lock:
+                self._inflight += 1
+            rfut = stage_fn(*args)
+        except Exception as e:
+            # resolve the caller's future instead of raising into the
+            # executor: the pipeline host-falls-back this group
+            if acquired:
+                self._release_slot()
+            self._resolve_exc(fut, e)
+            return
+        rfut.add_done_callback(
+            lambda rf: self._finish_group(fut, rf)
+        )
+
+    def _release_slot(self) -> None:
+        with self._stats_lock:
+            self._inflight -= 1
+        self._slots.release()
+
+    def _finish_group(self, fut, rfut) -> None:
+        self._release_slot()
+        exc = rfut.exception()
+        if exc is not None:
+            self._resolve_exc(fut, exc)
+        else:
+            try:
+                fut.set_result(rfut.result())
+            except concurrent.futures.InvalidStateError:
+                pass  # close()'s drain deadline got there first
+
+    # -- staging (submit thread) ---------------------------------------
+
+    def _stage_group(
+        self, tiles, rows, row_bytes, bpp, filter_mode, deflate_mode,
+        lanes, sizes, bit_depth, color_type, staged,
+    ):
+        import jax
+
+        mesh_mgr = self.mesh_manager
+        if mesh_mgr is not None and not staged:
+            # sharded groups run ENTIRELY on the readback worker: the
+            # dispatch must block on device completion inside
+            # MeshManager.dispatch, or a chip that wedges mid-compute
+            # would surface at a later block_until_ready outside the
+            # breaker/probe/shrink machinery and record a phantom
+            # success; chips supply the parallelism there, so losing
+            # the submit-thread overlap costs nothing. Dynamic mode
+            # downgrades to rle: the two-pass host hop doesn't
+            # compose with the one-program shard_map chain.
+            if deflate_mode == "dynamic":
+                deflate_mode = "rle"
+            self._register_mesh_shape(
+                tiles, rows, row_bytes, bpp, filter_mode, deflate_mode
+            )
+            return self._readback.submit(
+                self._mesh_group,
+                tiles, rows, row_bytes, bpp, filter_mode, deflate_mode,
+                lanes, sizes, bit_depth, color_type,
+            )
+        t0 = time.perf_counter()
+        if staged:
+            batch_dev = tiles
+            t_h2d = time.perf_counter()
+        else:
+            batch_dev = jax.device_put(tiles)
+            # blocking on the INPUT transfer only: earlier groups'
+            # compute keeps the device busy meanwhile
+            jax.block_until_ready(batch_dev)  # ompb-lint: disable=jax-hotpath -- H2D stage boundary: waits on the transfer engine, overlapped with earlier groups' compute
+            t_h2d = time.perf_counter()
+        DEVICE_STAGE_SECONDS.observe(t_h2d - t0, stage="h2d")
+        if deflate_mode == "dynamic":
+            from ..ops.device_deflate import fused_filter_histogram_batch
+
+            flat, counts, extras, real_b = fused_filter_histogram_batch(
+                batch_dev, rows, row_bytes, bpp, filter_mode=filter_mode,
+                donate=(not staged) and self._donate_ok(),
+            )
+            t_dispatch = time.perf_counter()
+            self._note_launch(t_dispatch)
+            return self._readback.submit(
+                self._dynamic_readback_group,
+                flat, counts, extras, real_b, t_dispatch, lanes, sizes,
+                bit_depth, color_type,
+            )
+        from ..ops.device_deflate import fused_filter_deflate_batch
+
+        streams, lengths = fused_filter_deflate_batch(
+            batch_dev, rows, row_bytes, bpp,
+            filter_mode=filter_mode, mode=deflate_mode,
+            packer=self._packer,
+            donate=(not staged) and self._donate_ok(),
+        )
+        t_dispatch = time.perf_counter()
+        self._note_launch(t_dispatch)
+        return self._readback.submit(
+            self._readback_group,
+            streams, lengths, t_dispatch, lanes, sizes,
+            bit_depth, color_type,
+        )
+
+    def _stage_render_group(
+        self, planes, index_tables, color_luts, rows, row_bytes,
+        filter_mode, deflate_mode, lanes, sizes,
+    ):
         import jax
 
         if self.mesh_manager is not None:
@@ -191,19 +480,22 @@ class DeviceEncodeDispatcher:
 
         t0 = time.perf_counter()
         batch_dev = jax.device_put(planes)
-        jax.block_until_ready(batch_dev)  # ompb-lint: disable=jax-hotpath -- H2D stage boundary: waits on the transfer engine, overlapped with the prior group's compute
+        jax.block_until_ready(batch_dev)  # ompb-lint: disable=jax-hotpath -- H2D stage boundary: waits on the transfer engine, overlapped with earlier groups' compute
         t_h2d = time.perf_counter()
+        DEVICE_STAGE_SECONDS.observe(t_h2d - t0, stage="h2d")
         streams, lengths = fused_render_filter_deflate_batch(
             batch_dev, index_tables, color_luts, rows, row_bytes,
             filter_mode=filter_mode, mode=deflate_mode,
             packer=self._packer,
         )
         t_dispatch = time.perf_counter()
-        DEVICE_STAGE_SECONDS.observe(t_h2d - t0, stage="h2d")
+        self._note_launch(t_dispatch)
         return self._readback.submit(
             self._readback_group,
             streams, lengths, t_dispatch, lanes, sizes, 8, 2,
         )
+
+    # -- mesh groups (readback worker) ---------------------------------
 
     def _mesh_render_group(
         self, planes, index_tables, color_luts, rows, row_bytes,
@@ -226,8 +518,7 @@ class DeviceEncodeDispatcher:
         def run(mesh):
             n = mesh.shape["data"]
             b = planes.shape[0]
-            pow2 = 1 << max(b - 1, 0).bit_length()
-            padded_b = -(-pow2 // n) * n
+            padded_b = _mesh_padded_lanes(b, n)
             batch = jnp.asarray(planes)
             if padded_b != b:
                 batch = jnp.pad(
@@ -248,12 +539,14 @@ class DeviceEncodeDispatcher:
             run, real_lanes=len(lanes)
         )
         t_ready = time.perf_counter()
-        DEVICE_STAGE_SECONDS.observe(
-            stamps.get("h2d", t0) - t0, stage="h2d"
-        )
-        DEVICE_STAGE_SECONDS.observe(
-            t_ready - stamps.get("h2d", t0), stage="compute"
-        )
+        t_h2d = stamps.get("h2d", t0)
+        # noted AFTER the managed dispatch returns: dispatch() may
+        # re-invoke run() once on a probe-shrink retry, and the queue
+        # telemetry must count each submitted group exactly once
+        self._note_launch(t_h2d)
+        DEVICE_STAGE_SECONDS.observe(t_h2d - t0, stage="h2d")
+        DEVICE_STAGE_SECONDS.observe(t_ready - t_h2d, stage="compute")
+        self._note_compute_done(t_ready, t_ready - t_h2d)
         return self._pull_and_frame(
             streams, lengths, t_ready, lanes, sizes, 8, 2
         )
@@ -281,8 +574,7 @@ class DeviceEncodeDispatcher:
         def run(mesh):
             n = mesh.shape["data"]
             b = tiles.shape[0]
-            pow2 = 1 << max(b - 1, 0).bit_length()
-            padded_b = -(-pow2 // n) * n
+            padded_b = _mesh_padded_lanes(b, n)
             batch = jnp.asarray(tiles)
             if padded_b != b:
                 batch = jnp.pad(
@@ -306,18 +598,121 @@ class DeviceEncodeDispatcher:
             run, real_lanes=len(lanes)
         )
         t_ready = time.perf_counter()
-        DEVICE_STAGE_SECONDS.observe(
-            stamps.get("h2d", t0) - t0, stage="h2d"
-        )
-        DEVICE_STAGE_SECONDS.observe(
-            t_ready - stamps.get("h2d", t0), stage="compute"
-        )
+        t_h2d = stamps.get("h2d", t0)
+        # noted AFTER the managed dispatch returns: dispatch() may
+        # re-invoke run() once on a probe-shrink retry, and the queue
+        # telemetry must count each submitted group exactly once
+        self._note_launch(t_h2d)
+        DEVICE_STAGE_SECONDS.observe(t_h2d - t0, stage="h2d")
+        DEVICE_STAGE_SECONDS.observe(t_ready - t_h2d, stage="compute")
+        self._note_compute_done(t_ready, t_ready - t_h2d)
         return self._pull_and_frame(
             streams, lengths, t_ready, lanes, sizes, bit_depth,
             color_type,
         )
 
-    # ------------------------------------------------------------------
+    # -- mesh-resize jit warmup ----------------------------------------
+
+    def _register_mesh_shape(
+        self, tiles, rows, row_bytes, bpp, filter_mode, deflate_mode
+    ) -> None:
+        """Remember a raw-tile mesh group's jit-relevant shape so a
+        later mesh WIDTH change can pre-warm its specialization."""
+        key = (
+            tuple(tiles.shape[1:]), np.dtype(tiles.dtype).str,
+            _pow2_lanes(tiles.shape[0]),
+            rows, row_bytes, bpp, filter_mode, deflate_mode,
+        )
+        with self._warm_lock:
+            self._seen_mesh[key] = None
+            while len(self._seen_mesh) > _WARM_SHAPES:
+                self._seen_mesh.pop(next(iter(self._seen_mesh)))
+
+    def _on_mesh_width(self, width: int) -> None:
+        """MeshManager width listener: a probe-shrink or heal changed
+        the healthy chip count, so every known group shape's padded
+        batch width — and therefore its jit specialization — changed.
+        Compile them NOW on a background thread instead of inside the
+        first serving dispatch on the resized mesh."""
+        with self._warm_lock:
+            shapes = [
+                k for k in self._seen_mesh
+                if (width, k) not in self._warmed
+            ]
+        if not shapes or self._closed:
+            return
+        t = threading.Thread(
+            target=self._warm_width,
+            args=(width, shapes),
+            name="devenc-mesh-warm",
+            daemon=True,
+        )
+        t.start()
+        self._warm_thread = t  # tests join this
+
+    def _warm_width(self, width: int, shapes: List[tuple]) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from ..parallel.sharding import shard_batch, sharded_filter_deflate
+
+        for key in shapes:
+            (lane_shape, dtype_str, pow2_b, rows, row_bytes, bpp,
+             filter_mode, deflate_mode) = key
+            try:
+                mesh = self.mesh_manager.mesh()
+                n = mesh.shape["data"]
+                if n != width:
+                    return  # the mesh moved again; a fresh warmup owns it
+                padded_b = _mesh_padded_lanes(pow2_b, n)
+                batch = jnp.zeros(
+                    (padded_b,) + lane_shape, dtype=np.dtype(dtype_str)
+                )
+                sharded = shard_batch(mesh, batch)
+                out = sharded_filter_deflate(
+                    mesh, sharded, rows, row_bytes, bpp,
+                    filter_mode=filter_mode, deflate_mode=deflate_mode,
+                    packer=self._packer,
+                )
+                jax.block_until_ready(out)  # ompb-lint: disable=jax-hotpath -- background warmup thread: compiles ahead of the serving path
+                with self._warm_lock:
+                    self._warmed.add((width, key))
+                log.info(
+                    "pre-warmed mesh width %d for group shape %s",
+                    width, lane_shape,
+                )
+            except Exception:
+                log.exception("mesh warmup failed for %s", key)
+
+    # -- readback (readback worker) ------------------------------------
+
+    def _dynamic_readback_group(
+        self, flat, counts, extras, real_b, t_dispatch, lanes, sizes,
+        bit_depth, color_type,
+    ) -> Dict[int, bytes]:
+        """Dynamic mode pass 2 on the readback worker: pull the pass-1
+        counts (absorbing the histogram program's wait), build the
+        canonical code tables on host (real lanes only — pad lanes
+        keep the fixed defaults), launch + block on the emit program,
+        then the shared pull/frame tail."""
+        import jax
+
+        from ..ops.device_deflate import dynamic_emit_batch
+
+        counts_np, extras_np = jax.device_get((counts, extras))  # ompb-lint: disable=jax-hotpath -- readback worker: the one thread that waits on device completion (pass-1 counts, a few KB)
+        t_hist = time.perf_counter()
+        DEVICE_STAGE_SECONDS.observe(t_hist - t_dispatch, stage="hist")
+        streams, lengths = dynamic_emit_batch(
+            flat, counts_np, extras_np, packer=self._packer, real=real_b
+        )
+        jax.block_until_ready((streams, lengths))  # ompb-lint: disable=jax-hotpath -- readback worker: the one thread that waits on device completion
+        t_ready = time.perf_counter()
+        DEVICE_STAGE_SECONDS.observe(t_ready - t_hist, stage="emit")
+        self._note_compute_done(t_ready, t_ready - t_dispatch)
+        return self._pull_and_frame(
+            streams, lengths, t_ready, lanes, sizes, bit_depth,
+            color_type,
+        )
 
     def _readback_group(
         self, streams, lengths, t_dispatch, lanes, sizes,
@@ -332,6 +727,7 @@ class DeviceEncodeDispatcher:
         jax.block_until_ready((streams, lengths))  # ompb-lint: disable=jax-hotpath -- readback worker: the one thread that waits on device completion
         t_ready = time.perf_counter()
         DEVICE_STAGE_SECONDS.observe(t_ready - t_dispatch, stage="compute")
+        self._note_compute_done(t_ready, t_ready - t_dispatch)
         return self._pull_and_frame(
             streams, lengths, t_ready, lanes, sizes, bit_depth,
             color_type,

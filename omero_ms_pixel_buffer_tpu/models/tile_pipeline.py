@@ -123,6 +123,8 @@ class TilePipeline:
         use_plane_cache: bool = True,
         max_tile_bytes: int = 256 << 20,
         device_deflate: bool = False,
+        device_deflate_mode: str = "dynamic",
+        queue_depth: int = 2,
         compilation_cache_dir: Optional[str] = None,
         lut_dir: Optional[str] = None,
     ):
@@ -142,14 +144,26 @@ class TilePipeline:
         # role shrinks to PNG chunk framing. Replaces the host half of
         # the reference's encode hot loop (TileRequestHandler.java:176-199).
         self.device_deflate = device_deflate
+        # which stream the accelerator builds for RAW PNG lanes:
+        # "dynamic" (two-pass canonical Huffman, ~host-parity ratio,
+        # the default), "rle" (fixed Huffman, single dispatch), or
+        # "stored". Render lanes always use "rle" — their host mirror
+        # (zlib_rle_np) is what pins device == host byte identity.
+        if device_deflate_mode not in ("dynamic", "rle", "stored"):
+            raise ValueError(
+                f"Unknown device deflate mode: {device_deflate_mode}"
+            )
+        self.device_deflate_mode = device_deflate_mode
+        # bounded in-flight groups for the streaming encode queue
+        self.queue_depth = max(1, int(queue_depth))
         self._device_deflate_logged = False
         self._probe_error_logged: Optional[str] = None
         # adaptive compressed-size guess per payload shape: lets the
         # deflate tail pull lengths AND stream bytes in ONE host sync
         # (tunnel round trips dominate the device path's latency)
         self._dd_cap: Dict[Tuple[int, int], int] = {}
-        # double-buffered device-encode dispatcher (built lazily on
-        # the first device-deflate batch; owns the readback worker)
+        # streaming device-encode queue (built lazily on the first
+        # device-deflate batch; owns the submit + readback workers)
         self._dispatcher = None
         # persistent XLA compilation cache: an explicit configured dir
         # (config `jax.compilation-cache-dir`) engages at construction
@@ -195,8 +209,9 @@ class TilePipeline:
 
     def close(self) -> None:
         """Release owned threads: the encode pool and (if the device
-        path ever ran) the dispatcher's readback worker. Idempotent;
-        the server's cleanup hook calls it."""
+        path ever ran) the streaming queue — DRAINED, so every
+        submitted group's future resolves before the threads die.
+        Idempotent; the server's cleanup hook calls it."""
         if self._dispatcher is not None:
             self._dispatcher.close()
         self._encode_pool.shutdown(wait=False)
@@ -355,9 +370,11 @@ class TilePipeline:
         return self.mesh
 
     def _get_dispatcher(self):
-        """The double-buffered device-encode dispatcher; with a
-        serving mesh it carries a MeshManager so encode batches shard
-        across chips and a sick chip degrades to the survivors."""
+        """The streaming device-encode queue (persistent across
+        batches — groups of batch N+1 stage and launch while batch N
+        is still in flight); with a serving mesh it carries a
+        MeshManager so encode batches shard across chips and a sick
+        chip degrades to the survivors."""
         if self._dispatcher is None:
             from .device_dispatch import DeviceEncodeDispatcher
 
@@ -368,9 +385,16 @@ class TilePipeline:
 
                 mgr = MeshManager(devices=list(mesh.devices.flat))
             self._dispatcher = DeviceEncodeDispatcher(
-                self._dd_cap, mesh_manager=mgr
+                self._dd_cap, mesh_manager=mgr,
+                queue_depth=self.queue_depth,
             )
         return self._dispatcher
+
+    def device_queue_snapshot(self) -> Optional[dict]:
+        """/healthz view of the streaming encode queue; None until the
+        device-deflate path has dispatched at least once."""
+        disp = self._dispatcher
+        return None if disp is None else disp.snapshot()
 
     @property
     def last_mesh_dispatch(self) -> Optional[dict]:
@@ -675,10 +699,11 @@ class TilePipeline:
                 log.exception("distributed plane lane failed; host fallback")
                 results[i] = self.encode(ctxs[i], tiles[i])
 
-        # device-deflate groups go through the double-buffered
-        # dispatcher: each group's H2D + fused compute launches while
-        # earlier groups are still in their D2H/framing tail (the
-        # readback worker), so the device never waits on host framing
+        # device-deflate groups go through the streaming encode queue:
+        # each group's H2D + fused compute launches while earlier
+        # groups — including groups of a PREVIOUS batch still being
+        # drained — are in their D2H/framing tail, so the device never
+        # waits on host framing or on the batcher boundary
         use_fused = use_device and self.device_deflate
         pending: List[Tuple[List[int], object]] = []
         for ((bh, bw), dtype_str, samples), lanes in png_groups.items():
@@ -1098,10 +1123,11 @@ class TilePipeline:
         if not self._device_deflate_logged:
             self._device_deflate_logged = True
             log.info(
-                "device deflate active: PNG lanes compress on the "
-                "accelerator (RLE + fixed Huffman, fused with the "
-                "filter in one program); backend.png.level/strategy "
-                "apply only to host-encoded lanes"
+                "device deflate active (mode=%s, queue-depth=%d): PNG "
+                "lanes compress on the accelerator through the "
+                "streaming encode queue; backend.png.level/strategy "
+                "apply only to host-encoded lanes",
+                self.device_deflate_mode, self.queue_depth,
             )
 
     def _submit_bucket_groups(
@@ -1132,11 +1158,19 @@ class TilePipeline:
                 for j, i in enumerate(idxs):
                     t = tiles[i]
                     batch[j, : t.shape[0], : t.shape[1]] = t
-                fut = disp.submit(
-                    batch, h, 1 + w * bpp, bpp, self.png_filter, "rle",
-                    idxs, [(w, h)] * len(idxs),
-                    itemsize * 8, 0 if samples == 1 else 2,
-                )
+                try:
+                    fut = disp.submit(
+                        batch, h, 1 + w * bpp, bpp, self.png_filter,
+                        self.device_deflate_mode, idxs,
+                        [(w, h)] * len(idxs),
+                        itemsize * 8, 0 if samples == 1 else 2,
+                    )
+                except Exception as e:
+                    # a raise here must not lose the futures of groups
+                    # ALREADY submitted in this loop — degrade this
+                    # group alone through the normal drain fallback
+                    fut = concurrent.futures.Future()
+                    fut.set_exception(e)
                 pending.append((idxs, fut))
         return pending
 
@@ -1167,12 +1201,17 @@ class TilePipeline:
                     else device_batch[jnp.asarray(js)]
                 )
                 idxs = [lanes[j] for j in js]
-                fut = disp.submit(
-                    sub, h, 1 + w * itemsize, itemsize,
-                    self.png_filter, "rle", idxs,
-                    [(w, h)] * len(idxs), itemsize * 8, 0,
-                    staged=True,
-                )
+                try:
+                    fut = disp.submit(
+                        sub, h, 1 + w * itemsize, itemsize,
+                        self.png_filter, self.device_deflate_mode, idxs,
+                        [(w, h)] * len(idxs), itemsize * 8, 0,
+                        staged=True,
+                    )
+                except Exception as e:
+                    # same per-group degradation as the bucket path
+                    fut = concurrent.futures.Future()
+                    fut.set_exception(e)
                 pending.append((idxs, fut))
         return pending
 

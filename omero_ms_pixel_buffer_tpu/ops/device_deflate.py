@@ -30,6 +30,26 @@ built on device** with static shapes, in two modes:
   the stored-block encoding (pathological no-run payloads expand past
   9 bits/byte), the stored stream is emitted instead — every lane's
   length is bounded by ``stored_stream_len(L)``.
+- ``dynamic`` (the r12 ratio path): a TWO-PASS canonical
+  dynamic-Huffman encode. Pass 1 runs ON DEVICE fused with the PNG
+  filter (``fused_filter_histogram_batch``): the same Z_RLE run
+  decomposition, but instead of emitting code bits it histograms the
+  286-symbol literal/length alphabet per lane (one scatter-add) and
+  sums the match extra-bits — only ``(B, 286)`` counts cross the link.
+  The HOST then builds per-lane length-limited (15) canonical Huffman
+  codes from the counts (heap build + frequency damping, the same
+  algorithm as native/fast_deflate.cc), the RFC 1951 §3.2.7 dynamic
+  block header (code-length tree, CL 16/17/18 run coding) as a
+  zero-padded token array, and per-lane code TABLES. Pass 2 re-runs
+  the decomposition on device and emits through the per-lane tables —
+  header tokens ++ body tokens ++ explicit EOB — into the same
+  carry-free packer. Per lane the host picks min(dynamic, fixed)
+  analytically from the counts BEFORE emitting (a fixed-winning lane
+  just gets the fixed tables + 3-bit header), and the framing keeps
+  the stored fallback, so every lane is min(dynamic, rle, stored) in
+  ONE emit dispatch and no content regresses past
+  ``stored_stream_len``. Closes the 1.38x-of-host-bytes gap on
+  low-run (rendered-RGB) content to ~parity with host zlib level 6.
 - ``stored``: BTYPE=00 stored blocks — no compression, but the
   simplest possible spec-valid stream; kept as the paranoia fallback
   and as the reference point in tests.
@@ -97,6 +117,24 @@ def _bit_reverse(code: int, nbits: int) -> int:
     return r
 
 
+_LEN_BASE = [3, 4, 5, 6, 7, 8, 9, 10, 11, 13, 15, 17, 19, 23, 27, 31,
+             35, 43, 51, 59, 67, 83, 99, 115, 131, 163, 195, 227, 258]
+_LEN_EXTRA = [0, 0, 0, 0, 0, 0, 0, 0, 1, 1, 1, 1, 2, 2, 2, 2,
+              3, 3, 3, 3, 4, 4, 4, 4, 5, 5, 5, 5, 0]
+_NUM_LITLEN = 286  # 0-255 literals, 256 EOB, 257-285 length symbols
+
+
+def _length_code_index(length: int) -> int:
+    """RFC 1951 length -> index into the 29-entry length-code rows."""
+    if length == _MAX_MATCH:
+        return 28  # code 285, exact, 0 extra
+    return max(
+        k for k in range(28)
+        if _LEN_BASE[k] <= length
+        and length < _LEN_BASE[k] + (1 << _LEN_EXTRA[k])
+    )
+
+
 def _build_tables():
     lit_bits = np.zeros(256, np.uint32)
     lit_nbits = np.zeros(256, np.int32)
@@ -108,34 +146,42 @@ def _build_tables():
         lit_bits[v] = _bit_reverse(code, n)
         lit_nbits[v] = n
 
-    len_base = [3, 4, 5, 6, 7, 8, 9, 10, 11, 13, 15, 17, 19, 23, 27, 31,
-                35, 43, 51, 59, 67, 83, 99, 115, 131, 163, 195, 227, 258]
-    len_extra = [0, 0, 0, 0, 0, 0, 0, 0, 1, 1, 1, 1, 2, 2, 2, 2,
-                 3, 3, 3, 3, 4, 4, 4, 4, 5, 5, 5, 5, 0]
     match_bits = np.zeros(_MAX_MATCH + 1, np.uint32)
     match_nbits = np.zeros(_MAX_MATCH + 1, np.int32)
+    # per match length: the SYMBOL id, the extra-bit count, and the
+    # base offset — shared by the fixed emit, the dynamic histogram
+    # pass, and the dynamic per-lane table build
+    mlen_sym = np.zeros(_MAX_MATCH + 1, np.int32)
+    mlen_extra = np.zeros(_MAX_MATCH + 1, np.int32)
+    mlen_base = np.zeros(_MAX_MATCH + 1, np.int32)
     for length in range(3, _MAX_MATCH + 1):
-        if length == _MAX_MATCH:
-            i = 28  # code 285, exact, 0 extra
-        else:
-            i = max(
-                k for k in range(28)
-                if len_base[k] <= length
-                and length < len_base[k] + (1 << len_extra[k])
-            )
+        i = _length_code_index(length)
         symbol = 257 + i
+        mlen_sym[length] = symbol
+        mlen_extra[length] = _LEN_EXTRA[i]
+        mlen_base[length] = _LEN_BASE[i]
         if symbol <= 279:
             rev, n = _bit_reverse(symbol - 256, 7), 7
         else:
             rev, n = _bit_reverse(0xC0 + (symbol - 280), 8), 8
-        extra_val = length - len_base[i]
+        extra_val = length - _LEN_BASE[i]
         match_bits[length] = rev | (extra_val << n)
         # + len_extra extra bits + 5-bit distance code (value 0)
-        match_nbits[length] = n + len_extra[i] + 5
-    return lit_bits, lit_nbits, match_bits, match_nbits
+        match_nbits[length] = n + _LEN_EXTRA[i] + 5
+    return (lit_bits, lit_nbits, match_bits, match_nbits,
+            mlen_sym, mlen_extra, mlen_base)
 
 
-_LIT_BITS, _LIT_NBITS, _MATCH_BITS, _MATCH_NBITS = _build_tables()
+(_LIT_BITS, _LIT_NBITS, _MATCH_BITS, _MATCH_NBITS,
+ _MLEN_SYM, _MLEN_EXTRA, _MLEN_BASE) = _build_tables()
+
+# fixed-Huffman CODE length per lit/len symbol (RFC 1951 §3.2.6) — the
+# analytic side of the per-lane dynamic-vs-fixed decision
+_FIXED_SYM_LEN = np.zeros(_NUM_LITLEN, np.int64)
+_FIXED_SYM_LEN[:144] = 8
+_FIXED_SYM_LEN[144:256] = 9
+_FIXED_SYM_LEN[256:280] = 7
+_FIXED_SYM_LEN[280:] = 8
 
 
 def stored_stream_len(payload_len: int) -> int:
@@ -198,8 +244,8 @@ def _adler_bytes(adler: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
-def _rle_tokens(payload: jax.Array):
-    """Z_RLE tokenization without a serial scan.
+def _run_decompose(payload: jax.Array):
+    """Z_RLE run decomposition without a serial scan.
 
     A maximal run of r identical bytes becomes: 1 literal head, then
     the match region of m = r-1 bytes split into chunks of <= 258;
@@ -209,6 +255,11 @@ def _rle_tokens(payload: jax.Array):
 
       start_pos  = cummax of run-start indices      (position of run head)
       next_start = reverse-cummin of later starts   (where the run ends)
+
+    Returns per-position ``(is_lit, is_match, mlen)`` — the SAME
+    decomposition feeds the fixed-Huffman emit, the dynamic histogram
+    pass, and the dynamic emit, which is what makes pass 2 of the
+    two-pass encode consistent with pass 1's counts by construction.
     """
     n = payload.shape[0]
     arange = jnp.arange(n, dtype=jnp.int32)
@@ -228,7 +279,13 @@ def _rle_tokens(payload: jax.Array):
     is_lit = (p_in_run == 0) | (chunk_size < 3)
     is_match = (p_in_run >= 1) & (qmod == 0) & (chunk_size >= 3)
     mlen = jnp.clip(jnp.minimum(_MAX_MATCH, rem), 0, _MAX_MATCH)
+    return is_lit, is_match, mlen
 
+
+def _rle_tokens(payload: jax.Array):
+    """Per-position fixed-Huffman (bits, nbits) token arrays from the
+    Z_RLE decomposition."""
+    is_lit, is_match, mlen = _run_decompose(payload)
     lit_bits = jnp.asarray(_LIT_BITS)[payload]
     lit_n = jnp.asarray(_LIT_NBITS)[payload]
     m_bits = jnp.asarray(_MATCH_BITS)[mlen]
@@ -238,12 +295,15 @@ def _rle_tokens(payload: jax.Array):
     return bits, nbits
 
 
-# Maximum significant bits in any token's code value: a match emits
-# rev(code) | extra<<n with n <= 8 and extra < 2^5 (13 bits); its BIT
-# COUNT adds the 5-bit distance code, but those bits are zero (symbol
-# 0 reverses to 0). Literals are 8/9 bits, the header 3.
-_TOKEN_VALUE_BITS = 13
-_TOKEN_MAX_NBITS = 18
+# Maximum significant bits in any token's code value: a FIXED match
+# emits rev(code) | extra<<n with n <= 8 and extra < 2^5 (13 bits); a
+# DYNAMIC match can reach 15-bit codes + 5 extra (20 bits). BIT COUNTS
+# additionally include the distance code (5 bits fixed / 1 bit
+# dynamic), whose bits are zero (symbol 0 reverses to 0). The packers
+# only require value < 2^32 and a <= 2-word span, which 20-bit values
+# satisfy at any alignment.
+_TOKEN_VALUE_BITS = 20
+_TOKEN_MAX_NBITS = 21
 
 
 def _pack_bits_scan(bits: jax.Array, nbits: jax.Array, maxbits: int):
@@ -386,15 +446,18 @@ def _stored_lane(payload: jax.Array, adler: jax.Array, cap: int):
     return jnp.pad(stream, (0, cap - stream.shape[0]))
 
 
-def _frame_lane(payload: jax.Array, packed: jax.Array, body_bits):
+def _frame_lane(payload: jax.Array, packed: jax.Array, body_bits,
+                eob_bits: int = 7):
     """Zlib-frame one lane's packed deflate body, then pick per lane
-    the smaller of the RLE and stored streams (RLE on no-run content
-    expands past 9 bits/byte; the stored bound must hold for every
-    lane): (stream padded to max_stream_len(L), true length)."""
+    the smaller of the coded and stored streams (a coded stream on
+    no-run content can expand past 9 bits/byte; the stored bound must
+    hold for every lane): (stream padded to max_stream_len(L), true
+    length). ``eob_bits``: the FIXED emit leaves the end-of-block
+    symbol implicit (7-bit all-zero code, appended here as length
+    only); the dynamic emit carries EOB as an explicit token and
+    passes 0."""
     n = payload.shape[0]
-    # end-of-block symbol 256: 7-bit code 0 -> contributes no set
-    # bits, only length
-    total_bits = body_bits + 7
+    total_bits = body_bits + eob_bits
     deflate_nbytes = (total_bits + 7) // 8
     cap = 2 + packed.shape[0] + 4
     rle_len = 2 + deflate_nbytes + 4
@@ -420,31 +483,42 @@ def _zlib_rle(
     # 126s vs 26s for the 512-tile shape)
     bits, nbits = jax.vmap(_lane_tokens)(payloads)
     maxbits = _packing_maxbits(payloads.shape[1])
-    if packer == "pallas":
-        from .pallas.bitpack import pack_tokens
-
-        packed, body_bits = pack_tokens(
-            bits, nbits, maxbits, interpret=interpret
-        )
-    elif packer == "gather":
-        packed, body_bits = jax.vmap(
-            lambda b, nb: _pack_bits_gather(b, nb, maxbits)
-        )(bits, nbits)
-    else:
-        packed, body_bits = jax.vmap(
-            lambda b, nb: _pack_bits_scan(b, nb, maxbits)
-        )(bits, nbits)
+    packed, body_bits = _pack_dispatch(bits, nbits, maxbits, packer, interpret)
     return jax.vmap(_frame_lane)(payloads, packed, body_bits)
 
 
+def _pack_dispatch(bits, nbits, maxbits: int, packer: str, interpret: bool):
+    """Route batched token arrays through the selected packer."""
+    if packer == "pallas":
+        from .pallas.bitpack import pack_tokens_sp
+
+        return pack_tokens_sp(bits, nbits, maxbits, interpret=interpret)
+    if packer == "pallas_dense":
+        from .pallas.bitpack import pack_tokens
+
+        return pack_tokens(bits, nbits, maxbits, interpret=interpret)
+    if packer == "gather":
+        return jax.vmap(
+            lambda b, nb: _pack_bits_gather(b, nb, maxbits)
+        )(bits, nbits)
+    return jax.vmap(
+        lambda b, nb: _pack_bits_scan(b, nb, maxbits)
+    )(bits, nbits)
+
+
+_PACKERS = ("scan", "pallas", "pallas_dense", "gather")
+
+
 def default_packer() -> str:
-    """'pallas' (the per-block VMEM emit kernel) on real TPU backends,
-    'scan' (the XLA prefix-sum packer) everywhere else. Overridable
-    with OMPB_BITPACK=scan|pallas|gather."""
+    """'pallas' (the scalar-prefetch token-window emit kernel) on real
+    TPU backends, 'scan' (the XLA prefix-sum packer) everywhere else.
+    Overridable with OMPB_BITPACK=scan|pallas|pallas_dense|gather
+    ('pallas_dense' is the r9 dense compare-reduce kernel, kept as the
+    pinned comparison point)."""
     import os
 
     forced = os.environ.get("OMPB_BITPACK")
-    if forced in ("scan", "pallas", "gather"):
+    if forced in _PACKERS:
         return forced
     try:
         return "pallas" if jax.default_backend() == "tpu" else "scan"
@@ -513,10 +587,331 @@ def zlib_rle_batch(payloads, packer: Optional[str] = None) -> tuple:
     return _zlib_rle(payloads, packer, _interpret_for(packer))
 
 
+# ---------------------------------------------------------------------------
+# Dynamic-Huffman encode (two-pass): device histogram -> host canonical
+# codes + header tokens -> device emit with per-lane code tables
+# ---------------------------------------------------------------------------
+
+# Header token capacity: 1 (BFINAL|BTYPE) + 3 (HLIT/HDIST/HCLEN) + 19
+# (CL code lengths) + <= 287 CL ops (hlit <= 286 literal/length lengths
+# + 1 distance length, each op covering >= 1 entry) = 310; rounded up.
+# A lane whose header would not fit (impossible by the bound, but the
+# plan checks) simply takes the fixed tables.
+_HDR_TOKENS = 320
+
+_CL_ORDER = (16, 17, 18, 0, 8, 7, 9, 6, 10, 5, 11, 4, 12, 3, 13, 2, 14, 1, 15)
+
+
+def _dyn_stats_lane(payload: jax.Array):
+    """Pass 1 for one lane: (L,) uint8 -> ((286,) int32 literal/length
+    symbol counts, () int32 total match extra bits). Runs the same
+    run decomposition the emit pass reruns, so the counts describe
+    exactly the tokens pass 2 will produce."""
+    is_lit, is_match, mlen = _run_decompose(payload)
+    sym = jnp.where(
+        is_lit,
+        payload.astype(jnp.int32),
+        jnp.where(is_match, jnp.asarray(_MLEN_SYM)[mlen], _NUM_LITLEN),
+    )
+    counts = jnp.zeros(_NUM_LITLEN + 1, jnp.int32).at[sym].add(1)
+    extra = jnp.where(
+        is_match, jnp.asarray(_MLEN_EXTRA)[mlen], 0
+    ).sum(dtype=jnp.int32)
+    return counts[:_NUM_LITLEN], extra
+
+
+@jax.jit
+def _dyn_stats(payloads: jax.Array):
+    return jax.vmap(_dyn_stats_lane)(payloads)
+
+
+def _build_lengths_np(freq_in, limit: int) -> np.ndarray:
+    """Length-limited canonical Huffman code lengths from symbol
+    frequencies: heap tree build + frequency damping (halve-and-
+    rebuild) until the depth fits — the native fast_deflate.cc
+    algorithm, deterministic via (freq, insertion-order) heap keys."""
+    import heapq
+
+    n = len(freq_in)
+    lengths = np.zeros(n, np.int32)
+    freq = np.asarray(freq_in, np.int64).copy()
+    while True:
+        sym = np.flatnonzero(freq)
+        if sym.size == 0:
+            return lengths
+        if sym.size == 1:
+            lengths[:] = 0
+            lengths[sym[0]] = 1
+            return lengths
+        heap = [(int(freq[s]), int(s), int(s)) for s in sym]
+        heapq.heapify(heap)
+        children = {}
+        next_id = n
+        while len(heap) > 1:
+            fa, _, a = heapq.heappop(heap)
+            fb, _, b = heapq.heappop(heap)
+            children[next_id] = (a, b)
+            heapq.heappush(heap, (fa + fb, next_id, next_id))
+            next_id += 1
+        lengths[:] = 0
+        maxdepth = 0
+        stack = [(heap[0][2], 0)]
+        while stack:
+            node, d = stack.pop()
+            kids = children.get(node)
+            if kids is None:
+                lengths[node] = max(d, 1)
+                maxdepth = max(maxdepth, max(d, 1))
+            else:
+                stack.append((kids[0], d + 1))
+                stack.append((kids[1], d + 1))
+        if maxdepth <= limit:
+            return lengths
+        freq[freq > 0] = (freq[freq > 0] + 1) >> 1  # damp, keep nonzero
+
+
+def _build_codes_np(lengths: np.ndarray, max_len: int) -> np.ndarray:
+    """Canonical codes from lengths (RFC 1951 §3.2.2), pre-bit-reversed
+    for LSB-first emission."""
+    bl_count = np.bincount(lengths, minlength=max_len + 1).astype(np.int64)
+    bl_count[0] = 0
+    next_code = np.zeros(max_len + 1, np.int64)
+    code = 0
+    for bits in range(1, max_len + 1):
+        code = (code + int(bl_count[bits - 1])) << 1
+        next_code[bits] = code
+    codes = np.zeros(len(lengths), np.uint32)
+    for i, ln in enumerate(lengths):
+        if ln:
+            codes[i] = _bit_reverse(int(next_code[ln]), int(ln))
+            next_code[ln] += 1
+    return codes
+
+
+def _encode_code_lengths_np(lens: np.ndarray):
+    """RFC 1951 §3.2.7 run coding of the code-length sequence with CL
+    symbols 16/17/18 -> ([(sym, extra_bits, extra_val)], (19,) freq)."""
+    ops = []
+    cl_freq = np.zeros(19, np.int64)
+    i, n = 0, len(lens)
+    while i < n:
+        v = int(lens[i])
+        run = 1
+        while i + run < n and lens[i + run] == v:
+            run += 1
+        if v == 0:
+            while run >= 3:
+                take = min(run, 138)
+                if take >= 11:
+                    ops.append((18, 7, take - 11))
+                    cl_freq[18] += 1
+                else:
+                    ops.append((17, 3, take - 3))
+                    cl_freq[17] += 1
+                run -= take
+                i += take
+            while run > 0:
+                ops.append((0, 0, 0))
+                cl_freq[0] += 1
+                i += 1
+                run -= 1
+        else:
+            ops.append((v, 0, 0))
+            cl_freq[v] += 1
+            i += 1
+            run -= 1
+            while run >= 3:
+                take = min(run, 6)
+                ops.append((16, 2, take - 3))
+                cl_freq[16] += 1
+                run -= take
+                i += take
+            while run > 0:
+                ops.append((v, 0, 0))
+                cl_freq[v] += 1
+                i += 1
+                run -= 1
+    return ops, cl_freq
+
+
+def _lane_dynamic_plan(counts: np.ndarray, extra_bits: int):
+    """One lane's dynamic-vs-fixed decision from the pass-1 counts.
+
+    Returns ``None`` when the fixed tables win (both totals are exact
+    bit counts computed analytically — no trial emit), else
+    ``(header_tokens, lit_code, lit_len, ml_bits, ml_nbits, eob_bits,
+    eob_len)`` ready to drop into the per-lane emit tables."""
+    counts = counts.astype(np.int64)
+    match_tokens = int(counts[257:].sum())
+    any_run = match_tokens > 0
+    freq = counts.copy()
+    freq[256] = 1  # end-of-block (pass 1 histograms payload tokens only)
+    lit_len = _build_lengths_np(freq, 15)
+    # exact body bits: code bits per symbol + match extra bits + one
+    # 1-bit distance code per match + the explicit EOB code
+    dyn_body = (
+        int((counts * lit_len.astype(np.int64)).sum())
+        + int(extra_bits) + match_tokens + int(lit_len[256])
+    )
+    fixed_total = (
+        3 + int((counts * _FIXED_SYM_LEN).sum())
+        + int(extra_bits) + match_tokens * 5 + 7
+    )
+    # dynamic block header: BFINAL|BTYPE=10, HLIT/HDIST/HCLEN, the CL
+    # tree, and the run-coded code-length sequence — all as <= 14-bit
+    # tokens for the same packer the body goes through
+    hlit = _NUM_LITLEN
+    while hlit > 257 and lit_len[hlit - 1] == 0:
+        hlit -= 1
+    all_lens = np.concatenate(
+        [lit_len[:hlit], np.asarray([1 if any_run else 0], np.int32)]
+    )
+    ops, cl_freq = _encode_code_lengths_np(all_lens)
+    cl_len = _build_lengths_np(cl_freq, 7)
+    nz = np.flatnonzero(cl_len)
+    if nz.size == 1:
+        # a single 1-bit CL code is an INCOMPLETE code-length tree,
+        # which inflate rejects (incomplete sets are only legal for
+        # single-code LENS/DISTS trees); a dummy 1-bit code on an
+        # unused symbol completes it at zero body cost
+        cl_len[0 if nz[0] != 0 else 1] = 1
+    cl_code = _build_codes_np(cl_len, 7)
+    hclen = 19
+    while hclen > 4 and cl_len[_CL_ORDER[hclen - 1]] == 0:
+        hclen -= 1
+    hdr = [(5, 3), (hlit - 257, 5), (0, 5), (hclen - 4, 4)]
+    hdr += [(int(cl_len[_CL_ORDER[k]]), 3) for k in range(hclen)]
+    for s, eb, ev in ops:
+        cn = int(cl_len[s])
+        hdr.append((int(cl_code[s]) | (ev << cn), cn + eb))
+    dyn_total = sum(t[1] for t in hdr) + dyn_body
+    if dyn_total >= fixed_total or len(hdr) > _HDR_TOKENS:
+        return None
+    lit_code = _build_codes_np(lit_len, 15)
+    ml_bits = np.zeros(_MAX_MATCH + 1, np.uint32)
+    ml_nbits = np.zeros(_MAX_MATCH + 1, np.int32)
+    for ln in range(3, _MAX_MATCH + 1):
+        s = int(_MLEN_SYM[ln])
+        cn = int(lit_len[s])
+        if cn == 0:
+            continue  # symbol absent from this lane: length never occurs
+        ev = ln - int(_MLEN_BASE[ln])
+        ml_bits[ln] = int(lit_code[s]) | (ev << cn)
+        # + extra bits + the 1-bit distance-1 code (value 0)
+        ml_nbits[ln] = cn + int(_MLEN_EXTRA[ln]) + 1
+    return (
+        hdr, lit_code[:256], lit_len[:256], ml_bits, ml_nbits,
+        int(lit_code[256]), int(lit_len[256]),
+    )
+
+
+def build_dynamic_tables(
+    counts: np.ndarray, extras: np.ndarray, real: Optional[int] = None
+):
+    """Per-lane emit tables from the pass-1 stats: lanes where the
+    canonical dynamic code wins get their own header tokens + code
+    tables; lanes where fixed wins get the fixed tables and the 3-bit
+    fixed header — ONE emit program serves both, so the per-lane
+    min(dynamic, fixed) costs no extra dispatch. Only the first
+    ``real`` lanes get a host Huffman plan (pow2 PAD lanes keep the
+    prefilled fixed tables — their streams are discarded, so building
+    codes for them would be pure waste on the readback worker).
+    Returns the 9-tuple of arrays ``_zlib_dynamic`` takes."""
+    b = counts.shape[0]
+    hdr_b = np.zeros((b, _HDR_TOKENS), np.uint32)
+    hdr_n = np.zeros((b, _HDR_TOKENS), np.int32)
+    # every lane starts as a valid FIXED emit (header BFINAL=1 BTYPE=01)
+    hdr_b[:, 0] = 3
+    hdr_n[:, 0] = 3
+    lit_b = np.tile(_LIT_BITS, (b, 1))
+    lit_n = np.tile(_LIT_NBITS, (b, 1))
+    ml_b = np.tile(_MATCH_BITS, (b, 1))
+    ml_n = np.tile(_MATCH_NBITS, (b, 1))
+    eob_b = np.zeros(b, np.uint32)
+    eob_n = np.full(b, 7, np.int32)  # fixed EOB: 7-bit all-zero code
+    for i in range(b if real is None else min(real, b)):
+        plan = _lane_dynamic_plan(counts[i], int(extras[i]))
+        if plan is None:
+            continue  # fixed wins: the prefilled tables ARE the plan
+        hdr, lcode, llen, mbits, mnbits, ebits, elen = plan
+        hdr_b[i, 0] = hdr_n[i, 0] = 0
+        for j, (v, nb) in enumerate(hdr):
+            hdr_b[i, j], hdr_n[i, j] = v, nb
+        lit_b[i], lit_n[i] = lcode, llen
+        ml_b[i], ml_n[i] = mbits, mnbits
+        eob_b[i], eob_n[i] = ebits, elen
+    return hdr_b, hdr_n, lit_b, lit_n, ml_b, ml_n, eob_b, eob_n
+
+
+def _dyn_lane_tokens(payload, lit_b, lit_n, ml_b, ml_n):
+    """Pass-2 body tokens for one lane through ITS code tables."""
+    is_lit, is_match, mlen = _run_decompose(payload)
+    bits = jnp.where(
+        is_lit, lit_b[payload], jnp.where(is_match, ml_b[mlen], 0)
+    )
+    nbits = jnp.where(
+        is_lit, lit_n[payload], jnp.where(is_match, ml_n[mlen], 0)
+    )
+    return bits, nbits
+
+
+@partial(jax.jit, static_argnames=("packer", "interpret"))
+def _zlib_dynamic(
+    payloads, hdr_b, hdr_n, lit_b, lit_n, ml_b, ml_n, eob_b, eob_n,
+    packer: str = "scan", interpret: bool = False,
+):
+    """Pass 2: emit header ++ body ++ explicit EOB through the per-lane
+    tables and pack. Capacity argument: the host plan only selects
+    dynamic when its exact total (header included) beats fixed, so
+    every lane's bits fit the fixed worst-case ``_packing_maxbits``
+    and the stream cap stays ``max_stream_len(L)``."""
+    body_b, body_n = jax.vmap(_dyn_lane_tokens)(
+        payloads, lit_b, lit_n, ml_b, ml_n
+    )
+    bits = jnp.concatenate(
+        [hdr_b, body_b, eob_b[:, None].astype(jnp.uint32)], axis=1
+    )
+    nbits = jnp.concatenate([hdr_n, body_n, eob_n[:, None]], axis=1)
+    if packer == "gather":
+        # the legacy window packer assumes >= 7-bit real tokens (its
+        # WIN sizing); dynamic codes can be 1 bit, so route to scan
+        packer = "scan"
+    maxbits = _packing_maxbits(payloads.shape[1])
+    packed, body_bits = _pack_dispatch(bits, nbits, maxbits, packer, interpret)
+    return jax.vmap(partial(_frame_lane, eob_bits=0))(
+        payloads, packed, body_bits
+    )
+
+
+def zlib_dynamic_batch(
+    payloads, packer: Optional[str] = None, real: Optional[int] = None,
+) -> tuple:
+    """Canonical dynamic-Huffman zlib streams (Z_RLE match policy,
+    per-lane two-pass code construction, per-lane min(dynamic, fixed,
+    stored) selection) for a batch of equal-length payloads. (B, L)
+    uint8 -> ((B, max_stream_len(L)) uint8, (B,) int32 lengths). TWO
+    device dispatches with one small (B, 286) host hop between — the
+    price of content-adaptive codes. ``real`` bounds the host plan
+    work to the leading real lanes (pad lanes keep the prefilled
+    fixed tables); the full padded batch is still emitted."""
+    payloads = jnp.asarray(payloads, dtype=jnp.uint8)
+    if payloads.ndim != 2:
+        raise ValueError("payloads must be (B, L)")
+    if payloads.shape[1] == 0:
+        raise ValueError("empty payload")
+    packer = packer or default_packer()
+    counts, extras = _dyn_stats(payloads)
+    counts_np, extras_np = jax.device_get((counts, extras))
+    tables = build_dynamic_tables(counts_np, extras_np, real=real)
+    return _zlib_dynamic(
+        payloads, *tables, packer=packer, interpret=_interpret_for(packer)
+    )
+
+
 def _interpret_for(packer: str) -> bool:
     """Pallas runs in interpret mode off-TPU (tests pin bit-exactness
     on the CPU backend through exactly this path)."""
-    if packer != "pallas":
+    if not packer.startswith("pallas"):
         return False
     try:
         return jax.default_backend() != "tpu"
@@ -559,6 +954,11 @@ def _pad_pow2_lanes(arr: jax.Array):
     return arr, b
 
 
+@partial(jax.jit, static_argnums=(1, 2))
+def _filtered_to_flat(filtered: jax.Array, rows: int, row_bytes: int):
+    return filtered[:, :rows, :row_bytes].reshape(filtered.shape[0], -1)
+
+
 def deflate_filtered_batch(
     filtered: jax.Array, rows: int, row_bytes: int, mode: str = "rle",
     packer: Optional[str] = None,
@@ -567,14 +967,19 @@ def deflate_filtered_batch(
     scanlines (B, H, 1 + W*itemsize) (device-resident, possibly
     bucket-padded) -> ((B, stream_cap) uint8 complete zlib streams,
     (B,) int32 true lengths) for the leading ``rows`` x ``row_bytes``
-    region of each lane."""
-    if mode not in ("rle", "stored"):
+    region of each lane. Mode ``dynamic`` takes the two-pass path
+    (device histogram, host code build, device emit)."""
+    if mode not in ("rle", "stored", "dynamic"):
         raise ValueError(f"Unknown device deflate mode: {mode}")
     packer = packer or default_packer()
     filtered, b = _pad_pow2_lanes(filtered)
-    streams, lengths = _filtered_to_streams(
-        filtered, rows, row_bytes, mode, packer, _interpret_for(packer)
-    )
+    if mode == "dynamic":
+        flat = _filtered_to_flat(filtered, rows, row_bytes)
+        streams, lengths = zlib_dynamic_batch(flat, packer=packer, real=b)
+    else:
+        streams, lengths = _filtered_to_streams(
+            filtered, rows, row_bytes, mode, packer, _interpret_for(packer)
+        )
     return streams[:b], lengths[:b]
 
 
@@ -637,7 +1042,15 @@ def fused_filter_deflate_batch(
     tiles (B, H, W[, S]) native dtype -> ((B, cap) uint8 zlib streams,
     (B,) int32 lengths) for the leading ``rows`` x ``row_bytes``
     region. ``donate=True`` donates the input buffer (TPU; XLA ignores
-    donation on backends that can't honor it)."""
+    donation on backends that can't honor it). Mode ``dynamic``
+    delegates to the two-pass chain (two dispatches + one small host
+    hop; the streaming dispatcher drives the stages separately so the
+    hop overlaps other groups' compute)."""
+    if mode == "dynamic":
+        return fused_filter_deflate_dynamic(
+            tiles, rows, row_bytes, bpp, filter_mode=filter_mode,
+            packer=packer, donate=donate,
+        )
     if mode not in ("rle", "stored"):
         raise ValueError(f"Unknown device deflate mode: {mode}")
     packer = packer or default_packer()
@@ -648,6 +1061,83 @@ def fused_filter_deflate_batch(
         _interpret_for(packer),
     )
     return streams[:b], lengths[:b]
+
+
+# -- dynamic two-pass entry points (the streaming dispatcher drives the
+# stages separately so the counts hop overlaps other groups' compute) --
+
+
+def _filter_histogram_core(tiles, rows, row_bytes, bpp, filter_mode):
+    from .convert import to_big_endian_bytes
+    from .png import _filter_batch
+
+    rows_be = to_big_endian_bytes(tiles)
+    if rows_be.ndim == 4:
+        rows_be = rows_be.reshape(*rows_be.shape[:2], -1)
+    filtered = _filter_batch(rows_be, bpp, filter_mode)
+    flat = filtered[:, :rows, :row_bytes].reshape(filtered.shape[0], -1)
+    counts, extras = jax.vmap(_dyn_stats_lane)(flat)
+    return flat, counts, extras
+
+
+_fused_filter_histogram = partial(jax.jit, static_argnums=(1, 2, 3, 4))(
+    _filter_histogram_core
+)
+_fused_filter_histogram_donated = partial(
+    jax.jit, static_argnums=(1, 2, 3, 4), donate_argnums=(0,)
+)(_filter_histogram_core)
+
+
+def fused_filter_histogram_batch(
+    tiles: jax.Array, rows: int, row_bytes: int, bpp: int,
+    filter_mode: str = "up", donate: bool = False,
+) -> tuple:
+    """Pass 1 of the dynamic encode as ONE dispatched program:
+    byteswap + PNG filter + flatten + symbol histogram. Returns
+    ``(flat, counts, extras, real_b)`` with the payload lanes pow2-
+    padded — ``flat`` stays device-resident for pass 2; only
+    ``counts``/``extras`` (a few KB) need to cross to the host."""
+    tiles, b = _pad_pow2_lanes(tiles)
+    fn = (
+        _fused_filter_histogram_donated if donate
+        else _fused_filter_histogram
+    )
+    flat, counts, extras = fn(tiles, rows, row_bytes, bpp, filter_mode)
+    return flat, counts, extras, b
+
+
+def dynamic_emit_batch(
+    flat: jax.Array, counts_np: np.ndarray, extras_np: np.ndarray,
+    packer: Optional[str] = None, real: Optional[int] = None,
+) -> tuple:
+    """Pass 2: host code/table build from the pulled counts, then the
+    single emit dispatch. ``real`` bounds the host plan work to the
+    real lanes AND slices the pow2 padding back off the outputs."""
+    packer = packer or default_packer()
+    tables = build_dynamic_tables(
+        np.asarray(counts_np), np.asarray(extras_np), real=real
+    )
+    streams, lengths = _zlib_dynamic(
+        flat, *tables, packer=packer, interpret=_interpret_for(packer)
+    )
+    if real is not None:
+        return streams[:real], lengths[:real]
+    return streams, lengths
+
+
+def fused_filter_deflate_dynamic(
+    tiles: jax.Array, rows: int, row_bytes: int, bpp: int,
+    filter_mode: str = "up", packer: Optional[str] = None,
+    donate: bool = False,
+) -> tuple:
+    """Both passes back to back (tests, microbench, non-streamed
+    callers): pass 1, ONE small host pull of the counts, pass 2."""
+    flat, counts, extras, b = fused_filter_histogram_batch(
+        tiles, rows, row_bytes, bpp, filter_mode=filter_mode,
+        donate=donate,
+    )
+    counts_np, extras_np = jax.device_get((counts, extras))
+    return dynamic_emit_batch(flat, counts_np, extras_np, packer, real=b)
 
 
 # ---------------------------------------------------------------------------

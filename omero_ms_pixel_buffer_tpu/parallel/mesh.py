@@ -113,6 +113,41 @@ class MeshManager:
         #: record of the most recent successful sharded dispatch —
         #: {"n_devices", "device_ids", "lanes_per_device", "executed"}
         self.last_dispatch: Optional[dict] = None
+        # width-change listeners (r12): a probe-shrink or heal changes
+        # the padded batch width every sharded group compiles against,
+        # so the encode dispatcher subscribes here and pre-warms its
+        # known group shapes on a background thread — the first
+        # dispatch on a resized mesh must not pay the recompile inline
+        self._width_listeners: list = []
+        self._last_width: Optional[int] = None
+
+    def add_width_listener(self, fn) -> None:
+        """``fn(new_width)`` fires whenever the healthy-device count
+        changes (shrink on a failed probe, growth on a heal). Called
+        from probe paths — listeners must be quick and must not
+        dispatch inline (spawn a thread for real work)."""
+        with self._lock:
+            self._width_listeners.append(fn)
+
+    def _notify_width(self) -> None:
+        # healthy_devices touches the breakers (which take _lock), so
+        # compute the width OUTSIDE the lock; the read-modify-write of
+        # _last_width is what must be atomic — concurrent probe paths
+        # (MeshProber tick + a dispatch-failure probe_all) must not
+        # interleave and swallow a real transition
+        n = len(self.healthy_devices())
+        fire = []
+        with self._lock:
+            prev = self._last_width
+            if n:
+                self._last_width = n
+            if n and prev is not None and n != prev:
+                fire = list(self._width_listeners)
+        for fn in fire:
+            try:
+                fn(n)
+            except Exception:
+                log.exception("mesh width listener failed")
 
     def _breaker(self, dev):
         key = f"device:{getattr(dev, 'id', dev)}"
@@ -149,6 +184,8 @@ class MeshManager:
             )
         key = tuple(getattr(d, "id", id(d)) for d in devs)
         with self._lock:
+            if self._last_width is None:
+                self._last_width = len(devs)  # change-detection baseline
             if self._mesh_cache is not None and self._mesh_cache[0] == key:
                 return self._mesh_cache[1]
         mesh = make_mesh(self._axes, devices=devs)
@@ -175,10 +212,12 @@ class MeshManager:
                 getattr(dev, "id", dev),
             )
             br.record_failure()
+            self._notify_width()
             return False
         br.record_success()
         if getattr(br, "heal", None) is not None:
             br.heal()  # readmit NOW, not after the open window
+        self._notify_width()
         return True
 
     def probe_all(self) -> list:

@@ -59,6 +59,28 @@ def synth_tiles(
     return tiles.astype(dtype)
 
 
+def synth_rgb_tiles(
+    b: int, h: int, w: int, seed: int = 5, noise: float = 6.0
+) -> np.ndarray:
+    """Rendered-RGB-like content (three smooth composited channels +
+    light noise — what the /render surface emits after window/LUT
+    compositing): the fixture for the dynamic-Huffman ratio pin.
+    Rendered composites are far less run-heavy than raw greyscale
+    planes, which is exactly where the fixed-Huffman device stream
+    paid its 1.38x-of-host bytes."""
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    chans = []
+    for ph, (fx, fy) in enumerate(
+        ((97.0, 131.0), (61.0, 89.0), (151.0, 47.0))
+    ):
+        chans.append(
+            120 + 60 * np.sin(xx / fx + ph) + 50 * np.cos(yy / fy)
+        )
+    img = np.stack(chans, -1)[None] + rng.normal(0, noise, (b, h, w, 3))
+    return img.clip(0, 255).astype(np.uint8)
+
+
 def _time_steady(fn, iters: int) -> float:
     """Seconds per call at steady state (fn must block on its result).
     MEDIAN of per-call times, not the mean: dispatch crosses the
@@ -188,6 +210,19 @@ def run_microbench(
         out["pack_gbps_gather"] = _sig(payload_bytes / dt_g / 1e9)
         out["pack_speedup_vs_gather"] = _sig(dt_g / dt)
 
+    # --- (b2b) the in-kernel emit formulations, pinned analytically ---
+    # runtime constants, not a measurement: the scalar-prefetch
+    # token-window kernel vs the r9 dense (SPAN x TB) compare-reduce
+    from ..ops.pallas.bitpack import emit_ops_per_token
+
+    dense_ops = emit_ops_per_token("dense")
+    sp_ops = emit_ops_per_token("sp")
+    out["emit_ops_per_token"] = {
+        "dense": round(dense_ops, 1),
+        "sp": round(sp_ops, 1),
+        "reduction_x": _sig(dense_ops / sp_ops),
+    }
+
     # --- (b3) stage breakdown of one host-staged fused batch ----------
     # what the double-buffered dispatcher overlaps: H2D of the native
     # tiles, the single fused byteswap+filter+deflate program, and the
@@ -286,6 +321,55 @@ def run_microbench(
     out["deflate_compression_x"] = round(
         float(tile * row_bytes / dev_sizes.mean()), 2
     )
+
+    # --- dynamic-Huffman ratio on the rendered-RGB fixture ------------
+    # The ratio pin the r12 two-pass path exists for: device bytes vs
+    # host zlib level 6 on identical filtered payloads of LOW-RUN
+    # rendered-RGB content (the fixed-Huffman stream measured 1.38x
+    # here; the acceptance bound is <= 1.10x). Also measured on the
+    # greyscale fixture above as deflate_dynamic_* for trend lines.
+    from ..ops.device_deflate import fused_filter_deflate_dynamic
+
+    rgb_np = synth_rgb_tiles(batch, tile, tile, seed=seed)
+    rgb_rows = 1 + tile * 3
+    rgb_dev = jax.device_put(rgb_np)
+    jax.block_until_ready(rgb_dev)
+    streams_d, lengths_d = fused_filter_deflate_dynamic(
+        rgb_dev, tile, rgb_rows, 3
+    )
+    dyn_sizes = np.asarray(lengths_d, dtype=np.int64)
+    rgb_filtered = np.asarray(
+        filter_batch(
+            to_big_endian_bytes(rgb_dev).reshape(batch, tile, tile * 3),
+            3, "up",
+        )
+    )
+    rgb_host = np.array(
+        [
+            len(zlib.compress(rgb_filtered[i].tobytes(), 6))
+            for i in range(batch)
+        ],
+        dtype=np.int64,
+    )
+    out["deflate_ratio_vs_host_dynamic"] = round(
+        float(dyn_sizes.mean() / rgb_host.mean()), 3
+    )
+    # fixed-Huffman on the SAME rgb payloads: what the dynamic path
+    # improves on (this is where the 1.38x lived)
+    from ..ops.device_deflate import fused_filter_deflate_batch as _ffd
+
+    _, lengths_r = _ffd(rgb_dev, tile, rgb_rows, 3, mode="rle")
+    out["deflate_ratio_vs_host_rle_rgb"] = round(
+        float(np.asarray(lengths_r, dtype=np.int64).mean() / rgb_host.mean()),
+        3,
+    )
+    dt = _time_steady(
+        lambda: jax.block_until_ready(
+            fused_filter_deflate_dynamic(rgb_dev, tile, rgb_rows, 3)[0]
+        ),
+        max(2, iters_deflate // 2),
+    )
+    out["deflate_dynamic_gbps"] = _sig(batch * tile * rgb_rows / dt / 1e9)
     return out
 
 

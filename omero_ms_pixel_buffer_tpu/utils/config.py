@@ -71,12 +71,21 @@ class PngConfig:
     level: int = 6
     # fast | default | filtered | huffman | rle | fixed
     strategy: str = "fast"
-    # Build the zlib stream on the accelerator (lane-parallel RLE +
-    # fixed-Huffman, ops/device_deflate) for device PNG lanes instead
-    # of host deflate: only compressed bytes cross the link and the
-    # host's role shrinks to PNG chunk framing. On by default — it
-    # only engages when the device engine serves the lane.
+    # Build the zlib stream on the accelerator (ops/device_deflate)
+    # for device PNG lanes instead of host deflate: only compressed
+    # bytes cross the link and the host's role shrinks to PNG chunk
+    # framing. On by default — it only engages when the device engine
+    # serves the lane.
     device_deflate: bool = True
+    # Which stream the accelerator builds for raw PNG lanes:
+    # "dynamic" (two-pass canonical Huffman — ~host-parity ratio),
+    # "rle" (fixed Huffman, one dispatch), or "stored". Render lanes
+    # always use "rle" (their host-mirror byte-identity contract).
+    device_deflate_mode: str = "dynamic"
+    # Bounded in-flight encode groups in the streaming device queue:
+    # 2 keeps the classic double buffer; deeper queues absorb longer
+    # host stalls at the cost of HBM residency per in-flight group.
+    queue_depth: int = 2
 
 
 @dataclasses.dataclass
@@ -365,6 +374,29 @@ class Config:
         if self.worker_pool_size is not None:
             return self.worker_pool_size
         return 2 * (os.cpu_count() or 1)
+
+    @staticmethod
+    def _parse_deflate_mode(value) -> str:
+        if value not in ("dynamic", "rle", "stored"):
+            # typos must fail at startup, not silently pick a stream
+            raise ConfigError(
+                "Invalid value for 'backend.png.device-deflate-mode': "
+                f"{value!r} (expected dynamic|rle|stored)"
+            )
+        return value
+
+    @staticmethod
+    def _parse_queue_depth(value) -> int:
+        try:
+            depth = int(value)
+        except (TypeError, ValueError):
+            raise ConfigError(
+                "Invalid value for 'backend.png.queue-depth': "
+                f"{value!r} (expected an integer >= 1)"
+            ) from None
+        if depth < 1:
+            raise ConfigError("'backend.png.queue-depth' must be >= 1")
+        return depth
 
     @staticmethod
     def _parse_ttl_value(value) -> float:
@@ -750,6 +782,12 @@ class Config:
                 strategy=png_raw.get("strategy", "fast"),
                 device_deflate=bool(
                     png_raw.get("device-deflate", True)
+                ),
+                device_deflate_mode=cls._parse_deflate_mode(
+                    png_raw.get("device-deflate-mode", "dynamic")
+                ),
+                queue_depth=cls._parse_queue_depth(
+                    png_raw.get("queue-depth", 2)
                 ),
             ),
             max_tile_mb=int(be_raw.get("max-tile-mb", 256)),

@@ -77,6 +77,27 @@ def test_png_block_parsed():
             cfg2.backend.png.strategy) == ("up", 6, "fast")
 
 
+def test_png_queue_and_deflate_mode_parsed():
+    cfg = Config.from_dict({
+        "session-store": {"type": "memory"},
+        "backend": {"png": {"queue-depth": 4,
+                            "device-deflate-mode": "rle"}},
+    })
+    assert cfg.backend.png.queue_depth == 4
+    assert cfg.backend.png.device_deflate_mode == "rle"
+    # defaults: streaming double buffer + the dynamic-Huffman stream
+    cfg2 = Config.from_dict({"session-store": {"type": "memory"}})
+    assert cfg2.backend.png.queue_depth == 2
+    assert cfg2.backend.png.device_deflate_mode == "dynamic"
+    for bad in ({"queue-depth": 0}, {"queue-depth": "deep"},
+                {"device-deflate-mode": "huffman"}):
+        with pytest.raises(ConfigError):
+            Config.from_dict({
+                "session-store": {"type": "memory"},
+                "backend": {"png": bad},
+            })
+
+
 def test_logging_block_and_shipped_config(tmp_path):
     # the shipped sample must load cleanly
     cfg = Config.load("conf/config.yaml")

@@ -320,3 +320,59 @@ class TestFastDeflate:
         fast = engine.png_encode_batch([tile], "up", 6, strategy="fast")[0]
         rle = engine.png_encode_batch([tile], "up", 6, strategy="rle")[0]
         assert len(fast) <= len(rle) * 1.02
+
+
+class TestSimdLiteralPacker:
+    """r12: the AVX2/NEON literal emit must be byte-identical to the
+    scalar path (OMPB_NO_SIMD=1 forces scalar at runtime — the same
+    binary, so the comparison pins the vector code, not the build)."""
+
+    def _assemble(self, payloads, w, h):
+        return engine.png_assemble_batch(
+            payloads,
+            widths=[w] * len(payloads), heights=[h] * len(payloads),
+            bit_depths=[16] * len(payloads),
+            color_types=[0] * len(payloads),
+            level=6, strategy="fast",
+        )
+
+    def test_simd_and_scalar_streams_byte_identical(self, monkeypatch):
+        rng = np.random.default_rng(23)
+        w, h = 311, 200  # odd width: exercises the <8 literal tail
+        row = 1 + w * 2
+        payloads = []
+        noisy = rng.integers(0, 256, h * row, dtype=np.uint8)
+        payloads.append(noisy.tobytes())
+        runny = np.repeat(
+            rng.integers(0, 6, h * row, dtype=np.uint8), 3
+        )[: h * row]
+        payloads.append(runny.tobytes())
+        payloads.append(bytes(h * row))  # all-zero: one giant run
+        monkeypatch.delenv("OMPB_NO_SIMD", raising=False)
+        simd = self._assemble(payloads, w, h)
+        monkeypatch.setenv("OMPB_NO_SIMD", "1")
+        scalar = self._assemble(payloads, w, h)
+        assert all(s is not None for s in simd)
+        for i, (a, b) in enumerate(zip(simd, scalar)):
+            assert a == b, f"lane {i}: SIMD and scalar PNGs differ"
+
+    def test_streams_decode_exact_with_simd(self, monkeypatch):
+        import struct
+
+        def idat(png):
+            i, out = 8, b""
+            while i < len(png):
+                ln, typ = struct.unpack(">I4s", png[i : i + 8])
+                if typ == b"IDAT":
+                    out += png[i + 8 : i + 8 + ln]
+                i += 12 + ln
+            return out
+
+        monkeypatch.delenv("OMPB_NO_SIMD", raising=False)
+        rng = np.random.default_rng(29)
+        w = h = 96
+        payload = rng.integers(
+            0, 256, h * (1 + w * 2), dtype=np.uint8
+        ).tobytes()
+        (png,) = self._assemble([payload], w, h)
+        assert zlib.decompress(idat(png)) == payload
