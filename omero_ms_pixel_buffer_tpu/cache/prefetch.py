@@ -37,6 +37,7 @@ from collections import OrderedDict
 from typing import Awaitable, Callable, List, Optional, Tuple
 
 from ..resilience.deadline import Deadline
+from ..resilience.scheduler import PRIORITY_PREFETCH
 from ..tile_ctx import RegionDef, TileCtx
 from ..utils.metrics import REGISTRY
 
@@ -74,6 +75,7 @@ class ViewportPrefetcher:
         lookahead: int = 2,
         max_streams: int = 1024,
         extent_fn=None,
+        sweep_detector=None,
     ):
         self._fetch = fetch
         self._cache = cache
@@ -97,10 +99,16 @@ class ViewportPrefetcher:
         self._extents: "OrderedDict[tuple, tuple]" = OrderedDict()
         # invalidation arrives from the resolver's refresh thread
         self._extents_lock = threading.Lock()
+        # the scheduler's SweepDetector (resilience/scheduler), when
+        # SLO scheduling is on: a session demoted to the bulk class is
+        # a robot sweep — its perfectly-predictable trajectory would
+        # flood the prefetch queue with work the scheduler is trying
+        # to deprioritize, so its streams don't predict at all
+        self._sweep_detector = sweep_detector
         self._stats = {
             "observed": 0, "enqueued": 0, "warmed": 0, "shed": 0,
             "already_cached": 0, "dropped_queue_full": 0, "failed": 0,
-            "pruned_off_image": 0,
+            "pruned_off_image": 0, "suppressed_sweep": 0,
         }
 
     # -- lifecycle -----------------------------------------------------
@@ -131,6 +139,11 @@ class ViewportPrefetcher:
         r = ctx.region
         if r.width <= 0 or r.height <= 0:
             return  # full-plane defaulting request: no grid to predict
+        if self._sweep_detector is not None and (
+            self._sweep_detector.is_sweep(ctx.omero_session_key)
+        ):
+            self._stats["suppressed_sweep"] += 1
+            return  # robot sweep: never warm ahead of bulk traffic
         stream_key = (
             ctx.omero_session_key, ctx.image_id, ctx.z, ctx.c, ctx.t,
             ctx.resolution, ctx.format,
@@ -224,6 +237,10 @@ class ViewportPrefetcher:
             format=origin.format,
             omero_session_key=origin.omero_session_key,
             render=origin.render,
+            # speculative work is second-class end to end: the
+            # batcher's deadline queue orders prefetch lanes behind
+            # every interactive lane of the same flush
+            priority=PRIORITY_PREFETCH,
         )
         key = ctx.cache_key(self._quality)
         if self._cache is not None and self._cache.contains(key):

@@ -389,6 +389,16 @@ class DiskTier:
             self.hits += 1
         return CachedTile(body, etag, filename, stored_at)
 
+    def peek_stored_at(self, key: str) -> Optional[float]:
+        """Index-only presence probe: the entry's ``stored_at``, or
+        None. No file I/O, no LRU promotion, no hit accounting — and
+        therefore, unlike every other method, safe to call from the
+        serving loop rather than the I/O executor (the in-memory
+        index is lock-guarded)."""
+        with self._lock:
+            meta = self._index.get(key)
+            return None if meta is None else meta[4]
+
     def put(self, key: str, entry: CachedTile) -> None:
         if entry.nbytes > self.max_bytes:
             return
@@ -641,6 +651,29 @@ class TileResultCache:
             return self._fresh(self.memory.peek(key)) is not None
         except Exception:
             return False
+
+    def contains_any_tier(self, key: str) -> bool:
+        """Presence probe across RAM AND the disk tier's in-memory
+        index (still no file I/O, no promotion) — the overload door
+        gate's hit exemption: a disk-resident entry serves without a
+        scheduler slot exactly like a RAM hit, so shedding it at the
+        door is a pure loss. Honors the TTL the serving ``get`` would
+        apply, so the gate never passes a request on an entry that
+        would miss anyway."""
+        if self.contains(key):
+            return True
+        if self.disk is None:
+            return False
+        try:
+            stored_at = self.disk.peek_stored_at(key)
+        except Exception:
+            return False
+        if stored_at is None:
+            return False
+        return not (
+            self.ttl_s > 0
+            and time.monotonic() - stored_at > self.ttl_s
+        )
 
     def generation(self) -> int:
         """Snapshot for ``put(..., generation=...)``: capture BEFORE

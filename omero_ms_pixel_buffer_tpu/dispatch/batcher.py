@@ -28,6 +28,7 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures
 import contextvars
+import inspect
 import logging
 import os
 from typing import Any, List, Optional, Set, Tuple
@@ -40,8 +41,9 @@ from ..errors import (
     PermissionDeniedError,
     TileError,
 )
-from ..models.tile_pipeline import TilePipeline
+from ..models.tile_pipeline import DeferredTile, TilePipeline
 from ..resilience.deadline import DEADLINE_EXCEEDED, deadline_scope
+from ..resilience.scheduler import DeadlineQueue
 from ..tile_ctx import TileCtx
 from ..utils.metrics import REGISTRY
 from ..utils.tracing import TRACER
@@ -82,7 +84,14 @@ class BatchingTileWorker:
         self.workers = max(
             1, workers if workers is not None else 2 * (os.cpu_count() or 1)
         )
-        self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_queue)
+        # deadline-ordered intake (resilience/scheduler DeadlineQueue):
+        # the coalescer pops (deadline, priority class) order instead
+        # of arrival order, so device batches form deadline-coherently
+        # — the lanes that must finish soonest share the next dispatch
+        # instead of queueing behind bulk, and an admitted lower-class
+        # lane can never be starved by later arrivals (deadline first,
+        # class only breaks same-instant ties)
+        self._queue: DeadlineQueue = DeadlineQueue(maxsize=max_queue)
         self._runner: Optional[asyncio.Task] = None
         self._inflight: Set[asyncio.Task] = set()
         # dedicated pool sized to the worker count: the loop's default
@@ -93,6 +102,9 @@ class BatchingTileWorker:
             thread_name_prefix="pixel-buffer-pool",  # the named pool
         )
         self._closed = False
+        # resolved on first batch: whether pipeline.handle_batch takes
+        # defer= (duck-typed stand-ins in tests/benches may not)
+        self._handle_batch_defers: Optional[bool] = None
 
     async def start(self) -> None:
         if self._runner is None:
@@ -182,7 +194,14 @@ class BatchingTileWorker:
                     raise GatewayTimeoutError()
                 raise NotFoundError(f"Cannot find Image:{ctx.image_id}")
             TILES_SERVED.inc(format=ctx.format or "raw")
-            return tile, {"filename": ctx.filename()}
+            headers = {"filename": ctx.filename()}
+            if ctx.degraded:
+                # survives into the reply so the HTTP front tags
+                # X-OMPB-Degraded from the lane's FINAL state (the
+                # pipeline clears the flag when no coarser level
+                # exists and the body is full-resolution after all)
+                headers["degraded"] = str(ctx.degraded)
+            return tile, headers
         except TileError as e:
             span.error(e)
             raise
@@ -290,10 +309,10 @@ class BatchingTileWorker:
                 canonical.append((c, f))
         batch = canonical
         ctxs = [b[0] for b in batch]
-        if len(batch) == 1:
+        if len(batch) == 1 and ctxs[0].render is None:
             work = lambda: [self.pipeline.handle(ctxs[0])]  # noqa: E731
         else:
-            work = lambda: self.pipeline.handle_batch(ctxs)  # noqa: E731
+            work = lambda: self._call_handle_batch(ctxs)  # noqa: E731
         # batch span joins the first lane's trace; entering it before
         # copy_context() makes it the parent of the pipeline spans
         # emitted inside the executor thread
@@ -348,6 +367,16 @@ class BatchingTileWorker:
                     lane_ctx.region.y = ctx.region.y
                     lane_ctx.region.width = ctx.region.width
                     lane_ctx.region.height = ctx.region.height
+                    lane_ctx.degraded = ctx.degraded
+            if isinstance(result, DeferredTile):
+                # the lane's device-encode group is still in flight:
+                # the queue's readback callback delivers it (or its
+                # host fallback) straight into the HTTP future — this
+                # batch's executor slot, and every other lane, are
+                # already free (the trailing-singleton-group fix)
+                self._chain_deferred(loop, result, lanes)
+                continue
+            for _lane_ctx, lane_fut in lanes:
                 if lane_fut.done():
                     continue
                 if isinstance(result, TileError):
@@ -357,3 +386,34 @@ class BatchingTileWorker:
                     lane_fut.set_exception(result)
                 else:
                     lane_fut.set_result(result)
+
+    def _call_handle_batch(self, ctxs):
+        """handle_batch with deferred device groups when the pipeline
+        supports it (duck-typed stand-ins in tests/benches may not)."""
+        fn = self.pipeline.handle_batch
+        if self._handle_batch_defers is None:
+            try:
+                self._handle_batch_defers = (
+                    "defer" in inspect.signature(fn).parameters
+                )
+            except (TypeError, ValueError):
+                self._handle_batch_defers = False
+        return fn(ctxs, defer=True) if self._handle_batch_defers else fn(ctxs)
+
+    @staticmethod
+    def _chain_deferred(loop, deferred: DeferredTile, lanes) -> None:
+        def on_done(cfut):
+            def deliver():
+                exc = cfut.exception()
+                for _, lane_fut in lanes:
+                    if lane_fut.done():
+                        continue
+                    if exc is not None:
+                        lane_fut.set_exception(InternalError())
+                    else:
+                        lane_fut.set_result(cfut.result())
+            try:
+                loop.call_soon_threadsafe(deliver)
+            except RuntimeError:
+                pass  # loop closed mid-shutdown; bus timeout reaps
+        deferred.future.add_done_callback(on_done)

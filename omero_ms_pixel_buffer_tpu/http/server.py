@@ -54,6 +54,12 @@ from ..models.tile_pipeline import TilePipeline
 from ..resilience import AdmissionController, Deadline
 from ..resilience import configure as configure_resilience
 from ..resilience.breaker import BOARD
+from ..resilience.scheduler import (
+    SloScheduler,
+    SweepDetector,
+    classify,
+    header_priority,
+)
 from ..tile_ctx import TileCtx
 from ..utils.config import Config
 from ..utils.loop_watchdog import LoopWatchdog
@@ -61,6 +67,11 @@ from ..utils.metrics import REGISTRY
 from ..utils.tracing import TRACER, configure as configure_tracing
 
 log = logging.getLogger("omero_ms_pixel_buffer_tpu.http")
+
+# /healthz?probe=1 rate floor: the endpoint is unauthenticated, so
+# active dependency probes are throttled to one round per interval no
+# matter the request rate (amplification / breaker-poisoning guard)
+_PROBE_MIN_INTERVAL_S = 5.0
 
 CONTENT_TYPES = {
     None: "application/octet-stream",
@@ -110,17 +121,25 @@ def session_middleware(store: OmeroWebSessionStore, synchronicity: str = "async"
     and OPTIONS are registered before auth in the reference and stay
     open here.
 
-    ``synchronicity`` honors the reference's
-    ``session-store.synchronicity`` key (config.yaml:25-26): ``sync``
-    serializes store lookups through one connection-at-a-time (the
-    blocking-client semantics of the reference's sync store variants),
-    ``async`` lets lookups run concurrently.
+    ``synchronicity: sync`` is accepted for config compatibility with
+    the reference (config.yaml:25-26) but no longer serializes: the
+    store implementations here are genuinely async (their own
+    per-connection locking is the correctness boundary), and the old
+    one-lookup-at-a-time lock meant ONE slow session check queued
+    every other request's auth behind it — the KNOWN_GAPS
+    "Operational" item. Lookups now always run concurrently; the key
+    logs a deprecation warning once at startup.
 
     Failure split (resilience layer): an unknown session is 403; a
     session store that cannot ANSWER — open breaker, connection
     refused — is 503 + Retry-After. Auth unavailable must never read
     as auth denied, or a Redis blip logs every user out."""
-    lookup_lock = asyncio.Lock() if synchronicity == "sync" else None
+    if synchronicity == "sync":
+        log.warning(
+            "session-store.synchronicity: sync no longer serializes "
+            "lookups (the async stores handle their own connection "
+            "locking); the key is accepted for compatibility only"
+        )
 
     @web.middleware
     async def middleware(request: web.Request, handler):
@@ -138,11 +157,7 @@ def session_middleware(store: OmeroWebSessionStore, synchronicity: str = "async"
         if not session_id:
             return web.Response(status=403, text="Permission denied")
         try:
-            if lookup_lock is not None:
-                async with lookup_lock:
-                    key = await store.get_omero_session_key(session_id)
-            else:
-                key = await store.get_omero_session_key(session_id)
+            key = await store.get_omero_session_key(session_id)
         except ServiceUnavailableError as e:
             return web.Response(
                 status=503, text="Session store unavailable",
@@ -169,11 +184,15 @@ def _retry_after(seconds: float) -> str:
 
 
 def admission_middleware(admission: AdmissionController):
-    """Load shedding at the door (resilience/admission): beyond the
+    """The LEGACY binary gate (resilience/admission): beyond the
     in-flight bound, tile/render requests answer 503 + Retry-After
-    immediately instead of queueing toward a bus timeout. Only the
-    serving lanes are gated — discovery, metrics, and health must stay
-    reachable precisely when the service is saturated."""
+    immediately instead of queueing toward a bus timeout. Installed
+    only with ``slo.enabled: false`` — the default serving path
+    replaced it with the SLO scheduler (resilience/scheduler), which
+    gates the *miss* path per priority class and queues deadline-
+    ordered instead of shedding at the door. Only the serving lanes
+    are gated — discovery, metrics, and health must stay reachable
+    precisely when the service is saturated."""
 
     @web.middleware
     async def middleware(request: web.Request, handler):
@@ -193,6 +212,65 @@ def admission_middleware(admission: AdmissionController):
             return await handler(request)
         finally:
             admission.release()
+
+    return middleware
+
+
+def overload_gate_middleware(app_obj: "PixelBufferApp"):
+    """The scheduler-era door gate (outermost, BEFORE the session
+    middleware): when the SLO wait queue is genuinely full and the
+    arrival's class would shed at ``acquire`` anyway, answer 503 now —
+    true overload must not convert into a session-store lookup plus a
+    cluster-cache (L2/peer) consult per excess request, or sustained
+    overload saturates the dependencies and takes down the cache-hit
+    traffic the scheduler is designed to keep serving (the r6
+    admission middleware's dependency-protection property).
+
+    Exemptions: local result-cache HITS pass through — serving a hit
+    costs no execution slot (the scheduler only gates misses), so
+    shedding it at the door would be a pure loss. The probe is the
+    pre-auth content key against the RAM/disk index; /render requests
+    skip the probe (their key needs the spec parse the handler owns)
+    and door-shed like any other would-shed arrival. Classification
+    here is header-only (the sweep detector keys on the authenticated
+    session, which does not exist yet): an unlabeled robot sweep
+    passes the door and sheds at ``acquire`` after auth instead."""
+
+    @web.middleware
+    async def middleware(request: web.Request, handler):
+        sched = app_obj.scheduler
+        if (
+            sched is None
+            or not request.path.startswith(("/tile/", "/render/"))
+            or request.method == "OPTIONS"  # discovery/CORS preflight
+        ):
+            return await handler(request)
+        priority = classify(
+            request.headers, None, None, app_obj._priority_header
+        )
+        if not sched.would_overflow_shed(priority):
+            return await handler(request)
+        cache = app_obj.result_cache
+        if cache is not None and request.path.startswith("/tile/"):
+            try:
+                params = dict(request.match_info)
+                params.update(request.query)
+                probe_ctx = TileCtx.from_params(params, None)
+                if cache.contains_any_tier(probe_ctx.cache_key(
+                    app_obj.pipeline.encode_signature()
+                )):
+                    return await handler(request)
+            except TileError:
+                pass  # malformed params: the handler owns the 400
+        sched.shed_at_door(priority)
+        return web.Response(
+            status=503, text="Service overloaded",
+            headers={
+                "Retry-After": _retry_after(
+                    app_obj.admission.retry_after_s
+                )
+            },
+        )
 
     return middleware
 
@@ -217,6 +295,27 @@ class PixelBufferApp:
             max_inflight=config.resilience.admission.max_inflight,
             retry_after_s=config.resilience.admission.retry_after_s,
         )
+        # SLO-aware scheduling (resilience/scheduler): priority
+        # classes + the deadline-ordered queue replace the binary
+        # admission gate on the serving (miss) path; the
+        # AdmissionController above stays the executing-slot counter
+        # (and the prefetcher's headroom gate), so /healthz and the
+        # inflight metrics keep their meaning
+        slo = config.slo
+        self.sweep_detector: Optional[SweepDetector] = None
+        self.scheduler: Optional[SloScheduler] = None
+        self._priority_header = slo.priority_header
+        if slo.enabled:
+            self.sweep_detector = SweepDetector(
+                threshold=slo.sweep_window, ttl_s=slo.sweep_ttl_s,
+            )
+            self.scheduler = SloScheduler(
+                self.admission,
+                queue_size=slo.queue_size,
+                class_weights=slo.class_weights,
+                degrade=slo.degrade,
+                degrade_factor=slo.degrade_factor,
+            )
         # per-request budget minted in handle_get_tile; defaults to
         # the bus send timeout so the deadline and the reply timeout
         # are the same clock
@@ -226,6 +325,9 @@ class PixelBufferApp:
             else config.event_bus_send_timeout_ms
         ) / 1000.0
         self._started_at = time.time()
+        # /healthz?probe=1 throttle state (one shared round per window)
+        self._probe_cache: Optional[tuple] = None
+        self._probe_task: Optional[asyncio.Task] = None
         # the runtime twin of tools/analyze's loop-block rule: a lag
         # monitor + blocked-loop stack dumper (the Vert.x
         # BlockedThreadChecker analog, utils/loop_watchdog.py) — armed
@@ -427,6 +529,7 @@ class PixelBufferApp:
                     extent_fn=self.pixels_service.peek_extent
                     if hasattr(self.pixels_service, "peek_extent")
                     else None,
+                    sweep_detector=self.sweep_detector,
                 )
         # authorization-verdict TTL cache for the hit path: a session
         # that just took the FULL path for an image (session join +
@@ -467,16 +570,25 @@ class PixelBufferApp:
             probe_nonblocking()
 
     def make_app(self) -> web.Application:
-        app = web.Application(
-            middlewares=[
-                admission_middleware(self.admission),
-                tracing_middleware,
-                session_middleware(
-                    self.session_store,
-                    self.config.session_store.synchronicity,
-                ),
-            ]
-        )
+        middlewares = [
+            tracing_middleware,
+            session_middleware(
+                self.session_store,
+                self.config.session_store.synchronicity,
+            ),
+        ]
+        if self.scheduler is None:
+            # slo.enabled: false restores the r6 binary gate at the
+            # door; with the scheduler on, admission happens at the
+            # miss path (_serve) per priority class instead
+            middlewares.insert(0, admission_middleware(self.admission))
+        else:
+            # scheduler on: the door still needs a gate for GENUINE
+            # overflow (queue full + class would shed anyway), or
+            # every excess request costs a session lookup + cluster
+            # cache consult before the scheduler can refuse it
+            middlewares.insert(0, overload_gate_middleware(self))
+        app = web.Application(middlewares=middlewares)
         app.router.add_get("/metrics", handle_metrics)
         app.router.add_get("/healthz", self.handle_healthz)
         app.router.add_route("OPTIONS", "/{tail:.*}", handle_options)
@@ -575,26 +687,116 @@ class PixelBufferApp:
         if mesh_mgr is not None:
             render_health["mesh"] = mesh_mgr.snapshot()
         device_queue = self.pipeline.device_queue_snapshot()
+        if self.scheduler is not None:
+            slo_health = self.scheduler.snapshot()
+            slo_health["sweep"] = self.sweep_detector.snapshot()
+        else:
+            slo_health = {"enabled": False}
         degraded = (
             any(b["state"] == "open" for b in breakers.values())
             or admission["inflight"] >= admission["max_inflight"]
             or loop_health.get("blocked", False)
         )
-        return web.json_response(
-            {
-                "status": "degraded" if degraded else "ok",
-                "uptime_s": round(time.time() - self._started_at, 1),
-                "breakers": breakers,
-                "admission": admission,
-                "queue_depth": queue_depth,
-                "loop": loop_health,
-                "cache": cache_health,
-                "prefetch": prefetch_health,
-                "render": render_health,
-                "device_queue": device_queue,
-                "request_budget_ms": self.request_budget_s * 1000.0,
-            }
-        )
+        body = {
+            "status": "degraded" if degraded else "ok",
+            "uptime_s": round(time.time() - self._started_at, 1),
+            "breakers": breakers,
+            "admission": admission,
+            "slo": slo_health,
+            "queue_depth": queue_depth,
+            "loop": loop_health,
+            "cache": cache_health,
+            "prefetch": prefetch_health,
+            "render": render_health,
+            "device_queue": device_queue,
+            "request_budget_ms": self.request_budget_s * 1000.0,
+        }
+        if request.query.get("probe", "").strip().lower() in (
+            "1", "true", "yes"
+        ):
+            # opt-in active dependency pings (?probe=1): exercise each
+            # configured remote dependency once so a never-used one
+            # materializes its breaker/state before first traffic.
+            # ``probe=0``/``probe=false`` means OFF — an orchestrator
+            # templating the flag must not trigger dependency traffic
+            body["probes"] = await self._probe_dependencies_throttled()
+            body["breakers"] = BOARD.snapshot()  # probes mint breakers
+        return web.json_response(body)
+
+    async def _probe_dependencies_throttled(self) -> dict:
+        """/healthz is unauthenticated, so ``?probe=1`` must not be an
+        amplification lever against the backing stores (or a way to
+        pump failures into their breakers): at most one probe round
+        per ``_PROBE_MIN_INTERVAL_S`` — concurrent callers share the
+        in-flight round, later callers inside the window get the
+        cached result."""
+        now = time.monotonic()
+        cached = self._probe_cache
+        if cached is not None and now - cached[0] < _PROBE_MIN_INTERVAL_S:
+            return cached[1]
+        task = self._probe_task
+        if task is None or task.done():
+
+            async def _round() -> dict:
+                probes = await self._probe_dependencies()
+                self._probe_cache = (time.monotonic(), probes)
+                return probes
+
+            task = asyncio.get_running_loop().create_task(_round())
+            self._probe_task = task
+        # shield: a disconnecting healthz client must not cancel the
+        # round other callers are sharing
+        return await asyncio.shield(task)
+
+    async def _probe_dependencies(self) -> dict:
+        """One lightweight, bounded exchange against each configured
+        remote dependency, concurrently. Each ping rides the
+        dependency's normal client path, so outcomes feed the same
+        breakers real traffic uses — after one probe, /healthz shows a
+        state for a dependency no request has touched yet. Failures
+        report as strings and never fail the endpoint."""
+        from ..resilience.timeouts import io_timeout_s
+
+        bound = io_timeout_s()
+        bound = min(bound, 2.0) if bound > 0 else 2.0
+        probes: dict = {}
+
+        async def run(name: str, awaitable_factory) -> None:
+            try:
+                await asyncio.wait_for(awaitable_factory(), bound)
+                probes[name] = "ok"
+            except Exception as e:
+                probes[name] = f"{type(e).__name__}: {e}"
+
+        tasks = [
+            run(
+                "session-store",
+                lambda: self.session_store.get_omero_session_key(
+                    "__ompb_healthz_probe__"
+                ),
+            )
+        ]
+        plane = self.cache_plane
+        if plane is not None and getattr(plane, "l2", None) is not None:
+            tasks.append(
+                run(
+                    "cache-l2",
+                    lambda: plane.l2.get("__ompb_healthz_probe__"),
+                )
+            )
+        resolver = getattr(self.pixels_service, "metadata_resolver", None)
+        if resolver is not None and hasattr(resolver, "query"):
+            loop = asyncio.get_running_loop()
+            tasks.append(
+                run(
+                    "postgres",
+                    lambda: loop.run_in_executor(
+                        None, lambda: resolver.query("SELECT 1", [])
+                    ),
+                )
+            )
+        await asyncio.gather(*tasks)
+        return probes
 
     # -- tile serving: cache hit / conditional GET / coalesced miss ----
 
@@ -613,6 +815,7 @@ class PixelBufferApp:
     def _tile_response(
         self, ctx: TileCtx, body: bytes, filename: str,
         etag: Optional[str], x_cache: Optional[str] = None,
+        degraded: int = 0,
     ) -> web.Response:
         headers = {
             "Content-Type": CONTENT_TYPES.get(
@@ -626,7 +829,47 @@ class PixelBufferApp:
         }
         if x_cache:
             headers["X-Cache"] = x_cache
+        if degraded:
+            # hybrid-resolution fallback body: the next-lower pyramid
+            # level upscaled (resilience/scheduler). The value is how
+            # many levels down the pixels came from; clients may
+            # re-request once pressure clears (the degraded entry has
+            # its own cache key + ETag, so full-resolution state is
+            # untouched)
+            headers["X-OMPB-Degraded"] = str(degraded)
         return web.Response(body=body, headers=headers)
+
+    def _failure_response(
+        self, request: web.Request, e: BaseException
+    ) -> web.Response:
+        """One failure-shaping path for every serving error: TileError
+        codes pass through (404 default, <1 -> 500), 503s carry
+        Retry-After — which, with the scheduler on, is only ever
+        emitted when the wait queue is genuinely full."""
+        status = http_status_for_failure(e)
+        if status < 1:
+            status = 500
+        headers = {}
+        if status == 503:
+            retry_s = getattr(e, "retry_after_s", None)
+            headers["Retry-After"] = _retry_after(
+                retry_s if retry_s else
+                self.config.resilience.admission.retry_after_s
+            )
+        span = request.get("span")
+        if span is not None:
+            span.tag("http.status", status)
+        return web.Response(status=status, headers=headers)
+
+    def _degradable(self, ctx: TileCtx) -> bool:
+        """Whether the hybrid-resolution fallback may serve this
+        request: viewport media only — PNG tiles and rendered tiles.
+        Raw binary and TIFF consumers are analysis tools; silently
+        interpolated pixels would corrupt measurements, so those
+        formats ride out the queue at full resolution."""
+        return ctx.degraded == 0 and (
+            ctx.format == "png" or ctx.render is not None
+        )
 
     def _authz_fresh(self, ctx: TileCtx) -> bool:
         with self._authz_lock:
@@ -683,13 +926,20 @@ class PixelBufferApp:
                       exc_info=True)
             return False
 
-    def _cache_filler(self, key: str):
+    def _cache_filler(self, key: str, full_res_key: Optional[str] = None):
         """The request_coalesced on_result hook: memoize exactly once
         per flight (no matter how many requests coalesced) and stamp
         the ETag onto the shared reply so every waiter's response
         carries the validator. The invalidation generation is captured
         NOW — before the render — so a purge landing mid-flight
-        discards this fill instead of racing it into the cache."""
+        discards this fill instead of racing it into the cache.
+
+        ``full_res_key`` is set when ``key`` is a degraded (|deg=N)
+        key: the pipeline clears ``ctx.degraded`` when no coarser
+        pyramid level exists, so the flight may come back with FULL-
+        resolution bytes — those must land under the full-resolution
+        key, or every later degraded-permit request would hit the
+        |deg=N entry and tag an undegraded body ``X-OMPB-Degraded``."""
         cache = self.result_cache
         generation = cache.generation()
 
@@ -699,22 +949,30 @@ class PixelBufferApp:
                 filename=msg.headers.get("filename", ""),
             )
             msg.headers["etag"] = entry.etag
-            await cache.put(key, entry, generation=generation)
+            target = key
+            if full_res_key is not None and not int(
+                msg.headers.get("degraded", 0) or 0
+            ):
+                target = full_res_key
+            await cache.put(target, entry, generation=generation)
             if self.cache_plane is not None:
                 # write-through to the shared L2 tier, once per flight
                 # (fire-and-forget: Redis must never cost the reply)
-                self.cache_plane.publish(key, entry)
+                self.cache_plane.publish(target, entry)
 
         return fill
 
-    async def _fetch_tile(self, ctx: TileCtx, key: str) -> Message:
+    async def _fetch_tile(
+        self, ctx: TileCtx, key: str,
+        full_res_key: Optional[str] = None,
+    ) -> Message:
         """The shared miss path: coalesced bus request, memoized on
         completion. ``key`` is the content key; the flight dedupes on
         the session-scoped key so one caller never rides past another
         caller's ACL check."""
         quality = self.pipeline.encode_signature()
         on_result = (
-            self._cache_filler(key)
+            self._cache_filler(key, full_res_key)
             if self.result_cache is not None else None
         )
         return await self.bus.request_coalesced(
@@ -875,6 +1133,30 @@ class PixelBufferApp:
 
     async def _serve(self, request: web.Request, ctx: TileCtx) -> web.Response:
         cache = self.result_cache
+        if self.scheduler is not None:
+            # classify BEFORE serving (header override > prefetch
+            # purpose markers > sweep detection), then feed this
+            # access to the sweep detector — a sweep demotes the
+            # session's NEXT request, not this one
+            ctx.priority = classify(
+                request.headers, ctx.omero_session_key,
+                self.sweep_detector, self._priority_header,
+            )
+            if header_priority(
+                request.headers, self._priority_header
+            ) is None:
+                # only UNLABELED traffic trains the sweep detector: a
+                # client honestly labeling its lookahead as prefetch
+                # produces the canonical constant-stride sweep shape,
+                # and learning from it would demote the whole session
+                # — shedding the same user's interactive pans.
+                # Detector-demoted (bulk) requests still observe, so a
+                # continuing robot walk keeps refreshing its TTL.
+                self.sweep_detector.observe(
+                    ctx.omero_session_key, ctx.image_id, ctx.z, ctx.c,
+                    ctx.t, ctx.resolution, ctx.region.x, ctx.region.y,
+                    ctx.region.width, ctx.region.height,
+                )
         if cache is not None:
             await self._normalize_region(ctx)
         inm = request.headers.get("If-None-Match", "")
@@ -948,38 +1230,87 @@ class PixelBufferApp:
 
         ctx.trace_context = TRACER.inject(request.get("span"))
         # the end-to-end budget: minted once here, decremented by
-        # every layer below (bus wait, batching, store retries) —
-        # resilience/deadline.py
+        # every layer below (scheduler wait, bus wait, batching,
+        # store retries) — resilience/deadline.py
         ctx.deadline = Deadline.after(self.request_budget_s)
 
+        sched = self.scheduler
+        permit = None
+        full_res_key = None
+        served = False
         try:
-            if key is not None:
-                reply = await self._fetch_tile(ctx, key)
-            else:
-                # cache.enabled: false disables the WHOLE subsystem,
-                # single-flight included — operators who turn it off
-                # (e.g. the chaos suite) get true per-request
-                # execution back
-                reply = await self.bus.request(
-                    GET_TILE_EVENT,
-                    ctx,
-                    timeout_ms=self.config.event_bus_send_timeout_ms,
-                )
-        except Exception as e:
-            status = http_status_for_failure(e)
-            if status < 1:
-                status = 500
-            headers = {}
-            if status == 503:
-                retry_s = getattr(e, "retry_after_s", None)
-                headers["Retry-After"] = _retry_after(
-                    retry_s if retry_s else
-                    self.config.resilience.admission.retry_after_s
-                )
-            span = request.get("span")
-            if span is not None:
-                span.tag("http.status", status)
-            return web.Response(status=status, headers=headers)
+            if sched is not None:
+                # the SLO gate sits HERE — between the cache and the
+                # pipeline — so hits never wait in the queue. A shed
+                # (queue genuinely full, this request the least
+                # valuable work in sight) or an in-queue expiry
+                # surfaces as 503/504 through the one failure shaper.
+                try:
+                    permit = await sched.acquire(
+                        ctx.priority, ctx.deadline,
+                        degradable=self._degradable(ctx),
+                    )
+                except TileError as e:
+                    return self._failure_response(request, e)
+                if permit.degraded:
+                    # deadline at risk: serve the next-lower pyramid
+                    # level upscaled instead of risking a 504. The
+                    # degraded resource has its OWN cache key + ETag
+                    # (|deg=1): it never overwrites, nor serves as,
+                    # the full-resolution entry.
+                    ctx.degraded = 1
+                    if cache is not None:
+                        # keep the full-resolution key: if the image
+                        # turns out to have no coarser level, the
+                        # flight returns full-res bytes and the fill
+                        # must land under THIS key, not |deg=1
+                        full_res_key = key
+                        key = ctx.cache_key(
+                            self.pipeline.encode_signature()
+                        )
+                        dentry = await cache.get(key)
+                        if dentry is not None and (
+                            await self._authorize_cached(ctx)
+                        ):
+                            if inm and etag_matches(inm, dentry.etag):
+                                return web.Response(
+                                    status=304,
+                                    headers={
+                                        **self._cache_headers(
+                                            dentry.etag
+                                        ),
+                                        "X-OMPB-Degraded": "1",
+                                    },
+                                )
+                            return self._tile_response(
+                                ctx, dentry.body, dentry.filename,
+                                dentry.etag, x_cache="hit",
+                                degraded=1,
+                            )
+            try:
+                if key is not None:
+                    reply = await self._fetch_tile(
+                        ctx, key, full_res_key
+                    )
+                else:
+                    # cache.enabled: false disables the WHOLE
+                    # subsystem, single-flight included — operators
+                    # who turn it off (e.g. the chaos suite) get true
+                    # per-request execution back
+                    reply = await self.bus.request(
+                        GET_TILE_EVENT,
+                        ctx,
+                        timeout_ms=self.config.event_bus_send_timeout_ms,
+                    )
+            except Exception as e:
+                return self._failure_response(request, e)
+            served = True
+        finally:
+            if permit is not None:
+                # failed requests don't train the service-time EWMA: a
+                # fast-failing burst (404 loop, open breaker) would
+                # collapse the estimate and disarm degradation
+                sched.release(permit, train=served)
 
         # the full path just validated the session AND resolved the
         # image under its ACL: remember the verdict for the hit path
@@ -990,15 +1321,21 @@ class PixelBufferApp:
         if self.prefetcher is not None:
             self.prefetcher.observe(ctx)
         etag = reply.headers.get("etag")
+        # the pipeline clears ctx.degraded when no coarser level
+        # exists; the reply header carries the LEADER lane's final
+        # state, so coalesced followers tag consistently
+        served_degraded = int(reply.headers.get("degraded", 0) or 0)
         if inm and etag and etag_matches(inm, etag):
             # freshly rendered, but it matches what the client holds
             # (e.g. the cache was cold after a restart): spare the body
-            return web.Response(
-                status=304, headers=self._cache_headers(etag)
-            )
+            headers = self._cache_headers(etag)
+            if served_degraded:
+                headers["X-OMPB-Degraded"] = str(served_degraded)
+            return web.Response(status=304, headers=headers)
         return self._tile_response(
             ctx, reply.body, reply.headers.get("filename", ""), etag,
             x_cache="miss" if cache is not None else None,
+            degraded=served_degraded,
         )
 
 

@@ -74,15 +74,39 @@ def _lane_unavailable(e: Exception) -> ServiceUnavailableError:
 
 class ResolvedTile:
     """A ctx bound to its image: metadata, buffer, level, resolved
-    region."""
+    region. ``degrade_level`` (hybrid-resolution fallback,
+    resilience/scheduler) is the COARSER pyramid level this tile's
+    pixels will actually be read from — the region/level fields keep
+    describing the *requested* resource, so keys, filenames, and the
+    encode tail never notice."""
 
-    __slots__ = ("ctx", "meta", "buffer", "level", "x", "y", "w", "h")
+    __slots__ = (
+        "ctx", "meta", "buffer", "level", "x", "y", "w", "h",
+        "degrade_level",
+    )
 
-    def __init__(self, ctx, meta, buffer, level, x, y, w, h):
+    def __init__(self, ctx, meta, buffer, level, x, y, w, h,
+                 degrade_level=None):
         self.ctx, self.meta, self.buffer = ctx, meta, buffer
         self.level, self.x, self.y, self.w, self.h = level, x, y, w, h
+        self.degrade_level = degrade_level
 
 
+
+
+class DeferredTile:
+    """A lane whose device-encode group is still in flight when
+    ``handle_batch(..., defer=True)`` returns. ``future`` resolves to
+    the lane's final ``bytes | None`` — device bytes on success, the
+    host-fallback encode on any group failure — on the encode queue's
+    readback callback, so the dispatch layer chains its reply instead
+    of the whole batch blocking on the slowest trailing group (the
+    KNOWN_GAPS r12 "singleton trailing group drains inline" fix)."""
+
+    __slots__ = ("future",)
+
+    def __init__(self, future: "concurrent.futures.Future"):
+        self.future = future
 
 
 def _png_native_eligible(tile: np.ndarray) -> bool:
@@ -476,14 +500,74 @@ class TilePipeline:
         # filename header carries the resolved w/h)
         ctx.region.x, ctx.region.y = x, y
         ctx.region.width, ctx.region.height = w, h
-        return ResolvedTile(ctx, meta, buffer, level, x, y, w, h)
+        degrade_level = None
+        if ctx.degraded:
+            target = level + int(ctx.degraded)
+            if 0 < target < buffer.resolution_levels:
+                degrade_level = target
+            else:
+                # no coarser level to fall back to: serve full
+                # resolution (the ctx flag clears so the HTTP layer
+                # doesn't tag a body that isn't degraded)
+                ctx.degraded = 0
+        return ResolvedTile(
+            ctx, meta, buffer, level, x, y, w, h,
+            degrade_level=degrade_level,
+        )
 
     def read(self, rt: ResolvedTile) -> np.ndarray:
         self._check_deadline(rt.ctx, "read")
+        if rt.degrade_level is not None:
+            return self._read_degraded(rt)
         with TRACER.start_span("get_tile_direct"):
             return rt.buffer.get_tile_at(
                 rt.level, rt.ctx.z, rt.ctx.c, rt.ctx.t, rt.x, rt.y, rt.w, rt.h
             )
+
+    # -- hybrid-resolution degradation (resilience/scheduler) ----------
+
+    @staticmethod
+    def _degrade_plan(rt: ResolvedTile):
+        """The coarse-read + upscale plan for a degraded lane: the
+        covering region at ``rt.degrade_level`` and the per-axis
+        nearest-neighbor index maps back to the requested (h, w).
+        Pure integer math from the two levels' actual extents, so
+        non-power-of-two pyramids map correctly; for the standard 2x
+        stride pyramid this is exactly pixel (y, x) -> coarse
+        (y//2, x//2)."""
+        sx0, sy0 = rt.buffer.level_size(rt.level)
+        sx1, sy1 = rt.buffer.level_size(rt.degrade_level)
+        cx0 = rt.x * sx1 // sx0
+        cy0 = rt.y * sy1 // sy0
+        cx1 = min(sx1, ((rt.x + rt.w) * sx1 + sx0 - 1) // sx0)
+        cy1 = min(sy1, ((rt.y + rt.h) * sy1 + sy0 - 1) // sy0)
+        cx1 = max(cx1, cx0 + 1)
+        cy1 = max(cy1, cy0 + 1)
+        xs = np.minimum(
+            (rt.x + np.arange(rt.w)) * sx1 // sx0, cx1 - 1
+        ) - cx0
+        ys = np.minimum(
+            (rt.y + np.arange(rt.h)) * sy1 // sy0, cy1 - 1
+        ) - cy0
+        return cx0, cy0, cx1 - cx0, cy1 - cy0, ys, xs
+
+    def _read_degraded(self, rt: ResolvedTile) -> np.ndarray:
+        """Serve the requested region from the next-lower pyramid
+        level, upscaled back to the requested size. The deliberate
+        contract (pinned in tests): the result is byte-for-byte the
+        coarse tile with rows/columns replicated — the SAME bytes a
+        client would get by fetching the lower level and upscaling —
+        so a degraded response is honest about its information
+        content, and identical across engines."""
+        cx0, cy0, cw, ch, ys, xs = self._degrade_plan(rt)
+        with TRACER.start_span("get_tile_degraded"):
+            coarse = rt.buffer.get_tile_at(
+                rt.degrade_level, rt.ctx.z, rt.ctx.c, rt.ctx.t,
+                cx0, cy0, cw, ch,
+            )
+        # np.ix_ indexes the leading (row, col) axes; a trailing
+        # samples axis (interleaved RGB) rides along untouched
+        return coarse[np.ix_(ys, xs)]
 
     # ------------------------------------------------------------------
     # single-request path (reference parity; also the fallback)
@@ -557,7 +641,9 @@ class TilePipeline:
                 return (b, b)
         return None
 
-    def handle_batch(self, ctxs: Sequence[TileCtx]) -> List[Optional[bytes]]:
+    def handle_batch(
+        self, ctxs: Sequence[TileCtx], defer: bool = False
+    ) -> List[Optional[object]]:
         """Coalesced execution of many tile requests.
 
         Stages: resolve all -> group reads by image (chunk-dedup) ->
@@ -568,6 +654,13 @@ class TilePipeline:
         (404) without failing the batch — except dependency-down
         failures (open breaker), which become per-lane
         ``ServiceUnavailableError`` markers (-> 503 + Retry-After).
+
+        ``defer=True`` (the batching worker's mode): lanes whose
+        device-encode group is still in flight return ``DeferredTile``
+        placeholders instead of blocking here — each group's results
+        (or its host fallback) deliver through the streaming queue's
+        readback callback, so a trailing singleton group no longer
+        serializes the whole batch's HTTP futures behind it.
         """
         n = len(ctxs)
         results: List[Optional[bytes]] = [None] * n
@@ -619,15 +712,32 @@ class TilePipeline:
             )
         in_plane = {i for lanes in plane_groups.values() for i in lanes}
 
-        # group reads by (image, level) to hit readers' batched path
+        # group reads by (image, level) to hit readers' batched path;
+        # degraded lanes read their coarse level + upscale per lane
+        # (they only exist under overload, and their reads are 4x
+        # smaller — grouping them would complicate the coord schema
+        # for no measurable win)
         with TRACER.start_span("batch_stage"):
             by_image: Dict[Tuple[int, int], List[int]] = {}
-            for i, rt in enumerate(resolved):
-                if rt is not None and i not in in_plane and i not in render_set:
-                    by_image.setdefault(
-                        (rt.meta.image_id, rt.level), []
-                    ).append(i)
             tiles: List[Optional[np.ndarray]] = [None] * n
+            for i, rt in enumerate(resolved):
+                if rt is None or i in in_plane or i in render_set:
+                    continue
+                if rt.degrade_level is not None:
+                    try:
+                        tiles[i] = self.read(rt)
+                    except DeadlineExceeded:
+                        pass  # lane -> 504 at the dispatch layer
+                    except _UNAVAILABLE as e:
+                        results[i] = _lane_unavailable(e)
+                    except Exception:
+                        log.exception(
+                            "degraded read failed; lane -> 404"
+                        )
+                    continue
+                by_image.setdefault(
+                    (rt.meta.image_id, rt.level), []
+                ).append(i)
             for (image_id, level), lanes in by_image.items():
                 buf = resolved[lanes[0]].buffer
                 coords = [
@@ -763,6 +873,18 @@ class TilePipeline:
                 use_fused=use_fused,
             )
 
+        if defer:
+            for idxs, fut in pending:
+                self._defer_group(
+                    idxs, fut, tiles, resolved, ctxs, results,
+                )
+            for idxs, fut in render_pending:
+                self._defer_group(
+                    idxs, fut, tiles, resolved, ctxs, results,
+                    render_stacks=render_stacks,
+                )
+            return results
+
         for idxs, fut in pending:
             try:
                 # audited: handle_batch runs on a BATCHER executor
@@ -804,6 +926,95 @@ class TilePipeline:
                         results,
                     )
         return results
+
+    # -- deferred group delivery (defer=True) ---------------------------
+
+    def _defer_group(
+        self, idxs, fut, tiles, resolved, ctxs, results,
+        render_stacks=None,
+    ) -> None:
+        """Swap one in-flight group's lanes for ``DeferredTile``
+        placeholders and chain delivery onto the group future: device
+        bytes distribute from the readback callback; a group failure
+        submits the host fallback to the encode pool (never encoding
+        on the readback worker — it must stay free to drain the next
+        group)."""
+        lane_futs = {}
+        for i in idxs:
+            lf: "concurrent.futures.Future" = concurrent.futures.Future()
+            lane_futs[i] = lf
+            results[i] = DeferredTile(lf)
+
+        def deliver(gfut):
+            try:
+                group = gfut.result()
+            except Exception:
+                log.exception(
+                    "deferred device group failed; host fallback"
+                )
+                fb = (
+                    self._deferred_render_fallback
+                    if render_stacks is not None
+                    else self._deferred_fallback
+                )
+                try:
+                    self._encode_pool.submit(
+                        fb, idxs, lane_futs, tiles, resolved, ctxs,
+                        render_stacks,
+                    )
+                except RuntimeError:
+                    # encode pool already shut down (close raced the
+                    # drain): the lanes resolve to None -> 404
+                    for lf in lane_futs.values():
+                        if not lf.done():
+                            lf.set_result(None)
+                return
+            if render_stacks is not None:
+                from ..render.engine import RENDER_TILES
+
+                RENDER_TILES.inc(
+                    len(group), path="device", format="png"
+                )
+            for i in idxs:
+                lf = lane_futs[i]
+                if not lf.done():
+                    lf.set_result(group.get(i))
+
+        fut.add_done_callback(deliver)
+
+    def _deferred_fallback(
+        self, idxs, lane_futs, tiles, resolved, ctxs, _stacks
+    ) -> None:
+        for i in idxs:
+            res = None
+            try:
+                tile = tiles[i]
+                if tile is None:
+                    tile = self.read(resolved[i])
+                res = self.encode(ctxs[i], tile)
+            except Exception:
+                log.exception("deferred host fallback failed for lane %d", i)
+            lf = lane_futs[i]
+            if not lf.done():
+                lf.set_result(res)
+
+    def _deferred_render_fallback(
+        self, idxs, lane_futs, _tiles, resolved, ctxs, stacks
+    ) -> None:
+        from ..render.engine import RENDER_FALLBACK
+
+        RENDER_FALLBACK.inc(len(idxs))
+        out: Dict[int, Optional[bytes]] = {}
+        for i in idxs:
+            try:
+                self._render_host_lane(
+                    i, ctxs[i], resolved[i], stacks.get(i), out
+                )
+            except Exception:
+                out[i] = None
+            lf = lane_futs[i]
+            if not lf.done():
+                lf.set_result(out.get(i))
 
     def _plane_fallback(self, lanes, resolved, ctxs, results) -> None:
         for i in lanes:
@@ -852,13 +1063,27 @@ class TilePipeline:
             if not renderable_dtype(rt.meta.dtype):
                 log.debug("unrenderable pixel type %s", rt.meta.dtype)
                 continue  # lane -> 404
-            coords = [
-                (z, ch.index, ctx.t, rt.x, rt.y, rt.w, rt.h)
-                for ch in chans for z in zs
-            ]
-            plans[i] = (chans, zs, coords)
+            upscale = None
+            if rt.degrade_level is not None:
+                # hybrid-resolution fallback: read every channel
+                # plane from the coarse level, upscale after staging
+                cx0, cy0, crw, crh, ys, xs = self._degrade_plan(rt)
+                coords = [
+                    (z, ch.index, ctx.t, cx0, cy0, crw, crh)
+                    for ch in chans for z in zs
+                ]
+                upscale = (ys, xs, crh, crw)
+            else:
+                coords = [
+                    (z, ch.index, ctx.t, rt.x, rt.y, rt.w, rt.h)
+                    for ch in chans for z in zs
+                ]
+            plans[i] = (chans, zs, coords, upscale)
             by_image.setdefault(
-                (rt.meta.image_id, rt.level), []
+                (
+                    rt.meta.image_id,
+                    rt.level if upscale is None else rt.degrade_level,
+                ), []
             ).append(i)
 
         with TRACER.start_span("render_stage"):
@@ -883,14 +1108,20 @@ class TilePipeline:
                     continue
                 pos = 0
                 for i in lanes:
-                    chans, zs, coords = plans[i]
+                    chans, zs, coords, upscale = plans[i]
                     lane_planes = planes[pos : pos + len(coords)]
                     pos += len(coords)
                     rt = resolved[i]
                     try:
-                        stack = np.stack(lane_planes).reshape(
-                            len(chans), len(zs), rt.h, rt.w
-                        )
+                        if upscale is not None:
+                            ys, xs, crh, crw = upscale
+                            stack = np.stack(lane_planes).reshape(
+                                len(chans), len(zs), crh, crw
+                            )[:, :, ys[:, None], xs[None, :]]
+                        else:
+                            stack = np.stack(lane_planes).reshape(
+                                len(chans), len(zs), rt.h, rt.w
+                            )
                         spec = ctxs[i].render
                         if spec.projection is not None:
                             stack = project(
@@ -1008,6 +1239,11 @@ class TilePipeline:
                 # render lanes (format is also "png") have their own
                 # multi-channel path — staging them here would encode
                 # the RAW plane into their result slot
+                continue
+            if rt.degrade_level is not None:
+                # degraded lanes read the COARSE level; cropping the
+                # full-resolution resident plane would serve full-res
+                # bytes under the degraded cache key
                 continue
             meta_dtype = rt.meta.dtype
             if (

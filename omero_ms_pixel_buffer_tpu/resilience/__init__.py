@@ -30,10 +30,26 @@ from .deadline import (
 )
 from .faultinject import INJECTOR
 from .retry import RetryPolicy, retry_call, set_default_policy
+from .scheduler import (
+    PRIORITY_BULK,
+    PRIORITY_INTERACTIVE,
+    PRIORITY_PREFETCH,
+    DeadlineQueue,
+    SloScheduler,
+    SweepDetector,
+    classify,
+)
 from .timeouts import io_timeout_s, set_io_timeout
 
 __all__ = [
     "AdmissionController",
+    "DeadlineQueue",
+    "PRIORITY_BULK",
+    "PRIORITY_INTERACTIVE",
+    "PRIORITY_PREFETCH",
+    "SloScheduler",
+    "SweepDetector",
+    "classify",
     "BOARD",
     "BreakerOpenError",
     "CircuitBreaker",

@@ -40,14 +40,32 @@ class AdmissionController:
         self._lock = threading.Lock()
 
     def try_acquire(self) -> bool:
+        if self.try_slot():
+            return True
+        self.count_shed()
+        return False
+
+    def try_slot(self) -> bool:
+        """``try_acquire`` without the shed accounting — the SLO
+        scheduler's probe (resilience/scheduler.py): a full gate there
+        means "queue the request", which is not a shed; the scheduler
+        counts its own sheds (via ``count_shed``) only when the wait
+        queue itself overflows."""
         with self._lock:
             if self._inflight >= self.max_inflight:
-                self._shed += 1
-                SHED.inc()
                 return False
             self._inflight += 1
             INFLIGHT.set(self._inflight)
             return True
+
+    def count_shed(self) -> None:
+        """Record a shed decided by a layer above (the SLO scheduler's
+        queue-overflow 503s) so ``shed_total`` and the
+        ``resilience_shed_total`` metric stay the one number operators
+        watch."""
+        with self._lock:
+            self._shed += 1
+            SHED.inc()
 
     def release(self) -> None:
         with self._lock:

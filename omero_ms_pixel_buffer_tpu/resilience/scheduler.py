@@ -1,0 +1,662 @@
+"""SLO-aware request scheduling: priority classes, an EDF queue, and
+the hybrid-resolution degradation signal.
+
+Admission control before this module was binary — past
+``max-inflight`` every request got 503 + Retry-After, so under
+sustained overload the service shed interactive viewport tiles and
+robot bulk sweeps with equal prejudice, and p99 for real users was
+whatever the FIFO queue said. This module is the PATCHEDSERVE shape
+(PAPERS.md): per-request SLOs with a scheduler that *reorders* while
+headroom exists, *sheds the least valuable work first* when it
+doesn't, and *trades resolution for deadline* instead of refusing an
+interactive request outright.
+
+Three pieces:
+
+- **Priority classes** — ``INTERACTIVE`` (a human waiting on a
+  viewport) > ``PREFETCH`` (speculative warming) > ``BULK`` (robot
+  sweeps, batch export). Classified per request from its shape:
+  an explicit override header wins, standard prefetch markers
+  (``Sec-Purpose``/``Purpose: prefetch``, ``X-OMPB-Prefetch``) mark
+  the middle class, and the ``SweepDetector`` — the same per-session
+  motion-stream tracking the viewport prefetcher runs, pointed at the
+  opposite question — demotes sessions whose access pattern is a
+  long constant-stride scan to ``BULK``.
+
+- **The deadline-aware queue** (``SloScheduler``) — replaces the
+  binary gate. Executing slots are still the ``AdmissionController``
+  bound (so ``/healthz`` and the prefetcher's headroom gate keep
+  their view); past it, requests WAIT in per-class earliest-deadline-
+  first heaps instead of shedding. Grants drain the heaps EDF within
+  a class and weighted-round-robin between classes (interactive gets
+  most of the slots under contention but lower classes never starve
+  outright while their deadlines can still be met). Only when the
+  wait queue itself is full does anything shed — and the victim is
+  the *lowest-class, latest-deadline* entry among the waiters and the
+  arrival, so an interactive request is 503'd only when there is
+  literally nothing less valuable to drop. ``Retry-After`` is
+  therefore only ever emitted when the queue is genuinely full.
+
+- **The degradation signal** — the scheduler keeps an EWMA of
+  full-resolution service time; when a grant's remaining budget is
+  inside ``degrade-factor`` x that estimate *under contention*, the
+  permit comes back flagged and the HTTP layer serves the next-lower
+  pyramid level upscaled (tagged ``X-OMPB-Degraded``) instead of
+  risking a 504 or shedding. Pressure gone -> grants stop flagging —
+  engagement and disengagement are both pinned by the chaos suite.
+
+``DeadlineQueue`` is the batcher-facing half: the coalescing worker
+pops (class, deadline) order instead of arrival order, so device
+batches form deadline-coherently — the lanes that must finish
+soonest share the next dispatch instead of queueing behind bulk.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import itertools
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import List, Optional, Tuple
+
+from ..errors import GatewayTimeoutError, ServiceUnavailableError
+from ..utils.metrics import REGISTRY
+from .admission import AdmissionController
+from .deadline import Deadline
+
+# Priority classes, smaller = more important. Values are wire/config
+# facing through their names; code compares numerically.
+PRIORITY_INTERACTIVE = 0
+PRIORITY_PREFETCH = 1
+PRIORITY_BULK = 2
+
+PRIORITY_NAMES = {
+    PRIORITY_INTERACTIVE: "interactive",
+    PRIORITY_PREFETCH: "prefetch",
+    PRIORITY_BULK: "bulk",
+}
+PRIORITY_BY_NAME = {v: k for k, v in PRIORITY_NAMES.items()}
+
+SLO_SHED = REGISTRY.counter(
+    "slo_shed_total",
+    "Requests shed (503) by the SLO scheduler, by class",
+)
+SLO_DEGRADED = REGISTRY.counter(
+    "slo_degraded_total",
+    "Permits granted with the hybrid-resolution degradation flag, "
+    "by class",
+)
+SLO_EXPIRED = REGISTRY.counter(
+    "slo_queue_expired_total",
+    "Requests whose deadline expired while waiting in the SLO queue, "
+    "by class",
+)
+SLO_QUEUE_WAIT = REGISTRY.histogram(
+    "slo_queue_wait_seconds",
+    "Time spent waiting in the SLO queue before a grant",
+)
+
+
+class SweepDetector:
+    """Marks sessions whose access pattern is a machine sweep.
+
+    The viewport prefetcher's motion streams model the same signal
+    from the other side: it tracks (last position, last delta) per
+    (session, plane) stream to predict a human pan. A robot walking a
+    slide produces the degenerate version — a constant stride held
+    for far longer than any human pan (humans wobble, pause, and
+    change direction within a handful of tiles). This detector keeps
+    the identical stream shape and counts the *run length* of the
+    constant stride; past ``threshold`` consecutive constant-stride
+    steps the session is marked ``BULK`` for ``ttl_s`` (refreshed
+    while the sweep continues, so a robot stays demoted for its whole
+    walk and a human who triggered a false positive recovers fast).
+
+    Thread-safe: observed from the serving loop, consulted from the
+    same, but invalidation/snapshots may come from elsewhere.
+    """
+
+    def __init__(
+        self,
+        threshold: int = 16,
+        ttl_s: float = 30.0,
+        max_streams: int = 1024,
+        clock=time.monotonic,
+    ):
+        self.threshold = max(2, int(threshold))
+        self.ttl_s = ttl_s
+        self._clock = clock
+        self._max_streams = max_streams
+        # stream key -> [x, y, dx, dy, run]
+        self._streams: "OrderedDict[tuple, list]" = OrderedDict()
+        # session -> demotion expiry (monotonic)
+        self._bulk: "OrderedDict[object, float]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.detected_total = 0
+
+    def observe(
+        self, session, image_id: int, z: int, c: int, t: int,
+        resolution, x: int, y: int, w: int, h: int,
+    ) -> None:
+        """Feed one real access (the serving path calls this for hits
+        and misses alike). Full-plane defaulted requests (w/h == 0)
+        carry no grid to measure and are ignored."""
+        if session is None or w <= 0 or h <= 0:
+            return
+        key = (session, image_id, z, c, t, resolution)
+        with self._lock:
+            stream = self._streams.get(key)
+            if stream is None:
+                self._streams[key] = [x, y, 0, 0, 0]
+                while len(self._streams) > self._max_streams:
+                    self._streams.popitem(last=False)
+                return
+            self._streams.move_to_end(key)
+            dx, dy = x - stream[0], y - stream[1]
+            if (dx, dy) == (0, 0):
+                return  # a refresh, not a step
+            if (dx, dy) == (stream[2], stream[3]):
+                stream[4] += 1
+            else:
+                stream[4] = 1
+            stream[0], stream[1] = x, y
+            stream[2], stream[3] = dx, dy
+            if stream[4] >= self.threshold:
+                if session not in self._bulk:
+                    self.detected_total += 1
+                self._bulk[session] = self._clock() + self.ttl_s
+                self._bulk.move_to_end(session)
+                while len(self._bulk) > self._max_streams:
+                    self._bulk.popitem(last=False)
+
+    def is_sweep(self, session) -> bool:
+        if session is None:
+            return False
+        with self._lock:
+            expiry = self._bulk.get(session)
+            if expiry is None:
+                return False
+            if expiry <= self._clock():
+                del self._bulk[session]
+                return False
+            return True
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            now = self._clock()
+            return {
+                "streams": len(self._streams),
+                "bulk_sessions": sum(
+                    1 for e in self._bulk.values() if e > now
+                ),
+                "detected_total": self.detected_total,
+                "threshold": self.threshold,
+            }
+
+
+def header_priority(
+    headers, override_header: str = "x-ompb-priority"
+) -> Optional[int]:
+    """The class the request's HEADERS alone decide, or None. Split
+    from ``classify`` so the serving path can tell an honest
+    self-label apart from an inferred class: header-labeled requests
+    must not feed the sweep detector (a well-behaved client's
+    constant-stride ``Sec-Purpose: prefetch`` lookahead run is the
+    canonical sweep shape — learning from it would demote the whole
+    session and shed the human's own interactive pans)."""
+    if override_header:
+        explicit = headers.get(override_header)
+        if explicit:
+            prio = PRIORITY_BY_NAME.get(explicit.strip().lower())
+            if prio is not None:
+                return prio
+    purpose = headers.get("Sec-Purpose") or headers.get("Purpose") or ""
+    if "prefetch" in purpose.lower() or headers.get("X-OMPB-Prefetch"):
+        return PRIORITY_PREFETCH
+    return None
+
+
+def classify(
+    headers,
+    session,
+    detector: Optional[SweepDetector] = None,
+    override_header: str = "x-ompb-priority",
+) -> int:
+    """Infer the priority class from the request's shape.
+
+    Precedence: explicit override header (operators and well-behaved
+    bulk clients label themselves) > standard prefetch purpose
+    headers (browsers and viewers send ``Sec-Purpose: prefetch``
+    for speculative loads; ``X-OMPB-Prefetch`` is the service's own
+    spelling) > sweep detection on the session's access stream >
+    interactive (the default: an unlabeled request is assumed to have
+    a human behind it — misclassifying a robot UP costs fairness,
+    misclassifying a human DOWN costs the product)."""
+    prio = header_priority(headers, override_header)
+    if prio is not None:
+        return prio
+    if detector is not None and detector.is_sweep(session):
+        return PRIORITY_BULK
+    return PRIORITY_INTERACTIVE
+
+
+class Permit:
+    """One granted execution slot. ``degraded`` asks the HTTP layer to
+    serve the hybrid-resolution fallback; ``queued_s`` is how long the
+    request waited before the grant."""
+
+    __slots__ = ("priority", "degraded", "queued_s", "_t_start")
+
+    def __init__(
+        self, priority: int, degraded: bool = False,
+        queued_s: float = 0.0,
+    ):
+        self.priority = priority
+        self.degraded = degraded
+        self.queued_s = queued_s
+        self._t_start = time.monotonic()
+
+
+class _Waiter:
+    __slots__ = (
+        "priority", "deadline", "fut", "seq", "cancelled", "popped",
+        "enqueued_at", "degradable",
+    )
+
+    def __init__(self, priority, deadline, fut, seq, degradable=True):
+        self.priority = priority
+        self.deadline = deadline
+        self.fut = fut
+        self.seq = seq
+        self.cancelled = False
+        self.popped = False
+        self.enqueued_at = time.monotonic()
+        self.degradable = degradable
+
+    @property
+    def expires_at(self) -> float:
+        return (
+            float("inf") if self.deadline is None
+            else self.deadline.expires_at
+        )
+
+
+class SloScheduler:
+    """The deadline-aware admission queue (module docstring has the
+    policy). Event-loop affine: ``acquire``/``release`` run on the
+    serving loop; ``snapshot`` may be called from anywhere (reads are
+    of loop-written scalars — tearing yields a stale number, never a
+    crash)."""
+
+    def __init__(
+        self,
+        admission: AdmissionController,
+        queue_size: int = 512,
+        class_weights: Tuple[int, int, int] = (8, 2, 1),
+        degrade: bool = True,
+        degrade_factor: float = 1.5,
+        ewma_alpha: float = 0.2,
+        clock=time.monotonic,
+    ):
+        self.admission = admission
+        self.queue_size = max(0, int(queue_size))
+        self.class_weights = tuple(
+            max(1, int(w)) for w in class_weights
+        )
+        self.degrade_enabled = degrade
+        self.degrade_factor = degrade_factor
+        self._ewma_alpha = ewma_alpha
+        self._clock = clock
+        self._heaps: List[list] = [[], [], []]  # per class, EDF min-heaps
+        self._waiting = [0, 0, 0]  # live (non-cancelled) waiters per class
+        self._credits = list(self.class_weights)
+        self._seq = itertools.count()
+        self._service_ewma = 0.0
+        # counters (per class)
+        self.classified = [0, 0, 0]
+        self.sheds = [0, 0, 0]
+        self.degraded = [0, 0, 0]
+        self.expired_in_queue = [0, 0, 0]
+        self.granted = [0, 0, 0]
+
+    # -- policy helpers -------------------------------------------------
+
+    @property
+    def _waiting_total(self) -> int:
+        return sum(self._waiting)
+
+    def _degrade_flag(
+        self, deadline: Optional[Deadline], contended: bool = True
+    ) -> bool:
+        """Should this grant serve the hybrid-resolution fallback?
+        Only for grants that WAITED (an immediate grant means free
+        capacity — no pressure, full resolution), only with a
+        service-time estimate, and only when the remaining budget is
+        inside ``degrade_factor`` x the estimated full-resolution
+        service time. The moment pressure clears, requests grant
+        immediately again and the flag drops on its own (the
+        disengage contract)."""
+        if not self.degrade_enabled or deadline is None or not contended:
+            return False
+        if self._service_ewma <= 0.0:
+            return False
+        return (
+            deadline.remaining()
+            < self._service_ewma * self.degrade_factor
+        )
+
+    def _shed_error(self) -> ServiceUnavailableError:
+        return ServiceUnavailableError(
+            "Service overloaded",
+            retry_after_s=self.admission.retry_after_s,
+        )
+
+    def _count_shed(self, priority: int) -> None:
+        self.sheds[priority] += 1
+        SLO_SHED.inc(priority=PRIORITY_NAMES[priority])
+        # keep the legacy resilience_shed_total metric + /healthz
+        # shed_total meaningful: every 503 the scheduler emits is a
+        # shed, whichever layer decided it
+        self.admission.count_shed()
+
+    def _worst_waiter(self) -> Optional[_Waiter]:
+        """The shed victim: latest deadline within the lowest
+        (least-important) class that has live waiters."""
+        for priority in (PRIORITY_BULK, PRIORITY_PREFETCH,
+                         PRIORITY_INTERACTIVE):
+            live = [
+                e for _, _, e in self._heaps[priority] if not e.cancelled
+            ]
+            if live:
+                return max(live, key=lambda e: (e.expires_at, e.seq))
+        return None
+
+    def would_overflow_shed(self, priority: int) -> bool:
+        """Read-only arrival preview for the HTTP door gate: would an
+        ``acquire(priority, <fresh full-budget deadline>)`` arriving
+        NOW shed? The gate asks BEFORE the session join, so true
+        overload answers 503 without costing a session-store lookup
+        or a cluster-cache consult per excess request (the r6
+        middleware's dependency-protection property, kept under the
+        scheduler). Advisory: a grant or shed racing the preview
+        flips the answer for one request — ``acquire`` still decides
+        for everything the gate lets through."""
+        priority = min(max(int(priority), 0), PRIORITY_BULK)
+        if self._waiting_total == 0 and (
+            self.admission.inflight < self.admission.max_inflight
+        ):
+            return False  # would grant immediately
+        if self.queue_size == 0:
+            return True  # binary-gate mode: no slot, no waiting room
+        if self._waiting_total < self.queue_size:
+            return False  # room to wait
+        # The victim's CLASS is all the door decision needs, and that
+        # is O(1) from the live-waiter counters — no _worst_waiter
+        # heap scan (O(queue-size)) on the overload hot path; acquire
+        # keeps the full scan because eviction needs the latest
+        # deadline WITHIN the class. A fresh arrival carries the
+        # latest deadline in sight, so it sheds unless a strictly
+        # lower class is waiting to evict.
+        for lower in range(PRIORITY_BULK, priority, -1):
+            if self._waiting[lower] > 0:
+                return False
+        return True
+
+    def shed_at_door(self, priority: int) -> None:
+        """Record a pre-auth door shed (the overload gate's 503) in
+        the same counters ``acquire``'s sheds use, so operators see
+        one shed number wherever the decision landed."""
+        priority = min(max(int(priority), 0), PRIORITY_BULK)
+        self.classified[priority] += 1
+        self._count_shed(priority)
+
+    # -- acquire / release ----------------------------------------------
+
+    async def acquire(
+        self, priority: int, deadline: Optional[Deadline],
+        degradable: bool = True,
+    ) -> Permit:
+        """One execution slot, or raises: ``ServiceUnavailableError``
+        (shed — queue genuinely full and this request is the least
+        valuable work in sight) or ``GatewayTimeoutError`` (the
+        deadline expired while waiting). ``degradable=False`` (raw/
+        TIFF measurement surfaces) means the grant is never flagged
+        for the hybrid-resolution fallback — so ``slo_degraded_total``
+        counts only requests that can actually degrade, and those
+        full-resolution serves keep training the service-time EWMA."""
+        priority = min(max(int(priority), 0), PRIORITY_BULK)
+        self.classified[priority] += 1
+        if self._waiting_total == 0 and self.admission.try_slot():
+            # free slot, empty queue: grant immediately at full
+            # resolution (the common, unloaded case)
+            self.granted[priority] += 1
+            return Permit(priority, degraded=False)
+        if self.queue_size == 0:
+            # binary-gate compatibility mode: no waiting room at all
+            self._count_shed(priority)
+            raise self._shed_error()
+        if self._waiting_total >= self.queue_size:
+            victim = self._worst_waiter()
+            incoming_key = (
+                priority,
+                float("inf") if deadline is None else deadline.expires_at,
+            )
+            if victim is None or incoming_key >= (
+                victim.priority, victim.expires_at
+            ):
+                # the arrival IS the least valuable work in sight
+                self._count_shed(priority)
+                raise self._shed_error()
+            # evict the queued victim to make room: its waiter gets
+            # the 503 (with Retry-After) this arrival would have
+            victim.cancelled = True
+            self._waiting[victim.priority] -= 1
+            self._count_shed(victim.priority)
+            if not victim.fut.done():
+                victim.fut.set_exception(self._shed_error())
+        entry = _Waiter(
+            priority, deadline,
+            asyncio.get_running_loop().create_future(), next(self._seq),
+            degradable=degradable,
+        )
+        heapq.heappush(
+            self._heaps[priority], (entry.expires_at, entry.seq, entry)
+        )
+        self._waiting[priority] += 1
+        try:
+            permit = await entry.fut
+        except asyncio.CancelledError:
+            # caller gave up (client disconnect / bus timeout): lazy-
+            # delete; a grant or shed that raced the cancellation is
+            # drained here so the slot returns / the exception is
+            # retrieved
+            if not entry.cancelled and not entry.popped:
+                entry.cancelled = True
+                self._waiting[priority] -= 1
+            if entry.fut.done() and not entry.fut.cancelled():
+                exc = entry.fut.exception()
+                if exc is None:
+                    # grant raced the cancellation: return the slot —
+                    # train=False, the request never executed (a
+                    # ~zero-duration sample would poison the EWMA)
+                    self.release(entry.fut.result(), train=False)  # ompb-lint: disable=loop-block -- future is done() here; result() is a non-blocking read
+            raise
+        SLO_QUEUE_WAIT.observe(permit.queued_s)
+        return permit
+
+    def release(self, permit: Permit, train: bool = True) -> None:
+        """Hand the slot back; trains the full-resolution service-time
+        estimate and grants the next waiter(s). ``train=False`` for
+        requests that did not serve successfully: a burst of
+        fast-failing requests (404 loop on a purged image, an open
+        breaker answering in microseconds) would otherwise collapse
+        the EWMA and disarm degradation exactly when it is needed.
+        Degraded executions are excluded too — a shrinking estimate
+        from cheap degraded serves would flap the engage condition."""
+        duration = time.monotonic() - permit._t_start
+        if train and not permit.degraded:
+            self._service_ewma = (
+                duration if self._service_ewma == 0.0
+                else self._ewma_alpha * duration
+                + (1 - self._ewma_alpha) * self._service_ewma
+            )
+        self.admission.release()
+        self._dispatch_next()
+
+    def _next_entry(self) -> Optional[_Waiter]:
+        """Weighted round-robin between classes, EDF within: the
+        highest class with credits and live waiters grants next; when
+        every waiting class is out of credits, refill from the weights
+        (interactive 8 : prefetch 2 : bulk 1 by default — under
+        saturation, interactive takes ~8/11 of the slots but a
+        deep bulk backlog still drains)."""
+        for _ in range(2):  # second pass runs after a refill
+            for priority in (PRIORITY_INTERACTIVE, PRIORITY_PREFETCH,
+                             PRIORITY_BULK):
+                heap = self._heaps[priority]
+                while heap and heap[0][2].cancelled:
+                    heapq.heappop(heap)  # lazy-deleted (shed/cancel)
+                if heap and self._credits[priority] > 0:
+                    self._credits[priority] -= 1
+                    _, _, entry = heapq.heappop(heap)
+                    entry.popped = True
+                    self._waiting[priority] -= 1
+                    return entry
+            if not any(
+                any(not e.cancelled for _, _, e in self._heaps[p])
+                for p in range(3)
+            ):
+                return None
+            self._credits = list(self.class_weights)
+        return None  # pragma: no cover - refill always finds a waiter
+
+    def _dispatch_next(self) -> None:
+        while self._waiting_total > 0 and self.admission.try_slot():
+            entry = self._next_entry()
+            if entry is None:
+                self.admission.release()
+                return
+            if entry.fut.done():
+                # cancelled between pop and grant: slot goes to the next
+                self.admission.release()
+                continue
+            if entry.deadline is not None and entry.deadline.expired:
+                # granting an expired request would burn the slot on a
+                # guaranteed 504; answer it now, give the slot away
+                self.expired_in_queue[entry.priority] += 1
+                SLO_EXPIRED.inc(priority=PRIORITY_NAMES[entry.priority])
+                self.admission.release()
+                entry.fut.set_exception(GatewayTimeoutError(
+                    "Request deadline expired in the scheduler queue"
+                ))
+                continue
+            self.granted[entry.priority] += 1
+            flag = entry.degradable and self._degrade_flag(entry.deadline)
+            if flag:
+                self.degraded[entry.priority] += 1
+                SLO_DEGRADED.inc(
+                    priority=PRIORITY_NAMES[entry.priority]
+                )
+            entry.fut.set_result(Permit(
+                entry.priority, degraded=flag,
+                queued_s=time.monotonic() - entry.enqueued_at,
+            ))
+
+    # -- observability --------------------------------------------------
+
+    def snapshot(self) -> dict:
+        names = [PRIORITY_NAMES[p] for p in range(3)]
+        return {
+            "enabled": True,
+            "queue_size": self.queue_size,
+            "queued": dict(zip(names, self._waiting)),
+            "classified": dict(zip(names, self.classified)),
+            "granted": dict(zip(names, self.granted)),
+            "shed": dict(zip(names, self.sheds)),
+            "degraded": dict(zip(names, self.degraded)),
+            "expired_in_queue": dict(
+                zip(names, self.expired_in_queue)
+            ),
+            "service_ewma_ms": round(self._service_ewma * 1000.0, 3),
+            "class_weights": list(self.class_weights),
+        }
+
+
+class DeadlineQueue:
+    """An asyncio queue that pops (deadline, priority class) order —
+    the batcher's replacement for its FIFO, so coalesced device
+    batches form deadline-coherently: the lanes that must finish
+    soonest share the next dispatch instead of queueing behind bulk.
+
+    Deadline is the PRIMARY key, class only the tie-break. Everything
+    in this queue already holds an execution slot the scheduler's
+    class policy granted — ordering strictly by class here would let
+    a steady interactive stream starve an admitted prefetch/bulk lane
+    indefinitely (its slot pinned, its flight eventually reaped by
+    the bus timeout, and any interactive request that coalesced onto
+    it starved too). Deadlines are arrival-ordered (one server-wide
+    budget), so deadline-first is FIFO with urgency jumps: bounded
+    wait for every lane, same-instant lanes still drain interactive
+    before prefetch before bulk.
+
+    API-compatible with the slice of ``asyncio.Queue`` the batching
+    worker uses (``put_nowait``/``get``/``get_nowait``/``empty``/
+    ``qsize``; ``put_nowait`` raises ``asyncio.QueueFull`` at
+    ``maxsize``). Items are ``(ctx, fut)`` pairs; ordering reads
+    ``ctx.deadline`` and ``ctx.priority``."""
+
+    def __init__(self, maxsize: int = 0):
+        self.maxsize = maxsize
+        self._heap: list = []
+        self._seq = itertools.count()
+        self._getters: "deque[asyncio.Future]" = deque()
+
+    @staticmethod
+    def _key(ctx) -> Tuple[float, int]:
+        deadline = getattr(ctx, "deadline", None)
+        return (
+            float("inf") if deadline is None else deadline.expires_at,
+            int(getattr(ctx, "priority", 0) or 0),
+        )
+
+    def put_nowait(self, item) -> None:
+        if 0 < self.maxsize <= len(self._heap):
+            raise asyncio.QueueFull
+        heapq.heappush(
+            self._heap, (*self._key(item[0]), next(self._seq), item)
+        )
+        while self._getters:
+            getter = self._getters.popleft()
+            if not getter.done():
+                getter.set_result(None)
+                break
+
+    def get_nowait(self):
+        if not self._heap:
+            raise asyncio.QueueEmpty
+        return heapq.heappop(self._heap)[-1]
+
+    async def get(self):
+        while not self._heap:
+            getter = asyncio.get_running_loop().create_future()
+            self._getters.append(getter)
+            try:
+                await getter
+            except asyncio.CancelledError:
+                # pass a wakeup we may have consumed to the next getter
+                if getter.done() and not getter.cancelled():
+                    while self._getters:
+                        nxt = self._getters.popleft()
+                        if not nxt.done():
+                            nxt.set_result(None)
+                            break
+                raise
+        return heapq.heappop(self._heap)[-1]
+
+    def empty(self) -> bool:
+        return not self._heap
+
+    def qsize(self) -> int:
+        return len(self._heap)

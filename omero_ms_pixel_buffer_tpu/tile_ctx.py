@@ -88,6 +88,16 @@ class TileCtx:
     # never alias each other) in the cache, the single-flight registry,
     # or the batcher's dedupe
     render: Optional["RenderSpec"] = None
+    # SLO scheduling (resilience/scheduler): the request's priority
+    # class (0 interactive > 1 prefetch > 2 bulk) — orders the
+    # batcher's deadline queue, never changes bytes — and the
+    # hybrid-resolution degradation level: degraded=d serves the
+    # pyramid level d steps below the requested one, upscaled back to
+    # the requested region. Degraded joins every cache/dedupe/lane key
+    # (a degraded body must never overwrite or serve as the
+    # full-resolution entry); priority joins none.
+    priority: int = 0
+    degraded: int = 0
 
     @classmethod
     def from_params(
@@ -142,6 +152,8 @@ class TileCtx:
             "render": (
                 None if self.render is None else self.render.to_json()
             ),
+            "priority": self.priority,
+            "degraded": self.degraded,
         }
 
     @classmethod
@@ -168,6 +180,8 @@ class TileCtx:
                 trace_context=dict(obj.get("traceContext") or {}),
                 deadline=Deadline.from_json(obj.get("deadline")),
                 render=_render_from_json(obj.get("render")),
+                priority=int(obj.get("priority", 0) or 0),
+                degraded=int(obj.get("degraded", 0) or 0),
             )
         except BadRequestError:
             raise
@@ -196,6 +210,11 @@ class TileCtx:
         )
         if self.render is not None:
             base += f"|render={self.render.signature()}"
+        if self.degraded:
+            # a degraded (coarser-upscaled) body is a DIFFERENT
+            # resource: it must never overwrite, nor serve as, the
+            # full-resolution entry (or its ETag)
+            base += f"|deg={self.degraded}"
         return base
 
     def dedupe_key(self, quality: str = "") -> str:
@@ -216,6 +235,7 @@ class TileCtx:
             r.x, r.y, r.width, r.height,
             self.resolution, self.format, self.omero_session_key,
             None if self.render is None else self.render.signature(),
+            self.degraded,
         )
 
     def filename(self) -> str:

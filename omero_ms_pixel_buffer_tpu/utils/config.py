@@ -182,6 +182,31 @@ class ResilienceConfig:
 
 
 @dataclasses.dataclass
+class SloConfig:
+    """The slo: block — SLO-aware scheduling + graceful degradation
+    (resilience/scheduler.py). ``queue_size`` is the deadline-ordered
+    wait room past ``resilience.admission.max-inflight`` (0 restores
+    the binary shed-at-the-door gate); ``class_weights`` are the
+    weighted-round-robin grants per cycle for
+    (interactive, prefetch, bulk); ``degrade`` enables the
+    hybrid-resolution fallback when a grant's remaining budget is
+    inside ``degrade_factor`` x the full-resolution service-time
+    EWMA; ``sweep_window`` consecutive constant-stride steps demote a
+    session to the bulk class for ``sweep_ttl_s``."""
+
+    enabled: bool = True
+    queue_size: int = 512
+    class_weights: tuple = (8, 2, 1)
+    degrade: bool = True
+    degrade_factor: float = 1.5
+    sweep_window: int = 16
+    sweep_ttl_s: float = 30.0
+    # Override header clients may set to label themselves
+    # (interactive|prefetch|bulk); empty string disables the override.
+    priority_header: str = "x-ompb-priority"
+
+
+@dataclasses.dataclass
 class PrefetchConfig:
     """Viewport prefetch (cache.prefetch): speculative warming of the
     result cache from per-session access streams, shed first under
@@ -357,6 +382,7 @@ class Config:
     resilience: ResilienceConfig = dataclasses.field(
         default_factory=ResilienceConfig
     )
+    slo: SloConfig = dataclasses.field(default_factory=SloConfig)
     cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
     cluster: ClusterConfig = dataclasses.field(
         default_factory=ClusterConfig
@@ -502,6 +528,75 @@ class Config:
                 else _num(res_raw, "request-budget-ms", None, 1.0)
             ),
             io_timeout_ms=_num(res_raw, "io-timeout-ms", 5000.0, 0.0),
+        )
+
+    @staticmethod
+    def _parse_slo(raw: dict) -> SloConfig:
+        """Validate the slo: block — same posture as resilience/cache:
+        typos and nonsense fail at startup, never silently default."""
+        sl = raw.get("slo") or {}
+        unknown = set(sl) - {
+            "enabled", "queue-size", "class-weights", "degrade",
+            "degrade-factor", "sweep-window", "sweep-ttl-s",
+            "priority-header",
+        }
+        if unknown:
+            raise ConfigError(
+                f"Unknown keys in 'slo' block: {sorted(unknown)}"
+            )
+
+        def _num(key: str, default, minimum, cast=float):
+            try:
+                value = cast(sl.get(key, default))
+            except (TypeError, ValueError):
+                raise ConfigError(
+                    f"Invalid value for 'slo.{key}': {sl.get(key)!r}"
+                ) from None
+            if value < minimum:
+                raise ConfigError(f"'slo.{key}' must be >= {minimum}")
+            return value
+
+        weights_raw = sl.get("class-weights", (8, 2, 1))
+        if (
+            not isinstance(weights_raw, (list, tuple))
+            or len(weights_raw) != 3
+        ):
+            raise ConfigError(
+                "'slo.class-weights' must be a list of 3 integers "
+                "(interactive, prefetch, bulk)"
+            )
+        weights = []
+        for w in weights_raw:
+            try:
+                w = int(w)
+            except (TypeError, ValueError):
+                raise ConfigError(
+                    f"Invalid 'slo.class-weights' entry: {w!r}"
+                ) from None
+            if w < 1:
+                raise ConfigError(
+                    "'slo.class-weights' entries must be >= 1"
+                )
+            weights.append(w)
+        header = sl.get("priority-header", "x-ompb-priority")
+        if header is None:
+            header = ""
+        if not isinstance(header, str):
+            raise ConfigError(
+                f"Invalid value for 'slo.priority-header': {header!r}"
+            )
+        factor = _num("degrade-factor", 1.5, 0.0)
+        if factor <= 0:
+            raise ConfigError("'slo.degrade-factor' must be > 0")
+        return SloConfig(
+            enabled=bool(sl.get("enabled", True)),
+            queue_size=_num("queue-size", 512, 0, int),
+            class_weights=tuple(weights),
+            degrade=bool(sl.get("degrade", True)),
+            degrade_factor=factor,
+            sweep_window=_num("sweep-window", 16, 2, int),
+            sweep_ttl_s=_num("sweep-ttl-s", 30.0, 0.0),
+            priority_header=header.lower(),
         )
 
     @staticmethod
@@ -819,6 +914,7 @@ class Config:
             jmx_metrics_enabled=bool(jmx.get("enabled", True)),
             backend=backend,
             resilience=cls._parse_resilience(raw),
+            slo=cls._parse_slo(raw),
             cache=cls._parse_cache(raw),
             cluster=cls._parse_cluster(raw),
             render=cls._parse_render(raw),

@@ -783,13 +783,16 @@ class TestLoadShedding:
     async def test_overload_sheds_503_with_retry_after(
         self, tmp_path, loop
     ):
-        """2x queue-capacity synthetic load: excess sheds immediately
-        with 503 + Retry-After; admitted requests stay near the
-        unloaded latency (p50 within 2x)."""
+        """2x capacity synthetic load against a BOUNDED SLO queue
+        (r13: the scheduler queues deadline-ordered past the in-flight
+        gate; only queue overflow sheds): 4 execute, 2 wait, the
+        excess sheds 503 + Retry-After; executed requests stay near
+        the unloaded latency."""
         app_obj, client = await _make_app(
             tmp_path,
             resilience={"admission": {"max-inflight": 4,
                                       "retry-after-s": 2}},
+            config_extra={"slo": {"queue-size": 2, "degrade": False}},
             slow_s=0.1, workers=4,
         )
         try:
@@ -813,13 +816,17 @@ class TestLoadShedding:
             admitted = [(r, dt) for r, dt in results if r.status == 200]
             shed = [r for r, _ in results if r.status == 503]
             assert admitted and shed  # both behaviors under overload
-            assert len(admitted) <= 4
+            # 4 slots + 2 queued may succeed; the overflow sheds
+            assert len(admitted) <= 6 and len(shed) >= 2
             for r in shed:
                 assert r.headers["Retry-After"] == "2"
             lat = sorted(dt for _, dt in admitted)
             admitted_p50 = lat[len(lat) // 2]
-            assert admitted_p50 <= 2 * unloaded_p50 + 0.05
+            assert admitted_p50 <= 2 * unloaded_p50 + 0.1
             assert app_obj.admission.shed_total == len(shed)
+            assert app_obj.scheduler.snapshot()["shed"][
+                "interactive"
+            ] == len(shed)
 
             # load gone: the gate reopens
             r = await client.get("/tile/1/0/0/0?w=32&h=32",

@@ -1,0 +1,1476 @@
+"""SLO-aware scheduling suite (resilience/scheduler, PR r13).
+
+Covers the scheduler unit contract (EDF within class, weighted
+round-robin between, lowest-class-latest-deadline shedding, in-queue
+expiry, the degradation signal), sweep detection + classification,
+the deadline-ordered batcher queue, and the HTTP integration: shed
+ordering under injected overload, Retry-After only when the queue is
+genuinely full, hybrid-resolution degradation engaging under pressure
+and disengaging cleanly after, degraded-vs-full cache/ETag isolation,
+the deferred trailing device group, concurrent session lookups, and
+the opt-in /healthz dependency probes.
+"""
+
+import asyncio
+import concurrent.futures
+import io
+import threading
+import time
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from omero_ms_pixel_buffer_tpu.auth.stores import (
+    MemorySessionStore,
+    OmeroWebSessionStore,
+)
+from omero_ms_pixel_buffer_tpu.errors import (
+    GatewayTimeoutError,
+    ServiceUnavailableError,
+)
+from omero_ms_pixel_buffer_tpu.http.server import PixelBufferApp
+from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+    ImageRegistry,
+    PixelsService,
+)
+from omero_ms_pixel_buffer_tpu.io.zarr import write_ngff
+from omero_ms_pixel_buffer_tpu.models.tile_pipeline import (
+    DeferredTile,
+    TilePipeline,
+)
+from omero_ms_pixel_buffer_tpu.resilience import AdmissionController
+from omero_ms_pixel_buffer_tpu.resilience.deadline import Deadline
+from omero_ms_pixel_buffer_tpu.resilience.scheduler import (
+    PRIORITY_BULK,
+    PRIORITY_INTERACTIVE,
+    PRIORITY_PREFETCH,
+    DeadlineQueue,
+    SloScheduler,
+    SweepDetector,
+    classify,
+)
+from omero_ms_pixel_buffer_tpu.tile_ctx import RegionDef, TileCtx
+from omero_ms_pixel_buffer_tpu.utils.config import Config, ConfigError
+
+rng = np.random.default_rng(13)
+IMG = rng.integers(0, 60000, (1, 1, 1, 64, 64), dtype=np.uint16)
+
+AUTH = {"Cookie": "sessionid=ck"}
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _deadline(clock, budget_s: float) -> Deadline:
+    return Deadline.after(budget_s, clock=clock)
+
+
+# ---------------------------------------------------------------------------
+# sweep detection + classification
+# ---------------------------------------------------------------------------
+
+
+class TestSweepDetector:
+    def _walk(self, det, session, n, stride=64, y=0):
+        for i in range(n):
+            det.observe(session, 1, 0, 0, 0, 0, i * stride, y, 64, 64)
+
+    def test_constant_stride_run_detects(self):
+        det = SweepDetector(threshold=4)
+        self._walk(det, "robot", 5)
+        assert det.is_sweep("robot")
+        assert det.snapshot()["detected_total"] == 1
+
+    def test_short_runs_and_direction_changes_do_not(self):
+        det = SweepDetector(threshold=4)
+        # a human pan: 3 right, wobble down, 3 right
+        self._walk(det, "human", 3)
+        det.observe("human", 1, 0, 0, 0, 0, 128, 64, 64, 64)
+        self._walk(det, "human", 3, y=64)
+        assert not det.is_sweep("human")
+
+    def test_refresh_is_not_a_step(self):
+        det = SweepDetector(threshold=3)
+        for _ in range(10):  # same tile re-requested (viewer refresh)
+            det.observe("s", 1, 0, 0, 0, 0, 0, 0, 64, 64)
+        assert not det.is_sweep("s")
+
+    def test_demotion_expires_and_refreshes(self):
+        clock = FakeClock()
+        det = SweepDetector(threshold=3, ttl_s=10.0, clock=clock)
+        self._walk(det, "robot", 4)
+        assert det.is_sweep("robot")
+        clock.advance(11.0)
+        assert not det.is_sweep("robot")
+        # a resumed sweep re-demotes (run state persists per stream)
+        self._walk(det, "robot2", 4)
+        clock.advance(9.0)
+        det.observe("robot2", 1, 0, 0, 0, 0, 4 * 64, 0, 64, 64)
+        clock.advance(5.0)  # 14s after first detection, 5s after refresh
+        assert det.is_sweep("robot2")
+
+    def test_full_plane_requests_ignored(self):
+        det = SweepDetector(threshold=2)
+        for i in range(5):
+            det.observe("s", 1, 0, 0, 0, 0, i * 64, 0, 0, 0)
+        assert not det.is_sweep("s")
+
+
+class TestClassify:
+    def test_default_is_interactive(self):
+        assert classify({}, "s") == PRIORITY_INTERACTIVE
+
+    def test_override_header_wins(self):
+        h = {"x-ompb-priority": "bulk"}
+        assert classify(h, "s") == PRIORITY_BULK
+        h = {"x-ompb-priority": "prefetch"}
+        assert classify(h, "s") == PRIORITY_PREFETCH
+        # an override even outranks sweep detection
+        det = SweepDetector(threshold=2)
+        for i in range(4):
+            det.observe("s", 1, 0, 0, 0, 0, i * 64, 0, 64, 64)
+        assert classify(
+            {"x-ompb-priority": "interactive"}, "s", det
+        ) == PRIORITY_INTERACTIVE
+
+    def test_unknown_override_value_ignored(self):
+        assert classify(
+            {"x-ompb-priority": "vip"}, "s"
+        ) == PRIORITY_INTERACTIVE
+
+    def test_purpose_headers_mark_prefetch(self):
+        assert classify(
+            {"Sec-Purpose": "prefetch;anonymous-client-ip"}, "s"
+        ) == PRIORITY_PREFETCH
+        assert classify({"Purpose": "prefetch"}, "s") == PRIORITY_PREFETCH
+        assert classify({"X-OMPB-Prefetch": "1"}, "s") == PRIORITY_PREFETCH
+
+    def test_sweep_session_demotes(self):
+        det = SweepDetector(threshold=2)
+        for i in range(4):
+            det.observe("robot", 1, 0, 0, 0, 0, i * 64, 0, 64, 64)
+        assert classify({}, "robot", det) == PRIORITY_BULK
+        assert classify({}, "other", det) == PRIORITY_INTERACTIVE
+
+
+# ---------------------------------------------------------------------------
+# the scheduler unit contract
+# ---------------------------------------------------------------------------
+
+
+def _sched(capacity=1, queue_size=4, weights=(8, 2, 1), degrade=True,
+           factor=1.5):
+    admission = AdmissionController(
+        max_inflight=capacity, retry_after_s=2.0
+    )
+    return SloScheduler(
+        admission, queue_size=queue_size, class_weights=weights,
+        degrade=degrade, degrade_factor=factor,
+    )
+
+
+class TestSloSchedulerUnit:
+    async def test_immediate_grant_under_capacity(self, loop):
+        s = _sched(capacity=2)
+        p1 = await s.acquire(PRIORITY_INTERACTIVE, None)
+        p2 = await s.acquire(PRIORITY_BULK, None)
+        assert not p1.degraded and not p2.degraded
+        assert s.admission.inflight == 2
+        s.release(p1)
+        s.release(p2)
+        assert s.admission.inflight == 0
+
+    async def test_edf_within_class(self, loop):
+        clock = FakeClock()
+        s = _sched(capacity=1, queue_size=8)
+        p0 = await s.acquire(PRIORITY_INTERACTIVE, None)
+        order = []
+
+        async def waiter(tag, budget):
+            p = await s.acquire(
+                PRIORITY_INTERACTIVE, _deadline(clock, budget)
+            )
+            order.append(tag)
+            s.release(p)
+
+        # enqueue latest-deadline first: EDF must invert the order
+        tasks = [
+            asyncio.ensure_future(waiter("late", 30.0)),
+            asyncio.ensure_future(waiter("mid", 20.0)),
+            asyncio.ensure_future(waiter("early", 10.0)),
+        ]
+        await asyncio.sleep(0.01)
+        s.release(p0)  # grants cascade as each waiter releases
+        await asyncio.gather(*tasks)
+        assert order == ["early", "mid", "late"]
+
+    async def test_wrr_between_classes(self, loop):
+        s = _sched(capacity=1, queue_size=16, weights=(2, 1, 1))
+        p0 = await s.acquire(PRIORITY_INTERACTIVE, None)
+        order = []
+
+        async def waiter(tag, prio):
+            p = await s.acquire(prio, None)
+            order.append(tag)
+            s.release(p)
+
+        tasks = [
+            asyncio.ensure_future(waiter(f"i{k}", PRIORITY_INTERACTIVE))
+            for k in range(4)
+        ]
+        await asyncio.sleep(0.01)
+        tasks += [
+            asyncio.ensure_future(waiter("b0", PRIORITY_BULK)),
+            asyncio.ensure_future(waiter("p0", PRIORITY_PREFETCH)),
+        ]
+        await asyncio.sleep(0.01)
+        s.release(p0)
+        await asyncio.gather(*tasks)
+        # interactive dominates 2:1:1 but the lower classes are NOT
+        # starved behind the interactive backlog
+        assert order.index("p0") < len(order) - 1
+        assert order[:2] == ["i0", "i1"]  # weight-2 head
+        assert "b0" in order
+
+    async def test_shed_order_lowest_class_latest_deadline(self, loop):
+        clock = FakeClock()
+        s = _sched(capacity=1, queue_size=2)
+        p0 = await s.acquire(PRIORITY_INTERACTIVE, None)
+        results = {}
+
+        async def waiter(tag, prio, budget):
+            try:
+                p = await s.acquire(prio, _deadline(clock, budget))
+                results[tag] = "granted"
+                s.release(p)
+            except ServiceUnavailableError:
+                results[tag] = "shed"
+            except GatewayTimeoutError:
+                results[tag] = "expired"
+
+        t_bulk = asyncio.ensure_future(
+            waiter("bulk", PRIORITY_BULK, 10.0)
+        )
+        await asyncio.sleep(0.01)
+        t_pre = asyncio.ensure_future(
+            waiter("prefetch", PRIORITY_PREFETCH, 10.0)
+        )
+        await asyncio.sleep(0.01)  # queue full: [bulk, prefetch]
+        # an incoming bulk with a LATER deadline is the worst work in
+        # sight: it sheds, the queue is untouched
+        with pytest.raises(ServiceUnavailableError) as ei:
+            await s.acquire(PRIORITY_BULK, _deadline(clock, 20.0))
+        assert ei.value.retry_after_s == 2.0
+        # an incoming interactive evicts the queued BULK entry
+        t_int = asyncio.ensure_future(
+            waiter("interactive", PRIORITY_INTERACTIVE, 10.0)
+        )
+        await asyncio.sleep(0.01)
+        assert results.get("bulk") == "shed"
+        # another interactive evicts the queued PREFETCH entry
+        t_int2 = asyncio.ensure_future(
+            waiter("interactive2", PRIORITY_INTERACTIVE, 12.0)
+        )
+        await asyncio.sleep(0.01)
+        assert results.get("prefetch") == "shed"
+        s.release(p0)
+        await asyncio.gather(t_bulk, t_pre, t_int, t_int2)
+        assert results["interactive"] == "granted"
+        assert results["interactive2"] == "granted"
+        snap = s.snapshot()
+        assert snap["shed"] == {
+            "interactive": 0, "prefetch": 1, "bulk": 2,
+        }
+
+    async def test_queue_size_zero_is_binary_gate(self, loop):
+        s = _sched(capacity=1, queue_size=0)
+        p0 = await s.acquire(PRIORITY_INTERACTIVE, None)
+        with pytest.raises(ServiceUnavailableError):
+            await s.acquire(PRIORITY_INTERACTIVE, None)
+        s.release(p0)
+        p1 = await s.acquire(PRIORITY_INTERACTIVE, None)
+        s.release(p1)
+
+    async def test_expired_in_queue_is_504_and_slot_moves_on(self, loop):
+        clock = FakeClock()
+        s = _sched(capacity=1, queue_size=4)
+        p0 = await s.acquire(PRIORITY_INTERACTIVE, None)
+        doomed = _deadline(clock, 5.0)
+        t_doomed = asyncio.ensure_future(
+            s.acquire(PRIORITY_INTERACTIVE, doomed)
+        )
+        await asyncio.sleep(0.01)
+        t_live = asyncio.ensure_future(
+            s.acquire(PRIORITY_INTERACTIVE, _deadline(clock, 60.0))
+        )
+        await asyncio.sleep(0.01)
+        clock.advance(6.0)  # doomed expires while queued
+        s.release(p0)
+        with pytest.raises(GatewayTimeoutError):
+            await t_doomed
+        live = await t_live  # the freed slot moved on to live work
+        assert live.priority == PRIORITY_INTERACTIVE
+        s.release(live)
+        assert s.snapshot()["expired_in_queue"]["interactive"] == 1
+
+    async def test_degrade_signal_engages_and_disengages(self, loop):
+        s = _sched(capacity=1, queue_size=4, factor=1.5)
+        # train the service-time EWMA: a 100 ms full-res execution
+        p = await s.acquire(PRIORITY_INTERACTIVE, None)
+        p._t_start = time.monotonic() - 0.1
+        s.release(p)
+        assert s._service_ewma == pytest.approx(0.1, rel=0.05)
+        # uncontended grant with plenty of budget: NOT degraded
+        p = await s.acquire(
+            PRIORITY_INTERACTIVE, Deadline.after(10.0)
+        )
+        assert not p.degraded
+        # contended grant with remaining < 1.5 x ewma: degraded
+        t = asyncio.ensure_future(
+            s.acquire(PRIORITY_INTERACTIVE, Deadline.after(0.12))
+        )
+        await asyncio.sleep(0.01)
+        p._t_start = time.monotonic()
+        s.release(p)
+        granted = await t
+        assert granted.degraded
+        s.release(granted)
+        # pressure gone: an identical tight budget no longer degrades
+        p2 = await s.acquire(
+            PRIORITY_INTERACTIVE, Deadline.after(0.12)
+        )
+        assert not p2.degraded
+        s.release(p2)
+        assert s.snapshot()["degraded"]["interactive"] == 1
+
+    async def test_degraded_durations_do_not_train_ewma(self, loop):
+        s = _sched(capacity=1)
+        p = await s.acquire(PRIORITY_INTERACTIVE, None)
+        p._t_start = time.monotonic() - 0.2
+        s.release(p)
+        ewma = s._service_ewma
+        p = await s.acquire(PRIORITY_INTERACTIVE, None)
+        p.degraded = True
+        p._t_start = time.monotonic() - 0.001
+        s.release(p)
+        assert s._service_ewma == ewma  # unchanged
+
+    async def test_failed_requests_do_not_train_ewma(self, loop):
+        """release(train=False) — the HTTP layer's path for requests
+        that errored: a fast-failing burst (404 loop, open breaker)
+        must not collapse the estimate and disarm degradation."""
+        s = _sched(capacity=1)
+        p = await s.acquire(PRIORITY_INTERACTIVE, None)
+        p._t_start = time.monotonic() - 0.2
+        s.release(p)
+        ewma = s._service_ewma
+        for _ in range(20):  # 20 near-instant failures
+            p = await s.acquire(PRIORITY_INTERACTIVE, None)
+            s.release(p, train=False)
+        assert s._service_ewma == ewma  # unchanged
+
+    async def test_non_degradable_grants_never_flagged(self, loop):
+        """acquire(degradable=False) — raw/TIFF measurement surfaces:
+        the permit is never degraded (slo_degraded_total counts only
+        requests that CAN degrade) and its full-res serve still
+        trains the EWMA."""
+        s = _sched(capacity=1, queue_size=4, factor=1.5)
+        p = await s.acquire(PRIORITY_INTERACTIVE, None)
+        p._t_start = time.monotonic() - 0.1
+        s.release(p)
+        p = await s.acquire(PRIORITY_INTERACTIVE, None)
+        t = asyncio.ensure_future(s.acquire(
+            PRIORITY_INTERACTIVE, Deadline.after(0.12),
+            degradable=False,
+        ))
+        await asyncio.sleep(0.01)
+        p._t_start = time.monotonic() - 0.1
+        s.release(p)
+        granted = await t
+        assert not granted.degraded  # would have been flagged
+        ewma = s._service_ewma
+        granted._t_start = time.monotonic() - 0.1
+        s.release(granted)
+        assert s._service_ewma != ewma  # full-res serve still trains
+        assert s.snapshot()["degraded"]["interactive"] == 0
+
+    async def test_cancelled_waiter_leaves_queue_consistent(self, loop):
+        s = _sched(capacity=1, queue_size=4)
+        p0 = await s.acquire(PRIORITY_INTERACTIVE, None)
+        t = asyncio.ensure_future(
+            s.acquire(PRIORITY_INTERACTIVE, None)
+        )
+        await asyncio.sleep(0.01)
+        t.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await t
+        assert s._waiting_total == 0
+        s.release(p0)
+        assert s.admission.inflight == 0
+        # the scheduler still grants cleanly afterwards
+        p = await s.acquire(PRIORITY_INTERACTIVE, None)
+        s.release(p)
+
+    async def test_door_preview_matches_victim_class(self, loop):
+        """``would_overflow_shed`` reads the per-class live-waiter
+        counters (O(1) on the overload hot path), not a heap scan —
+        but the answer must match acquire's victim choice: a fresh
+        arrival sheds unless a strictly lower class is waiting."""
+        s = _sched(capacity=1, queue_size=2)
+        p0 = await s.acquire(PRIORITY_BULK, None)
+        waiters = [
+            asyncio.ensure_future(
+                s.acquire(PRIORITY_BULK, Deadline.after(5.0))
+            )
+            for _ in range(2)
+        ]
+        await asyncio.sleep(0.01)
+        assert s._waiting_total == 2  # queue genuinely full
+        # bulk waiters evictable by anything strictly more important
+        assert not s.would_overflow_shed(PRIORITY_INTERACTIVE)
+        assert not s.would_overflow_shed(PRIORITY_PREFETCH)
+        # a fresh bulk arrival holds the latest deadline: it sheds
+        assert s.would_overflow_shed(PRIORITY_BULK)
+        # a cancelled waiter leaves the preview consistent
+        waiters[1].cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await waiters[1]
+        assert not s.would_overflow_shed(PRIORITY_BULK)  # room again
+        s.release(p0)
+        s.release(await waiters[0])
+
+
+class TestDeadlineQueue:
+    def _item(self, priority=0, budget=None, clock=None):
+        ctx = TileCtx(1, 0, 0, 0, RegionDef(0, 0, 1, 1))
+        ctx.priority = priority
+        if budget is not None:
+            ctx.deadline = Deadline.after(
+                budget, clock=clock or time.monotonic
+            )
+        return (ctx, object())
+
+    async def test_pops_deadline_then_class_order(self, loop):
+        """Deadline is the primary key (everything queued already
+        holds a granted slot — class-first would starve admitted
+        lower-class lanes under a steady interactive stream); class
+        breaks same-deadline ties interactive-first."""
+        clock = FakeClock()
+        q = DeadlineQueue()
+        a = self._item(PRIORITY_BULK, 1.0, clock)
+        b = self._item(PRIORITY_INTERACTIVE, 9.0, clock)
+        c = self._item(PRIORITY_INTERACTIVE, 2.0, clock)
+        d = self._item(PRIORITY_PREFETCH, 1.0, clock)
+        for it in (a, b, c, d):
+            q.put_nowait(it)
+        assert [q.get_nowait() for _ in range(4)] == [d, a, c, b]
+
+    async def test_admitted_lane_never_starved_by_later_arrivals(
+        self, loop
+    ):
+        """The starvation regression: a queued prefetch lane with the
+        earliest deadline pops before interactive lanes that arrived
+        after it — its admission slot is never pinned behind an
+        endless higher-class stream."""
+        clock = FakeClock()
+        q = DeadlineQueue()
+        prefetch = self._item(PRIORITY_PREFETCH, 5.0, clock)
+        q.put_nowait(prefetch)
+        clock.advance(1.0)  # later arrivals: later deadlines
+        later = [
+            self._item(PRIORITY_INTERACTIVE, 5.0, clock)
+            for _ in range(4)
+        ]
+        for it in later:
+            q.put_nowait(it)
+        assert q.get_nowait() is prefetch
+
+    async def test_fifo_within_equal_keys_and_maxsize(self, loop):
+        q = DeadlineQueue(maxsize=2)
+        a, b = self._item(), self._item()
+        q.put_nowait(a)
+        q.put_nowait(b)
+        with pytest.raises(asyncio.QueueFull):
+            q.put_nowait(self._item())
+        assert q.get_nowait() is a and q.get_nowait() is b
+        with pytest.raises(asyncio.QueueEmpty):
+            q.get_nowait()
+
+    async def test_async_get_wakes_on_put(self, loop):
+        q = DeadlineQueue()
+        task = asyncio.ensure_future(q.get())
+        await asyncio.sleep(0.01)
+        item = self._item()
+        q.put_nowait(item)
+        assert await task is item
+        assert q.empty() and q.qsize() == 0
+
+
+# ---------------------------------------------------------------------------
+# config surface
+# ---------------------------------------------------------------------------
+
+
+class TestSloConfig:
+    def _cfg(self, slo):
+        return Config.from_dict(
+            {"session-store": {"type": "memory"}, "slo": slo}
+        )
+
+    def test_defaults(self):
+        cfg = Config.from_dict({"session-store": {"type": "memory"}})
+        assert cfg.slo.enabled and cfg.slo.queue_size == 512
+        assert cfg.slo.class_weights == (8, 2, 1)
+        assert cfg.slo.degrade and cfg.slo.sweep_window == 16
+
+    def test_unknown_key_fails(self):
+        with pytest.raises(ConfigError):
+            self._cfg({"que-size": 3})
+
+    def test_weights_validated(self):
+        with pytest.raises(ConfigError):
+            self._cfg({"class-weights": [1, 2]})
+        with pytest.raises(ConfigError):
+            self._cfg({"class-weights": [1, 0, 1]})
+        with pytest.raises(ConfigError):
+            self._cfg({"class-weights": "high"})
+
+    def test_values_validated(self):
+        with pytest.raises(ConfigError):
+            self._cfg({"queue-size": -1})
+        with pytest.raises(ConfigError):
+            self._cfg({"degrade-factor": 0})
+        with pytest.raises(ConfigError):
+            self._cfg({"sweep-window": 1})
+        cfg = self._cfg({"queue-size": 0, "priority-header": None})
+        assert cfg.slo.queue_size == 0
+        assert cfg.slo.priority_header == ""
+
+
+class TestCtxKeys:
+    def test_degraded_joins_every_key(self):
+        a = TileCtx(1, 0, 0, 0, RegionDef(0, 0, 64, 64), format="png")
+        b = TileCtx(
+            1, 0, 0, 0, RegionDef(0, 0, 64, 64), format="png",
+            degraded=1,
+        )
+        assert a.cache_key("q") != b.cache_key("q")
+        assert a.dedupe_key("q") != b.dedupe_key("q")
+        assert a.lane_key() != b.lane_key()
+        assert "deg=1" in b.cache_key("q")
+        assert "deg" not in a.cache_key("q")
+
+    def test_priority_and_degraded_round_trip_json(self):
+        ctx = TileCtx(
+            1, 0, 0, 0, RegionDef(0, 0, 64, 64), format="png",
+            priority=PRIORITY_BULK, degraded=1,
+        )
+        back = TileCtx.from_json(ctx.to_json())
+        assert back.priority == PRIORITY_BULK and back.degraded == 1
+        assert back.cache_key("q") == ctx.cache_key("q")
+
+    def test_priority_never_changes_keys(self):
+        a = TileCtx(1, 0, 0, 0, RegionDef(0, 0, 64, 64), format="png")
+        b = TileCtx(
+            1, 0, 0, 0, RegionDef(0, 0, 64, 64), format="png",
+            priority=PRIORITY_BULK,
+        )
+        assert a.cache_key("q") == b.cache_key("q")
+        assert a.lane_key() == b.lane_key()
+
+
+# ---------------------------------------------------------------------------
+# HTTP integration
+# ---------------------------------------------------------------------------
+
+
+async def _make_app(
+    tmp_path, *, resilience=None, slo=None, config_extra=None,
+    slow_s=0.0, workers=4, cache=False, levels=2, size=64,
+    session_store=None,
+):
+    """A served 2-level NGFF image behind the full app with an
+    optionally slowed pipeline — the chaos-suite shape, tuned for
+    scheduler scenarios."""
+    path = str(tmp_path / "img.zarr")
+    write_ngff(
+        path, IMG[:, :, :, :size, :size], chunks=(32, 32),
+        levels=levels,
+    )
+    registry = ImageRegistry()
+    registry.add(1, path, type="zarr")
+    raw = {
+        "session-store": {"type": "memory"},
+        "worker_pool_size": workers,
+        "backend": {"batching": {"max-batch": 1,
+                                 "coalesce-window-ms": 0.0}},
+        "cache": {"enabled": bool(cache)},
+    }
+    if resilience:
+        raw["resilience"] = resilience
+    if slo:
+        raw["slo"] = slo
+    if config_extra:
+        raw.update(config_extra)
+    config = Config.from_dict(raw)
+    app_obj = PixelBufferApp(
+        config,
+        pixels_service=PixelsService(registry),
+        session_store=session_store
+        or MemorySessionStore({"ck": "key"}),
+    )
+    if slow_s:
+        inner = app_obj.pipeline.handle
+
+        def slowed(ctx):
+            time.sleep(slow_s)
+            return inner(ctx)
+
+        app_obj.pipeline.handle = slowed
+    client = TestClient(
+        TestServer(app_obj.make_app()), loop=asyncio.get_running_loop()
+    )
+    await client.start_server()
+    return app_obj, client
+
+
+def _png_pixels(body: bytes) -> np.ndarray:
+    from PIL import Image
+
+    return np.array(Image.open(io.BytesIO(body)))
+
+
+def _upscaled_reference(x, y, w, h):
+    """The expected degraded pixels: level-1 (stride-2) plane of IMG,
+    nearest-neighbor mapped back to the requested region — an
+    independent spelling of the pipeline's _degrade_plan contract."""
+    lvl1 = IMG[0, 0, 0, ::2, ::2]
+    ys = np.minimum((y + np.arange(h)) * lvl1.shape[0] // 64,
+                    lvl1.shape[0] - 1)
+    xs = np.minimum((x + np.arange(w)) * lvl1.shape[1] // 64,
+                    lvl1.shape[1] - 1)
+    return lvl1[np.ix_(ys, xs)]
+
+
+@pytest.mark.resilience
+class TestShedOrdering:
+    """Satellite: under injected overload, prefetch sheds before
+    interactive, bulk before prefetch, and Retry-After only appears
+    once the queue is genuinely full."""
+
+    async def test_shed_order_and_retry_after(self, tmp_path, loop):
+        gate = threading.Event()
+        app_obj, client = await _make_app(
+            tmp_path,
+            resilience={"admission": {"max-inflight": 1,
+                                      "retry-after-s": 3}},
+            slo={"queue-size": 2, "degrade": False},
+            workers=4,
+        )
+        inner = app_obj.pipeline.handle
+
+        def gated(ctx):
+            gate.wait(10.0)
+            return inner(ctx)
+
+        app_obj.pipeline.handle = gated
+        url = "/tile/1/0/0/0?w=32&h=32&format=png"
+
+        async def req(headers=None):
+            h = dict(AUTH)
+            if headers:
+                h.update(headers)
+            return await client.get(url, headers=h)
+
+        try:
+            occupant = asyncio.ensure_future(req())
+            await asyncio.sleep(0.1)  # slot taken, queue empty
+            queued_bulk = asyncio.ensure_future(
+                req({"X-OMPB-Priority": "bulk"})
+            )
+            await asyncio.sleep(0.05)
+            queued_pre = asyncio.ensure_future(
+                req({"Sec-Purpose": "prefetch"})
+            )
+            await asyncio.sleep(0.05)  # queue now FULL: [bulk, prefetch]
+
+            # an incoming bulk (later deadline) is the worst work in
+            # sight: it sheds with Retry-After; the queue is untouched
+            r = await req({"X-OMPB-Priority": "bulk"})
+            assert r.status == 503
+            assert r.headers["Retry-After"] == "3"
+
+            # incoming interactive evicts the queued BULK first ...
+            int1 = asyncio.ensure_future(req())
+            await asyncio.sleep(0.05)
+            r_bulk = await queued_bulk
+            assert r_bulk.status == 503
+            assert "Retry-After" in r_bulk.headers
+
+            # ... and the next interactive evicts the queued PREFETCH
+            int2 = asyncio.ensure_future(req())
+            await asyncio.sleep(0.05)
+            r_pre = await queued_pre
+            assert r_pre.status == 503
+
+            gate.set()
+            r0, r1, r2 = await asyncio.gather(occupant, int1, int2)
+            # ZERO interactive 503s while lower classes had sheddable
+            # work — the acceptance property
+            assert (r0.status, r1.status, r2.status) == (200,) * 3
+            snap = app_obj.scheduler.snapshot()
+            assert snap["shed"]["interactive"] == 0
+            assert snap["shed"]["bulk"] == 2
+            assert snap["shed"]["prefetch"] == 1
+        finally:
+            gate.set()
+            await client.close()
+
+    async def test_no_retry_after_while_queue_has_room(
+        self, tmp_path, loop
+    ):
+        """Queued-not-shed: with wait room available, overload
+        produces zero 503s — requests reorder and ride it out."""
+        app_obj, client = await _make_app(
+            tmp_path,
+            resilience={"admission": {"max-inflight": 1}},
+            slo={"queue-size": 8, "degrade": False},
+            slow_s=0.05, workers=2,
+        )
+        try:
+            rs = await asyncio.gather(*(
+                client.get("/tile/1/0/0/0?w=32&h=32&format=png",
+                           headers=AUTH)
+                for _ in range(6)
+            ))
+            assert all(r.status == 200 for r in rs)
+            assert app_obj.admission.shed_total == 0
+        finally:
+            await client.close()
+
+
+@pytest.mark.resilience
+class TestDegradation:
+    """Chaos pins for the hybrid-resolution fallback: injected
+    pressure flips the scheduler into degradation; pressure gone,
+    requests serve full resolution again."""
+
+    URL = "/tile/1/0/0/0?format=png&w=16&h=16"
+
+    @staticmethod
+    def _tiles():
+        # 16 distinct 16x16 tiles of the 64x64 plane
+        return [
+            (x, y) for y in range(0, 64, 16) for x in range(0, 64, 16)
+        ]
+
+    async def test_engage_then_disengage(self, tmp_path, loop):
+        app_obj, client = await _make_app(
+            tmp_path,
+            resilience={"admission": {"max-inflight": 1},
+                        "request-budget-ms": 1200},
+            slo={"queue-size": 16, "degrade-factor": 6.0},
+            slow_s=0.15, workers=2,
+        )
+        try:
+            # warm: trains the service-time EWMA, no contention
+            r = await client.get(self.URL + "&x=0&y=0", headers=AUTH)
+            assert r.status == 200
+            assert "X-OMPB-Degraded" not in r.headers
+
+            async def fetch(x, y):
+                r = await client.get(
+                    self.URL + f"&x={x}&y={y}", headers=AUTH
+                )
+                body = await r.read()
+                return x, y, r, body
+
+            burst = await asyncio.gather(*(
+                fetch(x, y) for x, y in self._tiles()[:6]
+            ))
+            full = [r for _, _, r, _ in burst
+                    if "X-OMPB-Degraded" not in r.headers]
+            degraded = [
+                (x, y, r, body) for x, y, r, body in burst
+                if "X-OMPB-Degraded" in r.headers
+            ]
+            assert all(r.status == 200 for _, _, r, _ in burst)
+            assert degraded, "pressure never engaged degradation"
+            assert full, "every request degraded (signal too eager)"
+            for x, y, r, body in degraded:
+                assert r.headers["X-OMPB-Degraded"] == "1"
+                assert np.array_equal(
+                    _png_pixels(body), _upscaled_reference(x, y, 16, 16)
+                ), "degraded body is not the upscaled lower level"
+
+            # pressure gone: the SAME tile serves full-resolution with
+            # no degraded tag and full-res pixels
+            x, y, _, dbody = degraded[0]
+            r = await client.get(
+                self.URL + f"&x={x}&y={y}", headers=AUTH
+            )
+            body = await r.read()
+            assert r.status == 200
+            assert "X-OMPB-Degraded" not in r.headers
+            assert np.array_equal(
+                _png_pixels(body),
+                IMG[0, 0, 0, y:y + 16, x:x + 16],
+            )
+            assert body != dbody
+            snap = app_obj.scheduler.snapshot()
+            assert snap["degraded"]["interactive"] == len(degraded)
+            assert snap["shed"] == {
+                "interactive": 0, "prefetch": 0, "bulk": 0,
+            }
+        finally:
+            await client.close()
+
+    async def test_no_coarser_level_fills_full_res_key(
+        self, tmp_path, loop
+    ):
+        """A single-level (non-pyramidal) image under a degraded
+        permit: the pipeline clears the flag, the response is
+        untagged full-resolution — and the cache fill must land
+        under the FULL-RES key, never |deg=1 (a full-res body cached
+        under the degraded key would serve later degraded-permit
+        hits tagged ``X-OMPB-Degraded`` on undegraded bytes)."""
+        app_obj, client = await _make_app(
+            tmp_path,
+            resilience={"admission": {"max-inflight": 1},
+                        "request-budget-ms": 1200},
+            slo={"queue-size": 16, "degrade-factor": 8.0},
+            slow_s=0.12, workers=2, cache=True, levels=1,
+        )
+        try:
+            r = await client.get(self.URL + "&x=48&y=48", headers=AUTH)
+            assert r.status == 200  # warm: trains the EWMA
+
+            async def fetch(x, y):
+                r = await client.get(
+                    self.URL + f"&x={x}&y={y}", headers=AUTH
+                )
+                await r.read()
+                return x, y, r
+
+            burst = await asyncio.gather(*(
+                fetch(x, y) for x, y in self._tiles()[:6]
+            ))
+            snap = app_obj.scheduler.snapshot()
+            assert snap["degraded"]["interactive"] > 0, (
+                "pressure never flagged a permit — scenario too light"
+            )
+            # no coarser level exists: nothing may be tagged
+            for _, _, r in burst:
+                assert r.status == 200
+                assert "X-OMPB-Degraded" not in r.headers
+            # the fill landed under the full-res key: a fresh GET of
+            # a bursted tile is a HIT with the same ETag (a |deg=1
+            # fill would leave this a miss/re-render)
+            x, y, br = burst[0]
+            r = await client.get(
+                self.URL + f"&x={x}&y={y}", headers=AUTH
+            )
+            assert r.status == 200
+            assert r.headers.get("X-Cache") == "hit"
+            assert "X-OMPB-Degraded" not in r.headers
+            assert r.headers["ETag"] == br.headers["ETag"]
+        finally:
+            await client.close()
+
+    async def test_degraded_cache_isolation(self, tmp_path, loop):
+        """A degraded body caches under its OWN key/ETag: it never
+        overwrites the full-resolution entry, a full-res request
+        never serves it, and its ETag never 304s a full-res GET."""
+        app_obj, client = await _make_app(
+            tmp_path,
+            resilience={"admission": {"max-inflight": 1},
+                        "request-budget-ms": 1200},
+            slo={"queue-size": 16, "degrade-factor": 8.0},
+            slow_s=0.12, workers=2, cache=True,
+        )
+        try:
+            # warm the EWMA on a tile outside the burst set
+            r = await client.get(self.URL + "&x=48&y=48", headers=AUTH)
+            assert r.status == 200
+
+            async def fetch(x, y):
+                r = await client.get(
+                    self.URL + f"&x={x}&y={y}", headers=AUTH
+                )
+                await r.read()
+                return x, y, r
+
+            burst = await asyncio.gather(*(
+                fetch(x, y) for x, y in self._tiles()[:6]
+            ))
+            degraded = [
+                (x, y, r) for x, y, r in burst
+                if "X-OMPB-Degraded" in r.headers
+            ]
+            assert degraded, "pressure never engaged degradation"
+            x, y, dr = degraded[0]
+            detag = dr.headers["ETag"]
+
+            # pressure gone: the full-resolution resource is intact —
+            # a fresh GET misses (never served from the degraded
+            # entry), carries a DIFFERENT ETag, and the degraded ETag
+            # does not revalidate it
+            url = self.URL + f"&x={x}&y={y}"
+            r = await client.get(url, headers=AUTH)
+            assert r.status == 200
+            assert "X-OMPB-Degraded" not in r.headers
+            fetag = r.headers["ETag"]
+            assert fetag != detag
+            r304 = await client.get(
+                url, headers={**AUTH, "If-None-Match": detag}
+            )
+            assert r304.status == 200  # degraded ETag proves nothing
+            r304 = await client.get(
+                url, headers={**AUTH, "If-None-Match": fetag}
+            )
+            assert r304.status == 304
+        finally:
+            await client.close()
+
+
+@pytest.mark.resilience
+class TestOverloadDoorGate:
+    """The pre-auth door gate: genuine overflow 503s BEFORE the
+    session join (true overload must not convert into session-store /
+    cluster-cache load), while cache hits still pass."""
+
+    @staticmethod
+    def _url(x, y):
+        return f"/tile/1/0/0/0?x={x}&y={y}&w=32&h=32&format=png"
+
+    async def test_genuine_overflow_sheds_before_auth(
+        self, tmp_path, loop
+    ):
+        class CountingStore(MemorySessionStore):
+            def __init__(self):
+                super().__init__({"ck": "key"})
+                self.lookups = 0
+
+            async def get_omero_session_key(self, session_id):
+                self.lookups += 1
+                return await super().get_omero_session_key(session_id)
+
+        gate = threading.Event()
+        store = CountingStore()
+        app_obj, client = await _make_app(
+            tmp_path,
+            resilience={"admission": {"max-inflight": 1,
+                                      "retry-after-s": 2}},
+            slo={"queue-size": 1, "degrade": False},
+            workers=2, session_store=store,
+        )
+        inner = app_obj.pipeline.handle
+
+        def gated(ctx):
+            gate.wait(10.0)
+            return inner(ctx)
+
+        app_obj.pipeline.handle = gated
+        try:
+            occ = asyncio.ensure_future(
+                client.get(self._url(0, 0), headers=AUTH)
+            )
+            await asyncio.sleep(0.1)  # slot taken
+            waiter = asyncio.ensure_future(
+                client.get(self._url(32, 0), headers=AUTH)
+            )
+            await asyncio.sleep(0.05)  # queue genuinely full
+            before = store.lookups
+            # would-shed arrival: 503 at the DOOR — no session lookup,
+            # even with a cookie the store would reject
+            r = await client.get(
+                self._url(0, 32),
+                headers={"Cookie": "sessionid=garbage"},
+            )
+            assert r.status == 503
+            assert "Retry-After" in r.headers
+            assert store.lookups == before
+            assert app_obj.scheduler.snapshot()["shed"][
+                "interactive"
+            ] == 1
+            gate.set()
+            r0, r1 = await asyncio.gather(occ, waiter)
+            assert (r0.status, r1.status) == (200, 200)
+        finally:
+            gate.set()
+            await client.close()
+
+    async def test_door_exempts_cache_hits(self, tmp_path, loop):
+        gate = threading.Event()
+        app_obj, client = await _make_app(
+            tmp_path,
+            resilience={"admission": {"max-inflight": 1}},
+            slo={"queue-size": 1, "degrade": False},
+            workers=2, cache=True,
+        )
+        try:
+            r = await client.get(self._url(0, 0), headers=AUTH)
+            assert r.status == 200  # fills the cache, uncontended
+            inner = app_obj.pipeline.handle
+
+            def gated(ctx):
+                gate.wait(10.0)
+                return inner(ctx)
+
+            app_obj.pipeline.handle = gated
+            occ = asyncio.ensure_future(
+                client.get(self._url(32, 0), headers=AUTH)
+            )
+            await asyncio.sleep(0.1)
+            waiter = asyncio.ensure_future(
+                client.get(self._url(0, 32), headers=AUTH)
+            )
+            await asyncio.sleep(0.05)  # queue genuinely full
+            # a HIT passes the door (serving it costs no slot) ...
+            r = await client.get(self._url(0, 0), headers=AUTH)
+            assert r.status == 200
+            assert r.headers.get("X-Cache") == "hit"
+            # ... while a miss that would shed still 503s at the door
+            r = await client.get(self._url(32, 32), headers=AUTH)
+            assert r.status == 503
+            gate.set()
+            r0, r1 = await asyncio.gather(occ, waiter)
+            assert (r0.status, r1.status) == (200, 200)
+        finally:
+            gate.set()
+            await client.close()
+
+    async def test_door_exempts_disk_tier_hits(self, tmp_path, loop):
+        """An entry that aged out of RAM onto the disk tier serves
+        without a scheduler slot exactly like a RAM hit — the door's
+        hit exemption must consult the spill index too, or overload
+        sheds precisely the cheap traffic the gate exists to keep."""
+        gate = threading.Event()
+        app_obj, client = await _make_app(
+            tmp_path,
+            resilience={"admission": {"max-inflight": 1}},
+            slo={"queue-size": 1, "degrade": False},
+            workers=2,
+            config_extra={"cache": {
+                "enabled": True,
+                "disk-dir": str(tmp_path / "spill"),
+                "prefetch": {"enabled": False},
+            }},
+        )
+        try:
+            r = await client.get(self._url(0, 0), headers=AUTH)
+            assert r.status == 200  # fills the RAM tier, uncontended
+            cache = app_obj.result_cache
+            # demote the entry to the disk tier: in the spill index,
+            # out of RAM — the shape an entry has under memory
+            # pressure once the LRU pushed it down
+            with cache.memory._lock:
+                key, entry = next(iter(
+                    list(cache.memory._protected.items())
+                    + list(cache.memory._probation.items())
+                ))
+            cache.disk.put(key, entry)
+            cache.memory.remove(key)
+            assert not cache.contains(key)
+            assert cache.contains_any_tier(key)
+            inner = app_obj.pipeline.handle
+
+            def gated(ctx):
+                gate.wait(10.0)
+                return inner(ctx)
+
+            app_obj.pipeline.handle = gated
+            occ = asyncio.ensure_future(
+                client.get(self._url(32, 0), headers=AUTH)
+            )
+            await asyncio.sleep(0.1)
+            waiter = asyncio.ensure_future(
+                client.get(self._url(0, 32), headers=AUTH)
+            )
+            await asyncio.sleep(0.05)  # queue genuinely full
+            # the disk-resident tile passes the door and serves
+            r = await client.get(self._url(0, 0), headers=AUTH)
+            assert r.status == 200
+            assert r.headers.get("X-Cache") == "hit"
+            # ... while a genuine miss still 503s at the door
+            r = await client.get(self._url(32, 32), headers=AUTH)
+            assert r.status == 503
+            gate.set()
+            r0, r1 = await asyncio.gather(occ, waiter)
+            assert (r0.status, r1.status) == (200, 200)
+        finally:
+            gate.set()
+            await client.close()
+
+
+@pytest.mark.resilience
+class TestSweepDemotionHttp:
+    async def test_sweeping_session_classified_bulk(
+        self, tmp_path, loop
+    ):
+        app_obj, client = await _make_app(
+            tmp_path, slo={"sweep-window": 4},
+        )
+        try:
+            for i in range(6):  # a constant-stride robot walk (the
+                # detector observes BEFORE serving, so the off-image
+                # tail 404s still count as accesses)
+                r = await client.get(
+                    f"/tile/1/0/0/0?format=png&w=16&h=16&x={i * 16}"
+                    "&y=0", headers=AUTH,
+                )
+                assert r.status in (200, 404)
+            # enough constant-stride steps: the NEXT request is bulk
+            await client.get(
+                "/tile/1/0/0/0?format=png&w=16&h=16&x=16&y=16",
+                headers=AUTH,
+            )
+            snap = app_obj.scheduler.snapshot()
+            assert snap["classified"]["bulk"] >= 1
+            det = app_obj.sweep_detector.snapshot()
+            assert det["bulk_sessions"] == 1
+        finally:
+            await client.close()
+
+    async def test_labeled_prefetch_never_demotes_session(
+        self, tmp_path, loop
+    ):
+        """A client honestly labeling its lookahead as prefetch runs
+        the canonical constant-stride sweep shape; learning from it
+        would demote the whole session and shed the same user's
+        interactive pans. Header-labeled traffic must not train the
+        detector."""
+        app_obj, client = await _make_app(
+            tmp_path, slo={"sweep-window": 4},
+        )
+        try:
+            for i in range(6):  # same walk, but self-labeled
+                r = await client.get(
+                    f"/tile/1/0/0/0?format=png&w=16&h=16&x={i * 16}"
+                    "&y=0",
+                    headers={**AUTH, "X-OMPB-Prefetch": "1"},
+                )
+                assert r.status in (200, 404)
+            # the user's own unlabeled pan stays interactive
+            r = await client.get(
+                "/tile/1/0/0/0?format=png&w=16&h=16&x=16&y=16",
+                headers=AUTH,
+            )
+            assert r.status == 200
+            snap = app_obj.scheduler.snapshot()
+            assert snap["classified"]["bulk"] == 0
+            assert snap["classified"]["prefetch"] >= 6
+            det = app_obj.sweep_detector.snapshot()
+            assert det["bulk_sessions"] == 0
+        finally:
+            await client.close()
+
+
+# ---------------------------------------------------------------------------
+# satellites: deferred trailing group, session overlap, healthz probes
+# ---------------------------------------------------------------------------
+
+
+class TestDeferredDeviceGroups:
+    """Satellite: device-encode groups resolve through the queue's
+    readback callback instead of draining inline in handle_batch."""
+
+    def _pipeline(self, tmp_path, **kw):
+        path = str(tmp_path / "img.zarr")
+        write_ngff(path, IMG, chunks=(32, 32))
+        registry = ImageRegistry()
+        registry.add(1, path, type="zarr")
+        svc = PixelsService(registry)
+        pipe = TilePipeline(
+            svc, engine="device", device_deflate=True,
+            device_deflate_mode="rle", buckets=(32,), **kw,
+        )
+        return svc, pipe
+
+    def _ctxs(self, n=2):
+        return [
+            TileCtx(
+                1, 0, 0, 0, RegionDef(x * 32, 0, 32, 32),
+                resolution=0, format="png",
+            )
+            for x in range(n)
+        ]
+
+    def test_defer_returns_placeholders_with_identical_bytes(
+        self, tmp_path
+    ):
+        svc, pipe = self._pipeline(tmp_path)
+        try:
+            inline = pipe.handle_batch(self._ctxs())
+            deferred = pipe.handle_batch(self._ctxs(), defer=True)
+            assert any(
+                isinstance(r, DeferredTile) for r in deferred
+            ), "device groups should defer"
+            resolved = [
+                r.future.result(timeout=30.0)
+                if isinstance(r, DeferredTile) else r
+                for r in deferred
+            ]
+            assert resolved == inline  # byte-identical either way
+        finally:
+            pipe.close()
+            svc.close()
+
+    def test_close_resolves_deferred_futures(self, tmp_path):
+        svc, pipe = self._pipeline(tmp_path)
+        try:
+            deferred = pipe.handle_batch(self._ctxs(), defer=True)
+            futs = [
+                r.future for r in deferred
+                if isinstance(r, DeferredTile)
+            ]
+            pipe.close()  # drains the queue: every future resolves
+            for f in futs:
+                assert f.result(timeout=5.0) is not None
+        finally:
+            pipe.close()
+            svc.close()
+
+    async def test_batcher_chains_deferred_lanes(self, loop):
+        """The dispatch layer: a DeferredTile lane's HTTP future
+        resolves from the group callback — the executor batch (and
+        the worker slot) completes without it."""
+        from omero_ms_pixel_buffer_tpu.dispatch.batcher import (
+            BatchingTileWorker,
+        )
+
+        group_fut: concurrent.futures.Future = concurrent.futures.Future()
+        batches = []
+
+        class FakePipeline:
+            def handle(self, ctx):
+                return b"inline"
+
+            def handle_batch(self, ctxs, defer=False):
+                batches.append((len(ctxs), defer))
+                if len(batches) == 1:
+                    assert defer
+                    return [DeferredTile(group_fut)] * len(ctxs)
+                return [b"second"] * len(ctxs)
+
+        class Validator:
+            async def validate(self, key):
+                return True
+
+        worker = BatchingTileWorker(
+            FakePipeline(), Validator(), max_batch=4,
+            coalesce_window_ms=5.0, workers=2,
+        )
+        await worker.start()
+        try:
+            c1 = TileCtx(1, 0, 0, 0, RegionDef(0, 0, 32, 32),
+                         format="png", omero_session_key="k")
+            c2 = TileCtx(1, 0, 0, 0, RegionDef(32, 0, 32, 32),
+                         format="png", omero_session_key="k")
+            t1 = asyncio.ensure_future(worker.handle(c1))
+            t2 = asyncio.ensure_future(worker.handle(c2))
+            await asyncio.sleep(0.1)
+            assert not t1.done() and not t2.done()
+            # the worker is FREE while the group is in flight: a new
+            # batch executes to completion
+            c3 = TileCtx(2, 0, 0, 0, RegionDef(0, 0, 32, 32),
+                         format="png", omero_session_key="k")
+            c4 = TileCtx(2, 0, 0, 0, RegionDef(32, 0, 32, 32),
+                         format="png", omero_session_key="k")
+            r3, r4 = await asyncio.gather(
+                worker.handle(c3), worker.handle(c4)
+            )
+            assert r3[0] == b"second" and r4[0] == b"second"
+            assert not t1.done()
+            group_fut.set_result(b"device-bytes")
+            r1, r2 = await asyncio.gather(t1, t2)
+            assert r1[0] == b"device-bytes"
+            assert r2[0] == b"device-bytes"
+        finally:
+            if not group_fut.done():
+                group_fut.set_result(None)
+            await worker.close()
+
+
+class TestSessionLookupOverlap:
+    """Satellite: `synchronicity: sync` no longer serializes — two
+    in-flight session checks overlap."""
+
+    async def test_lookups_overlap_under_sync_config(
+        self, tmp_path, loop
+    ):
+        class SlowStore(OmeroWebSessionStore):
+            def __init__(self):
+                self.concurrent = 0
+                self.max_concurrent = 0
+
+            async def get_omero_session_key(self, session_id):
+                self.concurrent += 1
+                self.max_concurrent = max(
+                    self.max_concurrent, self.concurrent
+                )
+                await asyncio.sleep(0.1)
+                self.concurrent -= 1
+                return "key"
+
+        store = SlowStore()
+        _, client = await _make_app(
+            tmp_path,
+            config_extra={
+                "session-store": {"type": "memory",
+                                  "synchronicity": "sync"},
+            },
+            session_store=store,
+        )
+        try:
+            url = "/tile/1/0/0/0?w=32&h=32&format=png"
+            rs = await asyncio.gather(
+                client.get(url, headers=AUTH),
+                client.get(url, headers=AUTH),
+            )
+            assert all(r.status == 200 for r in rs)
+            assert store.max_concurrent >= 2, (
+                "session lookups still serialized"
+            )
+        finally:
+            await client.close()
+
+
+class TestHealthzProbes:
+    """Satellite: opt-in active dependency pings on /healthz?probe=1."""
+
+    async def test_probe_pings_session_store(self, tmp_path, loop):
+        _, client = await _make_app(tmp_path)
+        try:
+            r = await client.get("/healthz")
+            body = await r.json()
+            assert "probes" not in body  # opt-in only
+            r = await client.get("/healthz?probe=1")
+            body = await r.json()
+            assert body["probes"]["session-store"] == "ok"
+        finally:
+            await client.close()
+
+    async def test_probe_falsy_values_ignored(self, tmp_path, loop):
+        """``?probe=0`` / ``?probe=false`` must not trigger a probe
+        round — only the documented truthy spellings opt in."""
+        _, client = await _make_app(tmp_path)
+        try:
+            for qs in ("probe=0", "probe=false", "probe=", "probe=no"):
+                r = await client.get(f"/healthz?{qs}")
+                body = await r.json()
+                assert "probes" not in body, qs
+        finally:
+            await client.close()
+
+    async def test_probe_reports_failure_without_failing(
+        self, tmp_path, loop
+    ):
+        class DeadStore(OmeroWebSessionStore):
+            async def get_omero_session_key(self, session_id):
+                raise ConnectionError("redis down")
+
+        app_obj, client = await _make_app(tmp_path)
+        try:
+            app_obj.session_store_probe_only = True
+            # swap the store the PROBE sees (requests keep the real
+            # middleware store wired at make_app time)
+            app_obj.session_store = DeadStore()
+            r = await client.get("/healthz?probe=1")
+            assert r.status == 200
+            body = await r.json()
+            assert "ConnectionError" in body["probes"]["session-store"]
+        finally:
+            await client.close()
+
+    async def test_probe_rounds_throttled(self, tmp_path, loop):
+        """/healthz is unauthenticated: ``?probe=1`` must not be an
+        amplification lever. Repeated calls inside the throttle
+        window share ONE probe round against the dependencies."""
+        class CountingStore(MemorySessionStore):
+            def __init__(self):
+                super().__init__({"ck": "key"})
+                self.probe_lookups = 0
+
+            async def get_omero_session_key(self, session_id):
+                if session_id == "__ompb_healthz_probe__":
+                    self.probe_lookups += 1
+                return await super().get_omero_session_key(session_id)
+
+        store = CountingStore()
+        _, client = await _make_app(tmp_path, session_store=store)
+        try:
+            for _ in range(3):
+                r = await client.get("/healthz?probe=1")
+                body = await r.json()
+                assert body["probes"]["session-store"] == "ok"
+            assert store.probe_lookups == 1
+        finally:
+            await client.close()
+
+    async def test_probe_pings_postgres_resolver(self, tmp_path, loop):
+        app_obj, client = await _make_app(tmp_path)
+        try:
+            class Resolver:
+                def __init__(self):
+                    self.queries = []
+
+                def query(self, sql, params):
+                    self.queries.append(sql)
+                    return [("1",)]
+
+            resolver = Resolver()
+            app_obj.pixels_service.metadata_resolver = resolver
+            r = await client.get("/healthz?probe=1")
+            body = await r.json()
+            assert body["probes"]["postgres"] == "ok"
+            assert resolver.queries == ["SELECT 1"]
+        finally:
+            await client.close()
+
+
+class TestPrefetcherSweepSuppression:
+    async def test_sweep_sessions_never_predict(self, loop):
+        from omero_ms_pixel_buffer_tpu.cache.prefetch import (
+            ViewportPrefetcher,
+        )
+
+        class Detector:
+            def is_sweep(self, session):
+                return session == "robot"
+
+        class Admission:
+            def has_headroom(self, fraction=0.5):
+                return True
+
+        fetched = []
+
+        async def fetch(ctx, key):
+            fetched.append(key)
+
+        pre = ViewportPrefetcher(
+            fetch, None, Admission(), sweep_detector=Detector(),
+        )
+        for i in range(4):
+            pre.observe(TileCtx(
+                1, 0, 0, 0, RegionDef(i * 64, 0, 64, 64),
+                omero_session_key="robot",
+            ))
+        assert pre.snapshot()["suppressed_sweep"] == 4
+        assert pre.snapshot()["enqueued"] == 0
+        # a human session on the same prefetcher still predicts
+        for i in range(3):
+            pre.observe(TileCtx(
+                1, 0, 0, 0, RegionDef(i * 64, 0, 64, 64),
+                omero_session_key="human",
+            ))
+        assert pre.snapshot()["enqueued"] > 0

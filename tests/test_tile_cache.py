@@ -285,6 +285,36 @@ class TestTieredCache:
         finally:
             cache.close()
 
+    async def test_contains_any_tier_sees_disk_index(self, tmp_path):
+        """The overload door gate's probe: disk-resident entries are
+        visible (index peek, no file I/O), memory-only ``contains``
+        stays blind to them, and TTL applies to both tiers."""
+        cache = TileResultCache(
+            memory_bytes=250, disk_dir=str(tmp_path / "spill"),
+            disk_bytes=1 << 20, ttl_s=30.0,
+        )
+        try:
+            await cache.put("img=1|a", _entry(b"a" * 100))
+            await cache.put("img=1|b", _entry(b"b" * 100))
+            await cache.put("img=1|c", _entry(b"c" * 100))  # evicts a
+            for _ in range(50):
+                if len(cache.disk):
+                    break
+                await asyncio.sleep(0.01)
+            assert not cache.contains("img=1|a")  # RAM-only probe
+            assert cache.contains_any_tier("img=1|a")
+            assert not cache.contains_any_tier("img=1|zz")
+            # a TTL-expired disk entry would miss at get-time: the
+            # probe must not pass it through the door either
+            with cache.disk._lock:
+                path, nb, etag, fn, _ = cache.disk._index["img=1|a"]
+                cache.disk._index["img=1|a"] = (
+                    path, nb, etag, fn, time.monotonic() - 60.0,
+                )
+            assert not cache.contains_any_tier("img=1|a")
+        finally:
+            cache.close()
+
     async def test_invalidate_image_purges_both_tiers(self, tmp_path):
         cache = TileResultCache(
             memory_bytes=1 << 20, disk_dir=str(tmp_path / "spill"),
