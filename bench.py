@@ -803,6 +803,183 @@ def bench_overload(
     return asyncio.run(run())
 
 
+def bench_io(cache_dir: str) -> dict:
+    """Cold-remote read plane (r14): a loopback HTTP object store with
+    per-request latency serving a multi-chunk NGFF image (16 chunks
+    per 256px tile) both unsharded and Zarr-v3-sharded.
+
+    Pins (io_ok_*): batch dedupe + range coalescing spend < 1.0 store
+    requests per tile on the sharded fixture (sequential was >= 16);
+    the parallel+coalesced plane is >= 2x the sequential path's
+    tiles/s on identical inputs; and sharded tile bytes are identical
+    to the unsharded ground truth."""
+    import functools
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from omero_ms_pixel_buffer_tpu.io import fetch
+    from omero_ms_pixel_buffer_tpu.io.zarr import (
+        ZarrPixelBuffer,
+        write_ngff,
+    )
+
+    rng = np.random.default_rng(23)
+    img = rng.integers(0, 60000, (1, 1, 1, 1024, 1024), dtype=np.uint16)
+    plain = os.path.join(cache_dir, "io_plain.zarr")
+    sharded = os.path.join(cache_dir, "io_sharded.zarr")
+    if not os.path.exists(plain):
+        write_ngff(plain, img, chunks=(64, 64), levels=1,
+                   zarr_format=3, compressor="zlib")
+    if not os.path.exists(sharded):
+        write_ngff(sharded, img, chunks=(64, 64), levels=1,
+                   zarr_format=3, compressor="zlib", shards=(512, 512))
+
+    class Handler(BaseHTTPRequestHandler):
+        """Range-capable static handler with a 2 ms per-request floor
+        — the round-trip a remote object store charges."""
+
+        protocol_version = "HTTP/1.1"
+        counts = {"n": 0}
+        lock = threading.Lock()
+
+        def __init__(self, root, *args, **kwargs):
+            self.root = root
+            super().__init__(*args, **kwargs)
+
+        def log_message(self, *a):
+            pass
+
+        def _reply(self, code, body=b""):
+            self.send_response(code)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            import urllib.parse
+
+            with self.lock:
+                self.counts["n"] += 1
+            time.sleep(0.002)
+            rel = urllib.parse.unquote(self.path.lstrip("/"))
+            path = os.path.join(self.root, rel)
+            if ".." in rel or not os.path.isfile(path):
+                return self._reply(404)
+            with open(path, "rb") as f:
+                data = f.read()
+            rng_h = self.headers.get("Range")
+            if rng_h is None:
+                return self._reply(200, data)
+            spec = rng_h.split("=", 1)[1]
+            if spec.startswith("-"):
+                n = int(spec[1:])
+                body = data[-n:] if n <= len(data) else data
+                self.send_response(206)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            lo_s, _, hi_s = spec.partition("-")
+            lo = int(lo_s)
+            if lo >= len(data):
+                return self._reply(416)
+            hi = int(hi_s) + 1 if hi_s else len(data)
+            body = data[lo:min(hi, len(data))]
+            self.send_response(206)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    server = ThreadingHTTPServer(
+        ("127.0.0.1", 0), functools.partial(Handler, cache_dir)
+    )
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+
+    tiles16 = [
+        (0, 0, 0, x * 256, y * 256, 256, 256)
+        for y in range(4) for x in range(4)
+    ]
+    ground = ZarrPixelBuffer(plain).read_tiles(tiles16, level=0)
+
+    out: dict = {"fixture": {
+        "plane": "1024x1024 uint16", "chunks": 64, "shards": 512,
+        "tile": 256, "chunks_per_tile": 16,
+    }}
+    try:
+        # -- sequential escape path, cold (the pre-r14 shape) ----------
+        fetch.CONFIG.parallel = False
+        Handler.counts["n"] = 0
+        buf = ZarrPixelBuffer(f"{base}/io_sharded.zarr")
+        meta_reqs = Handler.counts["n"]
+        t0 = time.perf_counter()
+        seq_tiles = []
+        for i in range(0, 16, 8):
+            seq_tiles += buf.read_tiles(tiles16[i:i + 8], level=0)
+        seq_s = time.perf_counter() - t0
+        seq_reqs = Handler.counts["n"] - meta_reqs
+        out["sequential"] = {
+            "tiles_per_sec": round(16 / seq_s, 2),
+            "requests_per_tile": round(seq_reqs / 16, 2),
+        }
+
+        # -- parallel + coalesced, cold --------------------------------
+        fetch.CONFIG.parallel = True
+        stats0 = fetch.IO_STATS.snapshot()
+        Handler.counts["n"] = 0
+        buf = ZarrPixelBuffer(f"{base}/io_sharded.zarr")
+        meta_reqs = Handler.counts["n"]
+        t0 = time.perf_counter()
+        par_tiles = []
+        for i in range(0, 16, 8):
+            par_tiles += buf.read_tiles(tiles16[i:i + 8], level=0)
+        par_s = time.perf_counter() - t0
+        par_reqs = Handler.counts["n"] - meta_reqs
+        stats1 = fetch.IO_STATS.snapshot()
+        planned = stats1["planned"] - stats0["planned"]
+        saved = stats1["coalesced_saved"] - stats0["coalesced_saved"]
+
+        # per-tile fetch latency distribution: 16 cold single-tile
+        # reads on a fresh buffer (each is one planned batch)
+        lat_ms = []
+        buf = ZarrPixelBuffer(f"{base}/io_sharded.zarr")
+        for co in tiles16:
+            t0 = time.perf_counter()
+            buf.read_tiles([co], level=0)
+            lat_ms.append((time.perf_counter() - t0) * 1000.0)
+        lat = np.array(sorted(lat_ms))
+
+        out["parallel"] = {
+            "tiles_per_sec": round(16 / par_s, 2),
+            "requests_per_tile": round(par_reqs / 16, 3),
+            "coalesced_ratio": (
+                round(saved / planned, 3) if planned else 0.0
+            ),
+            "fetch_p50_ms": round(float(np.percentile(lat, 50)), 2),
+            "fetch_p99_ms": round(float(np.percentile(lat, 99)), 2),
+        }
+        out["speedup_parallel_vs_sequential"] = round(seq_s / par_s, 2)
+        identical = all(
+            a.tobytes() == b.tobytes()
+            for a, b in zip(ground, par_tiles)
+        ) and all(
+            a.tobytes() == b.tobytes()
+            for a, b in zip(ground, seq_tiles)
+        )
+        # the three acceptance pins — explicit booleans in BENCH json
+        out["io_ok_requests_per_tile"] = (
+            out["parallel"]["requests_per_tile"] < 1.0
+        )
+        out["io_ok_parallel_speedup"] = (
+            out["speedup_parallel_vs_sequential"] >= 2.0
+        )
+        out["io_ok_sharded_identical"] = identical
+    finally:
+        fetch.CONFIG.parallel = True
+        server.shutdown()
+    return out
+
+
 def build_render_fixture(root: str, size: int = 2048):
     """3-channel uint16 fixture for the rendered-tile section."""
     from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
@@ -1177,6 +1354,18 @@ def main():
             overload_stats = {"error": f"{type(e).__name__}: {e}"}
             log(f"overload bench failed: {e!r}")
 
+    # --- batched read plane (r14): cold remote reads over a loopback
+    # HTTP object store — sequential vs parallel+coalesced, sharded
+    # byte identity, requests-per-tile (io_ok_* pins)
+    io_stats: dict = {}
+    if os.environ.get("BENCH_IO", "1") != "0":
+        try:
+            io_stats = bench_io(cache_dir)
+            log(f"io read plane: {io_stats}")
+        except Exception as e:
+            io_stats = {"error": f"{type(e).__name__}: {e}"}
+            log(f"io bench failed: {e!r}")
+
     # --- rendered-tile serving (render/): host vs headline engine ----
     render_stats: dict = {}
     if os.environ.get("BENCH_RENDER", "1") != "0":
@@ -1219,6 +1408,8 @@ def main():
         record["cache_plane"] = plane_stats
     if overload_stats:
         record["overload"] = overload_stats
+    if io_stats:
+        record["io"] = io_stats
     if render_stats:
         record["render"] = render_stats
     if device_stats:
@@ -1249,6 +1440,19 @@ def main():
         comparison["device_stage_breakdown"] = micro["stage_breakdown"]
     if "queue" in device_stats:
         comparison["device_queue"] = device_stats["queue"]
+    if io_stats and "parallel" in io_stats:
+        comparison["io_cold_sequential_tiles_per_sec"] = (
+            io_stats["sequential"]["tiles_per_sec"]
+        )
+        comparison["io_cold_parallel_tiles_per_sec"] = (
+            io_stats["parallel"]["tiles_per_sec"]
+        )
+        comparison["io_requests_per_tile"] = (
+            io_stats["parallel"]["requests_per_tile"]
+        )
+        comparison["io_coalesced_ratio"] = (
+            io_stats["parallel"]["coalesced_ratio"]
+        )
     if overload_stats and "interactive" in overload_stats:
         comparison["slo_interactive_p99_ms"] = (
             overload_stats["interactive"]["p99_ms"]

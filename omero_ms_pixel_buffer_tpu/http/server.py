@@ -51,6 +51,8 @@ from ..errors import (
 )
 from ..io.pixels_service import ImageRegistry, PixelsService
 from ..models.tile_pipeline import TilePipeline
+from ..io.fetch import configure as configure_fetch
+from ..io.fetch import io_snapshot
 from ..resilience import AdmissionController, Deadline
 from ..resilience import configure as configure_resilience
 from ..resilience.breaker import BOARD
@@ -291,6 +293,10 @@ class PixelBufferApp:
         # resilience policy FIRST: breakers minted by the stores /
         # clients below pick up the configured thresholds
         configure_resilience(config.resilience)
+        # the batched read plane (io/fetch): pool bounds, coalescing
+        # gap, decode pool, negative-chunk TTL — before any store is
+        # constructed so the first cold read already runs configured
+        configure_fetch(config.io)
         self.admission = AdmissionController(
             max_inflight=config.resilience.admission.max_inflight,
             retry_after_s=config.resilience.admission.retry_after_s,
@@ -709,6 +715,7 @@ class PixelBufferApp:
             "prefetch": prefetch_health,
             "render": render_health,
             "device_queue": device_queue,
+            "io": io_snapshot(),
             "request_budget_ms": self.request_budget_s * 1000.0,
         }
         if request.query.get("probe", "").strip().lower() in (

@@ -1,0 +1,679 @@
+"""The batched read plane: shared connection pool, ranged GETs, and
+the coalescing parallel fetch planner.
+
+Before r14 every chunk read was one blocking whole-key ``store.get``
+issued strictly sequentially — a cold remote-NGFF tile overlapping k
+chunks paid k round-trips in series, and each worker thread grew its
+own keep-alive socket per host (``_KeepAlive`` was thread-local, so
+sockets multiplied with the worker pool). This module replaces that
+with:
+
+- ``FetchPool`` — ONE process-wide keep-alive pool, bounded per
+  (scheme, host) by ``io.max-conns-per-host``: workers share sockets
+  instead of multiplying them, and the bound is the per-host
+  concurrency ceiling for the parallel fan-out.
+- ``resilient_get`` — the breaker + jittered-retry + fault-point
+  wrapper every store GET (whole-key or ranged) runs under; moved
+  here from io/stores so the pool and the stores share one policy.
+- ``fetch_many`` — the planner: dedupe identical requests, coalesce
+  adjacent ranges on the same key within ``io.coalesce-gap-kb`` into
+  one ranged GET (sliced back apart afterwards), fan the planned
+  requests out on a bounded shared executor, and degrade any failed
+  planned request to a single whole-key GET (``StoreUnavailableError``
+  — an OPEN breaker — never falls back: that would hammer a dependency
+  the breaker just took out of rotation).
+
+Fault points: ``io.fetch-pool`` fires on every pooled exchange,
+``io.range-get`` on every ranged GET (io/stores wires it); chaos lanes
+in tests/test_io_fetch.py pin fault -> single-key fallback, dead store
+-> breaker, hung fetch -> timeout. The sequential pre-r14 path
+survives as the ``io.parallel-fetch: false`` config escape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import concurrent.futures
+import http.client
+import threading
+import time
+import urllib.parse
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..resilience.breaker import (
+    NULL_BREAKER,
+    BreakerOpenError,
+)
+from ..resilience.deadline import DeadlineExceeded, current_deadline
+from ..resilience.faultinject import INJECTOR
+from ..resilience.retry import retry_call
+from ..utils.metrics import REGISTRY
+
+_RETRY_STATUSES = (500, 502, 503, 504)
+
+IO_FETCH_SECONDS = REGISTRY.histogram(
+    "io_fetch_seconds",
+    "Wall time of one planned batch fetch (get_many call)",
+)
+IO_REQUESTS_PER_TILE = REGISTRY.histogram(
+    "io_requests_per_tile",
+    "Store requests issued per tile in a batched read",
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0),
+)
+
+
+class StoreError(IOError):
+    """Store-level failure that is NOT a missing key (auth, transport,
+    5xx) — callers must not treat it as fill_value."""
+
+
+class StoreUnavailableError(StoreError):
+    """The store's circuit breaker is open: the dependency is known
+    sick and the GET was rejected without touching the network.
+    Subclasses StoreError so existing handling (lane -> 404, never
+    fill_value) applies; ``retry_after_s`` says when the next
+    half-open probe will be admitted."""
+
+    def __init__(self, message: str, retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class _TransientStatus(Exception):
+    """Internal retry-loop carrier for retryable HTTP statuses (5xx):
+    statuses are answers, not exceptions, but the shared retry helper
+    speaks exceptions."""
+
+    def __init__(self, status: int, body: bytes):
+        super().__init__(f"HTTP {status}")
+        self.status = status
+        self.body = body
+
+
+# ---------------------------------------------------------------------------
+# configuration (the io: block, utils/config.py; applied at startup)
+# ---------------------------------------------------------------------------
+
+
+class _FetchConfig:
+    """Process-wide read-plane knobs with the conf defaults; the lock
+    guards reconfiguration against in-flight planners."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.parallel = True
+        self.fetch_workers = 16
+        self.max_conns_per_host = 8
+        self.coalesce_gap_bytes = 64 << 10
+        self.decode_workers = 4
+        self.negative_ttl_s = 300.0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "parallel": self.parallel,
+                "fetch_workers": self.fetch_workers,
+                "max_conns_per_host": self.max_conns_per_host,
+                "coalesce_gap_kb": self.coalesce_gap_bytes >> 10,
+                "decode_workers": self.decode_workers,
+                "negative_ttl_s": self.negative_ttl_s,
+            }
+
+
+CONFIG = _FetchConfig()
+
+# one coalesced request never grows past this span (gap bytes are
+# fetched and discarded, so an unbounded merge could turn two small
+# reads into one enormous one)
+_MAX_COALESCED_BYTES = 32 << 20
+
+
+def configure(io_config) -> None:
+    """Apply the validated ``io:`` config block (utils/config.IoConfig)
+    process-wide; the server calls this at startup, tests directly."""
+    from .pixel_buffer import set_negative_ttl
+
+    with CONFIG._lock:
+        CONFIG.parallel = bool(io_config.parallel_fetch)
+        CONFIG.fetch_workers = int(io_config.fetch_workers)
+        CONFIG.max_conns_per_host = int(io_config.max_conns_per_host)
+        CONFIG.coalesce_gap_bytes = int(io_config.coalesce_gap_kb * 1024)
+        CONFIG.decode_workers = int(io_config.decode_workers)
+        CONFIG.negative_ttl_s = float(io_config.negative_ttl_s)
+    set_negative_ttl(CONFIG.negative_ttl_s)
+    POOL.set_max_per_host(CONFIG.max_conns_per_host)
+
+
+def parallel_enabled() -> bool:
+    return CONFIG.parallel
+
+
+# ---------------------------------------------------------------------------
+# stats
+# ---------------------------------------------------------------------------
+
+
+class FetchStats:
+    """Thread-safe counters for the read plane. One process-wide
+    instance (``IO_STATS``, the /healthz ``io`` snapshot) plus
+    per-call instances so ``read_tiles`` can compute requests-per-tile
+    for ITS batch without racing concurrent batches."""
+
+    __slots__ = (
+        "_lock", "planned", "issued", "ranged", "coalesced_saved",
+        "bytes_fetched", "bytes_discarded", "fallbacks", "batches",
+    )
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.planned = 0          # logical (pre-coalesce) requests
+        self.issued = 0           # store requests actually issued
+        self.ranged = 0           # of those, ranged GETs
+        self.coalesced_saved = 0  # requests avoided by range merging
+        self.bytes_fetched = 0
+        self.bytes_discarded = 0  # coalescing gap bytes thrown away
+        self.fallbacks = 0        # planned requests degraded to get()
+        self.batches = 0          # fetch_many calls
+
+    def add(self, **deltas) -> None:
+        with self._lock:
+            for k, v in deltas.items():
+                setattr(self, k, getattr(self, k) + v)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            planned = self.planned
+            saved = self.coalesced_saved
+            return {
+                "planned": planned,
+                "issued": self.issued,
+                "ranged": self.ranged,
+                "coalesced_saved": saved,
+                "coalesced_ratio": (
+                    round(saved / planned, 4) if planned else 0.0
+                ),
+                "bytes_fetched": self.bytes_fetched,
+                "bytes_discarded": self.bytes_discarded,
+                "fallbacks": self.fallbacks,
+                "batches": self.batches,
+            }
+
+
+IO_STATS = FetchStats()
+
+REGISTRY.gauge_fn(
+    "io_coalesced_ratio",
+    "Fraction of planned store requests avoided by range coalescing",
+    lambda: IO_STATS.snapshot()["coalesced_ratio"],
+)
+
+
+def io_snapshot() -> dict:
+    """The /healthz ``io`` key: read-plane counters + pool state."""
+    snap = IO_STATS.snapshot()
+    snap["pool"] = POOL.snapshot()
+    snap["config"] = CONFIG.snapshot()
+    return snap
+
+
+# ---------------------------------------------------------------------------
+# the shared keep-alive pool
+# ---------------------------------------------------------------------------
+
+
+class FetchPool:
+    """Bounded shared per-host HTTP(S) connection pool.
+
+    Replaces the thread-local ``_KeepAlive`` (one idle socket per host
+    PER WORKER THREAD — sockets multiplied with the pool size) with
+    one process-wide pool: at most ``max_per_host`` connections per
+    (scheme, netloc) exist at once, idle ones are reused by whichever
+    thread fetches next, and the per-host semaphore is what bounds the
+    parallel fan-out's concurrency against a single origin. One retry
+    on a stale reused socket (server closed it while idle), exactly
+    the ``_KeepAlive`` contract."""
+
+    def __init__(self, max_per_host: int = 8):
+        self._lock = threading.Lock()
+        self._max_per_host = max_per_host
+        self._idle: Dict[Tuple[str, str], list] = {}
+        self._sems: Dict[Tuple[str, str], threading.BoundedSemaphore] = {}
+        self._in_use: Dict[Tuple[str, str], int] = {}
+
+    def set_max_per_host(self, n: int) -> None:
+        """Reconfigure the per-host bound; existing hosts' semaphores
+        are rebuilt only when no connection is checked out (startup
+        reconfiguration — the serving path never resizes)."""
+        with self._lock:
+            self._max_per_host = max(1, int(n))
+            for key in list(self._sems):
+                if not self._in_use.get(key):
+                    self._sems.pop(key)
+                    for conn in self._idle.pop(key, []):
+                        conn.close()
+
+    def _sem(self, key) -> threading.BoundedSemaphore:
+        with self._lock:
+            sem = self._sems.get(key)
+            if sem is None:
+                sem = self._sems[key] = threading.BoundedSemaphore(
+                    self._max_per_host
+                )
+            return sem
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "max_per_host": self._max_per_host,
+                "hosts": {
+                    f"{scheme}://{netloc}": {
+                        "idle": len(self._idle.get((scheme, netloc), [])),
+                        "in_use": self._in_use.get((scheme, netloc), 0),
+                    }
+                    for scheme, netloc in self._sems
+                },
+            }
+
+    def request(
+        self,
+        url: str,
+        headers: dict,
+        timeout_s: float,
+        breaker=NULL_BREAKER,
+    ) -> Tuple[int, bytes]:
+        """One GET over a pooled connection: (status, body). The
+        ``breaker`` gate is for direct callers; the store paths pass
+        ``NULL_BREAKER`` because ``resilient_get`` already gated (a
+        second ``allow()`` would double-count half-open probes)."""
+        breaker.allow()
+        INJECTOR.fire("io.fetch-pool")
+        parsed = urllib.parse.urlsplit(url)
+        key = (parsed.scheme, parsed.netloc)
+        path = parsed.path or "/"
+        if parsed.query:
+            path += f"?{parsed.query}"
+        sem = self._sem(key)
+        if not sem.acquire(timeout=timeout_s):
+            raise StoreError(
+                f"fetch pool exhausted for {parsed.netloc} "
+                f"(waited {timeout_s:.1f}s for a connection)"
+            )
+        try:
+            for attempt in (0, 1):
+                with self._lock:
+                    idle = self._idle.get(key)
+                    conn = idle.pop() if idle else None
+                    self._in_use[key] = self._in_use.get(key, 0) + 1
+                reused = conn is not None
+                if conn is None:
+                    cls = (
+                        http.client.HTTPSConnection
+                        if parsed.scheme == "https"
+                        else http.client.HTTPConnection
+                    )
+                    conn = cls(parsed.netloc, timeout=timeout_s)
+                try:
+                    conn.request("GET", path, headers=headers)
+                    resp = conn.getresponse()
+                    body = resp.read()  # drain so the socket is reusable
+                except (http.client.HTTPException, OSError) as e:
+                    conn.close()
+                    with self._lock:
+                        self._in_use[key] -= 1
+                    # retry ONLY a reused socket the server closed
+                    # while idle; a fresh-connection failure is a real
+                    # outage and belongs to the caller's retry policy
+                    if reused and attempt == 0:
+                        continue
+                    raise StoreError(f"GET {url} failed: {e}") from None
+                with self._lock:
+                    self._in_use[key] -= 1
+                    idle = self._idle.setdefault(key, [])
+                    if len(idle) < self._max_per_host:
+                        idle.append(conn)
+                    else:
+                        conn.close()
+                return resp.status, body
+            raise StoreError(f"GET {url} failed")  # pragma: no cover
+        finally:
+            sem.release()
+
+
+POOL = FetchPool()
+
+
+# ---------------------------------------------------------------------------
+# the resilience wrapper (moved from io/stores in r14 — the pool and
+# the stores share one policy)
+# ---------------------------------------------------------------------------
+
+
+def resilient_get(
+    fn, breaker=NULL_BREAKER, point: Optional[str] = None, name: str = "",
+) -> Tuple[int, bytes]:
+    """Run a GET closure under the resilience policy: the store's
+    circuit breaker gates the call (open -> fail fast, no network),
+    transient failures (5xx statuses and transport errors) retry with
+    jittered-exponential backoff under a retry budget AND the ambient
+    request deadline, and the outcome feeds the breaker. 4xx returns
+    immediately — it is an answer, not an outage."""
+    try:
+        breaker.allow()
+    except BreakerOpenError as e:
+        raise StoreUnavailableError(str(e), e.retry_after_s) from None
+
+    # duration of the LAST attempt, for the breaker's slow-call rule:
+    # per-attempt (not per-retry-sequence) so backoff sleeps don't
+    # count, but injected chaos latency — which models a slow
+    # dependency — does (t0 precedes the injection point)
+    last_attempt_s = [0.0]
+
+    def attempt() -> Tuple[int, bytes]:
+        t0 = time.monotonic()
+        try:
+            if point is not None:
+                INJECTOR.fire(point)
+            status, body = fn()
+        finally:
+            last_attempt_s[0] = time.monotonic() - t0
+        if status in _RETRY_STATUSES:
+            raise _TransientStatus(status, body)
+        return status, body
+
+    try:
+        status, body = retry_call(
+            attempt,
+            retryable=(StoreError, _TransientStatus),
+            name=name,
+        )
+    except _TransientStatus as e:
+        # retries exhausted on a 5xx: surface the status to the caller
+        # (it raises StoreError with context) but count the outage
+        breaker.record_failure()
+        return e.status, e.body
+    except (StoreError, OSError):
+        breaker.record_failure()
+        raise
+    breaker.record_success(duration_s=last_attempt_s[0])
+    return status, body
+
+
+# ---------------------------------------------------------------------------
+# range requests + the coalescing planner
+# ---------------------------------------------------------------------------
+
+
+def project_range(
+    body: bytes, start: int, length: Optional[int]
+) -> bytes:
+    """Project a FULL object body onto a byte range — the ONE
+    implementation behind every degradation that has the whole body
+    but owes a slice (200-instead-of-206 origins, whole-key
+    fallbacks). Negative ``start`` is a suffix (clamped to the body —
+    an absent prefix cannot be invented); ``length`` None reads to
+    the end."""
+    if start < 0:
+        return body[start:] if -start <= len(body) else body
+    end = None if length is None else start + length
+    return body[start:end]
+
+
+@dataclasses.dataclass(frozen=True)
+class RangeReq:
+    """One logical read: a whole key (``start=0, length=None``), a
+    byte range ``[start, start+length)``, or a suffix (``start < 0``:
+    the last ``-start`` bytes — how a shard index footer is read
+    without knowing the object's size)."""
+
+    key: str
+    start: int = 0
+    length: Optional[int] = None
+
+    @property
+    def whole(self) -> bool:
+        return self.start == 0 and self.length is None
+
+
+@dataclasses.dataclass
+class _Planned:
+    """One store request the planner will actually issue, covering
+    ``members`` (indices into the caller's request list). A coalesced
+    request spans [start, end) on one key and is sliced back apart."""
+
+    key: str
+    start: int
+    end: Optional[int]       # None -> whole key / open-ended
+    members: List[int]
+    suffix: bool = False
+    whole: bool = False
+    length_hint: Optional[int] = None  # suffix/open-ended length
+
+
+def _coalesce(
+    reqs: Sequence[RangeReq], order: List[int], gap: int
+) -> List[_Planned]:
+    """Group ``order`` (indices of same-key bounded range requests,
+    any order) into coalesced spans: sorted by start, merged while the
+    inter-range gap stays within ``gap`` and the span within
+    ``_MAX_COALESCED_BYTES``."""
+    order = sorted(order, key=lambda i: reqs[i].start)
+    plans: List[_Planned] = []
+    for i in order:
+        r = reqs[i]
+        end = r.start + r.length
+        cur = plans[-1] if plans else None
+        if (
+            cur is not None
+            and r.start - cur.end <= gap
+            and max(end, cur.end) - cur.start <= _MAX_COALESCED_BYTES
+        ):
+            cur.end = max(cur.end, end)
+            cur.members.append(i)
+        else:
+            plans.append(_Planned(r.key, r.start, end, [i]))
+    return plans
+
+
+_executor_lock = threading.Lock()
+_fetch_executor: Optional[concurrent.futures.ThreadPoolExecutor] = None
+_decode_executor: Optional[concurrent.futures.ThreadPoolExecutor] = None
+
+
+def _get_fetch_executor() -> concurrent.futures.ThreadPoolExecutor:
+    global _fetch_executor
+    with _executor_lock:
+        if _fetch_executor is None:
+            _fetch_executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=CONFIG.fetch_workers,
+                thread_name_prefix="io-fetch",
+            )
+        return _fetch_executor
+
+
+def _get_decode_executor() -> Optional[
+    concurrent.futures.ThreadPoolExecutor
+]:
+    global _decode_executor
+    with _executor_lock:
+        if CONFIG.decode_workers <= 0:
+            return None
+        if _decode_executor is None:
+            _decode_executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=CONFIG.decode_workers,
+                thread_name_prefix="io-decode",
+            )
+        return _decode_executor
+
+
+def map_parallel(fn: Callable, items: Sequence) -> List:
+    """Map ``fn`` over ``items`` on the bounded decode pool (parallel
+    chunk decode: zlib/blosc/zstd release the GIL); serial when the
+    pool is disabled or the batch is trivial. Exceptions propagate."""
+    if len(items) <= 1:
+        return [fn(it) for it in items]
+    pool = _get_decode_executor()
+    if pool is None:
+        return [fn(it) for it in items]
+    return list(pool.map(fn, items))
+
+
+def _deadline_remaining() -> Optional[float]:
+    deadline = current_deadline()
+    if deadline is None:
+        return None
+    remaining = deadline.remaining()
+    if remaining <= 0:
+        raise DeadlineExceeded("io.fetch")
+    return remaining
+
+
+def _run_planned(
+    store, plan: _Planned, stats: Optional[FetchStats] = None
+) -> Optional[bytes]:
+    """Execute one planned request; StoreError (but never an open
+    breaker) degrades to a single whole-key GET — the pre-r14 shape —
+    so a range-hostile or flaky origin costs performance, not
+    correctness. The fallback body is sliced to the planned span so
+    callers never see the degradation; the extra request and its
+    surplus bytes ARE counted (issued/fallbacks/bytes_discarded), so
+    requests-per-tile and the bench pins reflect what the origin
+    actually served."""
+    if plan.whole:
+        return store.get(plan.key)
+    try:
+        if plan.suffix:
+            return store.get_range(
+                plan.key, plan.start, plan.length_hint
+            )
+        return store.get_range(
+            plan.key, plan.start, plan.end - plan.start
+        )
+    except StoreUnavailableError:
+        raise  # open breaker: fail fast, never hammer with fallbacks
+    except StoreError:
+        body = store.get(plan.key)
+        sliced = None if body is None else project_range(
+            body, plan.start,
+            None if plan.end is None else plan.end - plan.start,
+        )
+        surplus = 0 if body is None else len(body) - len(sliced)
+        for s in (IO_STATS, stats) if stats is not None else (IO_STATS,):
+            s.add(
+                fallbacks=1, issued=1,
+                bytes_discarded=max(0, surplus),
+            )
+        return sliced
+
+
+def fetch_many(
+    store,
+    requests: Sequence[RangeReq],
+    stats: Optional[FetchStats] = None,
+) -> List[Optional[bytes]]:
+    """The batched read plane's planner: results align with
+    ``requests`` (``None`` where the key is absent).
+
+    dedupe -> coalesce adjacent ranges per key (gap threshold) ->
+    parallel fan-out on the shared executor (bounded by the per-host
+    pool) -> slice coalesced bodies back into per-request answers.
+    With ``io.parallel-fetch: false`` the planned requests still
+    dedupe/coalesce but execute sequentially in plan order."""
+    n = len(requests)
+    if n == 0:
+        return []
+    _deadline_remaining()  # spent budget: stop before any network
+    t0 = time.monotonic()
+    gap = CONFIG.coalesce_gap_bytes
+    ranged_ok = hasattr(store, "get_range")
+
+    # -- dedupe identical logical requests ------------------------------
+    first_of: Dict[RangeReq, int] = {}
+    alias: List[int] = [0] * n
+    uniq: List[RangeReq] = []
+    for i, r in enumerate(requests):
+        j = first_of.get(r)
+        if j is None:
+            first_of[r] = j = len(uniq)
+            uniq.append(r)
+        alias[i] = j
+
+    # -- plan ------------------------------------------------------------
+    plans: List[_Planned] = []
+    bounded_by_key: Dict[str, List[int]] = {}
+    for i, r in enumerate(uniq):
+        if r.whole or not ranged_ok:
+            plans.append(_Planned(r.key, 0, None, [i], whole=True))
+        elif r.start < 0 or r.length is None:
+            plans.append(_Planned(
+                r.key, r.start, None, [i], suffix=True,
+                length_hint=r.length,
+            ))
+        else:
+            bounded_by_key.setdefault(r.key, []).append(i)
+    n_bounded = sum(len(v) for v in bounded_by_key.values())
+    for key, order in bounded_by_key.items():
+        plans.extend(_coalesce(uniq, order, gap))
+    saved = n_bounded - sum(
+        1 for p in plans if not p.whole and not p.suffix
+    )
+
+    # -- execute ---------------------------------------------------------
+    bodies: List[Optional[bytes]] = [None] * len(plans)
+    if CONFIG.parallel and len(plans) > 1:
+        pool = _get_fetch_executor()
+        futures = {
+            pool.submit(_run_planned, store, p, stats): k
+            for k, p in enumerate(plans)
+        }
+        err: Optional[BaseException] = None
+        for fut, k in futures.items():
+            try:
+                bodies[k] = fut.result(timeout=_deadline_remaining())
+            except concurrent.futures.TimeoutError:
+                err = err or DeadlineExceeded("io.fetch")
+            except (StoreError, DeadlineExceeded) as e:
+                err = err or e
+        if err is not None:
+            raise err
+    else:
+        for k, p in enumerate(plans):
+            bodies[k] = _run_planned(store, p, stats)
+
+    # -- slice back into per-request answers -----------------------------
+    out: List[Optional[bytes]] = [None] * len(uniq)
+    nbytes = 0
+    discarded = 0
+    for p, body in zip(plans, bodies):
+        if body is None:
+            continue  # absent key: every member reads fill_value
+        nbytes += len(body)
+        if p.whole or p.suffix:
+            for i in p.members:
+                out[i] = _slice_for(uniq[i], body, whole=p.whole)
+        else:
+            used = 0
+            for i in p.members:
+                r = uniq[i]
+                lo = r.start - p.start
+                out[i] = body[lo:lo + r.length]
+                used += min(r.length, max(0, len(body) - lo))
+            discarded += max(0, len(body) - used)
+
+    ranged = sum(1 for p in plans if not p.whole)
+    for s in (IO_STATS, stats) if stats is not None else (IO_STATS,):
+        s.add(
+            planned=len(uniq), issued=len(plans), ranged=ranged,
+            coalesced_saved=max(0, saved), bytes_fetched=nbytes,
+            bytes_discarded=discarded, batches=1,
+        )
+    IO_FETCH_SECONDS.observe(time.monotonic() - t0)
+    return [out[alias[i]] for i in range(n)]
+
+
+def _slice_for(r: RangeReq, body: bytes, whole: bool) -> bytes:
+    """Project a whole-key (fallback) or suffix body onto one logical
+    request. A suffix plan's body IS the request's answer; a whole
+    body is sliced by the request's own coordinates."""
+    if not whole or r.whole:
+        return body
+    return project_range(body, r.start, r.length)

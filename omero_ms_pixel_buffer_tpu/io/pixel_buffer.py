@@ -22,6 +22,7 @@ import dataclasses
 import itertools
 import os
 import threading
+import time
 from collections import OrderedDict
 from typing import Any, Hashable, List, Optional, Sequence, Tuple
 
@@ -45,6 +46,49 @@ def default_block_cache_bytes() -> int:
     return int(os.environ.get("OMPB_BLOCK_CACHE_MB", "256")) << 20
 
 
+# -- negative entries (r14) -------------------------------------------
+# An absent chunk (Zarr fill_value) is a legitimate answer worth
+# remembering: without it a sparse plane re-issues one store GET per
+# absent chunk per batch. But "absent" can become "present" (a writer
+# backfills a chunk), so negatives are TTL-bounded — and they charge a
+# nominal size against the byte budget so an ocean of fill_value can
+# never grow the entry count unboundedly (a raw None is 0 bytes and
+# would be immortal under a byte-only bound).
+
+_NEGATIVE_ENTRY_BYTES = 64
+
+_negative_lock = threading.Lock()
+_negative_ttl_s = 300.0
+
+
+def set_negative_ttl(seconds: float) -> None:
+    """Process-wide TTL for cached negative (absent-chunk) entries;
+    0 disables expiry (config ``io.negative-ttl-s``)."""
+    global _negative_ttl_s
+    with _negative_lock:
+        _negative_ttl_s = float(seconds)
+
+
+def negative_ttl_s() -> float:
+    with _negative_lock:
+        return _negative_ttl_s
+
+
+class _Negative:
+    """Boxed cached absence with its expiry stamp."""
+
+    __slots__ = ("expires_at",)
+
+    def __init__(self, expires_at: Optional[float]):
+        self.expires_at = expires_at
+
+    def expired(self) -> bool:
+        return (
+            self.expires_at is not None
+            and time.monotonic() >= self.expires_at
+        )
+
+
 class BlockCache:
     """Byte-bounded, thread-safe LRU of decoded storage blocks.
 
@@ -53,8 +97,9 @@ class BlockCache:
     chunk is inflated once and every later tile that overlaps it — in
     this batch or any future request — assembles from the cached
     bytes. Values are numpy arrays or None (a legitimately absent
-    chunk, e.g. Zarr fill_value); both count toward the budget
-    (None as 0 bytes).
+    chunk, e.g. Zarr fill_value); negatives are TTL-bounded and carry
+    a nominal budget charge (see ``set_negative_ttl``), and
+    ``purge_ns`` drops a namespace's entries on invalidation.
     """
 
     def __init__(self, max_bytes: Optional[int] = None):
@@ -69,11 +114,24 @@ class BlockCache:
 
     @staticmethod
     def _size(value: Any) -> int:
-        return int(value.nbytes) if isinstance(value, np.ndarray) else 0
+        if isinstance(value, np.ndarray):
+            return int(value.nbytes)
+        if isinstance(value, _Negative):
+            return _NEGATIVE_ENTRY_BYTES
+        return 0
 
     def get(self, key: Hashable, default: Any = None) -> Any:
         with self._lock:
             value = self._entries.get(key, _MISSING)
+            if isinstance(value, _Negative):
+                if value.expired():
+                    # expired negative: a real miss — the chunk may
+                    # exist by now, re-ask the store
+                    self._entries.pop(key)
+                    self._bytes -= _NEGATIVE_ENTRY_BYTES
+                    value = _MISSING
+                else:
+                    value = None
             if value is _MISSING:
                 self.misses += 1
                 return default
@@ -84,6 +142,11 @@ class BlockCache:
     def __setitem__(self, key: Hashable, value: Any) -> None:
         if self.max_bytes <= 0:
             return
+        if value is None:
+            ttl = negative_ttl_s()
+            value = _Negative(
+                time.monotonic() + ttl if ttl > 0 else None
+            )
         size = self._size(value)
         if size > self.max_bytes:
             return  # a single oversized block would evict everything
@@ -96,6 +159,21 @@ class BlockCache:
             while self._bytes > self.max_bytes and self._entries:
                 _, evicted = self._entries.popitem(last=False)
                 self._bytes -= self._size(evicted)
+
+    def purge_ns(self, cache_ns) -> int:
+        """Drop every entry whose (tuple) key leads with ``cache_ns``
+        — the invalidation hook: a changed pixels row must take its
+        decoded blocks AND its cached negatives with it (a backfilled
+        chunk would otherwise read as fill_value until TTL)."""
+        dropped = 0
+        with self._lock:
+            for key in [
+                k for k in self._entries
+                if isinstance(k, tuple) and k and k[0] == cache_ns
+            ]:
+                self._bytes -= self._size(self._entries.pop(key))
+                dropped += 1
+        return dropped
 
     def __len__(self) -> int:
         with self._lock:

@@ -26,8 +26,16 @@ caller's bus budget); 4xx never retries. Each remote store carries a
 per-dependency circuit breaker: repeated failures open it and
 subsequent GETs fail fast with ``StoreUnavailableError`` until a
 half-open probe heals (resilience/breaker.py). Chaos tests inject
-faults at the ``store.http`` / ``store.s3`` points
-(resilience/faultinject.py).
+faults at the ``store.http`` / ``store.s3`` points (whole-key GETs),
+``io.range-get`` (ranged GETs), and ``io.fetch-pool`` (the shared
+connection pool) — resilience/faultinject.py.
+
+The batched read plane (r14, io/fetch.py): remote stores additionally
+speak ``get_range(key, start, length)`` (HTTP/S3 ranged GETs, SigV4-
+signed for S3) and ``get_many(requests)`` — deduplicated, range-
+coalesced, parallel fetch over one shared bounded per-host connection
+pool. A failed ranged request degrades to a single whole-key GET;
+``io.parallel-fetch: false`` restores the sequential path.
 
 ``make_store(uri)`` picks by scheme.
 """
@@ -38,26 +46,28 @@ import configparser
 import datetime
 import hashlib
 import hmac
-import http.client
 import os
-import threading
 import time
-import urllib.error
 import urllib.parse
-import urllib.request
-from typing import Optional, Tuple
+from typing import List, Optional, Sequence, Tuple
 
-from ..resilience.breaker import (
-    NULL_BREAKER,
-    BreakerOpenError,
-    for_dependency,
+from ..resilience.breaker import for_dependency
+from .fetch import (
+    POOL,
+    RangeReq,
+    FetchStats,
+    StoreError,
+    StoreUnavailableError,
+    fetch_many,
+    project_range,
+    resilient_get,
 )
-from ..resilience.faultinject import INJECTOR
-from ..resilience.retry import retry_call
+
+# the resilience wrapper moved to io/fetch in r14; the old name stays
+# importable (tests and the lint marker set know both spellings)
+_get_with_retry = resilient_get
 
 _EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
-
-_RETRY_STATUSES = (500, 502, 503, 504)
 
 
 def load_shared_credentials(
@@ -114,81 +124,20 @@ def load_shared_credentials(
     return access, secret, token, region
 
 
-class _KeepAlive:
-    """Thread-local persistent connections keyed by (scheme, netloc).
-
-    A tile overlapping k chunks issues k sequential GETs on the serving
-    hot path; per-request TCP+TLS handshakes (urllib has no keep-alive)
-    would dominate remote-NGFF latency. One retry on a stale
-    connection (server closed the idle socket)."""
-
-    def __init__(self):
-        self._local = threading.local()
-
-    def get(
-        self, url: str, headers: dict, timeout_s: float
-    ) -> Tuple[int, bytes]:
-        parsed = urllib.parse.urlsplit(url)
-        key = (parsed.scheme, parsed.netloc)
-        conns = getattr(self._local, "conns", None)
-        if conns is None:
-            conns = self._local.conns = {}
-        path = parsed.path or "/"
-        if parsed.query:
-            path += f"?{parsed.query}"
-        for attempt in (0, 1):
-            conn = conns.get(key)
-            reused = conn is not None
-            if conn is None:
-                cls = (
-                    http.client.HTTPSConnection
-                    if parsed.scheme == "https"
-                    else http.client.HTTPConnection
-                )
-                conn = cls(parsed.netloc, timeout=timeout_s)
-                conns[key] = conn
-            try:
-                conn.request("GET", path, headers=headers)
-                resp = conn.getresponse()
-                body = resp.read()  # drain so the socket is reusable
-                return resp.status, body
-            except (http.client.HTTPException, OSError) as e:
-                conn.close()
-                conns.pop(key, None)
-                # retry ONLY a reused socket the server closed while
-                # idle; a fresh-connection failure is a real outage
-                # and belongs to the caller's (bounded) retry policy
-                if not (reused and attempt == 0):
-                    raise StoreError(f"GET {url} failed: {e}") from None
-        raise StoreError(f"GET {url} failed")  # pragma: no cover
+def _range_header(start: int, length: Optional[int]) -> str:
+    """RFC 7233 byte-range spelling for ``[start, start+length)``;
+    negative ``start`` is a suffix range (the last ``-start`` bytes —
+    shard index footers are read this way, object size unknown)."""
+    if start < 0:
+        return f"bytes={start}"
+    if length is None:
+        return f"bytes={start}-"
+    return f"bytes={start}-{start + length - 1}"
 
 
-class StoreError(IOError):
-    """Store-level failure that is NOT a missing key (auth, transport,
-    5xx) — callers must not treat it as fill_value."""
-
-
-class StoreUnavailableError(StoreError):
-    """The store's circuit breaker is open: the dependency is known
-    sick and the GET was rejected without touching the network.
-    Subclasses StoreError so existing handling (lane -> 404, never
-    fill_value) applies; ``retry_after_s`` says when the next
-    half-open probe will be admitted."""
-
-    def __init__(self, message: str, retry_after_s: float = 0.0):
-        super().__init__(message)
-        self.retry_after_s = retry_after_s
-
-
-class _TransientStatus(Exception):
-    """Internal retry-loop carrier for retryable HTTP statuses (5xx):
-    statuses are answers, not exceptions, but the shared retry helper
-    speaks exceptions."""
-
-    def __init__(self, status: int, body: bytes):
-        super().__init__(f"HTTP {status}")
-        self.status = status
-        self.body = body
+# the shared full-body -> range projection (io/fetch.py owns the one
+# implementation; this alias keeps the store-local spelling)
+_project_range = project_range
 
 
 def validate_key(key: str) -> str:
@@ -221,24 +170,57 @@ class FileStore:
         except IsADirectoryError:
             return None
 
+    def get_range(
+        self, key: str, start: int, length: Optional[int] = None
+    ) -> Optional[bytes]:
+        """Byte range ``[start, start+length)``; negative ``start``
+        reads a suffix. A short object returns the bytes it has —
+        callers validate lengths (the zarr layer's strict index
+        checks)."""
+        path = os.path.join(self.root, validate_key(key))
+        try:
+            with open(path, "rb") as f:
+                if start < 0:
+                    f.seek(0, os.SEEK_END)
+                    size = f.tell()
+                    f.seek(max(0, size + start))
+                else:
+                    f.seek(start)
+                return f.read() if length is None else f.read(length)
+        except FileNotFoundError:
+            return None
+        except IsADirectoryError:
+            return None
+
+    def get_many(
+        self,
+        requests: Sequence[RangeReq],
+        stats: Optional[FetchStats] = None,
+    ) -> List[Optional[bytes]]:
+        return fetch_many(self, requests, stats=stats)
+
     def describe(self) -> str:
         return self.root
 
 
 class HTTPStore:
-    """Read-only store over HTTP(S) GETs with keep-alive."""
+    """Read-only store over HTTP(S) GETs through the shared keep-alive
+    pool (io/fetch.FetchPool); ranged GETs + batched reads via
+    ``get_range`` / ``get_many``."""
 
     def __init__(self, base_url: str, timeout_s: float = 30.0):
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
-        self._conns = _KeepAlive()
         netloc = urllib.parse.urlsplit(self.base_url).netloc
         self.breaker = for_dependency(f"store:http:{netloc}")
 
+    def _url(self, key: str) -> str:
+        return f"{self.base_url}/{urllib.parse.quote(validate_key(key))}"
+
     def get(self, key: str) -> Optional[bytes]:
-        url = f"{self.base_url}/{urllib.parse.quote(validate_key(key))}"
+        url = self._url(key)
         status, body = _get_with_retry(
-            lambda: self._conns.get(url, {}, self.timeout_s),
+            lambda: POOL.request(url, {}, self.timeout_s),
             breaker=self.breaker, point="store.http",
             name=self.base_url,
         )
@@ -248,58 +230,36 @@ class HTTPStore:
             return None
         raise StoreError(f"HTTP {status} for {url}")
 
+    def get_range(
+        self, key: str, start: int, length: Optional[int] = None
+    ) -> Optional[bytes]:
+        """One ranged GET. 206 answers the range; a 200 (origin
+        ignores Range) is sliced locally so callers never notice; 416
+        (unsatisfiable) is a store error, never fill_value."""
+        url = self._url(key)
+        headers = {"range": _range_header(start, length)}
+        status, body = _get_with_retry(
+            lambda: POOL.request(url, headers, self.timeout_s),
+            breaker=self.breaker, point="io.range-get",
+            name=self.base_url,
+        )
+        if status == 206:
+            return body
+        if status == 200:
+            return _project_range(body, start, length)
+        if status in (404, 410):
+            return None
+        raise StoreError(f"HTTP {status} for ranged {url}")
+
+    def get_many(
+        self,
+        requests: Sequence[RangeReq],
+        stats: Optional[FetchStats] = None,
+    ) -> List[Optional[bytes]]:
+        return fetch_many(self, requests, stats=stats)
+
     def describe(self) -> str:
         return self.base_url
-
-
-def _get_with_retry(
-    fn, breaker=NULL_BREAKER, point: Optional[str] = None, name: str = "",
-) -> Tuple[int, bytes]:
-    """Run a GET closure under the resilience policy: the store's
-    circuit breaker gates the call (open -> fail fast, no network),
-    transient failures (5xx statuses and transport errors) retry with
-    jittered-exponential backoff under a retry budget AND the ambient
-    request deadline, and the outcome feeds the breaker. 4xx returns
-    immediately — it is an answer, not an outage."""
-    try:
-        breaker.allow()
-    except BreakerOpenError as e:
-        raise StoreUnavailableError(str(e), e.retry_after_s) from None
-
-    # duration of the LAST attempt, for the breaker's slow-call rule:
-    # per-attempt (not per-retry-sequence) so backoff sleeps don't
-    # count, but injected chaos latency — which models a slow
-    # dependency — does (t0 precedes the injection point)
-    last_attempt_s = [0.0]
-
-    def attempt() -> Tuple[int, bytes]:
-        t0 = time.monotonic()
-        try:
-            if point is not None:
-                INJECTOR.fire(point)
-            status, body = fn()
-        finally:
-            last_attempt_s[0] = time.monotonic() - t0
-        if status in _RETRY_STATUSES:
-            raise _TransientStatus(status, body)
-        return status, body
-
-    try:
-        status, body = retry_call(
-            attempt,
-            retryable=(StoreError, _TransientStatus),
-            name=name,
-        )
-    except _TransientStatus as e:
-        # retries exhausted on a 5xx: surface the status to the caller
-        # (it raises StoreError with context) but count the outage
-        breaker.record_failure()
-        return e.status, e.body
-    except (StoreError, OSError):
-        breaker.record_failure()
-        raise
-    breaker.record_success(duration_s=last_attempt_s[0])
-    return status, body
 
 
 def _resolve_credentials(
@@ -361,10 +321,14 @@ def sigv4_headers(
     payload_sha256: str = _EMPTY_SHA256,
     now: Optional[datetime.datetime] = None,
     service: str = "s3",
+    extra_headers: Optional[dict] = None,
 ) -> dict:
     """AWS Signature Version 4 headers for a request with no query
     string. Exposed standalone so tests can verify signatures
-    server-side."""
+    server-side. ``extra_headers`` (e.g. ``range`` for a ranged GET)
+    are included in the signature — S3 accepts signed Range headers,
+    and signing everything we send keeps the canonical request
+    unambiguous."""
     now = now or datetime.datetime.now(datetime.timezone.utc)
     amz_date = now.strftime("%Y%m%dT%H%M%SZ")
     datestamp = now.strftime("%Y%m%d")
@@ -373,6 +337,10 @@ def sigv4_headers(
         "x-amz-content-sha256": payload_sha256,
         "x-amz-date": amz_date,
     }
+    if extra_headers:
+        headers.update(
+            {k.lower(): v for k, v in extra_headers.items()}
+        )
     if session_token:
         headers["x-amz-security-token"] = session_token
     signed = ";".join(sorted(headers))
@@ -464,7 +432,6 @@ class S3Store:
         self.treat_403_as_missing = (
             os.environ.get("OMPB_S3_403_AS_MISSING", "0") == "1"
         )
-        self._conns = _KeepAlive()
         self.breaker = for_dependency(f"store:s3:{self.bucket}")
 
     def _url_and_path(self, key: str) -> Tuple[str, str]:
@@ -516,20 +483,24 @@ class S3Store:
         return fresh
 
     def _signed_get(
-        self, key: str, creds: Optional[Tuple] = None
+        self,
+        key: str,
+        creds: Optional[Tuple] = None,
+        extra_headers: Optional[dict] = None,
+        point: str = "store.s3",
     ) -> Tuple[int, bytes]:
         url, canonical_path = self._url_and_path(key)
         access, secret, token = creds if creds is not None else self._creds
-        headers: dict = {}
+        headers: dict = dict(extra_headers or {})
         if access and secret:
             host = urllib.parse.urlparse(url).netloc
             headers = sigv4_headers(
                 "GET", host, canonical_path, self.region,
-                access, secret, token,
+                access, secret, token, extra_headers=extra_headers,
             )
         return _get_with_retry(
-            lambda: self._conns.get(url, headers, self.timeout_s),
-            breaker=self.breaker, point="store.s3",
+            lambda: POOL.request(url, headers, self.timeout_s),
+            breaker=self.breaker, point=point,
             name=f"s3://{self.bucket}",
         )
 
@@ -567,6 +538,52 @@ class S3Store:
         raise StoreError(
             f"S3 {status} for s3://{self.bucket}/{key}{detail}"
         )
+
+    def get_range(
+        self, key: str, start: int, length: Optional[int] = None
+    ) -> Optional[bytes]:
+        """One SigV4-signed ranged GET (the Range header joins the
+        signature). 206 answers the range; 200 means the origin
+        ignored Range and the full body is sliced locally; 416 is a
+        store error. A 403 runs the SAME credential-rotation protocol
+        as ``get()`` (re-resolve, re-sign, commit only if the 403
+        stops) BEFORE the 403-as-missing mapping — the sequential
+        sharded path reads shard indexes through here directly, and
+        stale creds on a no-ListBucket bucket must not read an
+        existing shard as fill_value."""
+        validate_key(key)
+        headers = {"range": _range_header(start, length)}
+        status, body = self._signed_get(
+            key, extra_headers=headers, point="io.range-get"
+        )
+        if status == 403:
+            fresh = self._refresh_candidate()
+            if fresh is not None:
+                status2, body2 = self._signed_get(
+                    key, creds=fresh, extra_headers=headers,
+                    point="io.range-get",
+                )
+                if status2 != 403:
+                    self._creds = fresh  # rotation confirmed
+                    status, body = status2, body2
+        if status == 206:
+            return body
+        if status == 200:
+            return _project_range(body, start, length)
+        if status == 404:
+            return None
+        if status == 403 and self.treat_403_as_missing:
+            return None
+        raise StoreError(
+            f"S3 {status} for ranged s3://{self.bucket}/{key}"
+        )
+
+    def get_many(
+        self,
+        requests: Sequence[RangeReq],
+        stats: Optional[FetchStats] = None,
+    ) -> List[Optional[bytes]]:
+        return fetch_many(self, requests, stats=stats)
 
     def describe(self) -> str:
         return f"s3://{self.bucket}/{self.prefix}"

@@ -745,12 +745,14 @@ class DeviceEncodeDispatcher:
 
         w, h = sizes[0]
         full_cap = streams.shape[1]
-        guess = min(
-            self._dd_cap.get(
+        # _dd_cap is shared with host-fallback paths on other threads;
+        # the stats lock makes the read-update pair coherent (r14
+        # lock-discipline burndown — was a documented KNOWN_GAPS item)
+        with self._stats_lock:
+            cap_hint = self._dd_cap.get(
                 (w, h), 1 << max(full_cap // 4, 64).bit_length()
-            ),
-            full_cap,
-        )
+            )
+        guess = min(cap_hint, full_cap)
         real = len(lanes)
         lengths_np, streams_np = jax.device_get(
             (lengths[:real], streams[:real, :guess])
@@ -761,9 +763,10 @@ class DeviceEncodeDispatcher:
             # guess overflow: one extra pull, rare by construction
             # (the cap tracks the running max)
             streams_np = np.asarray(streams[:real, :cap])  # ompb-lint: disable=jax-hotpath -- guess-overflow path: a second bounded pull, not a per-lane sync
-        self._dd_cap[(w, h)] = min(
-            full_cap, 1 << max(2 * max_len - 1, 0).bit_length()
-        )
+        with self._stats_lock:
+            self._dd_cap[(w, h)] = min(
+                full_cap, 1 << max(2 * max_len - 1, 0).bit_length()
+            )
         t_d2h = time.perf_counter()
         DEVICE_STAGE_SECONDS.observe(t_d2h - t_ready, stage="d2h")
         out: Dict[int, bytes] = {}

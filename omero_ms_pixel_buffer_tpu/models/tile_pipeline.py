@@ -26,6 +26,7 @@ from __future__ import annotations
 import concurrent.futures
 import logging
 import os
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -160,6 +161,12 @@ class TilePipeline:
             engine = "device" if use_device else "host"
         if engine not in ("auto", "device", "host"):
             raise ValueError(f"Unknown engine: {engine}")
+        # guards the lazily-resolved executor-shared state (_engine,
+        # mesh, _dispatcher): concurrent first batches race the
+        # auto-resolution from different executor threads (the
+        # KNOWN_GAPS "Locking" inventory this closes). Reentrant:
+        # _get_dispatcher -> _get_mesh -> engine all take it.
+        self._state_lock = threading.RLock()
         self._engine = engine
         self._use_pallas_arg = use_pallas
         # Build the zlib stream on the accelerator (ops/device_deflate)
@@ -236,8 +243,10 @@ class TilePipeline:
         path ever ran) the streaming queue — DRAINED, so every
         submitted group's future resolves before the threads die.
         Idempotent; the server's cleanup hook calls it."""
-        if self._dispatcher is not None:
-            self._dispatcher.close()
+        with self._state_lock:
+            disp = self._dispatcher
+        if disp is not None:
+            disp.close()
         self._encode_pool.shutdown(wait=False)
 
     def encode_signature(self) -> str:
@@ -249,16 +258,21 @@ class TilePipeline:
 
     def invalidate_image(self, image_id: int) -> None:
         """Cache-invalidation hook (a changed ``pixels`` row): drop
-        the image's open buffer — its parsed structure is stale — and
-        any device-resident planes staged from it. The next request
-        re-opens from disk; orphaned decoded blocks age out of the
-        shared BlockCache by LRU (their namespace is never reused)."""
+        the image's open buffer — its parsed structure is stale — any
+        device-resident planes staged from it, and its decoded blocks
+        (r14: including cached NEGATIVES — a backfilled chunk must not
+        keep reading as fill_value until the TTL)."""
         svc = self.pixels_service
         ns = None
         if hasattr(svc, "invalidate"):
             ns = svc.invalidate(image_id)
-        if ns is not None and self._plane_cache is not None:
+        if ns is None:
+            return
+        if self._plane_cache is not None:
             self._plane_cache.invalidate_ns(ns)
+        block_cache = getattr(svc, "block_cache", None)
+        if block_cache is not None and hasattr(block_cache, "purge_ns"):
+            block_cache.purge_ns(ns)
 
     def plane_cache_snapshot(self) -> Optional[dict]:
         """/healthz view of the HBM plane tier; None when the device
@@ -322,30 +336,44 @@ class TilePipeline:
         batch at hand serves from the host engine, which needs no jax,
         and 'auto' stays unresolved. Only a definitive probe result
         (a reachable backend, fast or slow) pins the engine."""
-        if self._engine == "auto":
-            from ..runtime.device_probe import probe_nonblocking
+        # Double-checked fast path: once resolved, _engine never
+        # reverts to "auto", so a stale read is at worst one extra
+        # lock acquisition — and it keeps per-batch engine reads from
+        # serializing behind _get_dispatcher/_get_mesh, which hold
+        # the lock across multi-second first-time device init.
+        resolved = self._engine  # ompb-lint: disable=lock-discipline -- benign double-checked read: monotonic auto->resolved transition; blocking here would stall every host batch behind device bring-up
+        if resolved != "auto":
+            return resolved
+        with self._state_lock:
+            if self._engine == "auto":
+                from ..runtime.device_probe import probe_nonblocking
 
-            info = probe_nonblocking()
-            if info is None:
-                return "host"  # probe pending: serve host, stay auto
-            if "error" in info:
-                if info.get("error") != self._probe_error_logged:
-                    self._probe_error_logged = info["error"]
-                    log.warning(
-                        "accelerator unavailable (%s); serving host "
-                        "until the probe error expires", info["error"],
-                    )
-                return "host"  # transient: stay auto for recovery
-            min_mbps = float(os.environ.get("OMPB_DEVICE_MIN_MBPS", "1000"))
-            if (
-                info.get("backend") == "tpu"
-                and info.get("link_mbps", 0.0) >= min_mbps
-            ):
-                self._engine = "device"
-            else:
-                self._engine = "host"
-            log.info("engine auto-resolved to '%s'", self._engine)
-        return self._engine
+                info = probe_nonblocking()
+                if info is None:
+                    return "host"  # probe pending: host, stay auto
+                if "error" in info:
+                    if info.get("error") != self._probe_error_logged:
+                        self._probe_error_logged = info["error"]
+                        log.warning(
+                            "accelerator unavailable (%s); serving "
+                            "host until the probe error expires",
+                            info["error"],
+                        )
+                    return "host"  # transient: stay auto for recovery
+                min_mbps = float(
+                    os.environ.get("OMPB_DEVICE_MIN_MBPS", "1000")
+                )
+                if (
+                    info.get("backend") == "tpu"
+                    and info.get("link_mbps", 0.0) >= min_mbps
+                ):
+                    self._engine = "device"
+                else:
+                    self._engine = "host"
+                log.info(
+                    "engine auto-resolved to '%s'", self._engine
+                )
+            return self._engine
 
     @property
     def use_device(self) -> bool:
@@ -375,23 +403,27 @@ class TilePipeline:
         ICI instead of threads). Built once, only when the device
         engine is active and more than one accelerator is visible;
         None keeps every device stage single-chip."""
-        if self.mesh == "auto":
-            self.mesh = None
-            if self.use_device:
-                try:
-                    import jax
+        with self._state_lock:
+            if self.mesh == "auto":
+                self.mesh = None
+                if self.use_device:
+                    try:
+                        import jax
 
-                    if len(jax.devices()) > 1:
-                        from ..parallel.mesh import make_mesh
+                        if len(jax.devices()) > 1:
+                            from ..parallel.mesh import make_mesh
 
-                        self.mesh = make_mesh(("data",))
-                        log.info(
-                            "serving mesh: %s over %d devices",
-                            dict(self.mesh.shape), len(jax.devices()),
+                            self.mesh = make_mesh(("data",))
+                            log.info(
+                                "serving mesh: %s over %d devices",
+                                dict(self.mesh.shape),
+                                len(jax.devices()),
+                            )
+                    except Exception:
+                        log.exception(
+                            "mesh init failed; single-device serving"
                         )
-                except Exception:
-                    log.exception("mesh init failed; single-device serving")
-        return self.mesh
+            return self.mesh
 
     def _get_dispatcher(self):
         """The streaming device-encode queue (persistent across
@@ -399,33 +431,40 @@ class TilePipeline:
         is still in flight); with a serving mesh it carries a
         MeshManager so encode batches shard across chips and a sick
         chip degrades to the survivors."""
-        if self._dispatcher is None:
-            from .device_dispatch import DeviceEncodeDispatcher
+        with self._state_lock:
+            if self._dispatcher is None:
+                from .device_dispatch import DeviceEncodeDispatcher
 
-            mesh = self._get_mesh()
-            mgr = None
-            if mesh is not None:
-                from ..parallel.mesh import MeshManager
+                mesh = self._get_mesh()
+                mgr = None
+                if mesh is not None:
+                    from ..parallel.mesh import MeshManager
 
-                mgr = MeshManager(devices=list(mesh.devices.flat))
-            self._dispatcher = DeviceEncodeDispatcher(
-                self._dd_cap, mesh_manager=mgr,
-                queue_depth=self.queue_depth,
-            )
-        return self._dispatcher
+                    mgr = MeshManager(devices=list(mesh.devices.flat))
+                self._dispatcher = DeviceEncodeDispatcher(
+                    self._dd_cap, mesh_manager=mgr,
+                    queue_depth=self.queue_depth,
+                )
+            return self._dispatcher
 
     def device_queue_snapshot(self) -> Optional[dict]:
         """/healthz view of the streaming encode queue; None until the
-        device-deflate path has dispatched at least once."""
-        disp = self._dispatcher
+        device-deflate path has dispatched at least once. Deliberately
+        lock-free: _get_dispatcher holds _state_lock across first-time
+        jax backend init (seconds on a cold TPU), and a health probe
+        must never block behind device bring-up — a GIL-atomic
+        reference read (possibly one snapshot stale) is exactly what a
+        snapshot wants."""
+        disp = self._dispatcher  # ompb-lint: disable=lock-discipline -- atomic reference read; blocking on _state_lock would stall /healthz behind multi-second device init
         return None if disp is None else disp.snapshot()
 
     @property
     def last_mesh_dispatch(self) -> Optional[dict]:
         """Accounting of the most recent sharded encode dispatch
         (n_devices, device_ids, lanes_per_device) — what the MULTICHIP
-        record reports as proof of real multi-chip execution."""
-        disp = self._dispatcher
+        record reports as proof of real multi-chip execution.
+        Lock-free read, same rationale as device_queue_snapshot."""
+        disp = self._dispatcher  # ompb-lint: disable=lock-discipline -- atomic reference read; reporting path must not block behind device init
         if disp is None or disp.mesh_manager is None:
             return None
         return disp.mesh_manager.last_dispatch

@@ -303,6 +303,27 @@ class ClusterConfig:
 
 
 @dataclasses.dataclass
+class IoConfig:
+    """The io: block — the batched read plane (io/fetch.py).
+    ``parallel_fetch`` False restores the strictly sequential
+    one-GET-per-chunk path; ``fetch_workers`` bounds the shared
+    fan-out executor; ``max_conns_per_host`` bounds the keep-alive
+    pool (and therefore per-origin concurrency); ``coalesce_gap_kb``
+    merges adjacent ranged reads separated by at most this many KiB
+    into one request; ``decode_workers`` bounds the parallel chunk
+    decode pool (0 = decode serially); ``negative_ttl_s`` bounds how
+    long an absent chunk (fill_value) is remembered by the block
+    cache (0 = never expires)."""
+
+    parallel_fetch: bool = True
+    fetch_workers: int = 16
+    max_conns_per_host: int = 8
+    coalesce_gap_kb: float = 64.0
+    decode_workers: int = 4
+    negative_ttl_s: float = 300.0
+
+
+@dataclasses.dataclass
 class RenderConfig:
     """The render: block — the /render serving surface (render/
     package). ``lut_dir`` points at a directory of ImageJ ``.lut``
@@ -387,6 +408,7 @@ class Config:
     cluster: ClusterConfig = dataclasses.field(
         default_factory=ClusterConfig
     )
+    io: IoConfig = dataclasses.field(default_factory=IoConfig)
     render: RenderConfig = dataclasses.field(default_factory=RenderConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     jax: JaxConfig = dataclasses.field(default_factory=JaxConfig)
@@ -749,6 +771,40 @@ class Config:
         )
 
     @staticmethod
+    def _parse_io(raw: dict) -> IoConfig:
+        """Validate the io: block — same posture as the other blocks:
+        typos and nonsense fail at startup, never silently default."""
+        io = raw.get("io") or {}
+        unknown = set(io) - {
+            "parallel-fetch", "fetch-workers", "max-conns-per-host",
+            "coalesce-gap-kb", "decode-workers", "negative-ttl-s",
+        }
+        if unknown:
+            raise ConfigError(
+                f"Unknown keys in 'io' block: {sorted(unknown)}"
+            )
+
+        def _num(key: str, default, minimum, cast=float):
+            try:
+                value = cast(io.get(key, default))
+            except (TypeError, ValueError):
+                raise ConfigError(
+                    f"Invalid value for 'io.{key}': {io.get(key)!r}"
+                ) from None
+            if value < minimum:
+                raise ConfigError(f"'io.{key}' must be >= {minimum}")
+            return value
+
+        return IoConfig(
+            parallel_fetch=bool(io.get("parallel-fetch", True)),
+            fetch_workers=_num("fetch-workers", 16, 1, int),
+            max_conns_per_host=_num("max-conns-per-host", 8, 1, int),
+            coalesce_gap_kb=_num("coalesce-gap-kb", 64.0, 0.0),
+            decode_workers=_num("decode-workers", 4, 0, int),
+            negative_ttl_s=_num("negative-ttl-s", 300.0, 0.0),
+        )
+
+    @staticmethod
     def _parse_render(raw: dict) -> RenderConfig:
         """Validate the render: block — same posture as the others:
         typos and nonsense fail at startup, never silently default."""
@@ -917,6 +973,7 @@ class Config:
             slo=cls._parse_slo(raw),
             cache=cls._parse_cache(raw),
             cluster=cls._parse_cluster(raw),
+            io=cls._parse_io(raw),
             render=cls._parse_render(raw),
             mesh=cls._parse_mesh(raw),
             jax=cls._parse_jax(raw),

@@ -1,0 +1,643 @@
+"""The batched read plane (r14): shared per-host fetch pool, ranged
+GETs, the dedupe/coalesce planner, negative-chunk caching, and the
+chaos lanes (fault -> single-key fallback, dead store -> breaker,
+hung fetch -> timeout, expired deadline -> 504 path).
+"""
+
+import functools
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from omero_ms_pixel_buffer_tpu.io import fetch
+from omero_ms_pixel_buffer_tpu.io.fetch import (
+    FetchPool,
+    FetchStats,
+    RangeReq,
+    StoreError,
+    StoreUnavailableError,
+    fetch_many,
+    io_snapshot,
+)
+from omero_ms_pixel_buffer_tpu.io.pixel_buffer import (
+    BlockCache,
+    set_negative_ttl,
+)
+from omero_ms_pixel_buffer_tpu.io.stores import (
+    FileStore,
+    HTTPStore,
+    _project_range,
+    _range_header,
+)
+from omero_ms_pixel_buffer_tpu.io.zarr import ZarrPixelBuffer, write_ngff
+from omero_ms_pixel_buffer_tpu.resilience.breaker import BOARD
+from omero_ms_pixel_buffer_tpu.resilience.deadline import (
+    Deadline,
+    DeadlineExceeded,
+    deadline_scope,
+)
+from omero_ms_pixel_buffer_tpu.resilience.faultinject import (
+    INJECTOR,
+    always,
+)
+from omero_ms_pixel_buffer_tpu.utils.config import Config, ConfigError
+
+
+@pytest.fixture(autouse=True)
+def _clean_io():
+    """Every test starts with chaos off, stock read-plane config, and
+    closed breakers — and leaves it that way."""
+    yield
+    INJECTOR.clear()
+    BOARD.reset()
+    fetch.CONFIG.parallel = True
+    fetch.CONFIG.coalesce_gap_bytes = 64 << 10
+    set_negative_ttl(300.0)
+
+
+class RangeHandler(BaseHTTPRequestHandler):
+    """Range-capable static handler with keep-alive (HTTP/1.1) and
+    per-class request/concurrency accounting — the loopback stand-in
+    for a remote object store."""
+
+    protocol_version = "HTTP/1.1"
+    # class-level accounting (reset per test via reset())
+    requests: list = []
+    active = 0
+    max_active = 0
+    delay_s = 0.0
+    _stats_lock = threading.Lock()
+
+    def __init__(self, root, *args, **kwargs):
+        self.root = root
+        super().__init__(*args, **kwargs)
+
+    @classmethod
+    def reset(cls):
+        with cls._stats_lock:
+            cls.requests = []
+            cls.active = 0
+            cls.max_active = 0
+            cls.delay_s = 0.0
+
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, code, body=b"", extra=None):
+        self.send_response(code)
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        import urllib.parse
+
+        cls = type(self)
+        with cls._stats_lock:
+            cls.requests.append(
+                (self.path, self.headers.get("Range"))
+            )
+            cls.active += 1
+            cls.max_active = max(cls.max_active, cls.active)
+        try:
+            if cls.delay_s:
+                time.sleep(cls.delay_s)
+            rel = urllib.parse.unquote(self.path.lstrip("/"))
+            if ".." in rel:
+                return self._reply(400)
+            path = os.path.join(self.root, rel)
+            if not os.path.isfile(path):
+                return self._reply(404)
+            with open(path, "rb") as f:
+                data = f.read()
+            rng = self.headers.get("Range")
+            if rng is None:
+                return self._reply(200, data)
+            spec = rng.split("=", 1)[1]
+            if spec.startswith("-"):  # suffix
+                n = int(spec[1:])
+                body = data[-n:] if n <= len(data) else data
+                return self._reply(206, body)
+            lo_s, _, hi_s = spec.partition("-")
+            lo = int(lo_s)
+            if lo >= len(data):
+                return self._reply(416)
+            hi = int(hi_s) + 1 if hi_s else len(data)
+            return self._reply(206, data[lo:min(hi, len(data))])
+        finally:
+            with cls._stats_lock:
+                cls.active -= 1
+
+
+class NoRangeHandler(RangeHandler):
+    """An origin that ignores Range entirely (always 200 + full
+    body) — the degradation every ranged client must survive."""
+
+    def do_GET(self):
+        if self.headers.get("Range") is not None:
+            del self.headers["Range"]
+        return super().do_GET()
+
+
+def serve(root, handler_cls):
+    handler_cls.reset()
+    server = ThreadingHTTPServer(
+        ("127.0.0.1", 0), functools.partial(handler_cls, root)
+    )
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server
+
+
+@pytest.fixture()
+def payload_dir(tmp_path):
+    (tmp_path / "obj").write_bytes(bytes(range(256)) * 16)  # 4096 B
+    (tmp_path / "small").write_bytes(b"hello world")
+    return str(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# range plumbing
+# ---------------------------------------------------------------------------
+
+
+class TestRangeSpelling:
+    def test_header_forms(self):
+        assert _range_header(0, 10) == "bytes=0-9"
+        assert _range_header(100, 1) == "bytes=100-100"
+        assert _range_header(5, None) == "bytes=5-"
+        assert _range_header(-32, 32) == "bytes=-32"
+
+    def test_project_range(self):
+        body = bytes(range(100))
+        assert _project_range(body, 10, 5) == body[10:15]
+        assert _project_range(body, -7, 7) == body[-7:]
+        assert _project_range(body, 0, None) == body
+        # suffix longer than the body: the whole body (an absent
+        # prefix cannot be invented)
+        assert _project_range(b"ab", -10, 10) == b"ab"
+
+
+class TestFileStoreRanges:
+    def test_bounded_suffix_missing(self, payload_dir):
+        fs = FileStore(payload_dir)
+        data = bytes(range(256)) * 16
+        assert fs.get_range("obj", 100, 20) == data[100:120]
+        assert fs.get_range("obj", -64, 64) == data[-64:]
+        assert fs.get_range("obj", 10, None) == data[10:]
+        assert fs.get_range("nope", 0, 4) is None
+        # short object: returns what exists; callers validate length
+        assert fs.get_range("small", 8, 100) == b"rld"
+
+
+class TestHTTPStoreRanges:
+    def test_206_and_suffix(self, payload_dir):
+        server = serve(payload_dir, RangeHandler)
+        try:
+            store = HTTPStore(
+                f"http://127.0.0.1:{server.server_address[1]}"
+            )
+            data = bytes(range(256)) * 16
+            assert store.get_range("obj", 32, 64) == data[32:96]
+            assert store.get_range("obj", -100, 100) == data[-100:]
+            assert store.get_range("missing", 0, 4) is None
+            with pytest.raises(StoreError):
+                store.get_range("obj", 999999, 4)  # 416
+        finally:
+            server.shutdown()
+
+    def test_range_ignoring_origin_sliced_locally(self, payload_dir):
+        server = serve(payload_dir, NoRangeHandler)
+        try:
+            store = HTTPStore(
+                f"http://127.0.0.1:{server.server_address[1]}"
+            )
+            data = bytes(range(256)) * 16
+            assert store.get_range("obj", 32, 64) == data[32:96]
+            assert store.get_range("obj", -8, 8) == data[-8:]
+        finally:
+            server.shutdown()
+
+
+class TestFetchPool:
+    def test_keepalive_reuse(self, payload_dir):
+        server = serve(payload_dir, RangeHandler)
+        try:
+            pool = FetchPool(max_per_host=4)
+            url = (
+                f"http://127.0.0.1:{server.server_address[1]}/small"
+            )
+            for _ in range(5):
+                status, body = pool.request(url, {}, 5.0)
+                assert status == 200 and body == b"hello world"
+            snap = pool.snapshot()
+            host = next(iter(snap["hosts"].values()))
+            # all five requests rode ONE pooled connection
+            assert host["idle"] == 1 and host["in_use"] == 0
+        finally:
+            server.shutdown()
+
+    def test_per_host_bound(self, payload_dir):
+        server = serve(payload_dir, RangeHandler)
+        RangeHandler.delay_s = 0.15
+        try:
+            pool = FetchPool(max_per_host=2)
+            url = (
+                f"http://127.0.0.1:{server.server_address[1]}/small"
+            )
+            threads = [
+                threading.Thread(
+                    target=lambda: pool.request(url, {}, 5.0)
+                )
+                for _ in range(6)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            # the semaphore kept at most 2 requests in flight against
+            # the origin even with 6 concurrent callers
+            assert RangeHandler.max_active <= 2
+        finally:
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the planner
+# ---------------------------------------------------------------------------
+
+
+class RecordingStore:
+    """In-memory store that records every call the planner issues."""
+
+    def __init__(self, objects):
+        self.objects = dict(objects)
+        self.calls = []
+        self.fail_ranges = False
+
+    def get(self, key):
+        self.calls.append(("get", key))
+        return self.objects.get(key)
+
+    def get_range(self, key, start, length=None):
+        if self.fail_ranges:
+            raise StoreError("ranges are broken today")
+        self.calls.append(("range", key, start, length))
+        body = self.objects.get(key)
+        if body is None:
+            return None
+        return _project_range(body, start, length)
+
+    def describe(self):
+        return "recording://"
+
+
+class TestPlanner:
+    def test_adjacent_ranges_coalesce(self):
+        store = RecordingStore({"k": bytes(range(256))})
+        fetch.CONFIG.coalesce_gap_bytes = 16
+        reqs = [
+            RangeReq("k", 0, 10),
+            RangeReq("k", 10, 10),      # adjacent
+            RangeReq("k", 30, 10),      # 10-byte gap <= 16: merges
+            RangeReq("k", 100, 10),     # 60-byte gap: new request
+        ]
+        out = fetch_many(store, reqs)
+        assert out == [
+            bytes(range(0, 10)), bytes(range(10, 20)),
+            bytes(range(30, 40)), bytes(range(100, 110)),
+        ]
+        ranged = [c for c in store.calls if c[0] == "range"]
+        assert len(ranged) == 2
+        assert ranged[0] == ("range", "k", 0, 40)
+        assert ranged[1] == ("range", "k", 100, 10)
+
+    def test_gap_threshold_zero_splits(self):
+        store = RecordingStore({"k": bytes(range(256))})
+        fetch.CONFIG.coalesce_gap_bytes = 0
+        out = fetch_many(
+            store, [RangeReq("k", 0, 10), RangeReq("k", 20, 10)]
+        )
+        assert out == [bytes(range(0, 10)), bytes(range(20, 30))]
+        assert len([c for c in store.calls if c[0] == "range"]) == 2
+
+    def test_identical_requests_dedupe(self):
+        store = RecordingStore({"k": b"x" * 64})
+        out = fetch_many(store, [RangeReq("k")] * 5)
+        assert out == [b"x" * 64] * 5
+        assert store.calls == [("get", "k")]
+
+    def test_overlapping_ranges_merge(self):
+        store = RecordingStore({"k": bytes(range(200))})
+        out = fetch_many(
+            store, [RangeReq("k", 0, 100), RangeReq("k", 50, 100)]
+        )
+        assert out[0] == bytes(range(100))
+        assert out[1] == bytes(range(50, 150))
+        assert len(store.calls) == 1
+
+    def test_absent_key_is_none_for_all_members(self):
+        store = RecordingStore({})
+        out = fetch_many(
+            store, [RangeReq("gone", 0, 4), RangeReq("gone", 4, 4)]
+        )
+        assert out == [None, None]
+
+    def test_stats_accounting(self):
+        store = RecordingStore({"k": bytes(range(256))})
+        stats = FetchStats()
+        fetch_many(
+            store,
+            [RangeReq("k", 0, 8), RangeReq("k", 8, 8),
+             RangeReq("k", 16, 8)],
+            stats=stats,
+        )
+        snap = stats.snapshot()
+        assert snap["planned"] == 3
+        assert snap["issued"] == 1
+        assert snap["coalesced_saved"] == 2
+        assert snap["coalesced_ratio"] == pytest.approx(2 / 3, abs=1e-3)
+
+    def test_sequential_escape_same_bytes(self):
+        store = RecordingStore({"k": bytes(range(256))})
+        reqs = [RangeReq("k", i * 16, 16) for i in range(8)]
+        want = fetch_many(store, reqs)
+        fetch.CONFIG.parallel = False
+        store2 = RecordingStore({"k": bytes(range(256))})
+        assert fetch_many(store2, reqs) == want
+
+    def test_healthz_snapshot_shape(self):
+        snap = io_snapshot()
+        for key in ("planned", "issued", "coalesced_ratio", "pool",
+                    "config", "fallbacks"):
+            assert key in snap
+
+
+# ---------------------------------------------------------------------------
+# chaos lanes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.resilience
+class TestChaos:
+    def test_range_fault_degrades_to_single_key(self, payload_dir):
+        server = serve(payload_dir, RangeHandler)
+        try:
+            store = HTTPStore(
+                f"http://127.0.0.1:{server.server_address[1]}"
+            )
+            INJECTOR.install("io.range-get", always(
+                lambda: StoreError("injected range outage")
+            ))
+            data = bytes(range(256)) * 16
+            stats = FetchStats()
+            out = store.get_many(
+                [RangeReq("obj", 0, 64), RangeReq("obj", 2048, 64)],
+                stats=stats,
+            )
+            # bytes still correct — served by the whole-key fallback
+            assert out == [data[:64], data[2048:2048 + 64]]
+            assert fetch.IO_STATS.snapshot()["fallbacks"] >= 1
+            whole_gets = [
+                (p, r) for (p, r) in RangeHandler.requests if r is None
+            ]
+            assert len(whole_gets) >= 1
+        finally:
+            server.shutdown()
+
+    def test_dead_store_opens_breaker(self):
+        import socket
+
+        # a port nothing listens on: every connect is refused
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        store = HTTPStore(f"http://127.0.0.1:{port}", timeout_s=1.0)
+        with pytest.raises(StoreUnavailableError):
+            for _ in range(30):
+                try:
+                    store.get_many(
+                        [RangeReq("obj", 0, 16),
+                         RangeReq("obj", 1024, 16)]
+                    )
+                except StoreUnavailableError:
+                    raise
+                except StoreError:
+                    continue
+        assert store.breaker.state == "open"
+
+    def test_hung_fetch_bounded_by_timeout(self, payload_dir):
+        server = serve(payload_dir, RangeHandler)
+        RangeHandler.delay_s = 5.0
+        try:
+            store = HTTPStore(
+                f"http://127.0.0.1:{server.server_address[1]}",
+                timeout_s=0.3,
+            )
+            t0 = time.monotonic()
+            with pytest.raises(StoreError):
+                store.get_range("obj", 0, 16)
+            # bounded by the per-call timeout (x retries), never the
+            # 5 s the origin would have parked us for
+            assert time.monotonic() - t0 < 4.0
+        finally:
+            RangeHandler.delay_s = 0.0
+            server.shutdown()
+
+    def test_expired_deadline_stops_fetch(self):
+        store = RecordingStore({"k": bytes(range(64))})
+        expired = Deadline.after(-1.0)
+        with deadline_scope(expired):
+            with pytest.raises(DeadlineExceeded):
+                fetch_many(
+                    store,
+                    [RangeReq("k", 0, 8), RangeReq("k", 32, 8)],
+                )
+
+    def test_pool_fault_point_fires(self, payload_dir):
+        server = serve(payload_dir, RangeHandler)
+        try:
+            store = HTTPStore(
+                f"http://127.0.0.1:{server.server_address[1]}"
+            )
+            INJECTOR.install("io.fetch-pool", always(
+                lambda: StoreError("pool chaos")
+            ))
+            with pytest.raises(StoreError):
+                store.get("small")
+            assert INJECTOR.calls("io.fetch-pool") >= 1
+        finally:
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# negative-chunk caching (satellite): absent chunks stop costing one
+# store GET per batch — TTL-bounded, invalidation-purged
+# ---------------------------------------------------------------------------
+
+
+class CountingFileStore(FileStore):
+    def __init__(self, root):
+        super().__init__(root)
+        self.gets = []
+
+    def get(self, key):
+        self.gets.append(key)
+        return super().get(key)
+
+
+def _sparse_ngff(tmp_path):
+    """A 128x128 plane with only the top-left 32x32 chunk present —
+    15 of 16 chunk keys are absent (fill_value)."""
+    img = np.zeros((1, 1, 1, 128, 128), np.uint16)
+    img[0, 0, 0, :32, :32] = 7
+    root = str(tmp_path / "sparse.zarr")
+    write_ngff(root, img, chunks=(32, 32), levels=1)
+    import glob
+    import os as _os
+
+    for f in glob.glob(_os.path.join(root, "0", "0.0.0.*")):
+        if _os.path.basename(f) != "0.0.0.0.0":
+            _os.remove(f)
+    return root, img
+
+
+class TestNegativeChunkCache:
+    def test_absent_chunks_not_refetched_across_batches(self, tmp_path):
+        root, img = _sparse_ngff(tmp_path)
+        buf = ZarrPixelBuffer(root)
+        store = CountingFileStore(root)
+        buf.store = store
+        for lv in buf.levels:
+            lv.store = store
+        coords = [(0, 0, 0, 0, 0, 128, 128)]
+        first = buf.read_tiles(coords, level=0)
+        n_first = len(store.gets)
+        assert n_first == 16  # every chunk key asked once, cold
+        second = buf.read_tiles(coords, level=0)
+        # second batch: zero store traffic — data chunks AND absent
+        # chunks (negatives) answer from the shared BlockCache
+        assert len(store.gets) == n_first
+        assert np.array_equal(first[0], second[0])
+        assert np.array_equal(first[0], img[0, 0, 0])
+
+    def test_negative_ttl_expires(self, tmp_path):
+        root, _ = _sparse_ngff(tmp_path)
+        set_negative_ttl(0.05)
+        buf = ZarrPixelBuffer(root)
+        store = CountingFileStore(root)
+        buf.store = store
+        for lv in buf.levels:
+            lv.store = store
+        coords = [(0, 0, 0, 0, 0, 128, 128)]
+        buf.read_tiles(coords, level=0)
+        n_first = len(store.gets)
+        time.sleep(0.06)
+        buf.read_tiles(coords, level=0)
+        # the 15 negatives expired and re-asked; the decoded data
+        # chunk is NOT TTL-bounded and stays cached
+        assert len(store.gets) == n_first + 15
+
+    def test_invalidation_purges_negatives(self, tmp_path):
+        from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+            ImageRegistry,
+            PixelsService,
+        )
+        from omero_ms_pixel_buffer_tpu.models.tile_pipeline import (
+            TilePipeline,
+        )
+        from omero_ms_pixel_buffer_tpu.tile_ctx import RegionDef, TileCtx
+
+        root, img = _sparse_ngff(tmp_path)
+        registry = ImageRegistry()
+        registry.add(1, root)
+        service = PixelsService(registry)
+        pipe = TilePipeline(service, use_device=False)
+
+        def ctx():
+            return TileCtx(
+                image_id=1, z=0, c=0, t=0,
+                region=RegionDef(0, 0, 128, 128), format=None,
+            )
+
+        first = pipe.handle(ctx())
+        assert first is not None
+        ns = service.get_pixel_buffer(1).cache_ns
+        assert len(service.block_cache) >= 16
+        pipe.invalidate_image(1)
+        # the namespace's entries (data + negatives) are gone
+        assert all(
+            not (isinstance(k, tuple) and k and k[0] == ns)
+            for k in service.block_cache._entries
+        )
+        assert pipe.handle(ctx()) == first
+
+    def test_negative_entries_charge_budget(self):
+        cache = BlockCache(1 << 20)
+        for i in range(100):
+            cache[(1, 0, (i,))] = None
+        assert cache.nbytes == 100 * 64  # nominal charge, never 0
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+
+class TestIoConfig:
+    BASE = {"session-store": {"type": "memory"}}
+
+    def test_defaults(self):
+        cfg = Config.from_dict(dict(self.BASE))
+        assert cfg.io.parallel_fetch is True
+        assert cfg.io.fetch_workers == 16
+        assert cfg.io.max_conns_per_host == 8
+        assert cfg.io.coalesce_gap_kb == 64.0
+        assert cfg.io.decode_workers == 4
+        assert cfg.io.negative_ttl_s == 300.0
+
+    def test_unknown_key_rejected(self):
+        raw = dict(self.BASE)
+        raw["io"] = {"coalesce-gap": 1}
+        with pytest.raises(ConfigError, match="io"):
+            Config.from_dict(raw)
+
+    @pytest.mark.parametrize("key,value", [
+        ("fetch-workers", 0),
+        ("fetch-workers", "lots"),
+        ("max-conns-per-host", -1),
+        ("coalesce-gap-kb", "wide"),
+        ("decode-workers", -2),
+        ("negative-ttl-s", -5),
+    ])
+    def test_bad_values_rejected(self, key, value):
+        raw = dict(self.BASE)
+        raw["io"] = {key: value}
+        with pytest.raises(ConfigError):
+            Config.from_dict(raw)
+
+    def test_configure_applies(self):
+        from omero_ms_pixel_buffer_tpu.io.pixel_buffer import (
+            negative_ttl_s,
+        )
+
+        raw = dict(self.BASE)
+        raw["io"] = {
+            "parallel-fetch": False,
+            "coalesce-gap-kb": 8,
+            "negative-ttl-s": 12.5,
+        }
+        cfg = Config.from_dict(raw)
+        fetch.configure(cfg.io)
+        try:
+            assert fetch.parallel_enabled() is False
+            assert fetch.CONFIG.coalesce_gap_bytes == 8 << 10
+            assert negative_ttl_s() == 12.5
+        finally:
+            fetch.configure(Config.from_dict(dict(self.BASE)).io)

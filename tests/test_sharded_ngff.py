@@ -1,0 +1,511 @@
+"""Zarr v3 ``sharding_indexed`` (r14): the shard writer fixture, the
+ranged/coalesced read path, byte-identity against unsharded ground
+truth through ``read_region`` AND the full tile pipeline, strict
+corrupt/truncated-index errors, partial edge shards, and the
+one-coalesced-GET-per-shard batched access shape.
+"""
+
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from omero_ms_pixel_buffer_tpu.io import fetch
+from omero_ms_pixel_buffer_tpu.io.stores import HTTPStore
+from omero_ms_pixel_buffer_tpu.io.zarr import (
+    ZarrArray,
+    ZarrError,
+    ZarrPixelBuffer,
+    crc32c,
+    write_ngff,
+)
+from omero_ms_pixel_buffer_tpu.resilience.breaker import BOARD
+from omero_ms_pixel_buffer_tpu.resilience.faultinject import (
+    INJECTOR,
+    always,
+)
+
+from test_io_fetch import RangeHandler, serve
+
+rng = np.random.default_rng(41)
+# deliberately NOT shard-aligned: 300x280 with 128x128 shards leaves
+# partial edge shards in both axes
+IMG = rng.integers(0, 60000, (1, 2, 2, 300, 280), dtype=np.uint16)
+
+CHUNKS = (64, 64)
+SHARDS = (128, 128)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    INJECTOR.clear()
+    BOARD.reset()
+    fetch.CONFIG.parallel = True
+
+
+@pytest.fixture(scope="module")
+def roots(tmp_path_factory):
+    base = tmp_path_factory.mktemp("sharded_ngff")
+    unsharded = str(base / "plain.zarr")
+    sharded = str(base / "sharded.zarr")
+    write_ngff(unsharded, IMG, chunks=CHUNKS, levels=2,
+               zarr_format=3, compressor="zlib")
+    write_ngff(sharded, IMG, chunks=CHUNKS, levels=2,
+               zarr_format=3, compressor="zlib", shards=SHARDS)
+    return unsharded, sharded
+
+
+REGIONS = [
+    (0, 0, 0, 0, 0, 0, 280, 300),        # full plane
+    (0, 1, 1, 0, 33, 47, 200, 100),      # unaligned interior
+    (1, 0, 0, 0, 10, 10, 100, 80),       # pyramid level
+    (0, 0, 1, 0, 250, 280, 30, 20),      # edge-shard corner
+    (0, 1, 0, 0, 127, 127, 2, 2),        # shard boundary straddle
+]
+
+
+class TestShardedReads:
+    def test_metadata_parses(self, roots):
+        _, sharded = roots
+        arr = ZarrArray(os.path.join(sharded, "0"))
+        assert arr.sharding is not None
+        assert arr.chunks == (1, 1, 1) + CHUNKS
+        assert arr.sharding.shard_shape == (1, 1, 1) + SHARDS
+        assert arr.sharding.ratio == (1, 1, 1, 2, 2)
+        assert arr.sharding.index_nbytes == 4 * 16 + 4
+
+    @pytest.mark.parametrize("region", REGIONS)
+    def test_byte_identity_vs_unsharded(self, roots, region):
+        unsharded, sharded = roots
+        bu = ZarrPixelBuffer(unsharded)
+        bs = ZarrPixelBuffer(sharded)
+        lv, z, c, t, x, y, w, h = region
+        a = bu.get_tile_at(lv, z, c, t, x, y, w, h)
+        b = bs.get_tile_at(lv, z, c, t, x, y, w, h)
+        assert a.tobytes() == b.tobytes()
+
+    def test_read_tiles_batch_identity(self, roots):
+        unsharded, sharded = roots
+        bu = ZarrPixelBuffer(unsharded)
+        bs = ZarrPixelBuffer(sharded)
+        coords = [
+            (0, 0, 0, 0, 0, 140, 150),
+            (0, 1, 0, 140, 0, 140, 150),
+            (1, 0, 0, 0, 150, 140, 150),
+            (0, 0, 0, 0, 0, 140, 150),  # duplicate lane
+        ]
+        for a, b in zip(
+            bu.read_tiles(coords), bs.read_tiles(coords)
+        ):
+            assert a.tobytes() == b.tobytes()
+
+    def test_sequential_escape_identity(self, roots):
+        _, sharded = roots
+        want = ZarrPixelBuffer(sharded).get_tile_at(
+            0, 1, 1, 0, 33, 47, 200, 100
+        )
+        fetch.CONFIG.parallel = False
+        got = ZarrPixelBuffer(sharded).get_tile_at(
+            0, 1, 1, 0, 33, 47, 200, 100
+        )
+        assert want.tobytes() == got.tobytes()
+
+    def test_absent_shard_reads_fill_value(self, roots, tmp_path):
+        _, sharded = roots
+        import shutil
+
+        clone = str(tmp_path / "clone.zarr")
+        shutil.copytree(sharded, clone)
+        os.remove(os.path.join(clone, "0", "c", "0", "0", "0", "0", "0"))
+        buf = ZarrPixelBuffer(clone)
+        tile = buf.get_tile_at(0, 0, 0, 0, 0, 0, 128, 128)
+        assert (tile == 0).all()
+        # neighbours in OTHER shards are untouched
+        other = buf.get_tile_at(0, 0, 0, 0, 128, 0, 128, 128)
+        assert np.array_equal(other, IMG[0, 0, 0, 0:128, 128:256])
+
+    def test_missing_inner_chunk_sentinel(self, roots, tmp_path):
+        _, sharded = roots
+        import shutil
+
+        clone = str(tmp_path / "clone2.zarr")
+        shutil.copytree(sharded, clone)
+        shard = os.path.join(clone, "0", "c", "0", "0", "0", "0", "0")
+        blob = open(shard, "rb").read()
+        idx_nb = 4 * 16 + 4
+        body, index = blob[:-idx_nb], blob[-idx_nb:-4]
+        entries = list(
+            struct.unpack("<8Q", index)
+        )
+        entries[0] = entries[1] = (1 << 64) - 1  # chunk 0 -> absent
+        new_index = struct.pack("<8Q", *entries)
+        new_index += struct.pack("<I", crc32c(new_index))
+        open(shard, "wb").write(body + new_index)
+        buf = ZarrPixelBuffer(clone)
+        tile = buf.get_tile_at(0, 0, 0, 0, 0, 0, 128, 128)
+        # inner chunk (0,0) filled; the shard's other chunks intact
+        assert (tile[:64, :64] == 0).all()
+        assert np.array_equal(
+            tile[:64, 64:128], IMG[0, 0, 0, 0:64, 64:128]
+        )
+
+    def test_served_over_http_ranged(self, roots):
+        unsharded, sharded = roots
+        server = serve(os.path.dirname(sharded), RangeHandler)
+        try:
+            url = (
+                f"http://127.0.0.1:{server.server_address[1]}/"
+                f"{os.path.basename(sharded)}"
+            )
+            buf = ZarrPixelBuffer(url)
+            tile = buf.get_tile_at(0, 0, 0, 0, 33, 47, 200, 100)
+            assert np.array_equal(
+                tile, IMG[0, 0, 0, 47:147, 33:233]
+            )
+            # the shard bodies were fetched with RANGED requests
+            ranged = [r for _, r in RangeHandler.requests if r]
+            assert len(ranged) >= 2  # index footers + inner spans
+        finally:
+            server.shutdown()
+
+    def test_one_coalesced_get_per_shard(self, roots):
+        _, sharded = roots
+        server = serve(os.path.dirname(sharded), RangeHandler)
+        try:
+            url = (
+                f"http://127.0.0.1:{server.server_address[1]}/"
+                f"{os.path.basename(sharded)}"
+            )
+            buf = ZarrPixelBuffer(url)
+            RangeHandler.reset()
+            # one 128x128 tile == one full shard == 4 inner chunks,
+            # written contiguously -> ONE index GET + ONE body GET
+            buf.get_tile_at(0, 0, 0, 0, 0, 0, 128, 128)
+            shard_reqs = [
+                (p, r) for p, r in RangeHandler.requests
+                if p.endswith("/c/0/0/0/0/0")
+            ]
+            assert len(shard_reqs) == 2
+            kinds = sorted(
+                "suffix" if r.startswith("bytes=-") else "span"
+                for _, r in shard_reqs
+            )
+            assert kinds == ["span", "suffix"]
+        finally:
+            server.shutdown()
+
+
+class TestStrictIndexErrors:
+    def _mini_sharded(self, tmp_path, mutate=None, index_tail=True):
+        root = str(tmp_path / "mini.zarr")
+        img = rng.integers(0, 255, (1, 1, 1, 64, 64), dtype=np.uint8)
+        write_ngff(root, img, chunks=(32, 32), levels=1,
+                   zarr_format=3, compressor=None, shards=(64, 64))
+        shard = os.path.join(root, "0", "c", "0", "0", "0", "0", "0")
+        if mutate is not None:
+            blob = bytearray(open(shard, "rb").read())
+            mutate(blob)
+            open(shard, "wb").write(bytes(blob))
+        return root, img
+
+    def test_round_trip_uncompressed(self, tmp_path):
+        root, img = self._mini_sharded(tmp_path)
+        buf = ZarrPixelBuffer(root)
+        assert np.array_equal(
+            buf.get_tile_at(0, 0, 0, 0, 0, 0, 64, 64),
+            img[0, 0, 0],
+        )
+
+    def test_corrupt_index_crc_raises(self, tmp_path):
+        def flip(blob):
+            blob[-6] ^= 0xFF  # inside the index body
+
+        root, _ = self._mini_sharded(tmp_path, mutate=flip)
+        buf = ZarrPixelBuffer(root)
+        with pytest.raises(ZarrError, match="crc32c"):
+            buf.get_tile_at(0, 0, 0, 0, 0, 0, 64, 64)
+
+    def test_truncated_shard_raises(self, tmp_path):
+        def chop(blob):
+            del blob[50:]  # shorter than the 68-byte index itself
+
+        root, _ = self._mini_sharded(tmp_path, mutate=chop)
+        buf = ZarrPixelBuffer(root)
+        with pytest.raises(ZarrError, match="[Tt]runcated"):
+            buf.get_tile_at(0, 0, 0, 0, 0, 0, 64, 64)
+
+    def test_partially_chopped_shard_fails_crc(self, tmp_path):
+        def chop(blob):
+            # still longer than the index: the suffix window shifts
+            # onto chunk bytes, which the index checksum catches
+            del blob[-10:]
+
+        root, _ = self._mini_sharded(tmp_path, mutate=chop)
+        buf = ZarrPixelBuffer(root)
+        with pytest.raises(ZarrError, match="crc32c"):
+            buf.get_tile_at(0, 0, 0, 0, 0, 0, 64, 64)
+
+    def test_implausible_entry_raises(self, tmp_path):
+        def lie(blob):
+            # inner chunk 0 claims a gigabyte
+            idx_nb = 4 * 16 + 4
+            index = bytearray(blob[-idx_nb:-4])
+            index[8:16] = struct.pack("<Q", 1 << 30)
+            index += struct.pack("<I", crc32c(bytes(index)))
+            blob[-idx_nb:] = index
+
+        root, _ = self._mini_sharded(tmp_path, mutate=lie)
+        buf = ZarrPixelBuffer(root)
+        with pytest.raises(ZarrError, match="implausible"):
+            buf.get_tile_at(0, 0, 0, 0, 0, 0, 64, 64)
+
+    def test_truncated_inner_span_raises(self, tmp_path):
+        def lie(blob):
+            # inner chunk 0's nbytes exceeds the shard body by a bit
+            idx_nb = 4 * 16 + 4
+            index = bytearray(blob[-idx_nb:-4])
+            (nb,) = struct.unpack("<Q", index[8:16])
+            index[8:16] = struct.pack("<Q", nb + 64)
+            index += struct.pack("<I", crc32c(bytes(index)))
+            blob[-idx_nb:] = index
+
+        root, _ = self._mini_sharded(tmp_path, mutate=lie)
+        buf = ZarrPixelBuffer(root)
+        with pytest.raises(ZarrError):
+            buf.get_tile_at(0, 0, 0, 0, 0, 0, 32, 32)
+
+    def _meta(self, tmp_path, codecs):
+        path = str(tmp_path / "arr")
+        os.makedirs(path)
+        meta = {
+            "zarr_format": 3, "node_type": "array", "shape": [64, 64],
+            "data_type": "uint8",
+            "chunk_grid": {"name": "regular",
+                           "configuration": {"chunk_shape": [64, 64]}},
+            "chunk_key_encoding": {"name": "default"},
+            "fill_value": 0,
+            "codecs": codecs,
+        }
+        json.dump(meta, open(os.path.join(path, "zarr.json"), "w"))
+        return path
+
+    def test_malformed_config_rejected(self, tmp_path):
+        path = self._meta(tmp_path, [
+            {"name": "sharding_indexed", "configuration": {}}
+        ])
+        with pytest.raises(ZarrError, match="shard"):
+            ZarrArray(path)
+
+    def test_non_dividing_inner_rejected(self, tmp_path):
+        path = self._meta(tmp_path, [
+            {"name": "sharding_indexed",
+             "configuration": {"chunk_shape": [48, 48]}}
+        ])
+        with pytest.raises(ZarrError, match="divide"):
+            ZarrArray(path)
+
+    def test_nested_sharding_rejected(self, tmp_path):
+        path = self._meta(tmp_path, [
+            {"name": "sharding_indexed",
+             "configuration": {
+                 "chunk_shape": [32, 32],
+                 "codecs": [{"name": "sharding_indexed",
+                             "configuration": {"chunk_shape": [16, 16]}}],
+             }}
+        ])
+        with pytest.raises(ZarrError, match="nested"):
+            ZarrArray(path)
+
+    def test_compressed_index_rejected(self, tmp_path):
+        path = self._meta(tmp_path, [
+            {"name": "sharding_indexed",
+             "configuration": {
+                 "chunk_shape": [32, 32],
+                 "index_codecs": [{"name": "bytes"},
+                                  {"name": "gzip"}],
+             }}
+        ])
+        with pytest.raises(ZarrError, match="index_codecs"):
+            ZarrArray(path)
+
+    def test_index_location_start_reads(self, tmp_path):
+        """A hand-packed START-located shard (the in-tree writer only
+        emits 'end'): index first, inner-chunk offsets ABSOLUTE
+        within the object (so they include the index bytes)."""
+        img = rng.integers(0, 255, (64, 64), dtype=np.uint8)
+        path = self._meta(tmp_path, [
+            {"name": "sharding_indexed",
+             "configuration": {
+                 "chunk_shape": [32, 32],
+                 "codecs": [{"name": "bytes",
+                             "configuration": {"endian": "little"}}],
+                 "index_codecs": [
+                     {"name": "bytes",
+                      "configuration": {"endian": "little"}},
+                     {"name": "crc32c"},
+                 ],
+                 "index_location": "start",
+             }}
+        ])
+        idx_nb = 4 * 16 + 4
+        chunks = []
+        entries = []
+        off = idx_nb
+        for iy in range(2):
+            for ix in range(2):
+                raw = img[iy * 32:(iy + 1) * 32,
+                          ix * 32:(ix + 1) * 32].tobytes()
+                entries.append((off, len(raw)))
+                chunks.append(raw)
+                off += len(raw)
+        index = b"".join(
+            struct.pack("<QQ", o, n) for o, n in entries
+        )
+        index += struct.pack("<I", crc32c(index))
+        cdir = os.path.join(path, "c", "0")
+        os.makedirs(cdir)
+        with open(os.path.join(cdir, "0"), "wb") as f:
+            f.write(index + b"".join(chunks))
+        arr = ZarrArray(path)
+        assert not arr.sharding.index_at_end
+        out = arr.read_region((0, 0), (64, 64))
+        assert np.array_equal(out, img)
+
+    def test_bad_index_location_rejected(self, tmp_path):
+        path = self._meta(tmp_path, [
+            {"name": "sharding_indexed",
+             "configuration": {"chunk_shape": [32, 32],
+                               "index_location": "middle"}}
+        ])
+        with pytest.raises(ZarrError, match="index_location"):
+            ZarrArray(path)
+
+
+class TestFullTilePath:
+    """Sharded and unsharded images are indistinguishable through the
+    COMPLETE pipeline (resolve -> batched read -> encode)."""
+
+    def _pipe(self, root):
+        from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+            ImageRegistry,
+            PixelsService,
+        )
+        from omero_ms_pixel_buffer_tpu.models.tile_pipeline import (
+            TilePipeline,
+        )
+
+        registry = ImageRegistry()
+        registry.add(1, root)
+        service = PixelsService(registry)
+        return TilePipeline(service, use_device=False)
+
+    def _ctx(self, fmt="png", x=64, y=32, w=160, h=144):
+        from omero_ms_pixel_buffer_tpu.tile_ctx import RegionDef, TileCtx
+
+        return TileCtx(
+            image_id=1, z=1, c=1, t=0,
+            region=RegionDef(x, y, w, h), format=fmt,
+        )
+
+    @pytest.mark.parametrize("fmt", [None, "png", "tif"])
+    def test_pipeline_bytes_identical(self, roots, fmt):
+        unsharded, sharded = roots
+        a = self._pipe(unsharded).handle(self._ctx(fmt))
+        b = self._pipe(sharded).handle(self._ctx(fmt))
+        assert a is not None
+        assert a == b
+
+    def test_batch_path_identical(self, roots):
+        unsharded, sharded = roots
+        ctxs = [
+            self._ctx("png", x=0, y=0, w=128, h=128),
+            self._ctx("png", x=128, y=128, w=128, h=128),
+            self._ctx(None, x=32, y=32, w=200, h=200),
+        ]
+        pa = self._pipe(unsharded)
+        pb = self._pipe(sharded)
+        ra = pa.handle_batch(ctxs)
+        ctxs2 = [
+            self._ctx("png", x=0, y=0, w=128, h=128),
+            self._ctx("png", x=128, y=128, w=128, h=128),
+            self._ctx(None, x=32, y=32, w=200, h=200),
+        ]
+        rb = pb.handle_batch(ctxs2)
+        assert all(r is not None for r in ra)
+        assert ra == rb
+
+
+@pytest.mark.resilience
+class TestShardedChaos:
+    def test_range_fault_falls_back_byte_identical(self, roots):
+        _, sharded = roots
+        server = serve(os.path.dirname(sharded), RangeHandler)
+        try:
+            url = (
+                f"http://127.0.0.1:{server.server_address[1]}/"
+                f"{os.path.basename(sharded)}"
+            )
+            from omero_ms_pixel_buffer_tpu.io.stores import StoreError
+
+            want = IMG[0, 0, 0, 0:128, 0:128]
+            INJECTOR.install("io.range-get", always(
+                lambda: StoreError("injected range outage")
+            ))
+            buf = ZarrPixelBuffer(url)
+            tile = buf.get_tile_at(0, 0, 0, 0, 0, 0, 128, 128)
+            # every ranged read (index + inner spans) degraded to
+            # whole-shard GETs; pixels identical
+            assert np.array_equal(tile, want)
+            whole = [r for _, r in RangeHandler.requests if r is None]
+            assert len(whole) >= 1
+        finally:
+            server.shutdown()
+
+    def test_dead_store_surfaces_unavailable(self):
+        import socket
+
+        from omero_ms_pixel_buffer_tpu.io.stores import (
+            StoreError,
+            StoreUnavailableError,
+        )
+
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        with pytest.raises(
+            (StoreError, StoreUnavailableError)
+        ) as ei:
+            for _ in range(30):
+                try:
+                    ZarrPixelBuffer(f"http://127.0.0.1:{port}/x.zarr")
+                except StoreUnavailableError:
+                    raise
+                except StoreError:
+                    continue
+        assert isinstance(ei.value, StoreUnavailableError)
+
+    def test_hung_store_bounded(self, roots):
+        import time as _time
+
+        _, sharded = roots
+        server = serve(os.path.dirname(sharded), RangeHandler)
+        RangeHandler.delay_s = 5.0
+        try:
+            url = (
+                f"http://127.0.0.1:{server.server_address[1]}/"
+                f"{os.path.basename(sharded)}"
+            )
+            from omero_ms_pixel_buffer_tpu.io.stores import StoreError
+
+            store = HTTPStore(url, timeout_s=0.3)
+            t0 = _time.monotonic()
+            with pytest.raises(StoreError):
+                ZarrArray(store, "0")
+            assert _time.monotonic() - t0 < 4.0
+        finally:
+            RangeHandler.delay_s = 0.0
+            server.shutdown()

@@ -337,6 +337,10 @@ def check_lock_discipline(
 
 _RESILIENCE_SCOPE = (
     "omero_ms_pixel_buffer_tpu/io/stores.py",
+    # the batched read plane (r14): the shared fetch pool + the
+    # ranged/parallel fetch planner are THE remote chunk-read clients
+    # now — breaker gate + fault point + per-call timeout required
+    "omero_ms_pixel_buffer_tpu/io/fetch.py",
     "omero_ms_pixel_buffer_tpu/db/postgres.py",
     "omero_ms_pixel_buffer_tpu/auth/stores.py",
     "omero_ms_pixel_buffer_tpu/auth/ice.py",
@@ -361,7 +365,7 @@ def _has_breaker_marker(fn: FunctionInfo) -> bool:
             return True
         if call.name == "call" and call.base and "breaker" in call.base.lower():
             return True
-        if call.name == "_get_with_retry":
+        if call.name in ("_get_with_retry", "resilient_get"):
             return True
     return False
 
@@ -372,7 +376,7 @@ def _has_injection_marker(fn: FunctionInfo) -> bool:
             "injector" in call.base.lower()
         ):
             return True
-        if call.name == "_get_with_retry":
+        if call.name in ("_get_with_retry", "resilient_get"):
             return True
     return False
 
