@@ -1067,6 +1067,118 @@ def bench_render(
     return out
 
 
+def bench_analysis(
+    cache_dir: str, engine: str, size: int = 2048, n: int = 64
+) -> dict:
+    """Analysis plane (render/analysis + render/masks): histogram
+    tiles/s host vs the headline engine — with the integer-identity
+    pin ``analysis_ok_hist_identical`` (same ctx, byte-identical JSON
+    across engines) — and the masked-render overhead ratio
+    (``analysis_ok_masked_overhead``: ROI compositing must stay a
+    small multiple of the plain render, since rasters are cached per
+    (shape-set, region))."""
+    import time as _t
+
+    from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+        ImageRegistry,
+        PixelsService,
+    )
+    from omero_ms_pixel_buffer_tpu.models.tile_pipeline import TilePipeline
+    from omero_ms_pixel_buffer_tpu.render.analysis import HistogramSpec
+    from omero_ms_pixel_buffer_tpu.render.model import RenderSpec
+    from omero_ms_pixel_buffer_tpu.tile_ctx import RegionDef, TileCtx
+
+    path = build_render_fixture(cache_dir, size)
+    registry = ImageRegistry()
+    registry.add(1, path)
+    hspec = HistogramSpec.from_params({"bins": "256", "c": "1,2,3"})
+    rng = np.random.default_rng(41)
+    ctxs = []
+    for _ in range(n):
+        x = int(rng.integers(0, (size - 512) // 64)) * 64
+        y = int(rng.integers(0, (size - 512) // 64)) * 64
+        ctxs.append(TileCtx(
+            image_id=1, z=0, c=0, t=0,
+            region=RegionDef(x, y, 512, 512), format="json",
+            omero_session_key="bench", analysis=hspec,
+        ))
+    out: dict = {}
+    bodies: dict = {}
+    engines = ["host"] if engine == "host" else ["host", engine]
+    for label in engines:
+        service = PixelsService(registry)
+        try:
+            pipe = TilePipeline(service, engine=label, buckets=(512,))
+            warm = pipe.handle_batch(ctxs[:8])
+            assert all(w is not None for w in warm)
+            bodies[label] = pipe.handle_batch([ctxs[0]])[0]
+            tps = run_batched(pipe, ctxs, 16)
+            out[label] = {"hist_tiles_per_sec": round(tps, 2)}
+            log(f"[analysis] {label}: {out[label]}")
+            pipe.close()
+        except Exception as e:
+            out[label] = {"error": f"{type(e).__name__}: {e}"}
+            log(f"[analysis] {label} failed: {e!r}")
+        finally:
+            service.close()
+    vals = [b for b in bodies.values() if b is not None]
+    out["analysis_ok_hist_identical"] = (
+        len(vals) == len(engines) and all(v == vals[0] for v in vals)
+    )
+
+    # masked-render overhead: the same tile set rendered plain vs
+    # with a 3-shape ROI union (host engine — masked lanes serve
+    # through the host mirror), warm raster cache
+    roi = (
+        '[{"type":"rect","x":64,"y":64,"w":320,"h":320},'
+        '{"type":"ellipse","cx":256,"cy":256,"rx":200,"ry":140},'
+        '{"type":"polygon","points":[[0,0],[500,40],[260,500]]}]'
+    )
+    plain = RenderSpec.from_params({"c": "1|0:4095$FF0000"})
+    masked = RenderSpec.from_params(
+        {"c": "1|0:4095$FF0000", "roi": roi}
+    )
+    service = PixelsService(registry)
+    try:
+        pipe = TilePipeline(service, engine="host", buckets=(512,))
+
+        def render_ctxs(spec):
+            return [TileCtx(
+                image_id=1, z=0, c=0, t=0,
+                region=RegionDef(c.region.x, c.region.y, 512, 512),
+                format="png", omero_session_key="bench", render=spec,
+            ) for c in ctxs[:24]]
+
+        for spec in (plain, masked):  # warm reads + tables + rasters
+            assert all(
+                r is not None
+                for r in pipe.handle_batch(render_ctxs(spec)[:8])
+            )
+        times = {}
+        for key, spec in (("plain", plain), ("masked", masked)):
+            rcs = render_ctxs(spec)
+            t0 = _t.perf_counter()
+            res = pipe.handle_batch(rcs)
+            assert all(r is not None for r in res)
+            times[key] = _t.perf_counter() - t0
+        ratio = times["masked"] / max(times["plain"], 1e-9)
+        out["masked_overhead_ratio"] = round(ratio, 3)
+        out["analysis_ok_masked_overhead"] = ratio <= 3.0
+        log(
+            f"[analysis] masked overhead {ratio:.2f}x "
+            f"(plain {times['plain']*1000:.0f}ms, "
+            f"masked {times['masked']*1000:.0f}ms)"
+        )
+        pipe.close()
+    except Exception as e:
+        out["masked_error"] = f"{type(e).__name__}: {e}"
+        out["analysis_ok_masked_overhead"] = False
+        log(f"[analysis] masked bench failed: {e!r}")
+    finally:
+        service.close()
+    return out
+
+
 def bench_device(path: str, size: int, probe_info: dict) -> dict:
     """Accelerator-engine sub-run, recorded even when slower than host
     (over a tunneled chip the link dominates; BENCH tail carries the
@@ -1375,6 +1487,16 @@ def main():
             render_stats = {"error": f"{type(e).__name__}: {e}"}
             log(f"render bench failed: {e!r}")
 
+    # --- analysis plane (r15): histogram throughput host vs engine +
+    # masked-render overhead (analysis_ok_* pins) ----------------------
+    analysis_stats: dict = {}
+    if os.environ.get("BENCH_ANALYSIS", "1") != "0":
+        try:
+            analysis_stats = bench_analysis(cache_dir, pipe.engine)
+        except Exception as e:
+            analysis_stats = {"error": f"{type(e).__name__}: {e}"}
+            log(f"analysis bench failed: {e!r}")
+
     if os.environ.get("BENCH_SUBS", "1") != "0":
         try:
             sub_benches(pipe, service, size, cache_dir)
@@ -1412,6 +1534,8 @@ def main():
         record["io"] = io_stats
     if render_stats:
         record["render"] = render_stats
+    if analysis_stats:
+        record["analysis"] = analysis_stats
     if device_stats:
         record["device"] = device_stats
     # explicit host-vs-device table so the next round can read WHICH
@@ -1426,6 +1550,13 @@ def main():
     for label, stats in render_stats.items():
         if isinstance(stats, dict) and "tiles_per_sec" in stats:
             comparison[f"render_{label}"] = stats["tiles_per_sec"]
+    for label, stats in analysis_stats.items():
+        if isinstance(stats, dict) and "hist_tiles_per_sec" in stats:
+            comparison[f"hist_{label}"] = stats["hist_tiles_per_sec"]
+    if "masked_overhead_ratio" in analysis_stats:
+        comparison["masked_overhead_ratio"] = (
+            analysis_stats["masked_overhead_ratio"]
+        )
     micro = device_stats.get("micro") or {}
     for k in (
         "deflate_gbps", "pack_gbps", "pack_speedup_vs_gather",

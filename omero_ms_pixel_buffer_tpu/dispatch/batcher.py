@@ -309,7 +309,11 @@ class BatchingTileWorker:
                 canonical.append((c, f))
         batch = canonical
         ctxs = [b[0] for b in batch]
-        if len(batch) == 1 and ctxs[0].render is None:
+        if (
+            len(batch) == 1
+            and ctxs[0].render is None
+            and getattr(ctxs[0], "analysis", None) is None
+        ):
             work = lambda: [self.pipeline.handle(ctxs[0])]  # noqa: E731
         else:
             work = lambda: self._call_handle_batch(ctxs)  # noqa: E731

@@ -58,6 +58,28 @@ class InternalError(TileError):
         super().__init__(500, message)
 
 
+class RequestTooLargeError(TileError):
+    """413 — the request describes more pixel bytes than the service
+    will materialize (``backend.max-tile-mb``). Distinct from the 404
+    a bad coordinate gets: the resource exists, the ask is simply too
+    big — e.g. a z/t-projection whose full projected stack exceeds the
+    budget even though each individual plane fits."""
+
+    def __init__(self, message: str = "Request exceeds max-tile-bytes"):
+        super().__init__(413, message)
+
+
+class UnsupportedDialectError(TileError):
+    """501 — syntactically valid viewer-protocol grammar
+    (http/protocols/) this service deliberately does not serve
+    byte-exactly: arbitrary IIIF scaling/rotation, pct: regions,
+    bitonal quality, exotic formats. A clear refusal, distinct from
+    the 400 a malformed request gets."""
+
+    def __init__(self, message: str):
+        super().__init__(501, message)
+
+
 class ServiceUnavailableError(TileError):
     """503 — the service (or a dependency behind an open circuit
     breaker) cannot take the request right now; clients should back

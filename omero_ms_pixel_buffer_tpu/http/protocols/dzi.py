@@ -1,0 +1,143 @@
+"""DZI (Deep Zoom Image) adapter — OpenSeadragon's default dialect.
+
+Two URLs per image:
+
+- ``GET /dzi/{image}.dzi`` — the XML descriptor (byte-exact pinned in
+  tests: viewers hash/compare descriptors, so the encoding is part of
+  the contract);
+- ``GET /dzi/{image}_files/{level}/{col}_{row}.{fmt}`` — tiles on the
+  DZI level ladder: level N is the full image scaled by
+  2^(maxLevel - N) with maxLevel = ceil(log2(max(W, H))).
+
+The ladder maps onto the image's OWN pyramid: DZI level L serves
+pyramid resolution ``r = maxLevel - L``. Levels coarser than the
+stored pyramid (r >= resolution_levels) are 404 — this service never
+resynthesizes pyramid levels, and an honest 404 beats silently
+serving wrong-scale pixels (KNOWN_GAPS r15 records the scope).
+Rendering query params (``c``/``m``/``maps``/``q``/``roi``/``z``/
+``t``) ride along, so a DZI viewer can drive the full render model.
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from ...errors import BadRequestError
+from . import PROTOCOL_REQUESTS, levels_or_response, serve_translated
+
+_FORMATS = {"png": "png", "jpeg": "jpeg", "jpg": "jpeg"}
+
+# the descriptor template — byte-exact (tests pin it)
+_DESCRIPTOR = (
+    '<?xml version="1.0" encoding="UTF-8"?>\n'
+    '<Image xmlns="http://schemas.microsoft.com/deepzoom/2008" '
+    'Format="{fmt}" Overlap="0" TileSize="{tile}">'
+    '<Size Height="{h}" Width="{w}"/></Image>'
+)
+
+
+def max_level(w: int, h: int) -> int:
+    """ceil(log2(max(w, h))) — the DZI ladder's finest level index."""
+    level, extent = 0, max(int(w), int(h))
+    while (1 << level) < extent:
+        level += 1
+    return level
+
+
+def descriptor_xml(w: int, h: int, tile_size: int, fmt: str = "png") -> bytes:
+    return _DESCRIPTOR.format(
+        fmt=fmt, tile=tile_size, w=w, h=h
+    ).encode("ascii")
+
+
+def _dyadic(extent: int, res: int, actual: int) -> bool:
+    """Whether a stored level extent matches the DZI ladder's 2^res
+    expectation (floor or ceil halving both accepted — pyramid
+    writers differ on odd extents)."""
+    lo = max(1, extent >> res)
+    hi = max(1, (extent + (1 << res) - 1) >> res)
+    return lo <= actual <= hi
+
+
+def resolve_tile(
+    level_sizes, dzi_level: int, col: int, row: int, tile_size: int
+):
+    """(resolution, x, y, w, h) for one DZI tile, or raises
+    BadRequestError / returns None for a level/tile the pyramid does
+    not back (-> 404)."""
+    w0, h0 = level_sizes[0]
+    top = max_level(w0, h0)
+    if dzi_level > top:
+        return None  # finer than the image itself
+    res = top - dzi_level
+    if res >= len(level_sizes):
+        return None  # coarser than the stored pyramid
+    lw, lh = level_sizes[res]
+    if not (_dyadic(w0, res, lw) and _dyadic(h0, res, lh)):
+        # a non-dyadic pyramid (e.g. factor-4 NGFF coarsening) does
+        # not back this rung of the DZI ladder: serving it anyway
+        # would place wrong-scale pixels on the viewer's grid — the
+        # honest 404 the module contract promises
+        return None
+    x, y = col * tile_size, row * tile_size
+    if x >= lw or y >= lh:
+        return None  # off the level's grid
+    return res, x, y, min(tile_size, lw - x), min(tile_size, lh - y)
+
+
+def register_dzi(router, app_obj, cfg) -> None:
+    tile_size = cfg.tile_size
+
+    async def handle_descriptor(request: web.Request) -> web.Response:
+        PROTOCOL_REQUESTS.inc(dialect="dzi", kind="descriptor")
+        image_id = int(request.match_info["imageId"])
+        sizes, err = await levels_or_response(
+            app_obj, request, image_id
+        )
+        if err is not None:
+            return err
+        w, h = sizes[0]
+        return web.Response(
+            body=descriptor_xml(w, h, tile_size),
+            content_type="application/xml",
+        )
+
+    async def handle_tile(request: web.Request) -> web.Response:
+        PROTOCOL_REQUESTS.inc(dialect="dzi", kind="tile")
+        image_id = int(request.match_info["imageId"])
+        fmt = _FORMATS.get(request.match_info["fmt"])
+        if fmt is None:
+            return web.Response(
+                status=400,
+                text=f"Unsupported DZI format: "
+                     f"{request.match_info['fmt']!r} (png|jpeg|jpg)",
+            )
+        sizes, err = await levels_or_response(
+            app_obj, request, image_id
+        )
+        if err is not None:
+            return err
+        try:
+            placed = resolve_tile(
+                sizes,
+                int(request.match_info["level"]),
+                int(request.match_info["col"]),
+                int(request.match_info["row"]),
+                tile_size,
+            )
+        except BadRequestError as e:
+            return web.Response(status=400, text=e.message)
+        if placed is None:
+            return web.Response(status=404, text="No such tile")
+        res, x, y, w, h = placed
+        return await serve_translated(
+            app_obj, request, image_id, x, y, w, h, res,
+            overrides={"format": fmt},
+        )
+
+    router.add_get(r"/dzi/{imageId:\d+}.dzi", handle_descriptor)
+    router.add_get(
+        r"/dzi/{imageId:\d+}_files/{level:\d+}"
+        r"/{col:\d+}_{row:\d+}.{fmt:\w+}",
+        handle_tile,
+    )

@@ -1,0 +1,264 @@
+"""IIIF Image API adapter (2.1 / 3.0) — the subset the pipeline serves.
+
+- ``GET /iiif/{image}/info.json`` — the image information document
+  (3.0 by default; ``?version=2`` answers the 2.1 shape), advertising
+  the stored pyramid as ``sizes`` + one ``tiles`` ladder. Profile is
+  level0 + the explicit ladder: this service only serves scales its
+  pyramid actually stores.
+- ``GET /iiif/{image}/{region}/{size}/{rotation}/{quality}.{format}``
+  — region ``full`` or ``x,y,w,h`` (full-resolution frame, clipped to
+  the image like the spec demands); size ``max``/``full``, exact
+  ``w,h``/``w,``/``,h`` matching a stored pyramid scale of that
+  region, or best-fit ``!w,h``; rotation ``0`` only; quality
+  ``default``/``color``/``gray``; format ``png``/``jpg``.
+
+Everything outside that subset answers **501** with a one-line reason
+(``pct:`` regions, ``square``, arbitrary/upscaled sizes, non-zero or
+mirrored rotation, ``bitonal``, exotic formats) — a clear refusal
+beats a silently resampled lie. Grammar violations (malformed region
+tuple, bad size syntax) are **400**. Supported requests translate to
+the exact native ``/render`` ctx, so bytes, ETags, and cache entries
+are shared with every other dialect.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Tuple
+
+from aiohttp import web
+
+from ...errors import BadRequestError, UnsupportedDialectError
+from . import PROTOCOL_REQUESTS, levels_or_response, serve_translated
+
+
+class IiifNotSupported(UnsupportedDialectError):
+    """Valid IIIF grammar the pipeline cannot serve byte-exactly ->
+    501 Not Implemented (the errors.py taxonomy's 501 family)."""
+
+
+_FORMATS = {"png": "png", "jpg": "jpeg"}
+_QUALITIES = {"default": {}, "color": {}, "gray": {"m": "g"},
+              "grey": {"m": "g"}}
+
+
+def parse_region(
+    region: str, w0: int, h0: int
+) -> Tuple[int, int, int, int]:
+    """Full-resolution-frame region: ``full`` or ``x,y,w,h`` (clipped
+    to the extent; entirely-outside is a 400 per the spec)."""
+    if region == "full":
+        return 0, 0, w0, h0
+    if region == "square":
+        raise IiifNotSupported("square region is not supported")
+    if region.startswith("pct:"):
+        raise IiifNotSupported("pct: regions are not supported")
+    parts = region.split(",")
+    if len(parts) != 4:
+        raise BadRequestError(f"Malformed IIIF region: {region!r}")
+    try:
+        x, y, w, h = (int(p) for p in parts)
+    except ValueError:
+        raise BadRequestError(
+            f"Malformed IIIF region: {region!r}"
+        ) from None
+    if x < 0 or y < 0 or w <= 0 or h <= 0:
+        raise BadRequestError(f"Invalid IIIF region: {region!r}")
+    if x >= w0 or y >= h0:
+        raise BadRequestError(
+            f"IIIF region lies outside the image: {region!r}"
+        )
+    return x, y, min(w, w0 - x), min(h, h0 - y)
+
+
+def map_region_to_level(
+    x: int, y: int, w: int, h: int,
+    level_sizes: List[Tuple[int, int]], res: int,
+) -> Tuple[int, int, int, int]:
+    """The covering region at pyramid level ``res`` — the same
+    integer mapping the hybrid-resolution plan uses, so the choice is
+    deterministic and equals what a native request at that level
+    would spell."""
+    w0, h0 = level_sizes[0]
+    lw, lh = level_sizes[res]
+    x0 = x * lw // w0
+    y0 = y * lh // h0
+    x1 = min(lw, ((x + w) * lw + w0 - 1) // w0)
+    y1 = min(lh, ((y + h) * lh + h0 - 1) // h0)
+    return x0, y0, max(1, x1 - x0), max(1, y1 - y0)
+
+
+def parse_size(
+    size: str,
+    candidates: List[Tuple[int, Tuple[int, int, int, int]]],
+) -> int:
+    """Pick the pyramid level whose mapped region matches the size
+    request EXACTLY (this service never resamples). ``candidates`` is
+    [(resolution, (x, y, w, h))] finest-first."""
+    if size in ("max", "full"):
+        return candidates[0][0]
+    if size.startswith("^"):
+        raise IiifNotSupported("upscaling (^) is not supported")
+    if size.startswith("pct:"):
+        raise IiifNotSupported("pct: sizes are not supported")
+    best_fit = size.startswith("!")
+    if best_fit:
+        size = size[1:]
+    parts = size.split(",")
+    if len(parts) != 2 or (parts[0] == "" and parts[1] == ""):
+        raise BadRequestError(f"Malformed IIIF size: {size!r}")
+    try:
+        sw = int(parts[0]) if parts[0] else None
+        sh = int(parts[1]) if parts[1] else None
+    except ValueError:
+        raise BadRequestError(
+            f"Malformed IIIF size: {size!r}"
+        ) from None
+    if (sw is not None and sw <= 0) or (sh is not None and sh <= 0):
+        raise BadRequestError(f"Invalid IIIF size: {size!r}")
+    if best_fit:
+        if sw is None or sh is None:
+            raise BadRequestError(
+                f"Malformed IIIF best-fit size: !{size!r}"
+            )
+        for res, (_x, _y, w, h) in candidates:
+            if w <= sw and h <= sh:
+                return res
+        raise IiifNotSupported(
+            "no stored pyramid level fits the requested size"
+        )
+    for res, (_x, _y, w, h) in candidates:
+        if (sw is None or w == sw) and (sh is None or h == sh):
+            return res
+    raise IiifNotSupported(
+        "arbitrary scaling is not supported; request one of the "
+        "advertised sizes"
+    )
+
+
+def parse_rotation(rotation: str) -> None:
+    if rotation in ("0", "360"):
+        return
+    raise IiifNotSupported(
+        f"rotation {rotation!r} is not supported (only 0)"
+    )
+
+
+def parse_quality_format(last: str) -> Tuple[dict, str]:
+    """``{quality}.{format}`` -> (render-param overrides, format)."""
+    if "." not in last:
+        raise BadRequestError(
+            f"Malformed IIIF quality.format: {last!r}"
+        )
+    quality, fmt = last.rsplit(".", 1)
+    if quality == "bitonal":
+        raise IiifNotSupported("bitonal quality is not supported")
+    overrides = _QUALITIES.get(quality)
+    if overrides is None:
+        raise BadRequestError(f"Unknown IIIF quality: {quality!r}")
+    mapped = _FORMATS.get(fmt)
+    if mapped is None:
+        raise IiifNotSupported(
+            f"format {fmt!r} is not supported (png|jpg)"
+        )
+    return dict(overrides), mapped
+
+
+def info_document(
+    base_id: str,
+    level_sizes: List[Tuple[int, int]],
+    tile_size: int,
+    version: int = 3,
+) -> dict:
+    w0, h0 = level_sizes[0]
+    scale_factors = [
+        max(1, round(w0 / lw)) for (lw, _lh) in level_sizes
+    ]
+    sizes = [
+        {"width": lw, "height": lh}
+        for (lw, lh) in reversed(level_sizes)  # smallest first
+    ]
+    tiles = [{
+        "width": tile_size, "height": tile_size,
+        "scaleFactors": scale_factors,
+    }]
+    if version == 2:
+        return {
+            "@context": "http://iiif.io/api/image/2/context.json",
+            "@id": base_id,
+            "protocol": "http://iiif.io/api/image",
+            "profile": ["http://iiif.io/api/image/2/level0.json"],
+            "width": w0, "height": h0,
+            "sizes": sizes, "tiles": tiles,
+        }
+    return {
+        "@context": "http://iiif.io/api/image/3/context.json",
+        "id": base_id,
+        "type": "ImageService3",
+        "protocol": "http://iiif.io/api/image",
+        "profile": "level0",
+        "width": w0, "height": h0,
+        "sizes": sizes, "tiles": tiles,
+    }
+
+
+def register_iiif(router, app_obj, cfg) -> None:
+    tile_size = cfg.tile_size
+
+    async def handle_info(request: web.Request) -> web.Response:
+        PROTOCOL_REQUESTS.inc(dialect="iiif", kind="info")
+        image_id = int(request.match_info["imageId"])
+        sizes, err = await levels_or_response(
+            app_obj, request, image_id
+        )
+        if err is not None:
+            return err
+        version = 2 if request.query.get("version") == "2" else 3
+        doc = info_document(
+            f"{request.scheme}://{request.host}/iiif/{image_id}",
+            sizes, tile_size, version,
+        )
+        return web.Response(
+            body=json.dumps(doc, separators=(",", ":")).encode(),
+            content_type="application/json",
+        )
+
+    async def handle_tile(request: web.Request) -> web.Response:
+        PROTOCOL_REQUESTS.inc(dialect="iiif", kind="tile")
+        image_id = int(request.match_info["imageId"])
+        sizes, err = await levels_or_response(
+            app_obj, request, image_id
+        )
+        if err is not None:
+            return err
+        w0, h0 = sizes[0]
+        try:
+            x, y, w, h = parse_region(
+                request.match_info["region"], w0, h0
+            )
+            candidates = [
+                (res, map_region_to_level(x, y, w, h, sizes, res))
+                for res in range(len(sizes))
+            ]
+            res = parse_size(request.match_info["size"], candidates)
+            parse_rotation(request.match_info["rotation"])
+            overrides, fmt = parse_quality_format(
+                request.match_info["quality_format"]
+            )
+        except BadRequestError as e:
+            return web.Response(status=400, text=e.message)
+        except IiifNotSupported as e:
+            return web.Response(status=501, text=e.message)
+        overrides["format"] = fmt
+        lx, ly, lw, lh = dict(candidates)[res]
+        return await serve_translated(
+            app_obj, request, image_id, lx, ly, lw, lh,
+            res, overrides,
+        )
+
+    router.add_get(r"/iiif/{imageId:\d+}/info.json", handle_info)
+    router.add_get(
+        r"/iiif/{imageId:\d+}/{region}/{size}/{rotation}"
+        r"/{quality_format}",
+        handle_tile,
+    )

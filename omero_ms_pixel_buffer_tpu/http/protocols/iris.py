@@ -1,0 +1,130 @@
+"""Iris RESTful adapter — the WSI dialect OpenSeadragon's
+IrisTileSource speaks (PAPERS.md: "Iris RESTful Server and
+IrisTileSource").
+
+Two URLs per slide:
+
+- ``GET /iris/{image}/metadata`` — JSON slide metadata: the full
+  extent plus one entry per LAYER (Iris orders layers coarsest ->
+  finest — the reverse of this service's resolution levels) with its
+  tile-grid shape and scale.
+- ``GET /iris/{image}/layers/{layer}/tiles/{tile}`` — tiles by FLAT
+  index, row-major over the layer's grid at the configured tile size
+  (256 default — the Iris standard grid).
+
+Layer ``l`` maps to pyramid resolution ``levels - 1 - l``; a flat
+index decomposes as ``(tile % x_tiles, tile // x_tiles)``. Indices
+off the grid are 404 (the slide exists; that tile does not); non-
+numeric grammar never reaches the handler (route regex) or is 400.
+Tiles translate to the exact native ``/render`` ctx — same bytes,
+same ETags, same cache entries as every other dialect.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Tuple
+
+from aiohttp import web
+
+from . import PROTOCOL_REQUESTS, levels_or_response, serve_translated
+
+_FORMATS = {"png": "png", "jpeg": "jpeg", "jpg": "jpeg"}
+
+
+def layer_grid(
+    level_sizes: List[Tuple[int, int]], layer: int, tile_size: int
+):
+    """(resolution, x_tiles, y_tiles, lw, lh) for one Iris layer, or
+    None when the layer is off the ladder."""
+    if not 0 <= layer < len(level_sizes):
+        return None
+    res = len(level_sizes) - 1 - layer  # Iris: coarsest first
+    lw, lh = level_sizes[res]
+    x_tiles = (lw + tile_size - 1) // tile_size
+    y_tiles = (lh + tile_size - 1) // tile_size
+    return res, x_tiles, y_tiles, lw, lh
+
+
+def metadata_document(
+    level_sizes: List[Tuple[int, int]], tile_size: int
+) -> dict:
+    w0, h0 = level_sizes[0]
+    layers = []
+    for layer in range(len(level_sizes)):
+        res, x_tiles, y_tiles, lw, lh = layer_grid(
+            level_sizes, layer, tile_size
+        )
+        layers.append({
+            "x_tiles": x_tiles,
+            "y_tiles": y_tiles,
+            "scale": max(1, round(w0 / lw)),
+        })
+    return {
+        "type": "iris_slide_metadata",
+        "format": "png",
+        "encoding": "image",
+        "extent": {
+            "width": w0,
+            "height": h0,
+            "tile_size": tile_size,
+            "layers": layers,
+        },
+    }
+
+
+def register_iris(router, app_obj, cfg) -> None:
+    tile_size = cfg.tile_size
+
+    async def handle_metadata(request: web.Request) -> web.Response:
+        PROTOCOL_REQUESTS.inc(dialect="iris", kind="metadata")
+        image_id = int(request.match_info["imageId"])
+        sizes, err = await levels_or_response(
+            app_obj, request, image_id
+        )
+        if err is not None:
+            return err
+        return web.Response(
+            body=json.dumps(
+                metadata_document(sizes, tile_size),
+                separators=(",", ":"),
+            ).encode(),
+            content_type="application/json",
+        )
+
+    async def handle_tile(request: web.Request) -> web.Response:
+        PROTOCOL_REQUESTS.inc(dialect="iris", kind="tile")
+        image_id = int(request.match_info["imageId"])
+        fmt = _FORMATS.get(request.query.get("format", "png"))
+        if fmt is None:
+            return web.Response(
+                status=400,
+                text="Unsupported Iris format (png|jpeg|jpg)",
+            )
+        sizes, err = await levels_or_response(
+            app_obj, request, image_id
+        )
+        if err is not None:
+            return err
+        grid = layer_grid(
+            sizes, int(request.match_info["layer"]), tile_size
+        )
+        if grid is None:
+            return web.Response(status=404, text="No such layer")
+        res, x_tiles, y_tiles, lw, lh = grid
+        tile = int(request.match_info["tile"])
+        if tile >= x_tiles * y_tiles:
+            return web.Response(status=404, text="No such tile")
+        col, row = tile % x_tiles, tile // x_tiles
+        x, y = col * tile_size, row * tile_size
+        return await serve_translated(
+            app_obj, request, image_id, x, y,
+            min(tile_size, lw - x), min(tile_size, lh - y),
+            res, overrides={"format": fmt},
+        )
+
+    router.add_get(r"/iris/{imageId:\d+}/metadata", handle_metadata)
+    router.add_get(
+        r"/iris/{imageId:\d+}/layers/{layer:\d+}/tiles/{tile:\d+}",
+        handle_tile,
+    )

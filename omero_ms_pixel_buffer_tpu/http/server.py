@@ -80,7 +80,17 @@ CONTENT_TYPES = {
     "png": "image/png",
     "tif": "image/tiff",
     "jpeg": "image/jpeg",
+    "json": "application/json",  # histogram bodies (render/analysis)
 }
+
+# The serving lanes the admission machinery gates (binary gate, SLO
+# door gate, scheduler classification): the native endpoints AND every
+# protocol-adapter surface — an adapter request is the same pipeline
+# work in a different grammar, so it must shed/degrade/504 exactly
+# like a native one. Discovery, metrics, and health stay ungated.
+SERVING_PREFIXES = (
+    "/tile/", "/render/", "/histogram/", "/dzi/", "/iiif/", "/iris/",
+)
 
 
 async def handle_metrics(request: web.Request) -> web.Response:
@@ -199,7 +209,7 @@ def admission_middleware(admission: AdmissionController):
     @web.middleware
     async def middleware(request: web.Request, handler):
         if (
-            not request.path.startswith(("/tile/", "/render/"))
+            not request.path.startswith(SERVING_PREFIXES)
             or request.method == "OPTIONS"  # discovery/CORS preflight
         ):
             return await handler(request)
@@ -243,7 +253,7 @@ def overload_gate_middleware(app_obj: "PixelBufferApp"):
         sched = app_obj.scheduler
         if (
             sched is None
-            or not request.path.startswith(("/tile/", "/render/"))
+            or not request.path.startswith(SERVING_PREFIXES)
             or request.method == "OPTIONS"  # discovery/CORS preflight
         ):
             return await handler(request)
@@ -609,6 +619,20 @@ class PixelBufferApp:
             app.router.add_get(
                 "/render/{imageId}/{z}/{c}/{t}", self.handle_get_render
             )
+        self._protocols_enabled: dict = {}
+        if self.config.analysis.enabled:
+            app.router.add_get(
+                "/histogram/{imageId}/{z}/{c}/{t}",
+                self.handle_get_histogram,
+            )
+        if self.config.render.enabled:
+            # the protocol adapters serve RENDERED tiles, so they only
+            # mount when the render surface itself is on
+            from .protocols import register as register_protocols
+
+            self._protocols_enabled = register_protocols(
+                app.router, self
+            )
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         return app
@@ -689,6 +713,9 @@ class PixelBufferApp:
         render_health = {"enabled": self.config.render.enabled}
         if self.config.render.enabled:
             render_health.update(self.pipeline.render_snapshot())
+        analysis_health = {"enabled": self.config.analysis.enabled}
+        if self.config.analysis.enabled:
+            analysis_health.update(self.pipeline.analysis_snapshot())
         mesh_mgr = self._mesh_manager()
         if mesh_mgr is not None:
             render_health["mesh"] = mesh_mgr.snapshot()
@@ -714,6 +741,8 @@ class PixelBufferApp:
             "cache": cache_health,
             "prefetch": prefetch_health,
             "render": render_health,
+            "analysis": analysis_health,
+            "protocols": getattr(self, "_protocols_enabled", {}),
             "device_queue": device_queue,
             "io": io_snapshot(),
             "request_budget_ms": self.request_budget_s * 1000.0,
@@ -1104,38 +1133,92 @@ class PixelBufferApp:
         selection) never collides with the ``c`` PATH segment, which
         stays the default channel when no selection narrows it."""
         log.info("Get render")
+        try:
+            ctx = TileCtx.from_params(
+                dict(request.match_info), request.get("omero.session_key")
+            )
+        except TileError as e:
+            return web.Response(status=400, text=e.message)
+        spec, err = self.build_render_spec(request.query, ctx.c)
+        if err is not None:
+            return err
+        ctx.render = spec
+        ctx.format = spec.format  # drives Content-Type + filename
+        # query x/y/w/h/resolution ride along exactly like /tile's
+        err = self._apply_region_params(ctx, request.query)
+        if err is not None:
+            return err
+        return await self._serve(request, ctx)
+
+    @staticmethod
+    def _apply_region_params(ctx: TileCtx, query) -> Optional[web.Response]:
+        """Apply the x/y/w/h/resolution query params — the ONE parse
+        for every query-region surface (/render, /histogram), so a
+        bounds or message change can never drift between them.
+        Returns a 400 response on a malformed value, else None."""
+        try:
+            ctx.region.x = int(query.get("x", 0))
+            ctx.region.y = int(query.get("y", 0))
+            ctx.region.width = int(query.get("w", 0))
+            ctx.region.height = int(query.get("h", 0))
+            res = query.get("resolution")
+            ctx.resolution = None if res is None else int(res)
+        except (TypeError, ValueError) as e:
+            return web.Response(status=400, text=str(e))
+        return None
+
+    def build_render_spec(self, query, default_channel: int):
+        """Parse + validate a RenderSpec the ONE way — the native
+        /render handler and every protocol adapter call this, so
+        grammar 400s, default quality, and the LUT-registry check can
+        never drift between dialects. Returns (spec, None) or
+        (None, 400 response)."""
         from ..render.model import RenderSpec
+
+        try:
+            spec = RenderSpec.from_params(
+                query,
+                default_channel=default_channel,
+                default_quality=self.config.render.jpeg_quality,
+            )
+        except TileError as e:
+            return None, web.Response(status=400, text=e.message)
+        for ch in spec.channels:
+            if ch.lut is not None and (
+                ch.lut not in self.pipeline.lut_registry
+            ):
+                return None, web.Response(
+                    status=400, text=f"Unknown LUT: {ch.lut}"
+                )
+        return spec, None
+
+    async def handle_get_histogram(self, request: web.Request) -> web.Response:
+        """The analysis surface: per-channel pixel-intensity
+        histograms (render/analysis.py) in the omero-ms-image-region
+        dialect (``bins``, ``usePixelsTypeRange``, region/resolution
+        params, the render channel grammar for multi-channel +
+        windows). The JSON body is keyed, cached, ETagged, admitted,
+        and deadline-bounded EXACTLY like a tile — ``_serve`` is the
+        one serving path."""
+        log.info("Get histogram")
+        from ..render.analysis import HistogramSpec
 
         try:
             ctx = TileCtx.from_params(
                 dict(request.match_info), request.get("omero.session_key")
             )
-            spec = RenderSpec.from_params(
+            spec = HistogramSpec.from_params(
                 request.query,
                 default_channel=ctx.c,
-                default_quality=self.config.render.jpeg_quality,
+                max_bins=self.config.analysis.max_bins,
             )
         except TileError as e:
             return web.Response(status=400, text=e.message)
-        for ch in spec.channels:
-            if ch.lut is not None and (
-                ch.lut not in self.pipeline.lut_registry
-            ):
-                return web.Response(
-                    status=400, text=f"Unknown LUT: {ch.lut}"
-                )
-        ctx.render = spec
-        ctx.format = spec.format  # drives Content-Type + filename
-        # query x/y/w/h/resolution ride along exactly like /tile's
-        try:
-            ctx.region.x = int(request.query.get("x", 0))
-            ctx.region.y = int(request.query.get("y", 0))
-            ctx.region.width = int(request.query.get("w", 0))
-            ctx.region.height = int(request.query.get("h", 0))
-            res = request.query.get("resolution")
-            ctx.resolution = None if res is None else int(res)
-        except (TypeError, ValueError) as e:
-            return web.Response(status=400, text=str(e))
+        ctx.analysis = spec
+        ctx.format = "json"  # drives Content-Type
+        err = self._apply_region_params(ctx, request.query)
+        if err is not None:
+            return err
         return await self._serve(request, ctx)
 
     async def _serve(self, request: web.Request, ctx: TileCtx) -> web.Response:
@@ -1190,7 +1273,12 @@ class PixelBufferApp:
                         await cache.put(
                             key, plane_entry, generation=generation
                         )
-                        if self.prefetcher is not None:
+                        if self.prefetcher is not None and (
+                            ctx.analysis is None
+                        ):
+                            # histogram streams never train the tile
+                            # prefetcher: its predictions carry no
+                            # analysis spec and would warm RAW tiles
                             self.prefetcher.observe(ctx)
                         if inm and etag_matches(inm, plane_entry.etag):
                             return web.Response(
@@ -1221,7 +1309,9 @@ class PixelBufferApp:
                         status=304, headers=self._cache_headers(entry.etag)
                     )
                 if await self._authorize_cached(ctx):
-                    if self.prefetcher is not None:
+                    if self.prefetcher is not None and (
+                        ctx.analysis is None
+                    ):
                         self.prefetcher.observe(ctx)
                     if inm and etag_matches(inm, entry.etag):
                         return web.Response(
@@ -1325,7 +1415,7 @@ class PixelBufferApp:
         # is off)
         if cache is not None:
             self._authz_record(ctx)
-        if self.prefetcher is not None:
+        if self.prefetcher is not None and ctx.analysis is None:
             self.prefetcher.observe(ctx)
         etag = reply.headers.get("etag")
         # the pipeline clears ctx.degraded when no coarser level

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..db.postgres import PostgresUnavailableError
-from ..errors import ServiceUnavailableError
+from ..errors import RequestTooLargeError, ServiceUnavailableError
 from ..io.pixel_buffer import PixelBuffer
 from ..io.pixels_service import PixelsService
 from ..io.stores import StoreUnavailableError
@@ -93,6 +93,21 @@ class ResolvedTile:
         self.degrade_level = degrade_level
 
 
+
+
+class RenderLane:
+    """One staged render lane: the (C, H, W) unsigned channel stack
+    plus everything the encode needs to stay byte-identical across
+    engines — the TABLE spec/dtype (quantized float/int32 lanes build
+    their tables over the u16 bin space with windows erased, because
+    the windows are already baked into the host quantization) and the
+    rasterized ROI mask, when the spec carries shapes."""
+
+    __slots__ = ("stack", "tspec", "tdtype", "mask")
+
+    def __init__(self, stack, tspec, tdtype, mask=None):
+        self.stack, self.tspec, self.tdtype = stack, tspec, tdtype
+        self.mask = mask
 
 
 class DeferredTile:
@@ -237,6 +252,16 @@ class TilePipeline:
         self.lut_dir = lut_dir
         self._lut_registry = None
         self._render_tables: Dict[Tuple[str, str], tuple] = {}
+        # analysis plane (render/analysis): memoized value->bin tables
+        # for the histogram reduction, same bound/clear policy as the
+        # render tables
+        self._hist_tables: Dict[Tuple, np.ndarray] = {}
+        # ROI mask rasters (render/masks), memoized per (image,
+        # shape-set, region) and dropped with the image on
+        # invalidation like every other cached artifact
+        from ..render.masks import MaskRasterCache
+
+        self._mask_cache = MaskRasterCache()
 
     def close(self) -> None:
         """Release owned threads: the encode pool and (if the device
@@ -262,6 +287,7 @@ class TilePipeline:
         device-resident planes staged from it, and its decoded blocks
         (r14: including cached NEGATIVES — a backfilled chunk must not
         keep reading as fill_value until the TTL)."""
+        self._mask_cache.invalidate_image(image_id)
         svc = self.pixels_service
         ns = None
         if hasattr(svc, "invalidate"):
@@ -322,6 +348,13 @@ class TilePipeline:
                 if self._lut_registry is not None else None
             ),
             "lut_dir": self.lut_dir,
+            "masks": self._mask_cache.snapshot(),
+        }
+
+    def analysis_snapshot(self) -> dict:
+        """/healthz view of the analysis plane (histograms)."""
+        return {
+            "hist_tables_cached": len(self._hist_tables),
         }
 
     @property
@@ -617,10 +650,10 @@ class TilePipeline:
         ``ServiceUnavailableError`` marker (-> 503, dependency breaker
         open). Broad-catch like the reference
         (TileRequestHandler.java:133-137)."""
-        if ctx.render is not None:
-            # render lanes always take the batched machinery (multi-
-            # channel plane fetch, grouped device encode, host
-            # fallback); a singleton batch is the same code path
+        if ctx.render is not None or ctx.analysis is not None:
+            # render/analysis lanes always take the batched machinery
+            # (multi-channel plane fetch, grouped device reduction,
+            # host fallback); a singleton batch is the same code path
             return self.handle_batch([ctx])[0]
         with TRACER.start_span("get_tile"):
             try:
@@ -727,16 +760,27 @@ class TilePipeline:
         mesh = self._get_mesh() if use_device else None
 
         # render lanes (ctx.render set) split off here: they fetch one
-        # plane per active channel (x z-range under projection) and
+        # plane per active channel (x z/t-range under projection) and
         # composite on device, so the single-plane read grouping and
-        # the PNG bucket split below never see them
+        # the PNG bucket split below never see them. Analysis lanes
+        # (ctx.analysis set — histograms) split the same way: their
+        # result is a JSON body built from a batched integer
+        # reduction, never an encoded tile.
         render_idx = [
             i for i, ctx in enumerate(ctxs)
             if ctx.render is not None
+            and ctx.analysis is None
             and resolved[i] is not None
             and results[i] is None
         ]
         render_set = set(render_idx)
+        analysis_idx = [
+            i for i, ctx in enumerate(ctxs)
+            if ctx.analysis is not None
+            and resolved[i] is not None
+            and results[i] is None
+        ]
+        analysis_set = set(analysis_idx)
 
         # HBM-resident path: lanes whose plane is (or becomes) device-
         # resident skip the host read entirely — crop + filter happen
@@ -760,7 +804,10 @@ class TilePipeline:
             by_image: Dict[Tuple[int, int], List[int]] = {}
             tiles: List[Optional[np.ndarray]] = [None] * n
             for i, rt in enumerate(resolved):
-                if rt is None or i in in_plane or i in render_set:
+                if (
+                    rt is None or i in in_plane or i in render_set
+                    or i in analysis_set
+                ):
                     continue
                 if rt.degrade_level is not None:
                     try:
@@ -905,11 +952,17 @@ class TilePipeline:
                 self._plane_fallback(lanes, resolved, ctxs, results)
 
         render_pending: List[Tuple[List[int], object]] = []
-        render_stacks: Dict[int, np.ndarray] = {}
+        render_stacks: Dict[int, RenderLane] = {}
         if render_idx:
             render_pending, render_stacks = self._render_batch_lanes(
                 render_idx, resolved, ctxs, results,
                 use_fused=use_fused,
+            )
+
+        if analysis_idx:
+            self._analysis_batch_lanes(
+                analysis_idx, resolved, ctxs, results,
+                use_device=use_device,
             )
 
         if defer:
@@ -1071,14 +1124,20 @@ class TilePipeline:
         self, idxs, resolved, ctxs, results, use_fused: bool
     ):
         """Plan and read every render lane's channel planes (grouped
-        per image like the raw path), z-project, then either submit
-        fused device render groups (returned as [(lanes, future)] for
-        handle_batch's drain) or encode on the host in place. Per-lane
-        failures degrade to None (404) without failing the batch;
-        dependency-down reads become 503 markers like raw lanes."""
+        per image like the raw path; z/t-projection lanes consult —
+        and fill — the HBM plane cache first), project, quantize
+        float/int32 pixels onto the u16 bin space, rasterize ROI
+        masks, then either submit fused device render groups
+        (returned as [(lanes, future)] for handle_batch's drain) or
+        encode on the host in place. Per-lane failures degrade to
+        None (404) without failing the batch; dependency-down reads
+        become 503 markers like raw lanes; over-budget projection
+        stacks become 413 markers."""
         from ..render.engine import (
             RENDER_FALLBACK,
-            RENDER_TILES,
+            default_window,
+            quantizable_dtype,
+            quantize_to_u16,
             renderable_dtype,
             unsigned_view,
         )
@@ -1086,7 +1145,7 @@ class TilePipeline:
         from ..resilience.faultinject import INJECTOR
 
         pending: List[Tuple[List[int], object]] = []
-        stacks: Dict[int, np.ndarray] = {}
+        stacks: Dict[int, RenderLane] = {}
         plans: Dict[int, tuple] = {}
         by_image: Dict[Tuple[int, int], List[int]] = {}
         for i in idxs:
@@ -1094,30 +1153,64 @@ class TilePipeline:
             spec = ctx.render
             try:
                 chans = spec.resolve_channels(rt.meta.size_c)
-                zs = spec.z_range(ctx.z, rt.meta.size_z)
+                zts = spec.plane_range(
+                    ctx.z, ctx.t, rt.meta.size_z, rt.meta.size_t
+                )
             except Exception:
                 log.debug("unrenderable spec for image %d",
                           ctx.image_id, exc_info=True)
                 continue  # lane -> 404
-            if not renderable_dtype(rt.meta.dtype):
-                log.debug("unrenderable pixel type %s", rt.meta.dtype)
-                continue  # lane -> 404
+            dtype = rt.meta.dtype
+            quantized = False
+            if not renderable_dtype(dtype):
+                if not quantizable_dtype(dtype):
+                    log.debug("unrenderable pixel type %s", dtype)
+                    continue  # lane -> 404
+                quantized = True
+                if dtype.kind == "f" and any(
+                    ch.window is None for ch in chans
+                ):
+                    # float windowing needs an explicit window: float
+                    # pixels have no bounded pixel-type default
+                    log.debug(
+                        "float render without an explicit window "
+                        "for image %d", ctx.image_id,
+                    )
+                    continue  # lane -> 404
+            # Bound the TOTAL projected stack, not just one plane:
+            # resolve() guards w*h*bpp, but a z/t-projection
+            # materializes len(chans) * len(zts) planes before the
+            # reduction (the KNOWN_GAPS r10 per-plane gap). Over
+            # budget is 413, not 404 — the resource exists, the ask
+            # is too big.
+            nplanes = len(chans) * len(zts)
+            if (
+                self.max_tile_bytes
+                and rt.w * rt.h * rt.meta.bytes_per_pixel * nplanes
+                > self.max_tile_bytes
+            ):
+                results[i] = RequestTooLargeError(
+                    f"Projection stack {rt.w}x{rt.h} x {nplanes} "
+                    f"planes exceeds max-tile-bytes "
+                    f"({self.max_tile_bytes})"
+                )
+                continue
             upscale = None
             if rt.degrade_level is not None:
                 # hybrid-resolution fallback: read every channel
                 # plane from the coarse level, upscale after staging
                 cx0, cy0, crw, crh, ys, xs = self._degrade_plan(rt)
                 coords = [
-                    (z, ch.index, ctx.t, cx0, cy0, crw, crh)
-                    for ch in chans for z in zs
+                    (z, ch.index, t, cx0, cy0, crw, crh)
+                    for ch in chans for (z, t) in zts
                 ]
                 upscale = (ys, xs, crh, crw)
             else:
                 coords = [
-                    (z, ch.index, ctx.t, rt.x, rt.y, rt.w, rt.h)
-                    for ch in chans for z in zs
+                    (z, ch.index, t, rt.x, rt.y, rt.w, rt.h)
+                    for ch in chans for (z, t) in zts
                 ]
-            plans[i] = (chans, zs, coords, upscale)
+            plans[i] = (chans, zts, coords, upscale, quantized)
             by_image.setdefault(
                 (
                     rt.meta.image_id,
@@ -1128,9 +1221,47 @@ class TilePipeline:
         with TRACER.start_span("render_stage"):
             for (image_id, level), lanes in by_image.items():
                 buf = resolved[lanes[0]].buffer
-                flat = [c for i in lanes for c in plans[i][2]]
+                # projection lanes consult the HBM plane cache per
+                # (z, c, t) plane BEFORE the host read (and get_plane
+                # fills it on repeat touches): a repeated projection
+                # pan stops re-reading its whole plane range per tile
+                # (the KNOWN_GAPS r10 bypass). Misses fall into ONE
+                # batched read_tiles call like before.
+                per_lane: Dict[int, list] = {}
+                flat: List[tuple] = []
+                owners: List[Tuple[int, int]] = []
+                for i in lanes:
+                    chans, zts, coords, upscale, _q = plans[i]
+                    slots = [None] * len(coords)
+                    per_lane[i] = slots
+                    use_hbm = (
+                        ctxs[i].render.projection is not None
+                        and upscale is None
+                        and self.use_device
+                        and self.use_plane_cache
+                        and getattr(buf, "samples", 1) == 1
+                        # 64-bit planes must stay on the host path:
+                        # with x64 disabled, device_put silently
+                        # canonicalizes f8->f4 / i8->i4 (truncating),
+                        # so a cached crop would differ from the host
+                        # read and flip bytes after plane admission
+                        and resolved[i].meta.dtype.itemsize <= 4
+                    )
+                    for j, coord in enumerate(coords):
+                        arr = (
+                            self._plane_cache_region(buf, level, coord)
+                            if use_hbm else None
+                        )
+                        if arr is not None:
+                            slots[j] = arr
+                        else:
+                            flat.append(coord)
+                            owners.append((i, j))
                 try:
-                    planes = buf.read_tiles(flat, level=level)
+                    planes = (
+                        buf.read_tiles(flat, level=level)
+                        if flat else []
+                    )
                 except _UNAVAILABLE as e:
                     log.warning(
                         "store unavailable for image %d: %s", image_id, e
@@ -1145,23 +1276,43 @@ class TilePipeline:
                         image_id,
                     )
                     continue
-                pos = 0
+                for (i, j), arr in zip(owners, planes):
+                    per_lane[i][j] = arr
                 for i in lanes:
-                    chans, zs, coords, upscale = plans[i]
-                    lane_planes = planes[pos : pos + len(coords)]
-                    pos += len(coords)
+                    chans, zts, coords, upscale, quantized = plans[i]
+                    lane_planes = per_lane[i]
+                    if any(p is None for p in lane_planes):
+                        continue  # a read slot failed -> 404
                     rt = resolved[i]
+                    spec = ctxs[i].render
                     try:
                         if upscale is not None:
                             ys, xs, crh, crw = upscale
                             stack = np.stack(lane_planes).reshape(
-                                len(chans), len(zs), crh, crw
+                                len(chans), len(zts), crh, crw
                             )[:, :, ys[:, None], xs[None, :]]
                         else:
                             stack = np.stack(lane_planes).reshape(
-                                len(chans), len(zs), rt.h, rt.w
+                                len(chans), len(zts), rt.h, rt.w
                             )
-                        spec = ctxs[i].render
+                        tspec, tdtype = spec, rt.meta.dtype
+                        if quantized:
+                            # window each channel onto the u16 bin
+                            # space on the HOST (engine byte-identity:
+                            # every engine gathers identical indices);
+                            # projection then runs in the integer
+                            # domain like any 16-bit image
+                            q = np.empty(stack.shape, dtype=np.uint16)
+                            for ci, ch in enumerate(chans):
+                                win = (
+                                    ch.window
+                                    if ch.window is not None
+                                    else default_window(rt.meta.dtype)
+                                )
+                                q[ci] = quantize_to_u16(stack[ci], win)
+                            stack = q
+                            tspec = spec.without_windows()
+                            tdtype = np.dtype(np.uint16)
                         if spec.projection is not None:
                             stack = project(
                                 stack, spec.projection,
@@ -1169,53 +1320,65 @@ class TilePipeline:
                             )
                         else:
                             stack = stack[:, 0]
-                        stacks[i] = unsigned_view(
-                            np.ascontiguousarray(stack)
+                        mask = None
+                        if spec.masks:
+                            mask = self._mask_cache.get(
+                                rt.meta.image_id, spec.masks,
+                                (rt.x, rt.y, rt.w, rt.h),
+                            )
+                        stacks[i] = RenderLane(
+                            unsigned_view(np.ascontiguousarray(stack)),
+                            tspec, tdtype, mask,
                         )
                     except Exception:
                         log.exception(
                             "render staging failed for lane %d", i
                         )
 
-        # encode groups: (spec signature, pixel type, real size,
+        # encode groups: (spec signature, TABLE dtype, real size,
         # bucket) — one fused dispatch per group, one jit
-        # specialization per (shape, C)
+        # specialization per (shape, C). Masked lanes serve through
+        # the host mirror (byte-identical by the engine contract; the
+        # fused mask chain is validated but not queue-wired yet —
+        # KNOWN_GAPS r15), as do JPEG and over-bucket lanes.
         groups: Dict[Tuple, List[int]] = {}
-        for i, stack in stacks.items():
+        for i, lane in stacks.items():
             rt, spec = resolved[i], ctxs[i].render
             bucket = (
                 self._bucket(rt.w, rt.h)
-                if use_fused and spec.format == "png" else None
+                if use_fused and spec.format == "png"
+                and lane.mask is None
+                else None
             )
             if bucket is None:
                 self._render_host_lane(
-                    i, ctxs[i], rt, stack, results
+                    i, ctxs[i], rt, lane, results
                 )
                 continue
             groups.setdefault(
                 (
-                    spec.signature(), rt.meta.dtype.str,
+                    spec.signature(), lane.tdtype.str,
                     (rt.w, rt.h), bucket,
                 ),
                 [],
             ).append(i)
 
         fmode = self._render_filter_mode()
-        for (sig, dtype_str, (w, h), (bw, bh)), lanes in groups.items():
-            spec = ctxs[lanes[0]].render
+        for (sig, tdtype_str, (w, h), (bw, bh)), lanes in groups.items():
+            lane0 = stacks[lanes[0]]
             try:
                 # the chaos seam: failing `render.engine` here proves
                 # the host mirror serves byte-identical tiles
                 INJECTOR.fire("render.engine")
                 tables, luts = self._render_tables_for(
-                    spec, np.dtype(dtype_str)
+                    lane0.tspec, np.dtype(tdtype_str)
                 )
                 c = tables.shape[0]
                 batch = np.zeros(
-                    (len(lanes), c, bh, bw), dtype=stacks[lanes[0]].dtype
+                    (len(lanes), c, bh, bw), dtype=lane0.stack.dtype
                 )
                 for j, i in enumerate(lanes):
-                    batch[j, :, :h, :w] = stacks[i]
+                    batch[j, :, :h, :w] = stacks[i].stack
                 disp = self._get_dispatcher()
                 with TRACER.start_span("render_device"):
                     fut = disp.submit_render(
@@ -1234,29 +1397,301 @@ class TilePipeline:
                     )
         return pending, stacks
 
-    def _render_host_lane(self, i, ctx, rt, stack, results) -> None:
-        """One lane through the host mirror: numpy composite + the
-        numpy twin of the device stream builder (PNG bytes identical
-        to the fused device chain) or Pillow JPEG."""
+    def _render_host_lane(self, i, ctx, rt, lane, results) -> None:
+        """One lane through the host mirror: numpy composite (+ ROI
+        mask) + the numpy twin of the device stream builder (PNG
+        bytes identical to the fused device chain) or Pillow JPEG.
+        ``lane`` is the staged RenderLane (None -> 404)."""
         from ..render import engine as rengine
 
-        if stack is None:
+        if lane is None:
             results[i] = None
             return
         spec = ctx.render
         try:
-            tables, luts = self._render_tables_for(spec, rt.meta.dtype)
+            tables, luts = self._render_tables_for(
+                lane.tspec, lane.tdtype
+            )
             if spec.format == "png":
                 results[i] = rengine.render_png_host(
-                    stack, tables, luts, self._render_filter_mode()
+                    lane.stack, tables, luts,
+                    self._render_filter_mode(), lane.mask,
                 )
             else:
-                rgb = rengine.render_host(stack, tables, luts)
+                rgb = rengine.render_host(
+                    lane.stack, tables, luts, lane.mask
+                )
                 results[i] = rengine.encode_jpeg(rgb, spec.quality)
             rengine.RENDER_TILES.inc(path="host", format=spec.format)
         except Exception:
             log.exception("host render failed for lane %d", i)
             results[i] = None
+
+    def _plane_cache_region(self, buf, level, coord):
+        """One (z, c, t) plane region served from (and filling) the
+        HBM plane-cache namespace — the projection read path: the
+        cache's admission counter sees every touch, so a repeated
+        z/t-projection pan stages its plane range once and then crops
+        on-device instead of re-reading planes through the host per
+        tile. None on any miss/ineligibility (edge-clamped crop, cold
+        plane, budget); the caller falls back to the batched host
+        read. The crop's values are identical to the host read by
+        construction (the plane IS the host read, staged once)."""
+        z, c, t, x, y, w, h = coord
+        try:
+            from .device_cache import DevicePlaneCache
+
+            if self._plane_cache is None:
+                self._plane_cache = DevicePlaneCache()
+            cache = self._plane_cache
+            size_x, size_y = buf.level_size(level)
+            if x + w > size_x or y + h > size_y:
+                return None  # crop would clamp at the plane edge
+            plane = cache.get_plane(buf, level, z, c, t)
+            if plane is None:
+                return None
+            crop = cache.crop_batch(plane, [(y, x)], h, w)
+            # ompb-lint: disable=jax-hotpath -- the ONE intended pull of this path: the cached plane region returns to host staging
+            return np.asarray(crop)[0]
+        except Exception:
+            log.debug("plane-cache region read failed", exc_info=True)
+            return None
+
+    # ------------------------------------------------------------------
+    # analysis lanes (render/analysis): per-channel histograms as a
+    # batched integer reduction — device bincount, host mirror
+    # integer-identical, canonical JSON bodies through the same
+    # cache/ETag machinery as tiles
+    # ------------------------------------------------------------------
+
+    def _hist_table_for(self, dtype, window, bins: int) -> np.ndarray:
+        """Memoized value->bin table for integer pixel types (float/
+        int32 planes quantize first and use ``_quant_hist_table_for``);
+        same bound/clear policy as the render tables."""
+        from ..render import analysis as ran
+
+        key = (
+            np.dtype(dtype).str, float(window[0]), float(window[1]),
+            bins,
+        )
+        hit = self._hist_tables.get(key)
+        if hit is None:
+            hit = ran.build_bin_table(np.dtype(dtype), window, bins)
+            if len(self._hist_tables) >= 256:
+                self._hist_tables.clear()  # coarse but bounded
+            self._hist_tables[key] = hit
+        return hit
+
+    def _quant_hist_table_for(self, bins: int) -> np.ndarray:
+        from ..render import analysis as ran
+
+        key = ("quant", bins)
+        hit = self._hist_tables.get(key)
+        if hit is None:
+            hit = ran.quant_bin_table(bins)
+            if len(self._hist_tables) >= 256:
+                self._hist_tables.clear()
+            self._hist_tables[key] = hit
+        return hit
+
+    def _analysis_batch_lanes(
+        self, idxs, resolved, ctxs, results, use_device: bool
+    ) -> None:
+        """Histogram lanes: read each lane's channel-plane regions
+        (grouped per image like render lanes), map values onto bins
+        through host-built tables, reduce in batched device bincounts
+        (host mirror integer-identical — the ``analysis.engine``
+        chaos seam proves it byte-for-byte), and write the canonical
+        JSON body into the lane's result slot. Failure taxonomy
+        matches render lanes: per-lane 404s, dependency-down 503
+        markers, over-budget 413 markers."""
+        from ..render import analysis as ran
+        from ..render.engine import (
+            quantizable_dtype,
+            quantize_to_u16,
+            renderable_dtype,
+            unsigned_view,
+        )
+
+        plans: Dict[int, tuple] = {}
+        by_image: Dict[Tuple[int, int], List[int]] = {}
+        for i in idxs:
+            rt, ctx = resolved[i], ctxs[i]
+            spec = ctx.analysis
+            try:
+                chans = spec.resolve_channels(rt.meta.size_c)
+            except Exception:
+                log.debug("bad histogram channel for image %d",
+                          ctx.image_id, exc_info=True)
+                continue  # lane -> 404
+            d = rt.meta.dtype
+            if not (renderable_dtype(d) or quantizable_dtype(d)):
+                log.debug("unhistogrammable pixel type %s", d)
+                continue  # lane -> 404
+            if (
+                self.max_tile_bytes
+                and rt.w * rt.h * rt.meta.bytes_per_pixel * len(chans)
+                > self.max_tile_bytes
+            ):
+                results[i] = RequestTooLargeError(
+                    f"Histogram region {rt.w}x{rt.h} x {len(chans)} "
+                    f"channels exceeds max-tile-bytes "
+                    f"({self.max_tile_bytes})"
+                )
+                continue
+            coords = [
+                (ctx.z, ch.index, ctx.t, rt.x, rt.y, rt.w, rt.h)
+                for ch in chans
+            ]
+            plans[i] = (chans, coords)
+            by_image.setdefault(
+                (rt.meta.image_id, rt.level), []
+            ).append(i)
+
+        jobs: List[Tuple[int, list]] = []
+        with TRACER.start_span("analysis_stage"):
+            for (image_id, level), lanes in by_image.items():
+                buf = resolved[lanes[0]].buffer
+                flat = [c for i in lanes for c in plans[i][1]]
+                try:
+                    planes = buf.read_tiles(flat, level=level)
+                except _UNAVAILABLE as e:
+                    log.warning(
+                        "store unavailable for image %d: %s",
+                        image_id, e,
+                    )
+                    marker = _lane_unavailable(e)
+                    for i in lanes:
+                        results[i] = marker  # lanes -> 503
+                    continue
+                except Exception:
+                    log.exception(
+                        "histogram read failed for image %d; "
+                        "lanes -> 404", image_id,
+                    )
+                    continue
+                pos = 0
+                for i in lanes:
+                    chans, coords = plans[i]
+                    lane_planes = planes[pos : pos + len(coords)]
+                    pos += len(coords)
+                    rt, spec = resolved[i], ctxs[i].analysis
+                    try:
+                        entry = []
+                        for ch, plane in zip(chans, lane_planes):
+                            window = ran.resolve_window(
+                                ch, rt.meta.dtype,
+                                spec.use_pixel_range, plane=plane,
+                            )
+                            if renderable_dtype(rt.meta.dtype):
+                                tab = self._hist_table_for(
+                                    rt.meta.dtype, window, spec.bins
+                                )
+                                idx_plane = unsigned_view(
+                                    np.ascontiguousarray(plane)
+                                )
+                            else:
+                                idx_plane = quantize_to_u16(
+                                    plane, window
+                                )
+                                tab = self._quant_hist_table_for(
+                                    spec.bins
+                                )
+                            entry.append((ch, window, idx_plane, tab))
+                        jobs.append((i, entry))
+                    except Exception:
+                        log.exception(
+                            "histogram staging failed for lane %d", i
+                        )
+        if jobs:
+            self._reduce_histogram_jobs(
+                jobs, ctxs, resolved, results, use_device
+            )
+
+    def _reduce_histogram_jobs(
+        self, jobs, ctxs, resolved, results, use_device: bool
+    ) -> None:
+        """Group staged (plane, table) pairs by shape and reduce each
+        group in ONE batched call — device bincounts when the device
+        engine serves (sharded over the mesh when one is up), the
+        numpy mirror otherwise or on any device failure (counts are
+        integer-identical, so the JSON bytes cannot differ)."""
+        from ..render import analysis as ran
+        from ..resilience.faultinject import INJECTOR
+
+        counts_map: Dict[Tuple[int, int], np.ndarray] = {}
+        groups: Dict[Tuple, List[Tuple[int, int]]] = {}
+        for j, (i, entry) in enumerate(jobs):
+            for e, (_ch, _win, idx_plane, tab) in enumerate(entry):
+                key = (
+                    idx_plane.shape, idx_plane.dtype.str,
+                    tab.shape[0], ctxs[i].analysis.bins,
+                )
+                groups.setdefault(key, []).append((j, e))
+        for (_shape, _dstr, _k, bins), members in groups.items():
+            planes_arr = np.stack(
+                [jobs[j][1][e][2] for j, e in members]
+            )
+            tabs = np.stack([jobs[j][1][e][3] for j, e in members])
+            path = "host"
+            counts = None
+            if use_device:
+                try:
+                    # the chaos seam: failing `analysis.engine` proves
+                    # the host mirror answers identical counts/bytes
+                    INJECTOR.fire("analysis.engine")
+                    mesh = self._get_mesh()
+                    if mesh is not None:
+                        counts = ran.sharded_histogram_batch(
+                            mesh, planes_arr, tabs, bins
+                        )
+                        path = "mesh"
+                    else:
+                        counts = ran.histogram_batch(
+                            planes_arr, tabs, bins
+                        )
+                        path = "device"
+                except Exception:
+                    log.exception(
+                        "device histogram failed; host mirror"
+                    )
+                    counts = None
+            if counts is None:
+                counts = ran.histogram_host(planes_arr, tabs, bins)
+                path = "host"
+            ran.HIST_TILES.inc(len(members), path=path)
+            for (j, e), c in zip(members, counts):
+                counts_map[(j, e)] = c
+        for j, (i, entry) in enumerate(jobs):
+            try:
+                spec, ctx, rt = ctxs[i].analysis, ctxs[i], resolved[i]
+                ch_results = []
+                for e, (ch, window, _p, _t) in enumerate(entry):
+                    counts = counts_map.get((j, e))
+                    if counts is None:
+                        raise RuntimeError(
+                            "histogram reduction incomplete"
+                        )
+                    ch_results.append({
+                        "index": ch.index,
+                        "window": [
+                            round(float(window[0]), 6),
+                            round(float(window[1]), 6),
+                        ],
+                        "counts": [int(x) for x in counts],
+                        "stats": ran.stats_from_counts(
+                            counts, window, spec.bins
+                        ),
+                    })
+                results[i] = ran.histogram_body(
+                    ctx.image_id, ctx.z, ctx.t,
+                    (rt.x, rt.y, rt.w, rt.h), ctx.resolution,
+                    spec, ch_results,
+                )
+            except Exception:
+                log.exception(
+                    "histogram assembly failed for lane %d", i
+                )
 
     def _stage_plane_lanes(self, ctxs, resolved):
         """Group device-eligible PNG lanes by resident plane; stages

@@ -1,0 +1,391 @@
+"""Pixel-intensity histograms — the analysis half of the render plane.
+
+``GET /histogram/{image}/{z}/{c}/{t}`` (the ``omero-ms-image-region``
+histogram dialect: ``bins``, ``usePixelsTypeRange``, plus the same
+region/resolution/channel params every other endpoint speaks) answers
+per-channel integer histograms over exactly the planes the render path
+already reads. The reduction is the textbook batched-TPU workload:
+
+    bin  = bin_table[pixel]        # host-built value->bin gather
+    hist = zeros(bins).at[bin].add(1)   # integer scatter-add
+
+All float math (window -> bin edges) happens on the HOST in float64
+when the table is built — the device program is integer gathers and
+integer adds, so counts are INTEGER-IDENTICAL across the jitted device
+program, the numpy host mirror, and the shard_map mesh path (pinned in
+tests). Statistics (min/max/mean/percentiles) derive purely from the
+counts + the bin edges, so they are a deterministic function of data
+every engine agrees on.
+
+float32/int32 planes ride the same machinery through
+``engine.quantize_to_u16``: the window quantizes values onto the u16
+bin space on the host, and the device histogram is unchanged.
+
+The JSON body is canonicalized (sorted nothing, fixed field order,
+compact separators) so one histogram has ONE byte encoding — it flows
+through the result cache / ETag / 304 machinery like any tile.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, List, Mapping, Optional, Tuple
+
+import numpy as np
+
+from ..errors import BadRequestError
+from ..utils.metrics import REGISTRY
+from .engine import default_window
+from .model import ChannelSpec, _channel_from_token, _parse_maps
+
+HIST_TILES = REGISTRY.counter(
+    "analysis_histograms_total",
+    "Histogram requests served by engine path",
+)
+HIST_SECONDS = REGISTRY.histogram(
+    "analysis_histogram_seconds",
+    "Histogram reduction wall time (stage=tables|device|host)",
+)
+
+MAX_BINS = 65536
+DEFAULT_BINS = 256
+
+_TRUTHY = ("1", "true", "yes")
+
+
+@dataclasses.dataclass(frozen=True)
+class HistogramSpec:
+    """A parsed, canonical histogram request. ``channels`` reuses the
+    render channel dialect (``c=1|100:600,2``): each ACTIVE channel
+    gets its own histogram; per-channel windows bound the bin range
+    (``usePixelsTypeRange`` overrides every window with the pixel
+    type's full range, the omero-ms-image-region spelling)."""
+
+    channels: Tuple[ChannelSpec, ...]
+    bins: int = DEFAULT_BINS
+    use_pixel_range: bool = False
+
+    @classmethod
+    def from_params(
+        cls,
+        params: Mapping[str, Any],
+        default_channel: int = 0,
+        max_bins: int = MAX_BINS,
+    ) -> "HistogramSpec":
+        bins_raw = params.get("bins", DEFAULT_BINS)
+        try:
+            bins = int(bins_raw)
+        except (TypeError, ValueError):
+            raise BadRequestError(
+                f"Invalid bins: {bins_raw!r}"
+            ) from None
+        if not 2 <= bins <= min(max_bins, MAX_BINS):
+            raise BadRequestError(
+                f"bins must be in [2, {min(max_bins, MAX_BINS)}]"
+            )
+        upr = str(params.get("usePixelsTypeRange", "")).strip().lower()
+        use_pixel_range = upr in _TRUTHY
+        c_raw = params.get("c")
+        if c_raw is None:
+            if default_channel < 0:
+                raise BadRequestError("Channel must be >= 0")
+            channels: List[ChannelSpec] = [
+                ChannelSpec(index=int(default_channel))
+            ]
+        else:
+            tokens = [t for t in str(c_raw).split(",") if t.strip()]
+            if not tokens:
+                raise BadRequestError("Empty channel list")
+            maps = _parse_maps(params.get("maps"), len(tokens))
+            channels = []
+            for token, cmap in zip(tokens, maps):
+                ch = _channel_from_token(token, cmap)
+                if ch is not None:
+                    channels.append(ch)
+            if not channels:
+                raise BadRequestError("No active channels")
+            seen = set()
+            for ch in channels:
+                if ch.index in seen:
+                    raise BadRequestError(
+                        f"Duplicate channel index: {ch.index + 1}"
+                    )
+                seen.add(ch.index)
+        return cls(
+            channels=tuple(sorted(channels, key=lambda c: c.index)),
+            bins=bins,
+            use_pixel_range=use_pixel_range,
+        )
+
+    def signature(self) -> str:
+        """Canonical identity — keys the result cache, the batcher's
+        lane dedupe, and the single-flight registry like a render
+        signature does."""
+        ch = ",".join(
+            f"{c.index}:"
+            + ("auto" if c.window is None
+               else f"{c.window[0]:g}:{c.window[1]:g}")
+            for c in self.channels
+        )
+        r = "ptr" if self.use_pixel_range else "win"
+        return f"hist:b{self.bins}:{r}:[{ch}]"
+
+    def to_json(self) -> dict:
+        return {
+            "bins": self.bins,
+            "usePixelsTypeRange": self.use_pixel_range,
+            "channels": [dataclasses.asdict(c) for c in self.channels],
+        }
+
+    @classmethod
+    def from_json(cls, obj: Optional[dict]) -> Optional["HistogramSpec"]:
+        if obj is None:
+            return None
+        return cls(
+            channels=tuple(
+                ChannelSpec(
+                    index=int(c["index"]),
+                    window=(
+                        None if c.get("window") is None
+                        else tuple(c["window"])
+                    ),
+                )
+                for c in obj.get("channels", [])
+            ),
+            bins=int(obj.get("bins", DEFAULT_BINS)),
+            use_pixel_range=bool(obj.get("usePixelsTypeRange", False)),
+        )
+
+    def resolve_channels(self, size_c: int) -> Tuple[ChannelSpec, ...]:
+        for ch in self.channels:
+            if ch.index >= size_c:
+                raise ValueError(
+                    f"Channel {ch.index} out of range (SizeC={size_c})"
+                )
+        return self.channels
+
+
+# ---------------------------------------------------------------------------
+# bin tables — ALL float math lives here, on the host, in float64
+# ---------------------------------------------------------------------------
+
+
+def resolve_window(
+    ch: ChannelSpec,
+    dtype: np.dtype,
+    use_pixel_range: bool,
+    plane: Optional[np.ndarray] = None,
+) -> Tuple[float, float]:
+    """The value range the histogram spans for one channel: the pixel
+    type's full range under ``usePixelsTypeRange`` (or for any
+    integer channel without an explicit window), else the channel's
+    window; float planes without a window span the observed data
+    range (deterministic — the plane IS the request)."""
+    dtype = np.dtype(dtype)
+    if dtype.kind in "ui":
+        if use_pixel_range or ch.window is None:
+            return default_window(dtype)
+        return (float(ch.window[0]), float(ch.window[1]))
+    # float plane: no meaningful "pixel type range"
+    if ch.window is not None and not use_pixel_range:
+        return (float(ch.window[0]), float(ch.window[1]))
+    if plane is None:
+        raise ValueError(
+            "float histogram without a window needs the plane"
+        )
+    finite = plane[np.isfinite(plane)]
+    if finite.size == 0:
+        return (0.0, 1.0)
+    lo, hi = float(finite.min()), float(finite.max())
+    if not lo < hi:
+        hi = lo + 1.0
+    return (lo, hi)
+
+
+def build_bin_table(
+    dtype: np.dtype, window: Tuple[float, float], bins: int
+) -> np.ndarray:
+    """(K,) int32 value->bin table over pixel type ``dtype`` (<= 16-bit
+    integers; quantized planes use ``quant_bin_table``). Values below
+    the window clamp into bin 0, above into bins-1 — the
+    omero-ms-image-region clamping. Signed dtypes map through the same
+    two's-complement unsigned view the render tables use."""
+    dtype = np.dtype(dtype)
+    if dtype.kind not in "ui" or dtype.itemsize > 2:
+        raise ValueError(f"No direct bin table for {dtype}")
+    k = 1 << (8 * dtype.itemsize)
+    u = np.arange(k, dtype=np.int64)
+    values = u if dtype.kind == "u" else ((u + k // 2) % k) - k // 2
+    return _bins_for_values(values.astype(np.float64), window, bins)
+
+
+def quant_bin_table(bins: int) -> np.ndarray:
+    """(QUANT_BINS,) int32 bin table for planes already quantized to
+    u16 by ``engine.quantize_to_u16``: the window is baked into the
+    quantization, so bins split the u16 space linearly."""
+    from .engine import QUANT_BINS
+
+    values = np.arange(QUANT_BINS, dtype=np.float64)
+    return _bins_for_values(values, (0.0, float(QUANT_BINS - 1)), bins)
+
+
+def _bins_for_values(
+    values: np.ndarray, window: Tuple[float, float], bins: int
+) -> np.ndarray:
+    lo, hi = float(window[0]), float(window[1])
+    if not lo < hi:
+        raise ValueError(f"Degenerate histogram window [{lo}:{hi}]")
+    x = np.clip((values - lo) / (hi - lo), 0.0, 1.0)
+    return np.minimum(
+        np.floor(x * bins).astype(np.int64), bins - 1
+    ).astype(np.int32)
+
+
+def bin_edges(window: Tuple[float, float], bins: int) -> np.ndarray:
+    """(bins + 1,) float64 bin boundaries for stats derivation."""
+    return np.linspace(float(window[0]), float(window[1]), bins + 1)
+
+
+# ---------------------------------------------------------------------------
+# the reduction — device program + integer-identical host mirror
+# ---------------------------------------------------------------------------
+
+
+def _histogram_core(planes, bin_tables, bins: int):
+    """Traceable core: (B, H, W) unsigned planes + (B, K) int32 bin
+    tables -> (B, bins) int32 counts. Per-lane gather + scatter-add;
+    lane-independent, so shard_map shards it with no collectives."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(plane, tab):
+        idx = tab[plane.reshape(-1).astype(jnp.int32)]
+        return jnp.zeros((bins,), jnp.int32).at[idx].add(1)
+
+    return jax.vmap(one)(planes, bin_tables)
+
+
+_hist_jit = None
+
+
+def histogram_batch(planes, bin_tables, bins: int) -> np.ndarray:
+    """Jitted batched device histogram; returns host (B, bins) int32.
+    The jitted callable is built on first use so importing this module
+    never imports jax (host-only deployments)."""
+    global _hist_jit
+    import jax
+    import jax.numpy as jnp
+
+    if _hist_jit is None:
+        _hist_jit = jax.jit(_histogram_core, static_argnums=(2,))
+    with HIST_SECONDS.time(stage="device"):
+        out = _hist_jit(
+            jnp.asarray(planes), jnp.asarray(bin_tables), bins
+        )
+        # ompb-lint: disable=jax-hotpath -- the ONE intended pull: final integer counts return once per batch
+        return np.asarray(out)
+
+
+def histogram_host(planes, bin_tables, bins: int) -> np.ndarray:
+    """Numpy mirror — integer-identical counts."""
+    planes = np.asarray(planes)
+    bin_tables = np.asarray(bin_tables)
+    with HIST_SECONDS.time(stage="host"):
+        out = np.empty((planes.shape[0], bins), dtype=np.int32)
+        for i in range(planes.shape[0]):
+            idx = bin_tables[i][planes[i].reshape(-1).astype(np.int64)]
+            out[i] = np.bincount(idx, minlength=bins)[:bins]
+    return out
+
+
+def sharded_histogram_batch(mesh, planes, bin_tables, bins: int) -> np.ndarray:
+    """The mesh path: lanes shard over the batch axis (pad to the mesh
+    width), each chip bincounts its lanes locally — no collectives —
+    and counts come back integer-identical to the single-device
+    program on the same lanes."""
+    import jax.numpy as jnp
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover - jax < 0.6
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.sharding import pad_batch
+
+    axis = "data"
+    n = mesh.shape[axis]
+    padded, real = pad_batch(jnp.asarray(planes), n)
+    tabs, _ = pad_batch(jnp.asarray(bin_tables), n)
+    fn = shard_map(
+        lambda p, t: _histogram_core(p, t, bins),
+        mesh=mesh,
+        in_specs=(P(axis), P(axis)),
+        out_specs=P(axis),
+    )
+    # ompb-lint: disable=jax-hotpath -- the ONE intended pull: final integer counts return once per batch
+    return np.asarray(fn(padded, tabs))[:real]
+
+
+# ---------------------------------------------------------------------------
+# stats + canonical JSON body
+# ---------------------------------------------------------------------------
+
+_PERCENTILES = (1, 25, 50, 75, 99)
+
+
+def stats_from_counts(
+    counts: np.ndarray, window: Tuple[float, float], bins: int
+) -> dict:
+    """Summary statistics derived PURELY from (counts, bin edges):
+    every engine produced the same counts, so the stats agree byte-
+    for-byte. min/max report the lower/upper edge of the extreme
+    non-empty bins; mean uses bin midpoints; percentiles are the
+    lower edge of the bin where the cumulative count crosses."""
+    counts = np.asarray(counts, dtype=np.int64)
+    total = int(counts.sum())
+    edges = bin_edges(window, bins)
+    out = {"count": total}
+    nz = np.nonzero(counts)[0]
+    if total == 0 or nz.size == 0:
+        out.update({"min": None, "max": None, "mean": None})
+        out.update({f"p{p}": None for p in _PERCENTILES})
+        return out
+    out["min"] = round(float(edges[nz[0]]), 6)
+    out["max"] = round(float(edges[nz[-1] + 1]), 6)
+    mids = (edges[:-1] + edges[1:]) / 2.0
+    out["mean"] = round(float((counts * mids).sum() / total), 6)
+    cum = np.cumsum(counts)
+    for p in _PERCENTILES:
+        rank = max(1, int(np.ceil(total * p / 100.0)))
+        out[f"p{p}"] = round(
+            float(edges[int(np.searchsorted(cum, rank))]), 6
+        )
+    return out
+
+
+def histogram_body(
+    image_id: int,
+    z: int,
+    t: int,
+    region: Tuple[int, int, int, int],
+    resolution: Optional[int],
+    spec: HistogramSpec,
+    channel_results: List[dict],
+) -> bytes:
+    """The canonical JSON encoding — ONE byte form per histogram, so
+    the bytes cache/ETag like any tile. ``data`` mirrors the first
+    channel's counts (the omero-ms-image-region compatibility field);
+    ``channels`` carries the full per-channel results."""
+    obj = {
+        "imageId": image_id,
+        "z": z,
+        "t": t,
+        "region": list(region),
+        "resolution": resolution,
+        "bins": spec.bins,
+        "usePixelsTypeRange": spec.use_pixel_range,
+        "data": channel_results[0]["counts"] if channel_results else [],
+        "channels": channel_results,
+    }
+    return json.dumps(obj, separators=(",", ":")).encode("ascii")

@@ -97,11 +97,43 @@ def default_window(dtype: np.dtype) -> Tuple[float, float]:
 
 
 def renderable_dtype(dtype: np.dtype) -> bool:
-    """The engine's domain: integer pixels up to 16-bit (the OMERO
-    rendering engine's own domain; float pixels have no bounded
-    value->table mapping)."""
+    """The engine's DIRECT table domain: integer pixels up to 16-bit
+    (a value->table gather needs a bounded index space). Wider and
+    float pixels render through ``quantize_to_u16`` instead."""
     dtype = np.dtype(dtype)
     return dtype.kind in "ui" and dtype.itemsize <= 2
+
+
+def quantizable_dtype(dtype: np.dtype) -> bool:
+    """Pixel types the engine windows through the host value->bin
+    quantization (float32/float64/int32/uint32): the channel window
+    maps values onto ``QUANT_BINS`` uint16 bins on the host, and the
+    device program stays the same pure-integer gather chain."""
+    dtype = np.dtype(dtype)
+    return (
+        dtype.kind in "uif"
+        and dtype.itemsize in (4, 8)
+        and not renderable_dtype(dtype)
+    )
+
+
+QUANT_BINS = 65536  # the quantized (u16) index space
+
+
+def quantize_to_u16(
+    plane: np.ndarray, window: Tuple[float, float]
+) -> np.ndarray:
+    """Window a float/int32 plane onto the uint16 bin space: clip to
+    the window, scale to [0, 65535], round half-up — all in host
+    float64, so every engine gathers from identical indices. NaNs map
+    to bin 0 (below-window), infinities clip to the window edges."""
+    lo, hi = float(window[0]), float(window[1])
+    if not lo < hi or not (np.isfinite(lo) and np.isfinite(hi)):
+        raise RenderError(f"Degenerate quantization window [{lo}:{hi}]")
+    x = (plane.astype(np.float64) - lo) / (hi - lo)
+    x = np.nan_to_num(x, nan=0.0, posinf=1.0, neginf=0.0)
+    x = np.clip(x, 0.0, 1.0)
+    return np.floor(x * float(QUANT_BINS - 1) + 0.5).astype(np.uint16)
 
 
 def _channel_lut(
@@ -174,8 +206,18 @@ def build_tables(
             )
             if ch.reverse:
                 x = 1.0 - x
-            if ch.family == "exponential":
+            if ch.family in ("exponential", "polynomial"):
+                # the gamma curve; "polynomial" is OMERO's canonical
+                # name for it, "exponential" this service's historical
+                # spelling — identical tables by design
                 x = np.power(x, ch.coefficient)
+            elif ch.family == "logarithmic":
+                # normalized log map: log(1 + k*x) / log(1 + k);
+                # monotone on [0, 1] with slope set by k (> 0,
+                # validated at parse)
+                x = np.log1p(ch.coefficient * x) / np.log1p(
+                    ch.coefficient
+                )
             tables.append(
                 np.clip(np.floor(x * 255.0 + 0.5), 0, 255).astype(
                     np.uint8
@@ -196,12 +238,17 @@ def build_tables(
 
 
 def render_local(
-    planes: jax.Array, index_tables: jax.Array, color_luts: jax.Array
+    planes: jax.Array,
+    index_tables: jax.Array,
+    color_luts: jax.Array,
+    mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """(B, C, H, W) unsigned pixels + (C, K)/(C, 256, 3) tables ->
     (B, H, W, 3) uint8 composited RGB. Pure gathers + an int32 sum;
     un-jitted so parallel/sharding can shard_map it and the fused
-    serving program can inline it."""
+    serving program can inline it. ``mask`` (B, H, W) uint8 0/1
+    multiplies the composite (ROI masking): still pure integer ops,
+    so masked lanes keep the byte-identity contract."""
 
     def one(tab, lut, plane):  # (K,), (256, 3), (B, H, W)
         return lut[tab[plane]].astype(jnp.int32)  # (B, H, W, 3)
@@ -212,23 +259,31 @@ def render_local(
         index_tables, color_luts,
         planes[:, : index_tables.shape[0]],
     )  # (C, B, H, W, 3)
-    return jnp.minimum(contrib.sum(axis=0), 255).astype(jnp.uint8)
+    comp = jnp.minimum(contrib.sum(axis=0), 255)
+    if mask is not None:
+        comp = comp * mask[:, :, :, None].astype(jnp.int32)
+    return comp.astype(jnp.uint8)
 
 
 def render_host(
     planes: np.ndarray,
     index_tables: np.ndarray,
     color_luts: np.ndarray,
+    mask: Optional[np.ndarray] = None,
 ) -> np.ndarray:
     """Numpy mirror of ``render_local`` for one lane: (C, H, W)
-    unsigned pixels -> (H, W, 3) uint8, byte-identical pixels."""
+    unsigned pixels (+ optional (H, W) uint8 mask) -> (H, W, 3)
+    uint8, byte-identical pixels."""
     acc = None
     for c in range(index_tables.shape[0]):  # greyscale: 1 table
         contrib = color_luts[c][index_tables[c][planes[c]]].astype(
             np.int32
         )
         acc = contrib if acc is None else acc + contrib
-    return np.minimum(acc, 255).astype(np.uint8)
+    comp = np.minimum(acc, 255)
+    if mask is not None:
+        comp = comp * mask[:, :, None].astype(np.int32)
+    return comp.astype(np.uint8)
 
 
 @jax.jit
@@ -261,13 +316,15 @@ def render_filter_deflate_local(
     mode: str,
     packer: str,
     interpret: bool,
+    mask: Optional[jax.Array] = None,
 ):
     """Un-jitted fused core: unsigned channel planes (B, C, H, W) ->
-    (streams, lengths) — composite, PNG filter (bpp=3, RGB8 needs no
-    byteswap), and the deflate stream build in one traceable body.
-    shard_map maps exactly this over the mesh (parallel/sharding), so
-    multi-chip bytes are identical to single-device bytes."""
-    rgb = render_local(planes, index_tables, color_luts)
+    (streams, lengths) — composite, optional ROI mask multiply, PNG
+    filter (bpp=3, RGB8 needs no byteswap), and the deflate stream
+    build in one traceable body. shard_map maps exactly this over the
+    mesh (parallel/sharding), so multi-chip bytes are identical to
+    single-device bytes."""
+    rgb = render_local(planes, index_tables, color_luts, mask)
     b, h = rgb.shape[0], rgb.shape[1]
     scanrows = rgb.reshape(b, h, -1)
     filtered = _filter_batch(scanrows, 3, filter_mode)
@@ -278,11 +335,11 @@ def render_filter_deflate_local(
 @partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8))
 def _fused_render_filter_deflate(
     planes, index_tables, color_luts, rows, row_bytes, filter_mode,
-    mode, packer, interpret,
+    mode, packer, interpret, mask,
 ):
     return render_filter_deflate_local(
         planes, index_tables, color_luts, rows, row_bytes,
-        filter_mode, mode, packer, interpret,
+        filter_mode, mode, packer, interpret, mask,
     )
 
 
@@ -295,6 +352,7 @@ def fused_render_filter_deflate_batch(
     filter_mode: str = "up",
     mode: str = "rle",
     packer: Optional[str] = None,
+    mask=None,
 ) -> tuple:
     """The render serving chain as ONE dispatched program. planes
     (B, C, H, W) unsigned (bucket-padded; pointwise rendering of pad
@@ -307,10 +365,14 @@ def fused_render_filter_deflate_batch(
         raise ValueError(f"Unknown device deflate mode: {mode}")
     packer = packer or default_packer()
     planes, b = _pad_pow2_lanes(jnp.asarray(planes))
+    if mask is not None:
+        # pad the mask's lane axis identically (pad lanes mask to 0 —
+        # their bytes are sliced away regardless)
+        mask, _ = _pad_pow2_lanes(jnp.asarray(mask))
     streams, lengths = _fused_render_filter_deflate(
         planes, jnp.asarray(index_tables), jnp.asarray(color_luts),
         rows, row_bytes, filter_mode, mode, packer,
-        _interpret_for(packer),
+        _interpret_for(packer), mask,
     )
     return streams[:b], lengths[:b]
 
@@ -325,13 +387,15 @@ def render_png_host(
     index_tables: np.ndarray,
     color_luts: np.ndarray,
     filter_mode: str = "up",
+    mask: Optional[np.ndarray] = None,
 ) -> bytes:
     """One lane rendered and PNG-encoded entirely on the host,
-    byte-identical to the fused device chain: numpy composite + numpy
-    scanline filter + the numpy mirror of the device RLE/fixed-Huffman
-    stream (``ops.device_deflate.zlib_rle_np``)."""
+    byte-identical to the fused device chain: numpy composite (+
+    optional ROI mask) + numpy scanline filter + the numpy mirror of
+    the device RLE/fixed-Huffman stream
+    (``ops.device_deflate.zlib_rle_np``)."""
     with RENDER_SECONDS.time(stage="host"):
-        rgb = render_host(planes, index_tables, color_luts)
+        rgb = render_host(planes, index_tables, color_luts, mask)
         h, w = rgb.shape[:2]
         filtered = filter_rows_np(rgb.reshape(h, w * 3), 3, filter_mode)
         stream = zlib_rle_np(filtered.tobytes())

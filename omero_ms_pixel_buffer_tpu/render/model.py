@@ -18,9 +18,14 @@ params; this module parses that dialect into a frozen dataclass whose
   ``omero-ms-image-region`` spelling for per-channel reverse intensity
   and quantization: ``[{"reverse": {"enabled": true}, "quantization":
   {"family": "exponential", "coefficient": 1.5}}, ...]``. Families:
-  ``linear`` (default) and ``exponential`` (gamma).
-- ``p`` — z-projection: ``intmax`` or ``intmean``, optionally with an
-  inclusive range ``intmax|0:5``; without a range the whole stack.
+  ``linear`` (default), ``exponential``/``polynomial`` (gamma, x^k),
+  and ``logarithmic`` (log(1 + k*x) / log(1 + k)).
+- ``p`` — intensity projection: ``intmax`` or ``intmean``, optionally
+  with an axis (``intmax:t`` projects over time; default ``:z``) and
+  an inclusive range ``intmax|0:5``; without a range the whole stack.
+- ``roi`` — JSON array of shape objects (render/masks.py grammar:
+  rect/ellipse/polygon/polyline) rasterized into a per-tile mask and
+  composited multiplicatively (outside-the-shapes pixels black).
 - ``format`` — ``png`` (default) | ``jpeg`` (``jpg`` accepted);
   ``q`` — JPEG quality as the OMERO 0..1 float.
 
@@ -47,10 +52,16 @@ _CHANNEL = re.compile(
     r"(?:\$(?P<suffix>.+))?$"
 )
 _PROJECTION = re.compile(
-    r"^(?P<mode>intmax|intmean)(?:\|(?P<start>\d+):(?P<end>\d+))?$"
+    r"^(?P<mode>intmax|intmean)(?::(?P<axis>[zt]))?"
+    r"(?:\|(?P<start>\d+):(?P<end>\d+))?$"
 )
 
-FAMILIES = ("linear", "exponential")
+# Quantization families (the OMERO quantum map). "exponential" is the
+# historical gamma spelling this service shipped first (x^k);
+# "polynomial" is OMERO's canonical name for the same curve and maps
+# to identical tables; "logarithmic" is the normalized log map
+# log(1 + k*x) / log(1 + k).
+FAMILIES = ("linear", "exponential", "polynomial", "logarithmic")
 PROJECTIONS = ("intmax", "intmean")
 FORMATS = ("png", "jpeg")
 
@@ -160,7 +171,16 @@ class RenderSpec:
     quality: int = 90  # JPEG quality (1-100)
     projection: Optional[str] = None  # intmax | intmean
     proj_start: Optional[int] = None  # inclusive; None = 0
-    proj_end: Optional[int] = None  # inclusive; None = size_z - 1
+    proj_end: Optional[int] = None  # inclusive; None = size_{axis} - 1
+    # which axis the projection collapses: "z" (the classic stack
+    # projection) or "t" (``p=intmax:t`` — a time-series projection
+    # over the SAME integer reduction)
+    proj_axis: str = "z"
+    # ROI shape masks (render/masks.py), parsed from the ``roi=`` JSON
+    # query param: rasterized per tile into a uint8 mask composited
+    # multiplicatively after the channel composite (masked-out pixels
+    # render black). Canonically ordered tuple — part of signature().
+    masks: Tuple["ShapeSpec", ...] = ()
 
     @classmethod
     def from_params(
@@ -198,15 +218,18 @@ class RenderSpec:
             quality = max(1, min(100, round(q * 100)))
 
         projection = proj_start = proj_end = None
+        proj_axis = "z"
         p_raw = params.get("p")
         if p_raw is not None:
             m = _PROJECTION.match(p_raw)
             if m is None:
                 raise BadRequestError(
                     f"Malformed projection: {p_raw!r} "
-                    "(expected intmax|intmean, optionally |start:end)"
+                    "(expected intmax|intmean, optionally :z|:t for "
+                    "the axis and |start:end for the range)"
                 )
             projection = m.group("mode")
+            proj_axis = m.group("axis") or "z"
             if m.group("start") is not None:
                 proj_start = int(m.group("start"))
                 proj_end = int(m.group("end"))
@@ -214,6 +237,13 @@ class RenderSpec:
                     raise BadRequestError(
                         "Projection range end must be >= start"
                     )
+
+        masks: Tuple = ()
+        roi_raw = params.get("roi")
+        if roi_raw is not None:
+            from .masks import parse_roi  # deferred: keeps import light
+
+            masks = parse_roi(roi_raw)
 
         c_raw = params.get("c")
         if c_raw is None:
@@ -245,7 +275,7 @@ class RenderSpec:
             channels=tuple(sorted(channels, key=lambda ch: ch.index)),
             model=model, format=fmt, quality=quality,
             projection=projection, proj_start=proj_start,
-            proj_end=proj_end,
+            proj_end=proj_end, proj_axis=proj_axis, masks=masks,
         )
 
     # -- canonical identity ------------------------------------------------
@@ -258,9 +288,16 @@ class RenderSpec:
             "-" if self.projection is None
             else f"{self.projection}:{self.proj_start}:{self.proj_end}"
         )
+        if self.projection is not None and self.proj_axis != "z":
+            # axis only joins when non-default, so every pre-existing
+            # z-projection signature (and its cached entries) is stable
+            p += f"@{self.proj_axis}"
         ch = ",".join(c.token() for c in self.channels)
         q = f":q{self.quality}" if self.format == "jpeg" else ""
-        return f"m{self.model}:{self.format}{q}:p{p}:[{ch}]"
+        sig = f"m{self.model}:{self.format}{q}:p{p}:[{ch}]"
+        if self.masks:
+            sig += f":roi[{','.join(m.token() for m in self.masks)}]"
+        return sig
 
     # -- dispatch-boundary (de)serialization (TileCtx contract) ------------
 
@@ -272,7 +309,9 @@ class RenderSpec:
             "projection": self.projection,
             "projStart": self.proj_start,
             "projEnd": self.proj_end,
+            "projAxis": self.proj_axis,
             "channels": [dataclasses.asdict(c) for c in self.channels],
+            "masks": [dataclasses.asdict(m) for m in self.masks],
         }
 
     @classmethod
@@ -294,6 +333,13 @@ class RenderSpec:
             )
             for c in obj.get("channels", [])
         )
+        masks: Tuple = ()
+        if obj.get("masks"):
+            from .masks import ShapeSpec
+
+            masks = tuple(
+                ShapeSpec.from_json(m) for m in obj["masks"]
+            )
         return cls(
             channels=channels,
             model=obj.get("model", "c"),
@@ -302,6 +348,8 @@ class RenderSpec:
             projection=obj.get("projection"),
             proj_start=obj.get("projStart"),
             proj_end=obj.get("projEnd"),
+            proj_axis=obj.get("projAxis", "z"),
+            masks=masks,
         )
 
     # -- render-time resolution --------------------------------------------
@@ -321,16 +369,48 @@ class RenderSpec:
         return self.channels
 
     def z_range(self, z: int, size_z: int) -> List[int]:
-        """The z planes one lane reads: [z] without projection, else
-        the clipped inclusive projection range."""
-        if self.projection is None:
+        """The z planes one lane reads: [z] without a z-projection,
+        else the clipped inclusive projection range. (Kept as the
+        historical z-only spelling; ``plane_range`` is the general
+        z/t form.)"""
+        if self.projection is None or self.proj_axis != "z":
             return [z]
+        return self._axis_range(size_z, "Z")
+
+    def plane_range(
+        self, z: int, t: int, size_z: int, size_t: int
+    ) -> List[Tuple[int, int]]:
+        """The (z, t) plane coordinates one lane reads, in projection
+        order: a single plane without projection, the z stack for a
+        z-projection at fixed t, the t series for a t-projection at
+        fixed z."""
+        if self.projection is None:
+            return [(z, t)]
+        if self.proj_axis == "t":
+            return [(z, ti) for ti in self._axis_range(size_t, "T")]
+        return [(zi, t) for zi in self._axis_range(size_z, "Z")]
+
+    def _axis_range(self, size: int, label: str) -> List[int]:
         start = 0 if self.proj_start is None else self.proj_start
-        end = size_z - 1 if self.proj_end is None else self.proj_end
-        start, end = max(0, start), min(size_z - 1, end)
+        end = size - 1 if self.proj_end is None else self.proj_end
+        start, end = max(0, start), min(size - 1, end)
         if end < start:
             raise ValueError(
                 f"Projection range [{self.proj_start}:{self.proj_end}] "
-                f"outside the stack (SizeZ={size_z})"
+                f"outside the stack (Size{label}={size})"
             )
         return list(range(start, end + 1))
+
+    def without_windows(self) -> "RenderSpec":
+        """This spec with every channel window erased — the table key
+        for quantized (float32/int32) lanes, whose windows are baked
+        into the host value->bin quantization before the integer
+        engine ever sees the pixels (render/engine.quantize_to_u16):
+        two specs differing only in window share one u16 table set."""
+        return dataclasses.replace(
+            self,
+            channels=tuple(
+                dataclasses.replace(ch, window=None)
+                for ch in self.channels
+            ),
+        )

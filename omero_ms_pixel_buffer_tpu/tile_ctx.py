@@ -19,6 +19,7 @@ from .errors import BadRequestError
 from .resilience.deadline import Deadline
 
 if TYPE_CHECKING:  # pragma: no cover - import cycle guard (render -> errors)
+    from .render.analysis import HistogramSpec
     from .render.model import RenderSpec
 
 
@@ -65,6 +66,14 @@ def _render_from_json(obj: Any) -> Optional["RenderSpec"]:
     return RenderSpec.from_json(obj)
 
 
+def _analysis_from_json(obj: Any) -> Optional["HistogramSpec"]:
+    if obj is None:
+        return None
+    from .render.analysis import HistogramSpec  # deferred: same cycle
+
+    return HistogramSpec.from_json(obj)
+
+
 @dataclasses.dataclass
 class TileCtx:
     """Parsed /tile request (TileCtx.java:36-54,67-90)."""
@@ -88,6 +97,11 @@ class TileCtx:
     # never alias each other) in the cache, the single-flight registry,
     # or the batcher's dedupe
     render: Optional["RenderSpec"] = None
+    # /histogram requests carry the parsed HistogramSpec
+    # (render/analysis.py); None = not an analysis request. Joins
+    # every key below exactly like the render signature, so histogram
+    # JSON bodies never alias tile bytes in any tier.
+    analysis: Optional["HistogramSpec"] = None
     # SLO scheduling (resilience/scheduler): the request's priority
     # class (0 interactive > 1 prefetch > 2 bulk) — orders the
     # batcher's deadline queue, never changes bytes — and the
@@ -152,6 +166,9 @@ class TileCtx:
             "render": (
                 None if self.render is None else self.render.to_json()
             ),
+            "analysis": (
+                None if self.analysis is None else self.analysis.to_json()
+            ),
             "priority": self.priority,
             "degraded": self.degraded,
         }
@@ -180,6 +197,7 @@ class TileCtx:
                 trace_context=dict(obj.get("traceContext") or {}),
                 deadline=Deadline.from_json(obj.get("deadline")),
                 render=_render_from_json(obj.get("render")),
+                analysis=_analysis_from_json(obj.get("analysis")),
                 priority=int(obj.get("priority", 0) or 0),
                 degraded=int(obj.get("degraded", 0) or 0),
             )
@@ -210,6 +228,8 @@ class TileCtx:
         )
         if self.render is not None:
             base += f"|render={self.render.signature()}"
+        if self.analysis is not None:
+            base += f"|hist={self.analysis.signature()}"
         if self.degraded:
             # a degraded (coarser-upscaled) body is a DIFFERENT
             # resource: it must never overwrite, nor serve as, the
@@ -235,6 +255,7 @@ class TileCtx:
             r.x, r.y, r.width, r.height,
             self.resolution, self.format, self.omero_session_key,
             None if self.render is None else self.render.signature(),
+            None if self.analysis is None else self.analysis.signature(),
             self.degraded,
         )
 

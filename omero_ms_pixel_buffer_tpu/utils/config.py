@@ -336,6 +336,47 @@ class RenderConfig:
 
 
 @dataclasses.dataclass
+class AnalysisConfig:
+    """The analysis: block — the /histogram serving surface
+    (render/analysis.py). ``max_bins`` caps the per-request ``bins``
+    param (the reduction materializes a bins-wide table per lane, so
+    operators bound it like any other allocation)."""
+
+    enabled: bool = True
+    max_bins: int = 65536
+
+
+@dataclasses.dataclass
+class ProtocolAdapterConfig:
+    """One viewer-protocol adapter (http/protocols/): an independently
+    shippable grammar over the native TileCtx/RenderSpec core.
+    ``tile_size`` is the grid the dialect advertises (DZI TileSize /
+    IIIF tile width / Iris layer grid)."""
+
+    enabled: bool = True
+    tile_size: int = 256
+
+
+@dataclasses.dataclass
+class ProtocolsConfig:
+    """The protocols: block — per-adapter enable flags so an operator
+    can ship ``/histogram`` + DZI without exposing IIIF (or turn the
+    whole plane off). Adapters translate foreign URL grammars into
+    the SAME resolved TileCtx/RenderSpec the native endpoints build,
+    so they share cache entries, ETags, and admission behavior."""
+
+    dzi: ProtocolAdapterConfig = dataclasses.field(
+        default_factory=ProtocolAdapterConfig
+    )
+    iiif: ProtocolAdapterConfig = dataclasses.field(
+        default_factory=ProtocolAdapterConfig
+    )
+    iris: ProtocolAdapterConfig = dataclasses.field(
+        default_factory=ProtocolAdapterConfig
+    )
+
+
+@dataclasses.dataclass
 class MeshConfig:
     """The mesh: block — serving-mesh health. ``probe_interval_ms``
     > 0 runs MeshManager's chip probe on a background cadence so a
@@ -410,6 +451,12 @@ class Config:
     )
     io: IoConfig = dataclasses.field(default_factory=IoConfig)
     render: RenderConfig = dataclasses.field(default_factory=RenderConfig)
+    analysis: AnalysisConfig = dataclasses.field(
+        default_factory=AnalysisConfig
+    )
+    protocols: ProtocolsConfig = dataclasses.field(
+        default_factory=ProtocolsConfig
+    )
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     jax: JaxConfig = dataclasses.field(default_factory=JaxConfig)
     logging: LoggingConfig = dataclasses.field(default_factory=LoggingConfig)
@@ -840,6 +887,73 @@ class Config:
         )
 
     @staticmethod
+    def _parse_analysis(raw: dict) -> AnalysisConfig:
+        """Validate the analysis: block — same posture as the other
+        blocks: typos and nonsense fail at startup."""
+        an = raw.get("analysis") or {}
+        unknown = set(an) - {"enabled", "max-bins"}
+        if unknown:
+            raise ConfigError(
+                f"Unknown keys in 'analysis' block: {sorted(unknown)}"
+            )
+        try:
+            max_bins = int(an.get("max-bins", 65536))
+        except (TypeError, ValueError):
+            raise ConfigError(
+                "Invalid value for 'analysis.max-bins': "
+                f"{an.get('max-bins')!r}"
+            ) from None
+        if not 2 <= max_bins <= 65536:
+            raise ConfigError(
+                "'analysis.max-bins' must be in [2, 65536]"
+            )
+        return AnalysisConfig(
+            enabled=bool(an.get("enabled", True)),
+            max_bins=max_bins,
+        )
+
+    @staticmethod
+    def _parse_protocols(raw: dict) -> ProtocolsConfig:
+        """Validate the protocols: block — per-adapter sub-blocks
+        (dzi/iiif/iris), unknown keys fail at startup."""
+        pr = raw.get("protocols") or {}
+        unknown = set(pr) - {"dzi", "iiif", "iris"}
+        if unknown:
+            raise ConfigError(
+                f"Unknown keys in 'protocols' block: {sorted(unknown)}"
+            )
+
+        def adapter(name: str) -> ProtocolAdapterConfig:
+            block = pr.get(name) or {}
+            bad = set(block) - {"enabled", "tile-size"}
+            if bad:
+                raise ConfigError(
+                    f"Unknown keys in 'protocols.{name}' block: "
+                    f"{sorted(bad)}"
+                )
+            try:
+                ts = int(block.get("tile-size", 256))
+            except (TypeError, ValueError):
+                raise ConfigError(
+                    f"Invalid value for 'protocols.{name}.tile-size': "
+                    f"{block.get('tile-size')!r}"
+                ) from None
+            if not 16 <= ts <= 4096:
+                raise ConfigError(
+                    f"'protocols.{name}.tile-size' must be in "
+                    "[16, 4096]"
+                )
+            return ProtocolAdapterConfig(
+                enabled=bool(block.get("enabled", True)),
+                tile_size=ts,
+            )
+
+        return ProtocolsConfig(
+            dzi=adapter("dzi"), iiif=adapter("iiif"),
+            iris=adapter("iris"),
+        )
+
+    @staticmethod
     def _parse_mesh(raw: dict) -> MeshConfig:
         """Validate the mesh: block."""
         ms = raw.get("mesh") or {}
@@ -975,6 +1089,8 @@ class Config:
             cluster=cls._parse_cluster(raw),
             io=cls._parse_io(raw),
             render=cls._parse_render(raw),
+            analysis=cls._parse_analysis(raw),
+            protocols=cls._parse_protocols(raw),
             mesh=cls._parse_mesh(raw),
             jax=cls._parse_jax(raw),
             logging=LoggingConfig(

@@ -1,0 +1,722 @@
+"""Analysis plane suite (render/analysis + render/masks + the render
+model extensions this PR ships).
+
+Covers: HistogramSpec parsing (incl. 400s over HTTP), the histogram
+reduction pinned integer-identical across the numpy mirror, the
+jitted device program, and the 8-way CPU mesh (vs an independent
+np.histogram reference), ROI mask grammar + rasterization + the
+per-image raster cache, masked-render byte identity (fused device
+chain == host mirror), float32/int32 windowing through the u16
+quantization, polynomial/logarithmic quantization families,
+t-projection, the projection stack-byte 413 bound, the HBM
+plane-cache projection-read regression, and — under ``-m
+resilience`` — the ``analysis.engine`` chaos lane plus deadline/
+admission flow-through for histogram requests.
+"""
+
+import json
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from omero_ms_pixel_buffer_tpu.auth.stores import MemorySessionStore
+from omero_ms_pixel_buffer_tpu.errors import (
+    BadRequestError,
+    RequestTooLargeError,
+)
+from omero_ms_pixel_buffer_tpu.http.server import PixelBufferApp
+from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
+from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+    ImageRegistry,
+    PixelsService,
+)
+from omero_ms_pixel_buffer_tpu.models.device_cache import DevicePlaneCache
+from omero_ms_pixel_buffer_tpu.models.tile_pipeline import TilePipeline
+from omero_ms_pixel_buffer_tpu.render import analysis as ran
+from omero_ms_pixel_buffer_tpu.render import engine as rengine
+from omero_ms_pixel_buffer_tpu.render import masks as rmasks
+from omero_ms_pixel_buffer_tpu.render.analysis import HistogramSpec
+from omero_ms_pixel_buffer_tpu.render.model import RenderSpec
+from omero_ms_pixel_buffer_tpu.resilience.breaker import BOARD
+from omero_ms_pixel_buffer_tpu.resilience.faultinject import (
+    INJECTOR,
+    always,
+)
+from omero_ms_pixel_buffer_tpu.tile_ctx import RegionDef, TileCtx
+from omero_ms_pixel_buffer_tpu.utils.config import Config
+
+rng = np.random.default_rng(23)
+AUTH = {"Cookie": "sessionid=ck"}
+
+# (T, C, Z, Y, X): 2 timepoints, 3 channels, 4 z — enough for both
+# projection axes
+IMG = rng.integers(0, 4096, (2, 3, 4, 96, 128), dtype=np.uint16)
+FIMG = rng.normal(0.0, 25.0, (1, 1, 3, 64, 64)).astype(np.float32)
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    INJECTOR.clear()
+    yield
+    INJECTOR.clear()
+    BOARD.reset()
+
+
+def _registry(tmp_path):
+    write_ome_tiff(
+        str(tmp_path / "img.ome.tiff"), IMG, tile_size=(64, 64)
+    )
+    write_ome_tiff(
+        str(tmp_path / "f.ome.tiff"), FIMG, tile_size=(64, 64)
+    )
+    registry = ImageRegistry()
+    registry.add(1, str(tmp_path / "img.ome.tiff"))
+    registry.add(2, str(tmp_path / "f.ome.tiff"))
+    return registry
+
+
+def _ctx(
+    analysis=None, render=None, img=1, z=0, c=0, t=0,
+    x=0, y=0, w=64, h=48, session="k",
+):
+    fmt = "json" if analysis is not None else (
+        render.format if render is not None else "png"
+    )
+    return TileCtx(
+        image_id=img, z=z, c=c, t=t,
+        region=RegionDef(x, y, w, h), format=fmt,
+        omero_session_key=session, render=render, analysis=analysis,
+    )
+
+
+# ---------------------------------------------------------------------------
+# HistogramSpec parsing
+# ---------------------------------------------------------------------------
+
+
+class TestHistogramSpec:
+    def test_defaults(self):
+        spec = HistogramSpec.from_params({}, default_channel=2)
+        assert spec.bins == 256 and not spec.use_pixel_range
+        assert [c.index for c in spec.channels] == [2]
+
+    def test_channel_dialect_with_windows(self):
+        spec = HistogramSpec.from_params(
+            {"c": "1|100:600,-2,3", "bins": "64"}
+        )
+        assert [c.index for c in spec.channels] == [0, 2]
+        assert spec.channels[0].window == (100.0, 600.0)
+        assert spec.bins == 64
+
+    def test_use_pixels_type_range(self):
+        spec = HistogramSpec.from_params({"usePixelsTypeRange": "true"})
+        assert spec.use_pixel_range
+
+    @pytest.mark.parametrize("bins", ["1", "0", "-4", "999999", "x"])
+    def test_bad_bins_400(self, bins):
+        with pytest.raises(BadRequestError):
+            HistogramSpec.from_params({"bins": bins})
+
+    def test_max_bins_config_cap(self):
+        with pytest.raises(BadRequestError):
+            HistogramSpec.from_params({"bins": "512"}, max_bins=256)
+
+    def test_duplicate_channel_400(self):
+        with pytest.raises(BadRequestError):
+            HistogramSpec.from_params({"c": "1,1"})
+
+    def test_signature_and_json_round_trip(self):
+        spec = HistogramSpec.from_params(
+            {"c": "2|0:100", "bins": "32", "usePixelsTypeRange": "1"}
+        )
+        again = HistogramSpec.from_json(spec.to_json())
+        assert again.signature() == spec.signature()
+        other = HistogramSpec.from_params({"c": "2|0:100", "bins": "33"})
+        assert other.signature() != spec.signature()
+
+    def test_signature_joins_cache_key(self):
+        a = _ctx(analysis=HistogramSpec.from_params({"bins": "16"}))
+        b = _ctx(analysis=HistogramSpec.from_params({"bins": "32"}))
+        raw = _ctx(render=None)
+        raw.format = "json"
+        assert a.cache_key("q") != b.cache_key("q")
+        assert a.cache_key("q") != raw.cache_key("q")
+        assert a.lane_key() != b.lane_key()
+
+
+# ---------------------------------------------------------------------------
+# the reduction: host mirror == device == mesh == numpy reference
+# ---------------------------------------------------------------------------
+
+
+class TestHistogramReduction:
+    @pytest.mark.parametrize("dtype", [np.uint8, np.uint16, np.int16])
+    def test_host_device_reference_identical(self, dtype):
+        dtype = np.dtype(dtype)
+        info = np.iinfo(dtype)
+        planes = rng.integers(
+            info.min, int(info.max) + 1, (4, 40, 56), dtype=dtype
+        )
+        bins = 32
+        window = (float(info.min), float(info.max))
+        tab = ran.build_bin_table(dtype, window, bins)
+        idx = rengine.unsigned_view(planes)
+        tabs = np.stack([tab] * 4)
+        host = ran.histogram_host(idx, tabs, bins)
+        dev = ran.histogram_batch(idx, tabs, bins)
+        np.testing.assert_array_equal(host, dev)
+        # independent reference: np.histogram over the clamped range
+        for i in range(4):
+            ref, _ = np.histogram(
+                planes[i].astype(np.float64),
+                bins=bins,
+                range=(window[0], window[1] + 1),
+            )
+            np.testing.assert_array_equal(host[i], ref)
+
+    def test_mesh_identical(self):
+        from omero_ms_pixel_buffer_tpu.parallel.mesh import make_mesh
+
+        planes = rng.integers(0, 65536, (5, 32, 32), dtype=np.uint16)
+        tab = ran.build_bin_table(np.uint16, (0.0, 65535.0), 64)
+        tabs = np.stack([tab] * 5)
+        mesh = make_mesh(("data",))
+        sharded = ran.sharded_histogram_batch(mesh, planes, tabs, 64)
+        single = ran.histogram_batch(planes, tabs, 64)
+        np.testing.assert_array_equal(sharded, single)
+
+    def test_window_clamps_into_edge_bins(self):
+        plane = np.array([[0, 10, 50, 90, 255]], dtype=np.uint8)
+        tab = ran.build_bin_table(np.uint8, (10.0, 90.0), 4)
+        counts = ran.histogram_host(plane[None], tab[None], 4)[0]
+        # 0 clamps into bin 0; 255 clamps into bin 3
+        assert counts.sum() == 5
+        assert counts[0] >= 2 and counts[3] >= 2
+
+    def test_stats_from_counts(self):
+        counts = np.array([0, 2, 2, 0], dtype=np.int64)
+        st = ran.stats_from_counts(counts, (0.0, 8.0), 4)
+        assert st["count"] == 4
+        assert st["min"] == 2.0 and st["max"] == 6.0
+        assert st["p50"] == 2.0
+        empty = ran.stats_from_counts(np.zeros(4), (0.0, 8.0), 4)
+        assert empty["count"] == 0 and empty["min"] is None
+
+
+# ---------------------------------------------------------------------------
+# ROI masks: grammar, rasterization, cache
+# ---------------------------------------------------------------------------
+
+
+class TestMasks:
+    def test_rect_raster_pixel_center_rule(self):
+        (shape,) = rmasks.parse_roi(
+            '[{"type":"rect","x":1,"y":2,"w":3,"h":4}]'
+        )
+        m = rmasks.rasterize((shape,), 0, 0, 8, 8)
+        assert m.sum() == 12  # centers in [1,4]x[2,6]: 3 cols x 4 rows
+        assert m[2, 1] == 1 and m[0, 0] == 0
+
+    def test_ellipse_and_polygon(self):
+        shapes = rmasks.parse_roi(
+            '[{"type":"ellipse","cx":4,"cy":4,"rx":2,"ry":2},'
+            '{"type":"polygon","points":[[10,0],[16,0],[13,6]]}]'
+        )
+        m = rmasks.rasterize(shapes, 0, 0, 20, 8)
+        assert m[4, 4] == 1  # ellipse center
+        assert m[1, 13] == 1  # inside the triangle
+        assert m[7, 19] == 0
+
+    def test_polyline_stroke(self):
+        shapes = rmasks.parse_roi(
+            '[{"type":"polyline","points":[[0,4],[10,4]],"width":2}]'
+        )
+        m = rmasks.rasterize(shapes, 0, 0, 10, 10)
+        assert m[4, 5] == 1 and m[0, 5] == 0
+
+    def test_region_offset_consistency(self):
+        """A shape rasterizes identically no matter how the tile grid
+        cuts it — the pan-consistency contract."""
+        shapes = rmasks.parse_roi(
+            '[{"type":"ellipse","cx":30,"cy":30,"rx":18,"ry":12}]'
+        )
+        whole = rmasks.rasterize(shapes, 0, 0, 64, 64)
+        left = rmasks.rasterize(shapes, 0, 0, 32, 64)
+        right = rmasks.rasterize(shapes, 32, 0, 32, 64)
+        np.testing.assert_array_equal(
+            whole, np.concatenate([left, right], axis=1)
+        )
+
+    @pytest.mark.parametrize("raw", [
+        "not json",
+        "[]",
+        '[{"type":"blob"}]',
+        '[{"type":"rect","x":0,"y":0,"w":0,"h":5}]',
+        '[{"type":"polygon","points":[[0,0],[1,1]]}]',
+        '[{"type":"polygon","points":[[0,0],[1,1],"x"]}]',
+        '[{"type":"ellipse","cx":0,"cy":0,"rx":-1,"ry":1}]',
+        '[{"type":"rect","x":0,"y":0,"w":1,"h":1,"zz":1}]',
+        '[{"type":"polyline","points":[[0,0],[1,1]],"width":0}]',
+    ])
+    def test_grammar_errors_400(self, raw):
+        with pytest.raises(BadRequestError):
+            rmasks.parse_roi(raw)
+
+    def test_too_many_shapes_400(self):
+        raw = json.dumps(
+            [{"type": "rect", "x": i, "y": 0, "w": 1, "h": 1}
+             for i in range(65)]
+        )
+        with pytest.raises(BadRequestError):
+            rmasks.parse_roi(raw)
+
+    def test_cache_hit_and_invalidate(self):
+        cache = rmasks.MaskRasterCache()
+        shapes = rmasks.parse_roi(
+            '[{"type":"rect","x":0,"y":0,"w":4,"h":4}]'
+        )
+        a = cache.get(7, shapes, (0, 0, 8, 8))
+        b = cache.get(7, shapes, (0, 0, 8, 8))
+        assert a is b and cache.hits == 1
+        cache.invalidate_image(7)
+        c = cache.get(7, shapes, (0, 0, 8, 8))
+        assert c is not a
+
+    def test_roi_joins_render_signature(self):
+        plain = RenderSpec.from_params({"c": "1"})
+        masked = RenderSpec.from_params(
+            {"c": "1",
+             "roi": '[{"type":"rect","x":0,"y":0,"w":4,"h":4}]'}
+        )
+        assert plain.signature() != masked.signature()
+        again = RenderSpec.from_json(masked.to_json())
+        assert again.signature() == masked.signature()
+
+
+# ---------------------------------------------------------------------------
+# engine extensions: mask identity, quantization, families, t-projection
+# ---------------------------------------------------------------------------
+
+
+class TestEngineExtensions:
+    def test_masked_fused_device_equals_host_mirror(self):
+        spec = RenderSpec.from_params({"c": "1|0:4095$FF8000"})
+        planes = rng.integers(0, 4096, (2, 1, 32, 48), dtype=np.uint16)
+        mask = rmasks.rasterize(
+            rmasks.parse_roi(
+                '[{"type":"ellipse","cx":24,"cy":16,"rx":15,"ry":9}]'
+            ), 0, 0, 48, 32,
+        )
+        tables, luts = rengine.build_tables(spec, np.uint16)
+        streams, lengths = rengine.fused_render_filter_deflate_batch(
+            planes, tables, luts, 32, 1 + 48 * 3, "up", "rle",
+            mask=np.stack([mask, mask]),
+        )
+        from omero_ms_pixel_buffer_tpu.ops.png import frame_png
+
+        pngs = []
+        for b in range(2):
+            dev_png = frame_png(
+                bytes(np.asarray(streams[b])[: int(lengths[b])]),
+                48, 32, 8, 2,
+            )
+            host_png = rengine.render_png_host(
+                planes[b], tables, luts, "up", mask
+            )
+            assert dev_png == host_png
+            pngs.append(host_png)
+        # masked-out pixels are black, masked-in identical to plain
+        from omero_ms_pixel_buffer_tpu.ops.png import decode_png
+
+        rgb = decode_png(pngs[0])
+        plain = rengine.render_host(planes[0], tables, luts)
+        assert (rgb[mask == 0] == 0).all()
+        np.testing.assert_array_equal(rgb[mask == 1], plain[mask == 1])
+
+    def test_quantize_to_u16(self):
+        plane = np.array(
+            [[-5.0, 0.0, 5.0, 10.0, 15.0, np.nan, np.inf]],
+            dtype=np.float32,
+        )
+        q = rengine.quantize_to_u16(plane, (0.0, 10.0))
+        assert q[0, 0] == 0 and q[0, 1] == 0
+        assert q[0, 2] == 32768 and q[0, 3] == 65535
+        assert q[0, 4] == 65535  # clipped above
+        assert q[0, 5] == 0 and q[0, 6] == 65535  # nan / inf
+        with pytest.raises(rengine.RenderError):
+            rengine.quantize_to_u16(plane, (3.0, 3.0))
+
+    def test_quantizable_domain(self):
+        assert rengine.quantizable_dtype(np.float32)
+        assert rengine.quantizable_dtype(np.int32)
+        assert rengine.quantizable_dtype(np.float64)
+        assert not rengine.quantizable_dtype(np.uint16)
+        assert not rengine.renderable_dtype(np.float32)
+
+    def test_polynomial_equals_exponential_tables(self):
+        """OMERO's 'polynomial' family IS the gamma curve this
+        service always called 'exponential' — identical tables."""
+        maps_p = '[{"quantization":{"family":"polynomial","coefficient":2.0}}]'
+        maps_e = '[{"quantization":{"family":"exponential","coefficient":2.0}}]'
+        tp, _ = rengine.build_tables(
+            RenderSpec.from_params({"c": "1", "maps": maps_p}), np.uint8
+        )
+        te, _ = rengine.build_tables(
+            RenderSpec.from_params({"c": "1", "maps": maps_e}), np.uint8
+        )
+        np.testing.assert_array_equal(tp, te)
+
+    def test_logarithmic_family(self):
+        maps = '[{"quantization":{"family":"logarithmic","coefficient":9.0}}]'
+        spec = RenderSpec.from_params({"c": "1", "maps": maps})
+        tab, _ = rengine.build_tables(spec, np.uint8)
+        x = np.arange(256) / 255.0
+        ref = np.clip(
+            np.floor(
+                np.log1p(9.0 * x) / np.log1p(9.0) * 255.0 + 0.5
+            ), 0, 255,
+        ).astype(np.uint8)
+        np.testing.assert_array_equal(tab[0], ref)
+
+    def test_unknown_family_400(self):
+        with pytest.raises(BadRequestError):
+            RenderSpec.from_params({
+                "c": "1",
+                "maps": '[{"quantization":{"family":"cubic"}}]',
+            })
+
+    def test_projection_axis_parse_and_ranges(self):
+        spec = RenderSpec.from_params({"p": "intmean:t|1:3"})
+        assert spec.proj_axis == "t"
+        assert spec.plane_range(2, 0, 4, 6) == [(2, 1), (2, 2), (2, 3)]
+        zspec = RenderSpec.from_params({"p": "intmax"})
+        assert zspec.proj_axis == "z"
+        assert zspec.plane_range(0, 1, 3, 6) == [
+            (0, 1), (1, 1), (2, 1)
+        ]
+        # axis only joins the signature when non-default (old cached
+        # z-projection signatures stay stable)
+        assert "@t" in spec.signature()
+        assert "@" not in zspec.signature()
+        with pytest.raises(BadRequestError):
+            RenderSpec.from_params({"p": "intmax:q"})
+
+
+# ---------------------------------------------------------------------------
+# pipeline integration
+# ---------------------------------------------------------------------------
+
+
+class TestPipelineAnalysis:
+    def test_histogram_host_device_bytes_identical(self, tmp_path):
+        registry = _registry(tmp_path)
+        spec = HistogramSpec.from_params({"bins": "32", "c": "1,2"})
+        host = TilePipeline(PixelsService(registry), engine="host")
+        dev = TilePipeline(PixelsService(registry), engine="device")
+        bh = host.handle(_ctx(analysis=spec))
+        bd = dev.handle(_ctx(analysis=spec))
+        assert isinstance(bh, bytes) and bh == bd
+        obj = json.loads(bh)
+        ref = np.histogram(
+            IMG[0, 0, 0, :48, :64], bins=32, range=(0, 65536)
+        )[0]
+        assert obj["data"] == ref.tolist()
+        assert obj["channels"][1]["index"] == 1
+        assert obj["channels"][0]["stats"]["count"] == 64 * 48
+
+    def test_histogram_window_and_pixel_range(self, tmp_path):
+        registry = _registry(tmp_path)
+        pipe = TilePipeline(PixelsService(registry), engine="host")
+        win = HistogramSpec.from_params(
+            {"bins": "16", "c": "1|0:1024"}
+        )
+        body = pipe.handle(_ctx(analysis=win))
+        obj = json.loads(body)
+        assert obj["channels"][0]["window"] == [0.0, 1024.0]
+        # all pixels land somewhere (clamped), count preserved
+        assert sum(obj["data"]) == 64 * 48
+        ptr = HistogramSpec.from_params(
+            {"bins": "16", "c": "1|0:1024", "usePixelsTypeRange": "1"}
+        )
+        obj2 = json.loads(pipe.handle(_ctx(analysis=ptr)))
+        assert obj2["channels"][0]["window"] == [0.0, 65535.0]
+
+    def test_float_histogram_and_render(self, tmp_path):
+        registry = _registry(tmp_path)
+        host = TilePipeline(PixelsService(registry), engine="host")
+        dev = TilePipeline(PixelsService(registry), engine="device")
+        hspec = HistogramSpec.from_params({"bins": "16"})
+        ctx = _ctx(analysis=hspec, img=2, w=64, h=64)
+        bh = host.handle(ctx)
+        assert bh is not None and bh == dev.handle(ctx)
+        # float render with a window: host == device engine, and the
+        # pixels equal an independent quantize-then-table reference
+        rspec = RenderSpec.from_params({"c": "1|-50:50"})
+        rh = host.handle(_ctx(render=rspec, img=2, w=64, h=64))
+        rd = dev.handle(_ctx(render=rspec, img=2, w=64, h=64))
+        assert isinstance(rh, bytes) and rh == rd
+        from omero_ms_pixel_buffer_tpu.ops.png import decode_png
+
+        q = rengine.quantize_to_u16(
+            FIMG[0, 0, 0], (-50.0, 50.0)
+        )
+        tb, lu = rengine.build_tables(
+            rspec.without_windows(), np.uint16
+        )
+        np.testing.assert_array_equal(
+            decode_png(rh), rengine.render_host(q[None], tb, lu)
+        )
+
+    def test_float_render_without_window_404(self, tmp_path):
+        registry = _registry(tmp_path)
+        pipe = TilePipeline(PixelsService(registry), engine="host")
+        assert pipe.handle(
+            _ctx(render=RenderSpec.from_params({}), img=2)
+        ) is None
+
+    def test_t_projection(self, tmp_path):
+        registry = _registry(tmp_path)
+        pipe = TilePipeline(PixelsService(registry), engine="host")
+        spec = RenderSpec.from_params({"c": "1|0:4095", "p": "intmax:t"})
+        png = pipe.handle(_ctx(render=spec))
+        from omero_ms_pixel_buffer_tpu.ops.png import decode_png
+
+        ref = IMG[:, 0, 0, :48, :64].max(axis=0)
+        tb, lu = rengine.build_tables(spec, np.uint16)
+        np.testing.assert_array_equal(
+            decode_png(png), rengine.render_host(ref[None], tb, lu)
+        )
+
+    def test_masked_render_through_pipeline(self, tmp_path):
+        registry = _registry(tmp_path)
+        pipe = TilePipeline(PixelsService(registry), engine="host")
+        roi = '[{"type":"rect","x":8,"y":8,"w":16,"h":16}]'
+        plain = pipe.handle(
+            _ctx(render=RenderSpec.from_params({"c": "1|0:4095"}))
+        )
+        masked = pipe.handle(_ctx(render=RenderSpec.from_params(
+            {"c": "1|0:4095", "roi": roi}
+        )))
+        from omero_ms_pixel_buffer_tpu.ops.png import decode_png
+
+        m, p = decode_png(masked), decode_png(plain)
+        assert (m[40:, 40:] == 0).all()
+        np.testing.assert_array_equal(m[9:23, 9:23], p[9:23, 9:23])
+        # raster cache warmed + namespaced invalidation
+        assert pipe._mask_cache.snapshot()["rasters"] == 1
+        pipe.invalidate_image(1)
+        assert pipe._mask_cache.snapshot()["rasters"] == 0
+
+    def test_projection_stack_bytes_413(self, tmp_path):
+        """Regression: the per-plane max-tile-bytes guard let a
+        z-projection materialize size_z times the budget."""
+        registry = _registry(tmp_path)
+        pipe = TilePipeline(
+            PixelsService(registry), engine="host",
+            max_tile_bytes=64 * 48 * 2 * 2,  # two planes' worth
+        )
+        proj = RenderSpec.from_params({"c": "1|0:4095", "p": "intmax"})
+        r = pipe.handle(_ctx(render=proj))
+        assert isinstance(r, RequestTooLargeError) and r.code == 413
+        # a single plane (and a 2-plane range) still fits
+        assert isinstance(pipe.handle(
+            _ctx(render=RenderSpec.from_params({"c": "1|0:4095"}))
+        ), bytes)
+        assert isinstance(pipe.handle(_ctx(
+            render=RenderSpec.from_params(
+                {"c": "1|0:4095", "p": "intmax|0:1"}
+            )
+        )), bytes)
+
+    def test_histogram_multichannel_bytes_413(self, tmp_path):
+        registry = _registry(tmp_path)
+        pipe = TilePipeline(
+            PixelsService(registry), engine="host",
+            max_tile_bytes=64 * 48 * 2 * 2,
+        )
+        spec = HistogramSpec.from_params({"c": "1,2,3"})
+        r = pipe.handle(_ctx(analysis=spec))
+        assert isinstance(r, RequestTooLargeError)
+        assert isinstance(pipe.handle(
+            _ctx(analysis=HistogramSpec.from_params({"c": "1,2"}))
+        ), bytes)
+
+    def test_projection_reads_fill_plane_cache(self, tmp_path):
+        """Regression (KNOWN_GAPS r10): projection plane reads used
+        to bypass the HBM plane cache — a repeated projection pan
+        re-read every z plane per tile. Now they go through (and
+        fill) it: the second batch issues ZERO host tile reads."""
+        registry = _registry(tmp_path)
+        pipe = TilePipeline(PixelsService(registry), engine="device")
+        pipe._plane_cache = DevicePlaneCache(admit_after=1)
+        spec = RenderSpec.from_params(
+            {"c": "1|0:4095", "p": "intmax|0:3"}
+        )
+        buf = pipe.pixels_service.get_pixel_buffer(1)
+        calls = {"read_tiles": 0}
+        orig = buf.read_tiles
+
+        def counting(coords, level=0):
+            calls["read_tiles"] += len(coords)
+            return orig(coords, level=level)
+
+        buf.read_tiles = counting
+        first = pipe.handle(_ctx(render=spec))
+        after_first = calls["read_tiles"]
+        second = pipe.handle(_ctx(render=spec, x=64, w=64))
+        assert first is not None and second is not None
+        assert calls["read_tiles"] == after_first == 0
+        # and the bytes match the host engine exactly
+        host = TilePipeline(PixelsService(registry), engine="host")
+        assert host.handle(_ctx(render=spec)) == first
+
+
+# ---------------------------------------------------------------------------
+# HTTP integration + chaos lanes
+# ---------------------------------------------------------------------------
+
+
+async def _make_client(tmp_path, overrides=None):
+    registry = _registry(tmp_path)
+    store = MemorySessionStore({"ck": "key-1"})
+    raw = {
+        "session-store": {"type": "memory"},
+        "backend": {"batching": {"coalesce-window-ms": 1.0}},
+    }
+    for key, value in (overrides or {}).items():
+        raw[key] = value
+    config = Config.from_dict(raw)
+    app_obj = PixelBufferApp(
+        config, pixels_service=PixelsService(registry),
+        session_store=store,
+    )
+    client = TestClient(TestServer(app_obj.make_app()))
+    await client.start_server()
+    return client, app_obj
+
+
+class TestHistogramHttp:
+    async def test_full_flow(self, tmp_path):
+        client, _ = await _make_client(tmp_path)
+        try:
+            r = await client.get(
+                "/histogram/1/0/0/0?bins=16&w=64&h=64", headers=AUTH
+            )
+            assert r.status == 200
+            assert r.headers["Content-Type"] == "application/json"
+            assert r.headers["X-Cache"] == "miss"
+            etag = r.headers["ETag"]
+            obj = json.loads(await r.read())
+            ref = np.histogram(
+                IMG[0, 0, 0, :64, :64], bins=16, range=(0, 65536)
+            )[0]
+            assert obj["data"] == ref.tolist()
+            r2 = await client.get(
+                "/histogram/1/0/0/0?bins=16&w=64&h=64", headers=AUTH
+            )
+            assert r2.headers["X-Cache"] == "hit"
+            assert r2.headers["ETag"] == etag
+            r3 = await client.get(
+                "/histogram/1/0/0/0?bins=16&w=64&h=64",
+                headers={**AUTH, "If-None-Match": etag},
+            )
+            assert r3.status == 304
+        finally:
+            await client.close()
+
+    async def test_auth_and_grammar(self, tmp_path):
+        client, _ = await _make_client(tmp_path)
+        try:
+            assert (await client.get("/histogram/1/0/0/0")).status == 403
+            assert (await client.get(
+                "/histogram/1/0/0/0?bins=0", headers=AUTH
+            )).status == 400
+            assert (await client.get(
+                "/histogram/1/0/0/0?bins=999999", headers=AUTH
+            )).status == 400
+            assert (await client.get(
+                "/histogram/99/0/0/0", headers=AUTH
+            )).status == 404
+            assert (await client.get(
+                "/histogram/1/9/0/0", headers=AUTH
+            )).status == 404
+        finally:
+            await client.close()
+
+    async def test_analysis_disabled(self, tmp_path):
+        client, _ = await _make_client(
+            tmp_path, {"analysis": {"enabled": False}}
+        )
+        try:
+            r = await client.get(
+                "/histogram/1/0/0/0", headers=AUTH
+            )
+            # the route is simply not mounted (405 via the OPTIONS
+            # catch-all — the same answer any unknown GET path gets
+            # from this server)
+            assert r.status == 405
+        finally:
+            await client.close()
+
+    async def test_projection_413_over_http(self, tmp_path):
+        client, app_obj = await _make_client(
+            tmp_path,
+            {"backend": {"max-tile-mb": 256,
+                         "batching": {"coalesce-window-ms": 1.0}}},
+        )
+        app_obj.pipeline.max_tile_bytes = 64 * 64 * 2 * 2
+        try:
+            r = await client.get(
+                "/render/1/0/0/0?w=64&h=64&p=intmax", headers=AUTH
+            )
+            assert r.status == 413
+            ok = await client.get(
+                "/render/1/0/0/0?w=64&h=64", headers=AUTH
+            )
+            assert ok.status == 200
+        finally:
+            await client.close()
+
+    @pytest.mark.resilience
+    def test_engine_chaos_host_fallback_identical(
+        self, tmp_path
+    ):
+        """The analysis.engine chaos seam: a failing device reduction
+        degrades to the host mirror with byte-identical JSON."""
+        registry = _registry(tmp_path)
+        pipe = TilePipeline(PixelsService(registry), engine="device")
+        spec = HistogramSpec.from_params({"bins": "64", "c": "1,2"})
+        clean = pipe.handle(_ctx(analysis=spec))
+        INJECTOR.install("analysis.engine", always(RuntimeError))
+        broken = pipe.handle(_ctx(analysis=spec))
+        assert clean is not None and clean == broken
+
+    @pytest.mark.resilience
+    async def test_histogram_deadline_504(self, tmp_path):
+        client, _ = await _make_client(
+            tmp_path, {"resilience": {"request-budget-ms": 1}}
+        )
+        try:
+            r = await client.get(
+                "/histogram/1/0/0/0?w=64&h=64", headers=AUTH
+            )
+            assert r.status == 504
+        finally:
+            await client.close()
+
+    @pytest.mark.resilience
+    async def test_histogram_sheds_at_door_like_tiles(
+        self, tmp_path
+    ):
+        """Admission parity: when the SLO door gate sheds, histogram
+        requests 503 with Retry-After exactly like native tiles."""
+        client, app_obj = await _make_client(tmp_path)
+        try:
+            app_obj.scheduler.would_overflow_shed = lambda p: True
+            r = await client.get(
+                "/histogram/1/0/0/0?w=64&h=64", headers=AUTH
+            )
+            assert r.status == 503 and "Retry-After" in r.headers
+        finally:
+            await client.close()

@@ -1,0 +1,553 @@
+"""Viewer-protocol adapter conformance suite (http/protocols/).
+
+Covers: the DZI descriptor pinned BYTE-EXACT, the DZI level ladder
+math, IIIF info.json schema (3.0 + 2.1), the IIIF region/size/
+rotation/quality grammar (precise 400s vs 501s), the Iris metadata/
+grid math, and the equivalence matrix over real HTTP: adapter-served
+tiles byte-identical to the equivalent native ``/render`` request
+with the SAME ETag and SHARED cache entries (second request through
+any dialect is an ``X-Cache: hit`` without a second render). Chaos
+lanes (``-m resilience``) prove adapter requests shed/degrade/504
+exactly like native ones — same door gate, same deadline, same
+engine-fallback byte identity.
+"""
+
+import json
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from omero_ms_pixel_buffer_tpu.auth.stores import MemorySessionStore
+from omero_ms_pixel_buffer_tpu.errors import BadRequestError
+from omero_ms_pixel_buffer_tpu.http.protocols import dzi as pdzi
+from omero_ms_pixel_buffer_tpu.http.protocols import iiif as piiif
+from omero_ms_pixel_buffer_tpu.http.protocols import iris as piris
+from omero_ms_pixel_buffer_tpu.http.server import PixelBufferApp
+from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
+from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+    ImageRegistry,
+    PixelsService,
+)
+from omero_ms_pixel_buffer_tpu.resilience.breaker import BOARD
+from omero_ms_pixel_buffer_tpu.resilience.faultinject import (
+    INJECTOR,
+    always,
+)
+from omero_ms_pixel_buffer_tpu.utils.config import Config, ConfigError
+
+rng = np.random.default_rng(29)
+AUTH = {"Cookie": "sessionid=ck"}
+
+# 128x96 with a 2-level pyramid: DZI maxLevel = 7, pyramid levels
+# 0 (128x96) and 1 (64x48)
+IMG = rng.integers(0, 60000, (1, 2, 2, 96, 128), dtype=np.uint16)
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    INJECTOR.clear()
+    yield
+    INJECTOR.clear()
+    BOARD.reset()
+
+
+async def _make_client(tmp_path, overrides=None):
+    write_ome_tiff(
+        str(tmp_path / "img.ome.tiff"), IMG, tile_size=(64, 64),
+        pyramid_levels=2,
+    )
+    registry = ImageRegistry()
+    registry.add(1, str(tmp_path / "img.ome.tiff"))
+    store = MemorySessionStore({"ck": "key-1"})
+    raw = {
+        "session-store": {"type": "memory"},
+        "backend": {"batching": {"coalesce-window-ms": 1.0}},
+        "protocols": {
+            "dzi": {"tile-size": 64},
+            "iiif": {"tile-size": 64},
+            "iris": {"tile-size": 64},
+        },
+    }
+    for key, value in (overrides or {}).items():
+        raw[key] = value
+    config = Config.from_dict(raw)
+    app_obj = PixelBufferApp(
+        config, pixels_service=PixelsService(registry),
+        session_store=store,
+    )
+    client = TestClient(TestServer(app_obj.make_app()))
+    await client.start_server()
+    return client, app_obj
+
+
+# ---------------------------------------------------------------------------
+# pure grammar / math units
+# ---------------------------------------------------------------------------
+
+
+class TestDziMath:
+    def test_max_level(self):
+        assert pdzi.max_level(1, 1) == 0
+        assert pdzi.max_level(128, 96) == 7
+        assert pdzi.max_level(129, 96) == 8
+        assert pdzi.max_level(65536, 40000) == 16
+
+    def test_descriptor_golden_bytes(self):
+        """The descriptor is pinned BYTE-EXACT — viewers hash and
+        cache it, so encoding drift is a contract break."""
+        assert pdzi.descriptor_xml(128, 96, 64) == (
+            b'<?xml version="1.0" encoding="UTF-8"?>\n'
+            b'<Image xmlns="http://schemas.microsoft.com/deepzoom/2008"'
+            b' Format="png" Overlap="0" TileSize="64">'
+            b'<Size Height="96" Width="128"/></Image>'
+        )
+
+    def test_resolve_tile_ladder(self):
+        sizes = [(128, 96), (64, 48)]
+        # level 7 = resolution 0; full grid
+        assert pdzi.resolve_tile(sizes, 7, 0, 0, 64) == (
+            0, 0, 0, 64, 64
+        )
+        # right/bottom edge tiles clip
+        assert pdzi.resolve_tile(sizes, 7, 1, 1, 64) == (
+            0, 64, 64, 64, 32
+        )
+        # level 6 = resolution 1
+        assert pdzi.resolve_tile(sizes, 6, 0, 0, 64) == (
+            1, 0, 0, 64, 48
+        )
+        # coarser than the stored pyramid -> None (404)
+        assert pdzi.resolve_tile(sizes, 5, 0, 0, 64) is None
+        # finer than the image -> None
+        assert pdzi.resolve_tile(sizes, 8, 0, 0, 64) is None
+        # off the grid -> None
+        assert pdzi.resolve_tile(sizes, 7, 9, 0, 64) is None
+
+    def test_non_dyadic_pyramid_is_404_not_wrong_scale(self):
+        """A factor-4 NGFF pyramid does not back DZI's factor-2
+        ladder: the intermediate rung must 404, never serve 1/4-scale
+        pixels laid out at 1/2 scale."""
+        sizes = [(4096, 4096), (1024, 1024), (256, 256)]
+        # maxLevel 12; level 12 = res 0 (4096, dyadic) serves
+        assert pdzi.resolve_tile(sizes, 12, 0, 0, 256) is not None
+        # level 11 expects 2048 but the stored level 1 is 1024
+        assert pdzi.resolve_tile(sizes, 11, 0, 0, 256) is None
+        assert pdzi.resolve_tile(sizes, 10, 0, 0, 256) is None
+        # odd extents: floor AND ceil halvings both accepted
+        odd = [(97, 97), (48, 48)]
+        assert pdzi.resolve_tile(odd, 6, 0, 0, 64) is not None
+        odd_ceil = [(97, 97), (49, 49)]
+        assert pdzi.resolve_tile(odd_ceil, 6, 0, 0, 64) is not None
+
+
+class TestIiifGrammar:
+    SIZES = [(128, 96), (64, 48)]
+
+    def _candidates(self, x, y, w, h):
+        return [
+            (r, piiif.map_region_to_level(x, y, w, h, self.SIZES, r))
+            for r in range(len(self.SIZES))
+        ]
+
+    def test_region_full_and_rect(self):
+        assert piiif.parse_region("full", 128, 96) == (0, 0, 128, 96)
+        assert piiif.parse_region("0,0,64,64", 128, 96) == (0, 0, 64, 64)
+        # clips to the extent
+        assert piiif.parse_region("100,80,64,64", 128, 96) == (
+            100, 80, 28, 16
+        )
+
+    @pytest.mark.parametrize("region", [
+        "0,0,64", "a,0,64,64", "-1,0,64,64", "0,0,0,64", "200,0,1,1",
+    ])
+    def test_region_400(self, region):
+        with pytest.raises(BadRequestError):
+            piiif.parse_region(region, 128, 96)
+
+    @pytest.mark.parametrize("region", ["square", "pct:0,0,50,50"])
+    def test_region_501(self, region):
+        with pytest.raises(piiif.IiifNotSupported):
+            piiif.parse_region(region, 128, 96)
+
+    def test_size_exact_levels(self):
+        cands = self._candidates(0, 0, 128, 96)
+        assert piiif.parse_size("max", cands) == 0
+        assert piiif.parse_size("full", cands) == 0
+        assert piiif.parse_size("128,96", cands) == 0
+        assert piiif.parse_size("64,48", cands) == 1
+        assert piiif.parse_size("64,", cands) == 1
+        assert piiif.parse_size(",48", cands) == 1
+        assert piiif.parse_size("!100,60", cands) == 1  # best fit
+
+    def test_size_501(self):
+        cands = self._candidates(0, 0, 128, 96)
+        for s in ("100,75", "^200,150", "pct:50", "!32,24"):
+            with pytest.raises(piiif.IiifNotSupported):
+                piiif.parse_size(s, cands)
+
+    @pytest.mark.parametrize("size", ["", ",", "a,b", "0,0", "-1,"])
+    def test_size_400(self, size):
+        with pytest.raises(BadRequestError):
+            piiif.parse_size(size, self._candidates(0, 0, 128, 96))
+
+    def test_rotation_and_quality(self):
+        piiif.parse_rotation("0")
+        for r in ("90", "45.5", "!0"):
+            with pytest.raises(piiif.IiifNotSupported):
+                piiif.parse_rotation(r)
+        assert piiif.parse_quality_format("default.png") == ({}, "png")
+        assert piiif.parse_quality_format("gray.jpg") == (
+            {"m": "g"}, "jpeg"
+        )
+        with pytest.raises(piiif.IiifNotSupported):
+            piiif.parse_quality_format("bitonal.png")
+        with pytest.raises(piiif.IiifNotSupported):
+            piiif.parse_quality_format("default.tif")
+        with pytest.raises(BadRequestError):
+            piiif.parse_quality_format("defaultpng")
+        with pytest.raises(BadRequestError):
+            piiif.parse_quality_format("shiny.png")
+
+    def test_info_documents(self):
+        v3 = piiif.info_document("http://s/iiif/1", self.SIZES, 64, 3)
+        # required Image API 3.0 fields
+        for key in ("@context", "id", "type", "protocol", "profile",
+                    "width", "height"):
+            assert key in v3, key
+        assert v3["type"] == "ImageService3"
+        assert v3["width"] == 128 and v3["height"] == 96
+        assert v3["sizes"][0] == {"width": 64, "height": 48}
+        assert v3["tiles"][0]["scaleFactors"] == [1, 2]
+        v2 = piiif.info_document("http://s/iiif/1", self.SIZES, 64, 2)
+        assert v2["@context"].endswith("/2/context.json")
+        assert "@id" in v2 and "id" not in v2
+
+
+class TestIrisMath:
+    def test_layer_grid(self):
+        sizes = [(128, 96), (64, 48)]
+        # layer 0 = coarsest = resolution 1
+        assert piris.layer_grid(sizes, 0, 64) == (1, 1, 1, 64, 48)
+        assert piris.layer_grid(sizes, 1, 64) == (0, 2, 2, 128, 96)
+        assert piris.layer_grid(sizes, 2, 64) is None
+
+    def test_metadata_document(self):
+        doc = piris.metadata_document([(128, 96), (64, 48)], 64)
+        assert doc["extent"]["width"] == 128
+        layers = doc["extent"]["layers"]
+        assert layers[0] == {"x_tiles": 1, "y_tiles": 1, "scale": 2}
+        assert layers[1] == {"x_tiles": 2, "y_tiles": 2, "scale": 1}
+
+
+# ---------------------------------------------------------------------------
+# HTTP: descriptors, equivalence matrix, grammar statuses, gating
+# ---------------------------------------------------------------------------
+
+
+class TestAdapterHttp:
+    async def test_descriptors(self, tmp_path):
+        client, _ = await _make_client(tmp_path)
+        try:
+            r = await client.get("/dzi/1.dzi", headers=AUTH)
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith(
+                "application/xml"
+            )
+            assert await r.read() == pdzi.descriptor_xml(128, 96, 64)
+
+            r = await client.get("/iiif/1/info.json", headers=AUTH)
+            info = json.loads(await r.read())
+            assert info["type"] == "ImageService3"
+            assert info["width"] == 128
+            r = await client.get(
+                "/iiif/1/info.json?version=2", headers=AUTH
+            )
+            assert "@id" in json.loads(await r.read())
+
+            r = await client.get("/iris/1/metadata", headers=AUTH)
+            meta = json.loads(await r.read())
+            assert meta["extent"]["tile_size"] == 64
+
+            # unknown image -> 404; no session -> 403
+            for url in ("/dzi/9.dzi", "/iiif/9/info.json",
+                        "/iris/9/metadata"):
+                assert (await client.get(url, headers=AUTH)).status == 404
+            assert (await client.get("/dzi/1.dzi")).status == 403
+        finally:
+            await client.close()
+
+    async def test_equivalence_matrix(self, tmp_path):
+        """The acceptance pin: adapter responses for equivalent
+        regions are byte-identical to native /render output, carry
+        the same ETag, and SHARE its cache entries — the second
+        request through any dialect is a hit without a second
+        render."""
+        client, _ = await _make_client(tmp_path)
+        try:
+            native_url = (
+                "/render/1/0/0/0?x=64&y=0&w=64&h=64&resolution=0"
+                "&format=png"
+            )
+            n = await client.get(native_url, headers=AUTH)
+            assert n.status == 200 and n.headers["X-Cache"] == "miss"
+            native = await n.read()
+            etag = n.headers["ETag"]
+
+            # DZI level 7 == resolution 0; tile (1, 0)
+            d = await client.get("/dzi/1_files/7/1_0.png", headers=AUTH)
+            assert d.status == 200
+            assert d.headers["X-Cache"] == "hit"  # SHARED entry
+            assert d.headers["ETag"] == etag
+            assert await d.read() == native
+
+            # IIIF full-res region spelling of the same tile
+            i = await client.get(
+                "/iiif/1/64,0,64,64/64,64/0/default.png", headers=AUTH
+            )
+            assert i.status == 200
+            assert i.headers["X-Cache"] == "hit"
+            assert i.headers["ETag"] == etag
+            assert await i.read() == native
+
+            # Iris layer 1 (= resolution 0), flat tile 1 = (col 1, row 0)
+            ir = await client.get(
+                "/iris/1/layers/1/tiles/1", headers=AUTH
+            )
+            assert ir.status == 200
+            assert ir.headers["X-Cache"] == "hit"
+            assert ir.headers["ETag"] == etag
+            assert await ir.read() == native
+
+            # 304 revalidation straight through an adapter
+            d304 = await client.get(
+                "/dzi/1_files/7/1_0.png",
+                headers={**AUTH, "If-None-Match": etag},
+            )
+            assert d304.status == 304
+        finally:
+            await client.close()
+
+    async def test_adapter_first_warms_native(self, tmp_path):
+        """The reverse direction: a cold DZI request warms the entry
+        the native endpoint then hits."""
+        client, _ = await _make_client(tmp_path)
+        try:
+            d = await client.get("/dzi/1_files/6/0_0.png", headers=AUTH)
+            assert d.status == 200 and d.headers["X-Cache"] == "miss"
+            n = await client.get(
+                "/render/1/0/0/0?x=0&y=0&w=64&h=48&resolution=1"
+                "&format=png",
+                headers=AUTH,
+            )
+            assert n.headers["X-Cache"] == "hit"
+            assert await n.read() == await d.read()
+        finally:
+            await client.close()
+
+    async def test_render_params_ride_along(self, tmp_path):
+        """A DZI viewer appending render settings (channels, colors,
+        gamma) drives the full render model — and still shares keys
+        with the native spelling of the same thing."""
+        client, _ = await _make_client(tmp_path)
+        try:
+            q = "c=1|0:60000$FF0000,2|0:60000$00FF00"
+            d = await client.get(
+                f"/dzi/1_files/7/0_0.png?{q}", headers=AUTH
+            )
+            assert d.status == 200
+            n = await client.get(
+                f"/render/1/0/0/0?x=0&y=0&w=64&h=64&resolution=0"
+                f"&format=png&{q}",
+                headers=AUTH,
+            )
+            assert n.headers["X-Cache"] == "hit"
+            assert await n.read() == await d.read()
+        finally:
+            await client.close()
+
+    async def test_grammar_statuses(self, tmp_path):
+        client, _ = await _make_client(tmp_path)
+        try:
+            # DZI: bad format 400, unbacked level 404, off-grid 404
+            assert (await client.get(
+                "/dzi/1_files/7/0_0.gif", headers=AUTH
+            )).status == 400
+            assert (await client.get(
+                "/dzi/1_files/4/0_0.png", headers=AUTH
+            )).status == 404
+            assert (await client.get(
+                "/dzi/1_files/7/5_0.png", headers=AUTH
+            )).status == 404
+            # IIIF 501s: pct region, arbitrary scale, rotation,
+            # bitonal, exotic format
+            for url in (
+                "/iiif/1/pct:0,0,50,50/max/0/default.png",
+                "/iiif/1/full/100,75/0/default.png",
+                "/iiif/1/full/max/90/default.png",
+                "/iiif/1/full/max/0/bitonal.png",
+                "/iiif/1/full/max/0/default.webp",
+            ):
+                assert (await client.get(url, headers=AUTH)).status == 501, url
+            # IIIF 400s: malformed region/size/quality
+            for url in (
+                "/iiif/1/0,0,64/max/0/default.png",
+                "/iiif/1/full/a,b/0/default.png",
+                "/iiif/1/full/max/0/shiny.png",
+                "/iiif/1/500,500,10,10/max/0/default.png",
+            ):
+                assert (await client.get(url, headers=AUTH)).status == 400, url
+            # Iris: off-ladder layer / off-grid tile
+            assert (await client.get(
+                "/iris/1/layers/9/tiles/0", headers=AUTH
+            )).status == 404
+            assert (await client.get(
+                "/iris/1/layers/1/tiles/99", headers=AUTH
+            )).status == 404
+        finally:
+            await client.close()
+
+    async def test_adapter_gating(self, tmp_path):
+        """Per-adapter enable flags: IIIF off leaves DZI serving."""
+        client, _ = await _make_client(
+            tmp_path, {"protocols": {
+                "dzi": {"tile-size": 64},
+                "iiif": {"enabled": False},
+                "iris": {"tile-size": 64},
+            }},
+        )
+        try:
+            assert (await client.get(
+                "/iiif/1/info.json", headers=AUTH
+            )).status == 405  # not mounted (OPTIONS catch-all)
+            assert (await client.get(
+                "/dzi/1.dzi", headers=AUTH
+            )).status == 200
+            h = json.loads(
+                await (await client.get("/healthz")).read()
+            )
+            assert h["protocols"] == {
+                "dzi": True, "iiif": False, "iris": True
+            }
+        finally:
+            await client.close()
+
+    def test_protocols_config_validation(self):
+        base = {"session-store": {"type": "memory"}}
+        with pytest.raises(ConfigError):
+            Config.from_dict({**base, "protocols": {"dzzi": {}}})
+        with pytest.raises(ConfigError):
+            Config.from_dict(
+                {**base, "protocols": {"dzi": {"tile": 64}}}
+            )
+        with pytest.raises(ConfigError):
+            Config.from_dict(
+                {**base, "protocols": {"dzi": {"tile-size": 4}}}
+            )
+        with pytest.raises(ConfigError):
+            Config.from_dict({**base, "analysis": {"bins": 1}})
+        with pytest.raises(ConfigError):
+            Config.from_dict({**base, "analysis": {"max-bins": 1}})
+        cfg = Config.from_dict({
+            **base,
+            "protocols": {"iiif": {"enabled": False}},
+            "analysis": {"max-bins": 1024},
+        })
+        assert not cfg.protocols.iiif.enabled
+        assert cfg.protocols.dzi.enabled
+        assert cfg.analysis.max_bins == 1024
+
+
+# ---------------------------------------------------------------------------
+# chaos lanes: adapters degrade exactly like native requests
+# ---------------------------------------------------------------------------
+
+
+class TestAdapterChaos:
+    @pytest.mark.resilience
+    async def test_door_shed_parity(self, tmp_path):
+        """When the SLO door gate sheds, the DZI/IIIF/Iris surfaces
+        503 with Retry-After exactly like native /render — adapters
+        are serving lanes, not side doors around admission."""
+        client, app_obj = await _make_client(tmp_path)
+        try:
+            app_obj.scheduler.would_overflow_shed = lambda p: True
+            native = await client.get(
+                "/render/1/0/0/0?w=64&h=64", headers=AUTH
+            )
+            assert native.status == 503
+            for url in (
+                "/dzi/1_files/7/0_0.png",
+                "/iiif/1/full/max/0/default.png",
+                "/iris/1/layers/1/tiles/0",
+            ):
+                r = await client.get(url, headers=AUTH)
+                assert r.status == 503, url
+                assert "Retry-After" in r.headers
+        finally:
+            await client.close()
+
+    @pytest.mark.resilience
+    async def test_engine_chaos_adapter_bytes_identical(self, tmp_path):
+        """render.engine failing under a DZI request host-falls-back
+        to byte-identical tiles — the adapter inherits the engine
+        contract wholesale."""
+        client, app_obj = await _make_client(
+            tmp_path, {"cache": {"enabled": False}}
+        )
+        try:
+            clean = await client.get(
+                "/dzi/1_files/7/0_0.png", headers=AUTH
+            )
+            assert clean.status == 200
+            clean_body = await clean.read()
+            INJECTOR.install("render.engine", always(RuntimeError))
+            broken = await client.get(
+                "/dzi/1_files/7/0_0.png", headers=AUTH
+            )
+            assert broken.status == 200
+            assert await broken.read() == clean_body
+        finally:
+            await client.close()
+
+    @pytest.mark.resilience
+    async def test_dependency_down_is_503_not_404(self, tmp_path):
+        """An open-breaker store under an adapter descriptor/tile
+        lookup answers 503 + Retry-After, never 404 — a 404 would
+        read as 'image gone' to viewers and HTTP caches for the whole
+        open duration (the tile_pipeline contract)."""
+        from omero_ms_pixel_buffer_tpu.io.stores import (
+            StoreUnavailableError,
+        )
+
+        client, app_obj = await _make_client(tmp_path)
+        try:
+            def dead(*a, **k):
+                raise StoreUnavailableError(
+                    "breaker open", retry_after_s=2.0
+                )
+
+            app_obj.pixels_service.get_pixel_buffer = dead
+            for url in ("/dzi/1.dzi", "/iiif/1/info.json",
+                        "/iris/1/metadata",
+                        "/dzi/1_files/7/0_0.png"):
+                r = await client.get(url, headers=AUTH)
+                assert r.status == 503, (url, r.status)
+                assert "Retry-After" in r.headers
+        finally:
+            await client.close()
+
+    @pytest.mark.resilience
+    async def test_adapter_deadline_504(self, tmp_path):
+        client, _ = await _make_client(
+            tmp_path, {"resilience": {"request-budget-ms": 1}}
+        )
+        try:
+            for url in (
+                "/dzi/1_files/7/0_0.png",
+                "/iris/1/layers/1/tiles/0",
+            ):
+                r = await client.get(url, headers=AUTH)
+                assert r.status == 504, (url, r.status)
+        finally:
+            await client.close()

@@ -348,6 +348,11 @@ _RESILIENCE_SCOPE = (
     # and the peer-fetch HTTP client must carry breaker gate + fault
     # point + per-call timeout like every other remote edge
     "omero_ms_pixel_buffer_tpu/cache/plane/",
+    # the viewer-protocol adapters (r15): grammar-only today (every
+    # network hop happens in the native serving path they delegate
+    # to), but the scope pin means any future remote call added here
+    # must arrive wrapped like every other edge
+    "omero_ms_pixel_buffer_tpu/http/protocols/",
 )
 
 _NET_PRIMITIVES: List[Tuple[Optional[str], str, str]] = [
@@ -498,6 +503,9 @@ _JAX_SYNC_SCOPE = (
     "omero_ms_pixel_buffer_tpu/models/tile_pipeline.py",
     "omero_ms_pixel_buffer_tpu/models/device_dispatch.py",
     "omero_ms_pixel_buffer_tpu/ops/",
+    # render/ covers the whole analysis plane too: engine.py,
+    # analysis.py (device histograms), masks.py — every device->host
+    # pull there needs the intended-sink justification
     "omero_ms_pixel_buffer_tpu/render/",
 )
 _JAX_JIT_SCOPE = _JAX_SYNC_SCOPE + (
