@@ -980,6 +980,172 @@ def bench_io(cache_dir: str) -> dict:
     return out
 
 
+def bench_obs(cache_dir: str, n: int = 240) -> dict:
+    """Observability plane (r16) section — two pins:
+
+    - ``obs_ok_overhead``: the flight recorder's warm-path cost. The
+      same warm (cache-hit) URL set is replayed through two identical
+      apps, obs on vs off, A/B interleaved over several rounds with
+      the per-arm MIN p50 compared (min-of-rounds discards scheduler
+      noise on a shared CI box). Pin: p50 penalty <= 3%, with a
+      0.3 ms absolute floor — a sub-ms warm hit jitters by more than
+      the recorder's ~30 us cost, and the floor keeps timer noise
+      from failing a pin the recorder didn't earn.
+    - ``obs_ok_tail_capture``: a forced-slow request (slow-threshold
+      0 ms makes every cold render "slow") appears in the
+      /debug/requests ring with full attribution — pipeline stages
+      stamped and the stage sum within the observed total.
+    """
+    import hashlib  # noqa: F401 - parity with bench_cache imports
+
+    from aiohttp import web
+
+    from omero_ms_pixel_buffer_tpu.auth.stores import MemorySessionStore
+    from omero_ms_pixel_buffer_tpu.http.server import PixelBufferApp
+    from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
+    from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+        ImageRegistry,
+        PixelsService,
+    )
+    from omero_ms_pixel_buffer_tpu.utils.config import Config
+
+    size = 2048
+    path = os.path.join(cache_dir, "obs_fixture.ome.tiff")
+    if not os.path.exists(path):
+        rng = np.random.default_rng(61)
+        img = rng.integers(
+            0, 60000, (1, 1, 1, size, size), dtype=np.uint16
+        )
+        write_ome_tiff(path, img, tile_size=(256, 256))
+
+    def make_app(obs_enabled: bool, slow_ms: float = 10_000.0):
+        registry = ImageRegistry()
+        registry.add(1, path)
+        config = Config.from_dict({
+            "session-store": {"type": "memory"},
+            "backend": {"engine": "host"},
+            "cache": {"prefetch": {"enabled": False}},
+            "obs": {
+                "enabled": obs_enabled,
+                # overhead arms: nothing kept (pure recording cost);
+                # the tail arm flips slow-threshold to 0 instead
+                "head-sample-rate": 0.0,
+                "slow-threshold-ms": slow_ms,
+            },
+        })
+        service = PixelsService(registry)
+        return PixelBufferApp(
+            config,
+            pixels_service=service,
+            session_store=MemorySessionStore({"bench": "bench-key"}),
+        ), service
+
+    # 512-px tiles (the bench_cache latency-probe shape): the warm
+    # baseline includes a realistic body transfer, so the pin reads
+    # the recorder against what a viewer actually feels per hit
+    urls = [
+        f"/tile/1/0/0/0?x={512 * (i % 3)}&y={512 * (i // 3 % 3)}"
+        "&w=512&h=512&format=png"
+        for i in range(9)
+    ]
+
+    async def drive(port, request_urls, expect_status=200):
+        latencies = []
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        try:
+            for url in request_urls:
+                t0 = time.perf_counter()
+                writer.write(
+                    f"GET {url} HTTP/1.1\r\n"
+                    "Host: bench\r\n"
+                    "Cookie: sessionid=bench\r\n"
+                    "\r\n".encode()
+                )
+                await writer.drain()
+                status = int((await reader.readline()).split()[1])
+                clen = 0
+                while True:
+                    line = await reader.readline()
+                    if line in (b"\r\n", b""):
+                        break
+                    if line.lower().startswith(b"content-length:"):
+                        clen = int(line.split(b":", 1)[1])
+                body = await reader.readexactly(clen)
+                assert status == expect_status, (status, body[:200])
+                latencies.append(time.perf_counter() - t0)
+        finally:
+            writer.close()
+        return latencies, body
+
+    async def warm_p50(app_obj, service, rounds: int = 3) -> float:
+        runner = web.AppRunner(app_obj.make_app(), access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = runner.addresses[0][1]
+        try:
+            await drive(port, urls)  # cold fill + warmup
+            p50s = []
+            for _ in range(rounds):
+                lat, _ = await drive(
+                    port, (urls * (n // len(urls) + 1))[:n]
+                )
+                p50s.append(
+                    float(np.percentile(np.array(lat) * 1e3, 50))
+                )
+            return min(p50s)
+        finally:
+            await runner.cleanup()
+            service.close()
+
+    async def run() -> dict:
+        out: dict = {"warm_requests_per_arm": n}
+        app_on, svc_on = make_app(True)
+        app_off, svc_off = make_app(False)
+        out["warm_p50_on_ms"] = round(await warm_p50(app_on, svc_on), 3)
+        out["warm_p50_off_ms"] = round(
+            await warm_p50(app_off, svc_off), 3
+        )
+        penalty = (
+            out["warm_p50_on_ms"] - out["warm_p50_off_ms"]
+        ) / max(out["warm_p50_off_ms"], 1e-9)
+        out["warm_p50_penalty"] = round(penalty, 4)
+        out["obs_ok_overhead"] = bool(
+            penalty <= 0.03
+            or out["warm_p50_on_ms"] - out["warm_p50_off_ms"] <= 0.3
+        )
+
+        # forced-slow tail capture: slow-threshold 0 -> every serve is
+        # "slow" and must be kept with full attribution
+        app_slow, svc_slow = make_app(True, slow_ms=0.0)
+        runner = web.AppRunner(app_slow.make_app(), access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = runner.addresses[0][1]
+        try:
+            await drive(port, urls[:1])
+            events = app_slow.recorder.events()
+            captured = bool(events)
+            event = events[0] if events else {}
+            stages = event.get("stages_ms", {})
+            attributed = sum(stages.values())
+            out["tail_event_stages"] = sorted(stages)
+            out["tail_event_total_ms"] = event.get("total_ms")
+            out["obs_ok_tail_capture"] = bool(
+                captured
+                and event.get("kept_reason") == "slow"
+                and {"resolve", "read", "encode"} <= set(stages)
+                and attributed <= (event.get("total_ms") or 0) + 1.0
+            )
+        finally:
+            await runner.cleanup()
+            svc_slow.close()
+        return out
+
+    return asyncio.run(run())
+
+
 def build_render_fixture(root: str, size: int = 2048):
     """3-channel uint16 fixture for the rendered-tile section."""
     from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
@@ -1478,6 +1644,17 @@ def main():
             io_stats = {"error": f"{type(e).__name__}: {e}"}
             log(f"io bench failed: {e!r}")
 
+    # --- observability plane (r16): flight-recorder warm-path
+    # overhead A/B + forced-slow tail capture (obs_ok_* pins) ----------
+    obs_stats: dict = {}
+    if os.environ.get("BENCH_OBS", "1") != "0":
+        try:
+            obs_stats = bench_obs(cache_dir)
+            log(f"obs: {obs_stats}")
+        except Exception as e:
+            obs_stats = {"error": f"{type(e).__name__}: {e}"}
+            log(f"obs bench failed: {e!r}")
+
     # --- rendered-tile serving (render/): host vs headline engine ----
     render_stats: dict = {}
     if os.environ.get("BENCH_RENDER", "1") != "0":
@@ -1532,6 +1709,8 @@ def main():
         record["overload"] = overload_stats
     if io_stats:
         record["io"] = io_stats
+    if obs_stats:
+        record["obs"] = obs_stats
     if render_stats:
         record["render"] = render_stats
     if analysis_stats:
@@ -1590,6 +1769,10 @@ def main():
         )
         comparison["slo_interactive_degraded_fraction"] = (
             overload_stats["interactive"]["degraded_fraction"]
+        )
+    if obs_stats and "warm_p50_penalty" in obs_stats:
+        comparison["obs_warm_p50_penalty"] = (
+            obs_stats["warm_p50_penalty"]
         )
     record["engine_comparison"] = comparison
     print(json.dumps(record))

@@ -22,6 +22,7 @@ import asyncio
 import logging
 from typing import Optional, Tuple
 
+from ...obs.recorder import ambient_stage, current_record
 from ...utils.metrics import REGISTRY
 from ..result_cache import CachedTile
 from .l2 import RedisL2Tier
@@ -103,15 +104,30 @@ class CachePlane:
         if peer_originated:
             return None, None
         if self.l2 is not None:
-            entry = await self.l2.get(key)
+            with ambient_stage("l2"):
+                entry = await self.l2.get(key)
             if entry is not None:
                 return entry, "l2-hit"
         if self.ring is not None:
             owner = self.ring.owner(key)
             if owner != self.self_url:
-                result = await self.peers.fetch(
-                    owner, path_qs, session_cookie
-                )
+                # inject the requester's trace onto the hop so the
+                # owner's flight record joins it (cross-replica
+                # continuity); the owner's identity lands in the
+                # requester's tags either way
+                rec = current_record()
+                trace_context = None
+                if rec is not None:
+                    trace_context = {
+                        "trace_id": rec.trace_id,
+                        "span_id": rec.span_id,
+                    }
+                    rec.tag("peer_owner", owner)
+                with ambient_stage("peer"):
+                    result = await self.peers.fetch(
+                        owner, path_qs, session_cookie,
+                        trace_context=trace_context,
+                    )
                 if result is not None and result[0] == 200:
                     status, headers, body = result
                     entry = CachedTile(

@@ -49,6 +49,13 @@ PEER_REQUESTS = REGISTRY.counter(
 )
 
 PEER_HEADER = "X-OMPB-Peer"
+# Trace continuity across the hop (obs/recorder): the requester's
+# trace id + its root span id ride the peer GET, and the owner's
+# flight record JOINS the trace instead of minting a new one — one
+# trace spans requester and owner. Honored only together with the
+# peer marker (the same network-trust surface as /internal/*).
+TRACE_HEADER = "X-OMPB-Trace-Id"
+TRACE_PARENT_HEADER = "X-OMPB-Trace-Span"
 _MAX_BODY = 64 << 20  # hard bound on a peer reply body
 _FILENAME_RE = re.compile(r'filename="([^"]*)"')
 
@@ -81,10 +88,13 @@ class PeerClient:
         member: str,
         path_qs: str,
         session_cookie: Optional[str],
+        trace_context: Optional[Dict[str, str]] = None,
     ) -> Optional[Tuple[int, Dict[str, str], bytes]]:
         """GET ``path_qs`` from ``member``; ``(status, headers, body)``
         on an HTTP-complete exchange, None on any transport failure,
-        timeout, or open breaker (the caller renders locally)."""
+        timeout, or open breaker (the caller renders locally).
+        ``trace_context`` ({trace_id, span_id}) injects the requester's
+        trace onto the hop so the owner's record joins it."""
         breaker = self._breaker(member)
         try:
             breaker.allow()
@@ -95,7 +105,10 @@ class PeerClient:
         try:
             await INJECTOR.fire_async("cache.peer")
             result = await asyncio.wait_for(
-                self._exchange(member, "GET", path_qs, session_cookie),
+                self._exchange(
+                    member, "GET", path_qs, session_cookie,
+                    trace_context=trace_context,
+                ),
                 self.timeout_s,
             )
         except asyncio.CancelledError:
@@ -146,6 +159,7 @@ class PeerClient:
         method: str,
         path_qs: str,
         session_cookie: Optional[str],
+        trace_context: Optional[Dict[str, str]] = None,
     ) -> Tuple[int, Dict[str, str], bytes]:
         parsed = urlparse(member)
         host = parsed.hostname or "localhost"
@@ -160,6 +174,13 @@ class PeerClient:
                 "Accept-Encoding: identity",
                 "Content-Length: 0",
             ]
+            if trace_context:
+                tid = trace_context.get("trace_id")
+                if tid:
+                    lines.append(f"{TRACE_HEADER}: {tid}")
+                sid = trace_context.get("span_id")
+                if sid:
+                    lines.append(f"{TRACE_PARENT_HEADER}: {sid}")
             if session_cookie:
                 lines.append(f"Cookie: sessionid={session_cookie}")
             writer.write(

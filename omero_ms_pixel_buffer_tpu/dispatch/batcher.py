@@ -31,9 +31,11 @@ import contextvars
 import inspect
 import logging
 import os
+import time
 from typing import Any, List, Optional, Set, Tuple
 
 from ..auth.omero_session import SessionValidator
+from ..obs.recorder import record_scope
 from ..errors import (
     GatewayTimeoutError,
     InternalError,
@@ -179,6 +181,11 @@ class BatchingTileWorker:
                 # enqueueing would hang the caller until the bus timeout
                 raise InternalError("Service shutting down")
             fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            rec = getattr(ctx, "obs", None)
+            if rec is not None:
+                # batch-formation wait: enqueue -> batch execution
+                # start, stamped in _execute from this mark
+                rec.enqueued_at = time.perf_counter()
             try:
                 self._queue.put_nowait((ctx, fut))
             except asyncio.QueueFull:
@@ -290,6 +297,12 @@ class BatchingTileWorker:
         self, batch: List[Tuple[TileCtx, asyncio.Future]], loop
     ) -> None:
         BATCH_SIZE.observe(len(batch))
+        t_exec = time.perf_counter()
+        for c, _ in batch:
+            rec = getattr(c, "obs", None)
+            if rec is not None and rec.enqueued_at is not None:
+                rec.stamp("batch_wait", t_exec - rec.enqueued_at)
+                rec.tag("batch_size", len(batch))
         # Identical-key dedup: lanes equal under lane_key (tile spec +
         # session) execute ONCE; followers share the canonical lane's
         # result. The HTTP front's single-flight already collapses its
@@ -341,7 +354,17 @@ class BatchingTileWorker:
                 "deadline.remaining_ms",
                 round(batch_deadline.remaining() * 1000, 1),
             )
-        with deadline_scope(batch_deadline):
+        # ambient record for the executor hop: the batch runs in the
+        # RUNNER task's context, not any requester's, so exemplars and
+        # fault-point attribution deep in the pipeline would vanish —
+        # scope the lead lane's record in before the context copy
+        # (per-lane stage stamps ride ctx.obs and need no ambience)
+        lead_rec = next(
+            (getattr(c, "obs", None) for c in ctxs
+             if getattr(c, "obs", None) is not None),
+            None,
+        )
+        with deadline_scope(batch_deadline), record_scope(lead_rec):
             run_ctx = contextvars.copy_context()
         try:
             # pipeline work is blocking (I/O + device); keep the

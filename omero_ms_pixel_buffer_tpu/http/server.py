@@ -35,7 +35,11 @@ from ..auth.omero_session import (
     SessionValidator,
 )
 from ..auth.stores import OmeroWebSessionStore, make_session_store
-from ..cache.plane.peer import PEER_HEADER
+from ..cache.plane.peer import (
+    PEER_HEADER,
+    TRACE_HEADER,
+    TRACE_PARENT_HEADER,
+)
 from ..cache.prefetch import ViewportPrefetcher
 from ..cache.result_cache import (
     CachedTile,
@@ -51,12 +55,15 @@ from ..errors import (
 )
 from ..io.pixels_service import ImageRegistry, PixelsService
 from ..models.tile_pipeline import TilePipeline
+from ..obs import FlightRecorder, SliLayer
+from ..obs import recorder as obs_recorder
 from ..io.fetch import configure as configure_fetch
 from ..io.fetch import io_snapshot
 from ..resilience import AdmissionController, Deadline
 from ..resilience import configure as configure_resilience
 from ..resilience.breaker import BOARD
 from ..resilience.scheduler import (
+    PRIORITY_NAMES,
     SloScheduler,
     SweepDetector,
     classify,
@@ -94,6 +101,16 @@ SERVING_PREFIXES = (
 
 
 async def handle_metrics(request: web.Request) -> web.Response:
+    # content negotiation: scrapers asking for OpenMetrics get the
+    # exemplar-carrying dialect (metric -> trace pivots); everything
+    # else gets the byte-stable classic Prometheus text
+    accept = request.headers.get("Accept", "")
+    if "application/openmetrics-text" in accept:
+        return web.Response(
+            body=REGISTRY.exposition(openmetrics=True).encode(),
+            content_type="application/openmetrics-text",
+            charset="utf-8",
+        )
     return web.Response(
         text=REGISTRY.exposition(),
         content_type="text/plain",
@@ -112,9 +129,108 @@ async def handle_options(request: web.Request) -> web.Response:
     )
 
 
+def obs_middleware(app_obj: "PixelBufferApp"):
+    """The flight recorder's door (outermost middleware, before the
+    overload gate and session auth, so door sheds and 403s record
+    too): mint one ``FlightRecord`` per serving request, make it the
+    ambient record for the request's task, and complete it — total,
+    stage histograms, SLI accounting, the tail-sampling decision —
+    when the response (or the exception) comes back.
+
+    Peer-hop continuity: a request carrying the cache plane's
+    ``X-OMPB-Peer`` marker may also carry ``X-OMPB-Trace-Id`` — the
+    requester's trace — and the owner's record JOINS it instead of
+    minting its own, so one trace spans both replicas. Adoption is
+    gated on the peer marker: the trace headers ride the same
+    network-trust internal surface as ``/internal/*`` (deploy-time
+    network policy, documented in ARCHITECTURE)."""
+
+    @web.middleware
+    async def middleware(request: web.Request, handler):
+        recorder = app_obj.recorder
+        if (
+            recorder is None
+            or not recorder.enabled
+            or not request.path.startswith(SERVING_PREFIXES)
+            or request.method == "OPTIONS"
+        ):
+            return await handler(request)
+        trace_id = parent = None
+        if PEER_HEADER in request.headers:
+            # adopt the forwarded trace only when it LOOKS like one of
+            # ours (lowercase hex): a malformed id would poison the
+            # deterministic keep-hash and every downstream exposition
+            trace_id = _valid_trace_id(
+                request.headers.get(TRACE_HEADER)
+            )
+            parent = _valid_trace_id(
+                request.headers.get(TRACE_PARENT_HEADER), 16
+            )
+        rec = recorder.start(
+            request.path, request.method,
+            trace_id=trace_id, parent_span_id=parent,
+        )
+        if rec is None:
+            return await handler(request)
+        if trace_id is not None:
+            rec.peer_origin = request.headers.get(PEER_HEADER)
+        request["obs.rec"] = rec
+        status = 500
+        try:
+            with obs_recorder.record_scope(rec):
+                response = await handler(request)
+            status = response.status
+            degraded = response.headers.get("X-OMPB-Degraded")
+            if degraded:
+                rec.tag("degraded", int(degraded))
+            x_cache = response.headers.get("X-Cache")
+            if x_cache:
+                rec.tag("cache", x_cache)
+            return response
+        except web.HTTPException as e:
+            # router-raised responses (404 on an unroutable /tile/...
+            # path, 405 on a bad method) are CLIENT outcomes — without
+            # this they'd complete as 500s, force-keep into the ring,
+            # and burn the SLI error budget on scanner noise
+            status = e.status
+            raise
+        finally:
+            recorder.complete(rec, status)
+
+    return middleware
+
+
+def _valid_trace_id(value, length: int = 32):
+    """The forwarded trace/span id, or None when absent/malformed
+    (ids this service mints are fixed-width lowercase hex)."""
+    if (
+        isinstance(value, str)
+        and len(value) == length
+        and all(c in "0123456789abcdef" for c in value)
+    ):
+        return value
+    return None
+
+
 @web.middleware
 async def tracing_middleware(request: web.Request, handler):
-    span = TRACER.start_span(f"http:{request.path}")
+    rec = request.get("obs.rec")
+    if rec is not None and TRACER.enabled:
+        # live tracing joins the flight record's trace, so a span in
+        # Zipkin and a wide event in the ring share one trace id (and
+        # a peer-forwarded trace id reaches the spans too)
+        span = TRACER.start_span_with_context(
+            f"http:{request.path}",
+            {"traceId": rec.trace_id, "spanId": rec.parent_span_id},
+        )
+        if span.span_id is not None:
+            # the record's span id is what the peer hop propagates as
+            # the owner's parent (coordinator.fetch) — the LIVE root
+            # span must carry the same id or the owner's spans parent
+            # to an id no exported span ever has
+            span.span_id = rec.span_id
+    else:
+        span = TRACER.start_span(f"http:{request.path}")
     request["span"] = span
     with span:
         try:
@@ -156,20 +272,27 @@ def session_middleware(store: OmeroWebSessionStore, synchronicity: str = "async"
     @web.middleware
     async def middleware(request: web.Request, handler):
         if request.path in ("/metrics", "/healthz") or (
-            request.path.startswith("/internal/")
+            request.path.startswith(("/internal/", "/debug/"))
             or request.method == "OPTIONS"
         ):
             # /internal/* is the peer-to-peer surface (cache plane
             # purge fan-out): peers carry no browser session, and the
             # handlers only drop caches (re-renders produce identical
             # bytes) — deploy-time network policy, not session auth,
-            # is the trust boundary there (deploy/nginx.conf.sample)
+            # is the trust boundary there (deploy/nginx.conf.sample).
+            # /debug/* (the flight-recorder ring) is the same class of
+            # internal surface: operators reach it from inside the
+            # perimeter exactly when the session stack may be the
+            # thing that's broken.
             return await handler(request)
         session_id = request.cookies.get("sessionid")
         if not session_id:
             return web.Response(status=403, text="Permission denied")
         try:
-            key = await store.get_omero_session_key(session_id)
+            # ambient_stage: no-op without a flight record, one
+            # lookup call either way
+            with obs_recorder.ambient_stage("auth"):
+                key = await store.get_omero_session_key(session_id)
         except ServiceUnavailableError as e:
             return web.Response(
                 status=503, text="Session store unavailable",
@@ -257,10 +380,14 @@ def overload_gate_middleware(app_obj: "PixelBufferApp"):
             or request.method == "OPTIONS"  # discovery/CORS preflight
         ):
             return await handler(request)
+        rec = request.get("obs.rec")
+        t_door = time.perf_counter()
         priority = classify(
             request.headers, None, None, app_obj._priority_header
         )
         if not sched.would_overflow_shed(priority):
+            if rec is not None:
+                rec.stamp("door", time.perf_counter() - t_door)
             return await handler(request)
         cache = app_obj.result_cache
         if cache is not None and request.path.startswith("/tile/"):
@@ -271,10 +398,16 @@ def overload_gate_middleware(app_obj: "PixelBufferApp"):
                 if cache.contains_any_tier(probe_ctx.cache_key(
                     app_obj.pipeline.encode_signature()
                 )):
+                    if rec is not None:
+                        rec.stamp("door", time.perf_counter() - t_door)
                     return await handler(request)
             except TileError:
                 pass  # malformed params: the handler owns the 400
         sched.shed_at_door(priority)
+        if rec is not None:
+            rec.stamp("door", time.perf_counter() - t_door)
+            rec.tag("priority", PRIORITY_NAMES[priority])
+            rec.tag("shed_at", "door")
         return web.Response(
             status=503, text="Service overloaded",
             headers={
@@ -356,18 +489,37 @@ class PixelBufferApp:
             )
             if wd.enabled else None
         )
+        # The flight recorder (obs/): one fixed-slot stamp record per
+        # serving request, always on by default — stage histograms and
+        # slow-request forensics no longer depend on the tracing flag
+        oc = config.obs
+        self.recorder: Optional[FlightRecorder] = None
+        if oc.enabled:
+            self.recorder = FlightRecorder(
+                enabled=True,
+                slow_threshold_s=oc.slow_threshold_ms / 1000.0,
+                head_sample_rate=oc.head_sample_rate,
+                ring_size=oc.ring_size,
+                sli=SliLayer(budget_s=oc.slow_threshold_ms / 1000.0),
+            )
         # Reporter selection mirrors the reference
         # (PixelBufferMicroserviceVerticle.java:169-200): zipkin-url ->
         # batched HTTP sender; enabled without URL -> log reporter;
-        # DISABLED -> noop spans (the reference's :196-198 — span
+        # DISABLED -> noop live spans (the reference's :196-198 — span
         # objects cost uuid4 + contextvar churn per request, so off
-        # means off)
+        # means off). With the flight recorder on, a configured
+        # zipkin-url builds the reporter even with live tracing off:
+        # kept (tail-sampled) records materialize into retroactive
+        # spans through it.
         configure_tracing(
             enabled=config.http_tracing_enabled,
             log_spans=config.http_tracing_enabled,
             zipkin_url=(
-                config.zipkin_url if config.http_tracing_enabled else None
+                config.zipkin_url
+                if (config.http_tracing_enabled or oc.enabled)
+                else None
             ),
+            tail=oc.enabled,
         )
         self.session_store = session_store or make_session_store(
             config.session_store.type, config.session_store.uri
@@ -604,9 +756,22 @@ class PixelBufferApp:
             # every excess request costs a session lookup + cluster
             # cache consult before the scheduler can refuse it
             middlewares.insert(0, overload_gate_middleware(self))
+        if self.recorder is not None:
+            # outermost: door sheds, auth 503s, and 403s all complete
+            # a record — "every outcome leaves a trace" is the
+            # completeness contract the obs tests pin
+            middlewares.insert(0, obs_middleware(self))
         app = web.Application(middlewares=middlewares)
         app.router.add_get("/metrics", handle_metrics)
         app.router.add_get("/healthz", self.handle_healthz)
+        if self.recorder is not None:
+            app.router.add_get(
+                "/debug/requests", self.handle_debug_requests
+            )
+            app.router.add_get(
+                "/debug/requests/{traceId}",
+                self.handle_debug_request_detail,
+            )
         app.router.add_route("OPTIONS", "/{tail:.*}", handle_options)
         app.router.add_get(
             "/tile/{imageId}/{z}/{c}/{t}", self.handle_get_tile
@@ -730,9 +895,15 @@ class PixelBufferApp:
             or admission["inflight"] >= admission["max_inflight"]
             or loop_health.get("blocked", False)
         )
+        obs_health = (
+            self.recorder.snapshot()
+            if self.recorder is not None
+            else {"enabled": False}
+        )
         body = {
             "status": "degraded" if degraded else "ok",
             "uptime_s": round(time.time() - self._started_at, 1),
+            "obs": obs_health,
             "breakers": breakers,
             "admission": admission,
             "slo": slo_health,
@@ -853,6 +1024,7 @@ class PixelBufferApp:
         etag: Optional[str], x_cache: Optional[str] = None,
         degraded: int = 0,
     ) -> web.Response:
+        t_frame = time.perf_counter()
         headers = {
             "Content-Type": CONTENT_TYPES.get(
                 ctx.format, "application/octet-stream"
@@ -873,6 +1045,9 @@ class PixelBufferApp:
             # its own cache key + ETag, so full-resolution state is
             # untouched)
             headers["X-OMPB-Degraded"] = str(degraded)
+        rec = getattr(ctx, "obs", None)
+        if rec is not None:
+            rec.stamp("frame", time.perf_counter() - t_frame)
         return web.Response(body=body, headers=headers)
 
     def _failure_response(
@@ -939,6 +1114,10 @@ class PixelBufferApp:
         maps auth/store failures to proper statuses."""
         if self._authz_fresh(ctx):
             return True
+        with obs_recorder.ambient_stage("cache_probe"):
+            return await self._authorize_cached_slow(ctx)
+
+    async def _authorize_cached_slow(self, ctx: TileCtx) -> bool:
         try:
             ok = await self.session_validator.validate(
                 ctx.omero_session_key
@@ -1047,6 +1226,42 @@ class PixelBufferApp:
         self._invalidate_local(image_id)
         if self.cache_plane is not None:
             self.cache_plane.invalidate_image(image_id)
+
+    async def handle_debug_requests(self, request: web.Request) -> web.Response:
+        """The flight-recorder ring: most-recent-first kept wide
+        events. Session-exempt like /internal/* (an internal,
+        network-trust surface — it must answer precisely when auth or
+        the serving path is the thing being debugged); bounded by the
+        ring, with an optional ``?limit=`` narrowing."""
+        limit = None
+        raw = request.query.get("limit")
+        if raw is not None:
+            try:
+                limit = max(0, int(raw))
+            except (TypeError, ValueError):
+                return web.Response(status=400, text="bad limit")
+        events = self.recorder.events(limit=limit)
+        return web.json_response({
+            "kept": self.recorder.kept_count(),
+            "ring_size": self.recorder.ring_size,
+            "count": len(events),
+            "events": events,
+        })
+
+    async def handle_debug_request_detail(
+        self, request: web.Request
+    ) -> web.Response:
+        """One trace's kept wide events (a trace id can appear once
+        per completed request it spanned — e.g. requester + owner on
+        a peer hop hold separate rings; each replica serves its own
+        half)."""
+        trace_id = request.match_info["traceId"]
+        events = self.recorder.events(trace_id=trace_id)
+        if not events:
+            return web.Response(status=404, text="unknown trace id")
+        return web.json_response({
+            "trace_id": trace_id, "events": events,
+        })
 
     async def handle_internal_purge(self, request: web.Request) -> web.Response:
         """Inbound half of the purge fan-out. Requires the peer
@@ -1223,6 +1438,8 @@ class PixelBufferApp:
 
     async def _serve(self, request: web.Request, ctx: TileCtx) -> web.Response:
         cache = self.result_cache
+        rec = request.get("obs.rec")
+        ctx.obs = rec  # the pipeline stamps per-lane through the ctx
         if self.scheduler is not None:
             # classify BEFORE serving (header override > prefetch
             # purpose markers > sweep detection), then feed this
@@ -1247,14 +1464,21 @@ class PixelBufferApp:
                     ctx.t, ctx.resolution, ctx.region.x, ctx.region.y,
                     ctx.region.width, ctx.region.height,
                 )
+        if rec is not None:
+            rec.tag("priority", PRIORITY_NAMES.get(
+                ctx.priority, "interactive"
+            ))
+            rec.tag("engine", getattr(self.pipeline, "_engine", None))
         if cache is not None:
-            await self._normalize_region(ctx)
+            with obs_recorder.ambient_stage("cache_probe"):
+                await self._normalize_region(ctx)
         inm = request.headers.get("If-None-Match", "")
         key = None
         plane_entry = plane_source = None
         if cache is not None:
             key = ctx.cache_key(self.pipeline.encode_signature())
-            entry = await cache.get(key)
+            with obs_recorder.ambient_stage("cache_probe"):
+                entry = await cache.get(key)
             if entry is None and self.cache_plane is not None:
                 # the cluster consult, between local miss and render:
                 # shared L2 first, then one bounded GET to the key's
@@ -1348,7 +1572,17 @@ class PixelBufferApp:
                         degradable=self._degradable(ctx),
                     )
                 except TileError as e:
+                    if rec is not None and isinstance(
+                        e, ServiceUnavailableError
+                    ):
+                        # acquire's only 503 is a shed decision —
+                        # tagged so the record's outcome reads "shed",
+                        # not "unavailable" (dependency-down 503s
+                        # carry no shed_at)
+                        rec.tag("shed_at", "queue")
                     return self._failure_response(request, e)
+                if rec is not None and permit.queued_s > 0.0:
+                    rec.stamp("queue_wait", permit.queued_s)
                 if permit.degraded:
                     # deadline at risk: serve the next-lower pyramid
                     # level upscaled instead of risking a 504. The

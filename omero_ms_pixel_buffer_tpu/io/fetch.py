@@ -44,6 +44,7 @@ from ..resilience.breaker import (
     NULL_BREAKER,
     BreakerOpenError,
 )
+from ..obs.recorder import defer_exemplar
 from ..resilience.deadline import DeadlineExceeded, current_deadline
 from ..resilience.faultinject import INJECTOR
 from ..resilience.retry import retry_call
@@ -666,7 +667,13 @@ def fetch_many(
             coalesced_saved=max(0, saved), bytes_fetched=nbytes,
             bytes_discarded=discarded, batches=1,
         )
-    IO_FETCH_SECONDS.observe(time.monotonic() - t0)
+    # exemplar: the batch's ambient record (the batcher scopes the
+    # lead lane's record around the executor hop) — deferred to
+    # completion so a cold-read tail pivots to a trace the /debug
+    # ring can actually answer
+    dt = time.monotonic() - t0
+    IO_FETCH_SECONDS.observe(dt)
+    defer_exemplar(IO_FETCH_SECONDS, dt)
     return [out[alias[i]] for i in range(n)]
 
 

@@ -59,6 +59,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..obs.recorder import current_record, defer_exemplar, record_scope
 from ..utils.metrics import REGISTRY
 
 log = logging.getLogger("omero_ms_pixel_buffer_tpu.device_dispatch")
@@ -68,6 +69,16 @@ DEVICE_STAGE_SECONDS = REGISTRY.histogram(
     "Device encode pipeline stage durations "
     "(stage=stage|h2d|compute|hist|emit|d2h|frame)",
 )
+
+
+def _observe_stage(duration: float, stage: str) -> None:
+    """Stage histogram + deferred trace exemplar: the submitting
+    request's record is scoped onto the queue's worker threads per
+    group (``record_scope`` in ``_run_stage`` and the readback wrap),
+    and the exemplar only lands if the tail sampler keeps the trace —
+    a device-stage spike in a dashboard pivots to a citable trace."""
+    DEVICE_STAGE_SECONDS.observe(duration, stage=stage)
+    defer_exemplar(DEVICE_STAGE_SECONDS, duration, stage=stage)
 DEVICE_QUEUE_IDLE_SECONDS = REGISTRY.histogram(
     "device_queue_idle_seconds",
     "Device idle gap between one encode group's compute finishing and "
@@ -324,8 +335,14 @@ class DeviceEncodeDispatcher:
         with self._pending_lock:
             self._pending.add(fut)
         fut.add_done_callback(self._discard_pending)
+        # capture the submitting request's flight record NOW (the
+        # caller runs inside the batcher's record scope); the queue's
+        # worker threads re-scope it per group for deferred exemplars
+        rec = current_record()
         try:
-            self._submit_pool.submit(self._run_stage, stage_fn, fut, args)
+            self._submit_pool.submit(
+                self._run_stage, stage_fn, fut, args, rec
+            )
         except RuntimeError as e:
             # close() raced the _closed check and shut the pool down:
             # resolve THIS group's future exceptionally (the pipeline
@@ -339,6 +356,22 @@ class DeviceEncodeDispatcher:
             self._pending.discard(fut)
 
     @staticmethod
+    def _tid_bound(fn):
+        """Carry the ambient flight record (set by ``_run_stage``)
+        onto the readback worker so the compute/d2h/frame stage
+        observes keep their deferred exemplar — the readback thread
+        outlives any request context."""
+        rec = current_record()
+        if rec is None:
+            return fn
+
+        def bound(*args, **kwargs):
+            with record_scope(rec):
+                return fn(*args, **kwargs)
+
+        return bound
+
+    @staticmethod
     def _resolve_exc(fut, exc) -> None:
         # close()'s drain deadline may have resolved the future first;
         # losing that race is fine — the caller already host-fell-back
@@ -347,7 +380,7 @@ class DeviceEncodeDispatcher:
         except concurrent.futures.InvalidStateError:
             pass
 
-    def _run_stage(self, stage_fn, fut, args) -> None:
+    def _run_stage(self, stage_fn, fut, args, rec=None) -> None:
         """Submit-thread trampoline: acquire an in-flight slot, stage +
         launch, chain the readback future into the caller's. Any
         failure resolves the caller future exceptionally (the pipeline
@@ -364,7 +397,8 @@ class DeviceEncodeDispatcher:
             acquired = True
             with self._stats_lock:
                 self._inflight += 1
-            rfut = stage_fn(*args)
+            with record_scope(rec):
+                rfut = stage_fn(*args)
         except Exception as e:
             # resolve the caller's future instead of raising into the
             # executor: the pipeline host-falls-back this group
@@ -417,7 +451,7 @@ class DeviceEncodeDispatcher:
                 tiles, rows, row_bytes, bpp, filter_mode, deflate_mode
             )
             return self._readback.submit(
-                self._mesh_group,
+                self._tid_bound(self._mesh_group),
                 tiles, rows, row_bytes, bpp, filter_mode, deflate_mode,
                 lanes, sizes, bit_depth, color_type,
             )
@@ -431,7 +465,7 @@ class DeviceEncodeDispatcher:
             # compute keeps the device busy meanwhile
             jax.block_until_ready(batch_dev)  # ompb-lint: disable=jax-hotpath -- H2D stage boundary: waits on the transfer engine, overlapped with earlier groups' compute
             t_h2d = time.perf_counter()
-        DEVICE_STAGE_SECONDS.observe(t_h2d - t0, stage="h2d")
+        _observe_stage(t_h2d - t0, "h2d")
         if deflate_mode == "dynamic":
             from ..ops.device_deflate import fused_filter_histogram_batch
 
@@ -442,7 +476,7 @@ class DeviceEncodeDispatcher:
             t_dispatch = time.perf_counter()
             self._note_launch(t_dispatch)
             return self._readback.submit(
-                self._dynamic_readback_group,
+                self._tid_bound(self._dynamic_readback_group),
                 flat, counts, extras, real_b, t_dispatch, lanes, sizes,
                 bit_depth, color_type,
             )
@@ -457,7 +491,7 @@ class DeviceEncodeDispatcher:
         t_dispatch = time.perf_counter()
         self._note_launch(t_dispatch)
         return self._readback.submit(
-            self._readback_group,
+            self._tid_bound(self._readback_group),
             streams, lengths, t_dispatch, lanes, sizes,
             bit_depth, color_type,
         )
@@ -472,7 +506,7 @@ class DeviceEncodeDispatcher:
             # same rationale as the raw-tile mesh path: block inside
             # the managed dispatch so a sick chip degrades the mesh
             return self._readback.submit(
-                self._mesh_render_group,
+                self._tid_bound(self._mesh_render_group),
                 planes, index_tables, color_luts, rows, row_bytes,
                 filter_mode, deflate_mode, lanes, sizes,
             )
@@ -482,7 +516,7 @@ class DeviceEncodeDispatcher:
         batch_dev = jax.device_put(planes)
         jax.block_until_ready(batch_dev)  # ompb-lint: disable=jax-hotpath -- H2D stage boundary: waits on the transfer engine, overlapped with earlier groups' compute
         t_h2d = time.perf_counter()
-        DEVICE_STAGE_SECONDS.observe(t_h2d - t0, stage="h2d")
+        _observe_stage(t_h2d - t0, "h2d")
         streams, lengths = fused_render_filter_deflate_batch(
             batch_dev, index_tables, color_luts, rows, row_bytes,
             filter_mode=filter_mode, mode=deflate_mode,
@@ -491,7 +525,7 @@ class DeviceEncodeDispatcher:
         t_dispatch = time.perf_counter()
         self._note_launch(t_dispatch)
         return self._readback.submit(
-            self._readback_group,
+            self._tid_bound(self._readback_group),
             streams, lengths, t_dispatch, lanes, sizes, 8, 2,
         )
 
@@ -544,8 +578,8 @@ class DeviceEncodeDispatcher:
         # re-invoke run() once on a probe-shrink retry, and the queue
         # telemetry must count each submitted group exactly once
         self._note_launch(t_h2d)
-        DEVICE_STAGE_SECONDS.observe(t_h2d - t0, stage="h2d")
-        DEVICE_STAGE_SECONDS.observe(t_ready - t_h2d, stage="compute")
+        _observe_stage(t_h2d - t0, "h2d")
+        _observe_stage(t_ready - t_h2d, "compute")
         self._note_compute_done(t_ready, t_ready - t_h2d)
         return self._pull_and_frame(
             streams, lengths, t_ready, lanes, sizes, 8, 2
@@ -603,8 +637,8 @@ class DeviceEncodeDispatcher:
         # re-invoke run() once on a probe-shrink retry, and the queue
         # telemetry must count each submitted group exactly once
         self._note_launch(t_h2d)
-        DEVICE_STAGE_SECONDS.observe(t_h2d - t0, stage="h2d")
-        DEVICE_STAGE_SECONDS.observe(t_ready - t_h2d, stage="compute")
+        _observe_stage(t_h2d - t0, "h2d")
+        _observe_stage(t_ready - t_h2d, "compute")
         self._note_compute_done(t_ready, t_ready - t_h2d)
         return self._pull_and_frame(
             streams, lengths, t_ready, lanes, sizes, bit_depth,
@@ -701,13 +735,13 @@ class DeviceEncodeDispatcher:
 
         counts_np, extras_np = jax.device_get((counts, extras))  # ompb-lint: disable=jax-hotpath -- readback worker: the one thread that waits on device completion (pass-1 counts, a few KB)
         t_hist = time.perf_counter()
-        DEVICE_STAGE_SECONDS.observe(t_hist - t_dispatch, stage="hist")
+        _observe_stage(t_hist - t_dispatch, "hist")
         streams, lengths = dynamic_emit_batch(
             flat, counts_np, extras_np, packer=self._packer, real=real_b
         )
         jax.block_until_ready((streams, lengths))  # ompb-lint: disable=jax-hotpath -- readback worker: the one thread that waits on device completion
         t_ready = time.perf_counter()
-        DEVICE_STAGE_SECONDS.observe(t_ready - t_hist, stage="emit")
+        _observe_stage(t_ready - t_hist, "emit")
         self._note_compute_done(t_ready, t_ready - t_dispatch)
         return self._pull_and_frame(
             streams, lengths, t_ready, lanes, sizes, bit_depth,
@@ -726,7 +760,7 @@ class DeviceEncodeDispatcher:
         # device wait so submitters never do
         jax.block_until_ready((streams, lengths))  # ompb-lint: disable=jax-hotpath -- readback worker: the one thread that waits on device completion
         t_ready = time.perf_counter()
-        DEVICE_STAGE_SECONDS.observe(t_ready - t_dispatch, stage="compute")
+        _observe_stage(t_ready - t_dispatch, "compute")
         self._note_compute_done(t_ready, t_ready - t_dispatch)
         return self._pull_and_frame(
             streams, lengths, t_ready, lanes, sizes, bit_depth,
@@ -768,14 +802,12 @@ class DeviceEncodeDispatcher:
                 full_cap, 1 << max(2 * max_len - 1, 0).bit_length()
             )
         t_d2h = time.perf_counter()
-        DEVICE_STAGE_SECONDS.observe(t_d2h - t_ready, stage="d2h")
+        _observe_stage(t_d2h - t_ready, "d2h")
         out: Dict[int, bytes] = {}
         for j, lane in enumerate(lanes):
             out[lane] = frame_png(
                 streams_np[j, : int(lengths_np[j])].tobytes(),
                 sizes[j][0], sizes[j][1], bit_depth, color_type,
             )
-        DEVICE_STAGE_SECONDS.observe(
-            time.perf_counter() - t_d2h, stage="frame"
-        )
+        _observe_stage(time.perf_counter() - t_d2h, "frame")
         return out

@@ -27,6 +27,7 @@ import concurrent.futures
 import logging
 import os
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -51,6 +52,7 @@ from ..ops.png import (
     encode_png,
     filter_batch,
 )
+from ..obs.recorder import stage_all, stage_of
 from ..ops.tiff import TiffEncodeError, encode_tiff
 from ..runtime.native import get_engine
 from ..tile_ctx import TileCtx
@@ -520,6 +522,10 @@ class TilePipeline:
         is unknown; raises on invalid coordinates (callers map to the
         reference's broad-catch -> None -> 404)."""
         self._check_deadline(ctx, "resolve")
+        with stage_of(ctx, "resolve"):
+            return self._resolve_inner(ctx)
+
+    def _resolve_inner(self, ctx: TileCtx) -> Optional[ResolvedTile]:
         with TRACER.start_span("get_pixels"):
             # the session key scopes permission-aware resolvers — the
             # reference's HQL runs inside the joined session, so ACLs
@@ -589,12 +595,14 @@ class TilePipeline:
 
     def read(self, rt: ResolvedTile) -> np.ndarray:
         self._check_deadline(rt.ctx, "read")
-        if rt.degrade_level is not None:
-            return self._read_degraded(rt)
-        with TRACER.start_span("get_tile_direct"):
-            return rt.buffer.get_tile_at(
-                rt.level, rt.ctx.z, rt.ctx.c, rt.ctx.t, rt.x, rt.y, rt.w, rt.h
-            )
+        with stage_of(rt.ctx, "read"):
+            if rt.degrade_level is not None:
+                return self._read_degraded(rt)
+            with TRACER.start_span("get_tile_direct"):
+                return rt.buffer.get_tile_at(
+                    rt.level, rt.ctx.z, rt.ctx.c, rt.ctx.t,
+                    rt.x, rt.y, rt.w, rt.h,
+                )
 
     # -- hybrid-resolution degradation (resilience/scheduler) ----------
 
@@ -675,6 +683,10 @@ class TilePipeline:
                 return None
 
     def encode(self, ctx: TileCtx, tile: np.ndarray) -> Optional[bytes]:
+        with stage_of(ctx, "encode"):
+            return self._encode_inner(ctx, tile)
+
+    def _encode_inner(self, ctx: TileCtx, tile: np.ndarray) -> Optional[bytes]:
         fmt = ctx.format
         if fmt is None:
             # raw big-endian bytes (OMERO convention)
@@ -832,7 +844,8 @@ class TilePipeline:
                     for i in lanes
                 ]
                 try:
-                    batch = buf.read_tiles(coords, level=level)
+                    with stage_all([ctxs[i] for i in lanes], "read"):
+                        batch = buf.read_tiles(coords, level=level)
                     for i, tile in zip(lanes, batch):
                         tiles[i] = tile
                 except _UNAVAILABLE as e:
@@ -954,16 +967,21 @@ class TilePipeline:
         render_pending: List[Tuple[List[int], object]] = []
         render_stacks: Dict[int, RenderLane] = {}
         if render_idx:
-            render_pending, render_stacks = self._render_batch_lanes(
-                render_idx, resolved, ctxs, results,
-                use_fused=use_fused,
-            )
+            # coarse per-lane attribution: plane reads + table build +
+            # compose/submit — the device drain below stamps "device"
+            # separately for fused groups
+            with stage_all([ctxs[i] for i in render_idx], "render"):
+                render_pending, render_stacks = self._render_batch_lanes(
+                    render_idx, resolved, ctxs, results,
+                    use_fused=use_fused,
+                )
 
         if analysis_idx:
-            self._analysis_batch_lanes(
-                analysis_idx, resolved, ctxs, results,
-                use_device=use_device,
-            )
+            with stage_all([ctxs[i] for i in analysis_idx], "render"):
+                self._analysis_batch_lanes(
+                    analysis_idx, resolved, ctxs, results,
+                    use_device=use_device,
+                )
 
         if defer:
             for idxs, fut in pending:
@@ -982,7 +1000,8 @@ class TilePipeline:
                 # audited: handle_batch runs on a BATCHER executor
                 # thread and the future resolves on the dispatcher's
                 # readback pool — distinct pools, no self-deadlock
-                group = fut.result()  # ompb-lint: disable=loop-block -- executor-thread wait on a different pool
+                with stage_all([ctxs[i] for i in idxs], "device"):
+                    group = fut.result()  # ompb-lint: disable=loop-block -- executor-thread wait on a different pool
                 for i, png in group.items():
                     results[i] = png
             except Exception:
@@ -999,7 +1018,8 @@ class TilePipeline:
         for idxs, fut in render_pending:
             try:
                 # audited: same two-pool shape as the drain above
-                group = fut.result()  # ompb-lint: disable=loop-block -- executor-thread wait on a different pool
+                with stage_all([ctxs[i] for i in idxs], "device"):
+                    group = fut.result()  # ompb-lint: disable=loop-block -- executor-thread wait on a different pool
                 for i, png in group.items():
                     results[i] = png
                 from ..render.engine import RENDER_TILES
@@ -1036,8 +1056,18 @@ class TilePipeline:
             lf: "concurrent.futures.Future" = concurrent.futures.Future()
             lane_futs[i] = lf
             results[i] = DeferredTile(lf)
+        t_submit = time.perf_counter()
 
         def deliver(gfut):
+            # device-stage attribution: submit -> group resolution is
+            # the request's wall time inside the encode queue (the
+            # queue's own snapshot breaks the interior into
+            # h2d/compute/d2h with exemplar-carrying histograms)
+            dt = time.perf_counter() - t_submit
+            for i in idxs:
+                rec = getattr(ctxs[i], "obs", None)
+                if rec is not None:
+                    rec.stamp("device", dt)
             try:
                 group = gfut.result()
             except Exception:
@@ -1932,7 +1962,9 @@ class TilePipeline:
         engine = get_engine()
         encoded = None
         if engine is not None:
-            with TRACER.start_span("batch_encode"):
+            with TRACER.start_span("batch_encode"), stage_all(
+                [ctxs[i] for i in lanes], "encode"
+            ):
                 encoded = engine.png_encode_batch(
                     [tiles[i] for i in lanes],
                     filter_mode=self.png_filter,

@@ -1,0 +1,32 @@
+"""Observability plane — the flight recorder, tail sampler, wide-event
+ring, and SLO SLI layer (see recorder.py for the design)."""
+
+from .recorder import (
+    STAGES,
+    FlightRecord,
+    FlightRecorder,
+    ambient_stage,
+    current_record,
+    current_trace_id,
+    defer_exemplar,
+    note_fault,
+    record_scope,
+    stage_all,
+    stage_of,
+)
+from .sli import SliLayer
+
+__all__ = [
+    "STAGES",
+    "FlightRecord",
+    "FlightRecorder",
+    "SliLayer",
+    "ambient_stage",
+    "current_record",
+    "current_trace_id",
+    "defer_exemplar",
+    "note_fault",
+    "record_scope",
+    "stage_all",
+    "stage_of",
+]

@@ -143,6 +143,7 @@ class FaultInjector:
         outcome = self._outcome(point)
         if outcome is None:
             return
+        _note_obs_fault(point)
         if isinstance(outcome, Latency):
             # Guard the loop: injected latency models a slow
             # *dependency*, and sleeping on the event-loop thread
@@ -172,10 +173,23 @@ class FaultInjector:
         outcome = self._outcome(point)
         if outcome is None:
             return
+        _note_obs_fault(point)
         if isinstance(outcome, Latency):
             await asyncio.sleep(outcome.seconds)
             return
         outcome.raise_()
+
+
+def _note_obs_fault(point: str) -> None:
+    """Tag the ambient flight record (obs/recorder) with the fired
+    point — a kept trace then says WHICH injected fault shaped the
+    request. Off the fast path: only reached when a schedule yielded
+    an outcome (chaos runs), never in production serving."""
+    try:
+        from ..obs.recorder import note_fault
+    except ImportError:  # pragma: no cover - partial-install guard
+        return
+    note_fault(point)
 
 
 # Default process-wide injector (the REGISTRY/TRACER/BOARD pattern).

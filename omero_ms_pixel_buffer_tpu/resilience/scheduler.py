@@ -62,6 +62,7 @@ from collections import OrderedDict, deque
 from typing import List, Optional, Tuple
 
 from ..errors import GatewayTimeoutError, ServiceUnavailableError
+from ..obs.recorder import defer_exemplar
 from ..utils.metrics import REGISTRY
 from .admission import AdmissionController
 from .deadline import Deadline
@@ -483,7 +484,12 @@ class SloScheduler:
                     # ~zero-duration sample would poison the EWMA)
                     self.release(entry.fut.result(), train=False)  # ompb-lint: disable=loop-block -- future is done() here; result() is a non-blocking read
             raise
+        # exemplar: the waiting request's trace id rides the queue-wait
+        # histogram — DEFERRED to completion so it only lands if the
+        # tail sampler keeps the trace (a dashboard pivot must reach
+        # the /debug ring, not a 404)
         SLO_QUEUE_WAIT.observe(permit.queued_s)
+        defer_exemplar(SLO_QUEUE_WAIT, permit.queued_s)
         return permit
 
     def release(self, permit: Permit, train: bool = True) -> None:

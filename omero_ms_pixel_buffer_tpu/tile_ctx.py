@@ -112,6 +112,15 @@ class TileCtx:
     # full-resolution entry); priority joins none.
     priority: int = 0
     degraded: int = 0
+    # Flight record (obs/recorder): attached at the HTTP door, stamped
+    # by every layer the request touches. TRANSIENT — never serialized
+    # across the dispatch boundary (cross-process continuity rides the
+    # trace headers, not the record object) and never part of any
+    # cache/dedupe/lane key (compare=False keeps ctx equality
+    # record-blind).
+    obs: Optional[object] = dataclasses.field(
+        default=None, compare=False, repr=False
+    )
 
     @classmethod
     def from_params(

@@ -207,6 +207,23 @@ class SloConfig:
 
 
 @dataclasses.dataclass
+class ObsConfig:
+    """The obs: block — the flight-recorder observability plane
+    (obs/ package). ``enabled`` turns the per-request stamp record,
+    the tail sampler, the ``/debug/requests`` ring, and the SLI layer
+    on (default) or off entirely; ``slow_threshold_ms`` is both the
+    tail sampler's keep-if-slower bound and the SLI latency budget;
+    ``head_sample_rate`` keeps that fraction of healthy fast requests
+    (deterministic per trace id); ``ring_size`` bounds the in-memory
+    wide-event ring."""
+
+    enabled: bool = True
+    slow_threshold_ms: float = 300.0
+    head_sample_rate: float = 0.01
+    ring_size: int = 512
+
+
+@dataclasses.dataclass
 class PrefetchConfig:
     """Viewport prefetch (cache.prefetch): speculative warming of the
     result cache from per-session access streams, shed first under
@@ -445,6 +462,7 @@ class Config:
         default_factory=ResilienceConfig
     )
     slo: SloConfig = dataclasses.field(default_factory=SloConfig)
+    obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
     cluster: ClusterConfig = dataclasses.field(
         default_factory=ClusterConfig
@@ -666,6 +684,43 @@ class Config:
             sweep_window=_num("sweep-window", 16, 2, int),
             sweep_ttl_s=_num("sweep-ttl-s", 30.0, 0.0),
             priority_header=header.lower(),
+        )
+
+    @staticmethod
+    def _parse_obs(raw: dict) -> ObsConfig:
+        """Validate the obs: block — same posture as the others:
+        typos and nonsense fail at startup, never silently default."""
+        ob = raw.get("obs") or {}
+        unknown = set(ob) - {
+            "enabled", "slow-threshold-ms", "head-sample-rate",
+            "ring-size",
+        }
+        if unknown:
+            raise ConfigError(
+                f"Unknown keys in 'obs' block: {sorted(unknown)}"
+            )
+
+        def _num(key: str, default, minimum, cast=float):
+            try:
+                value = cast(ob.get(key, default))
+            except (TypeError, ValueError):
+                raise ConfigError(
+                    f"Invalid value for 'obs.{key}': {ob.get(key)!r}"
+                ) from None
+            if value < minimum:
+                raise ConfigError(f"'obs.{key}' must be >= {minimum}")
+            return value
+
+        rate = _num("head-sample-rate", 0.01, 0.0)
+        if rate > 1.0:
+            raise ConfigError(
+                "'obs.head-sample-rate' must be in [0, 1]"
+            )
+        return ObsConfig(
+            enabled=bool(ob.get("enabled", True)),
+            slow_threshold_ms=_num("slow-threshold-ms", 300.0, 0.0),
+            head_sample_rate=rate,
+            ring_size=_num("ring-size", 512, 1, int),
         )
 
     @staticmethod
@@ -1085,6 +1140,7 @@ class Config:
             backend=backend,
             resilience=cls._parse_resilience(raw),
             slo=cls._parse_slo(raw),
+            obs=cls._parse_obs(raw),
             cache=cls._parse_cache(raw),
             cluster=cls._parse_cluster(raw),
             io=cls._parse_io(raw),

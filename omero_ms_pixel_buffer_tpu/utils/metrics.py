@@ -6,10 +6,27 @@ Replaces the reference's Prometheus wiring
 PrometheusSpanHandler). No prometheus_client in the environment; the
 text exposition format is a few lines of string assembly and the
 framework wants zero-dependency counters on the hot path.
+
+Two exposition dialects from one registry (``exposition(openmetrics=)``;
+the /metrics handler negotiates on ``Accept``):
+
+- classic Prometheus text — byte-stable with what every earlier round
+  emitted;
+- **OpenMetrics 1.0** — counter families drop the ``_total`` suffix in
+  their metadata lines (samples keep it), ``le`` labels are canonical
+  floats, the body ends with ``# EOF``, and histogram ``_bucket``
+  samples may carry **exemplars**: ``... # {trace_id="…"} value ts``.
+
+Exemplars are how dashboards pivot metric -> trace: callers pass
+``observe(v, exemplar=<trace id>)`` and the LAST exemplar per
+(labelset, bucket) is kept — bounded memory, newest evidence wins.
+Exemplars never appear in the classic dialect (Prometheus would
+reject them).
 """
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from collections import defaultdict
@@ -28,7 +45,17 @@ def _fmt_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
     return "{" + inner + "}"
 
 
+def _om_family(name: str, kind: str) -> str:
+    """OpenMetrics family name: counter metadata drops the ``_total``
+    sample suffix (the spec's naming contract — samples keep it)."""
+    if kind == "counter" and name.endswith("_total"):
+        return name[: -len("_total")]
+    return name
+
+
 class Counter:
+    kind = "counter"
+
     def __init__(self, name: str, help_: str):
         self.name, self.help = name, help_
         self._values: Dict[Tuple[Tuple[str, str], ...], float] = defaultdict(float)
@@ -39,9 +66,12 @@ class Counter:
         with self._lock:
             self._values[key] += value
 
-    def collect(self) -> Iterable[str]:
-        yield f"# HELP {self.name} {self.help}"
-        yield f"# TYPE {self.name} counter"
+    def collect(self, openmetrics: bool = False) -> Iterable[str]:
+        family = (
+            _om_family(self.name, self.kind) if openmetrics else self.name
+        )
+        yield f"# HELP {family} {self.help}"
+        yield f"# TYPE {family} {self.kind}"
         with self._lock:
             items = list(self._values.items()) or [((), 0.0)]
         for labels, v in items:
@@ -49,52 +79,93 @@ class Counter:
 
 
 class Gauge(Counter):
+    kind = "gauge"
+
     def set(self, value: float, **labels) -> None:
         key = tuple(sorted(labels.items()))
         with self._lock:
             self._values[key] = value
-
-    def collect(self) -> Iterable[str]:
-        yield f"# HELP {self.name} {self.help}"
-        yield f"# TYPE {self.name} gauge"
-        with self._lock:
-            items = list(self._values.items()) or [((), 0.0)]
-        for labels, v in items:
-            yield f"{self.name}{_fmt_labels(labels)} {v}"
 
 
 class Histogram:
     def __init__(self, name: str, help_: str, buckets=_BUCKETS):
         self.name, self.help = name, help_
         self.buckets = buckets
+        # per-bucket (NON-cumulative) counts, accumulated into the
+        # Prometheus cumulative form at collect time — observe is one
+        # bisect + one increment instead of a walk over every bucket
+        # (the flight recorder observes several histograms per request)
         self._counts: Dict[Tuple[Tuple[str, str], ...], list] = {}
         self._sums: Dict[Tuple[Tuple[str, str], ...], float] = defaultdict(float)
+        # (labelset, bucket index) -> (trace_id, value, epoch ts);
+        # last writer wins, so memory is bounded by labelsets x buckets
+        self._exemplars: Dict[Tuple[Tuple[Tuple[str, str], ...], int], tuple] = {}
         self._lock = threading.Lock()
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(
+        self, value: float, exemplar: Optional[str] = None, **labels
+    ) -> None:
         key = tuple(sorted(labels.items()))
+        # bisect_left(value) is the smallest bucket with value <= le
+        # (ties land on the exact bucket); +Inf is always last
+        i = bisect.bisect_left(self.buckets, value)
         with self._lock:
-            counts = self._counts.setdefault(key, [0] * len(self.buckets))
-            for i, b in enumerate(self.buckets):
-                if value <= b:
-                    counts[i] += 1
+            counts = self._counts.get(key)
+            if counts is None:
+                counts = self._counts[key] = [0] * len(self.buckets)
+            counts[i] += 1
             self._sums[key] += value
+            if exemplar is not None:
+                # the exemplar belongs to the bucket that "contains"
+                # the observation
+                self._exemplars[(key, i)] = (
+                    exemplar, value, time.time()
+                )
+
+    def attach_exemplar(
+        self, value: float, exemplar: str, **labels
+    ) -> None:
+        """Annotate the bucket ``value`` landed in WITHOUT observing —
+        for deferred exemplars (obs/recorder): the observation was
+        recorded mid-request, the trace id only becomes citable once
+        the tail sampler keeps the trace at completion."""
+        key = tuple(sorted(labels.items()))
+        i = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            if key in self._counts:  # annotate only observed series
+                self._exemplars[(key, i)] = (
+                    exemplar, value, time.time()
+                )
 
     def time(self, **labels):
         return _Timer(self, labels)
 
-    def collect(self) -> Iterable[str]:
+    def collect(self, openmetrics: bool = False) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} histogram"
         with self._lock:
-            items = list(self._counts.items())
+            items = [(k, list(v)) for k, v in self._counts.items()]
             sums = dict(self._sums)
+            exemplars = dict(self._exemplars) if openmetrics else {}
         for labels, counts in items:
-            for b, c in zip(self.buckets, counts):
-                le = "+Inf" if b == float("inf") else repr(b)
+            running = 0
+            for i, (b, c) in enumerate(zip(self.buckets, counts)):
+                running += c
+                if openmetrics:
+                    # OpenMetrics wants canonical float le values
+                    le = "+Inf" if b == float("inf") else repr(float(b))
+                else:
+                    le = "+Inf" if b == float("inf") else repr(b)
                 lab = labels + (("le", le),)
-                yield f"{self.name}_bucket{_fmt_labels(lab)} {c}"
-            yield f"{self.name}_count{_fmt_labels(labels)} {counts[-1]}"
+                line = f"{self.name}_bucket{_fmt_labels(lab)} {running}"
+                ex = exemplars.get((labels, i))
+                if ex is not None:
+                    tid, v, ts = ex
+                    line += (
+                        f' # {{trace_id="{tid}"}} {v} {round(ts, 3)}'
+                    )
+                yield line
+            yield f"{self.name}_count{_fmt_labels(labels)} {running}"
             yield f"{self.name}_sum{_fmt_labels(labels)} {sums[labels]}"
 
 
@@ -109,7 +180,7 @@ class GaugeFn:
     def __init__(self, name: str, help_: str, fn):
         self.name, self.help, self.fn = name, help_, fn
 
-    def collect(self) -> Iterable[str]:
+    def collect(self, openmetrics: bool = False) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} gauge"
         try:
@@ -161,13 +232,26 @@ class Registry:
             self._metrics.append(metric)
         return metric
 
-    def exposition(self) -> str:
-        """Prometheus text format (the GET /metrics body)."""
+    def exposition(self, openmetrics: bool = False) -> str:
+        """The GET /metrics body: classic Prometheus text by default,
+        OpenMetrics 1.0 (counter-family naming, float ``le``, bucket
+        exemplars, ``# EOF`` terminator) when negotiated."""
         lines = []
         with self._lock:
             metrics = list(self._metrics)
         for m in metrics:
-            lines.extend(m.collect())
+            if openmetrics:
+                try:
+                    lines.extend(m.collect(openmetrics=True))
+                except TypeError:
+                    # external collectors predating the dialect split
+                    # (process metrics): exemplar-free lines are valid
+                    # in both formats
+                    lines.extend(m.collect())
+            else:
+                lines.extend(m.collect())
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
 

@@ -16,7 +16,7 @@ import resource
 import time
 from typing import Iterable
 
-from .metrics import REGISTRY, Registry
+from .metrics import REGISTRY, Registry, _om_family
 
 _CLK_TCK = os.sysconf("SC_CLK_TCK") if hasattr(os, "sysconf") else 100
 _PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
@@ -53,13 +53,21 @@ class ProcessCollector:
         except OSError:
             return None
 
-    def collect(self) -> Iterable[str]:
+    def collect(self, openmetrics: bool = False) -> Iterable[str]:
+        # OpenMetrics counter metadata drops the _total sample suffix
+        # — the ONE naming rule lives in utils/metrics._om_family
+        def fam(name: str) -> str:
+            return _om_family(name, "counter") if openmetrics else name
+
+        cpu_fam = fam("process_cpu_seconds_total")
+        gc_coll_fam = fam("python_gc_collections_total")
+        gc_obj_fam = fam("python_gc_objects_collected_total")
         stat = self._stat()
         if stat:
             utime, stime, threads, vsize, rss = stat
-            yield ("# HELP process_cpu_seconds_total Total user+system "
+            yield (f"# HELP {cpu_fam} Total user+system "
                    "CPU time")
-            yield "# TYPE process_cpu_seconds_total counter"
+            yield f"# TYPE {cpu_fam} counter"
             yield f"process_cpu_seconds_total {utime + stime}"
             yield "# HELP process_threads Current thread count"
             yield "# TYPE process_threads gauge"
@@ -84,14 +92,14 @@ class ProcessCollector:
         yield f"process_start_time_seconds {_START}"
         # GC — the hotspot GC-collector analog for CPython
         counts = gc.get_stats()
-        yield ("# HELP python_gc_collections_total Collections per "
+        yield (f"# HELP {gc_coll_fam} Collections per "
                "generation")
-        yield "# TYPE python_gc_collections_total counter"
+        yield f"# TYPE {gc_coll_fam} counter"
         for gen, st in enumerate(counts):
             yield (f'python_gc_collections_total{{generation="{gen}"}} '
                    f'{st.get("collections", 0)}')
-        yield "# HELP python_gc_objects_collected_total Collected objects"
-        yield "# TYPE python_gc_objects_collected_total counter"
+        yield f"# HELP {gc_obj_fam} Collected objects"
+        yield f"# TYPE {gc_obj_fam} counter"
         for gen, st in enumerate(counts):
             yield (f'python_gc_objects_collected_total{{generation="{gen}"}} '
                    f'{st.get("collected", 0)}')

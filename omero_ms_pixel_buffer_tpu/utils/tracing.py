@@ -24,10 +24,14 @@ spans (the request budget as it crosses the dispatch boundary),
 breaker rejects or a budget expires mid-span.
 
 Reporter model mirrors the reference's config gates: disabled -> noop
-spans (zero per-request cost, no metrics); enabled without sink -> log
-reporter (LogSpanReporter analog). With tracing enabled, span
+spans (zero per-request cost, no span metrics); enabled without sink
+-> log reporter (LogSpanReporter analog). With tracing enabled, span
 durations land in the ``span_duration_seconds`` histogram
-(PrometheusSpanHandler analog).
+(PrometheusSpanHandler analog). Since r16 the flight recorder
+(obs/recorder) owns ALWAYS-ON stage attribution — disabling tracing
+no longer blinds stage-latency metrics — and, with ``tail=True`` in
+``configure``, materializes tail-sampled records into retroactive
+spans through the (breaker-guarded, bounded) reporter below.
 """
 
 from __future__ import annotations
@@ -45,6 +49,11 @@ log = logging.getLogger("omero_ms_pixel_buffer_tpu.tracing")
 
 SPAN_SECONDS = REGISTRY.histogram(
     "span_duration_seconds", "Duration of tracing spans by name"
+)
+SPANS_DROPPED = REGISTRY.counter(
+    "tracing_spans_dropped_total",
+    "Spans dropped by the Zipkin reporter (full queue, dead sink, "
+    "open breaker), by reason",
 )
 
 _current_span: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
@@ -99,24 +108,38 @@ class ZipkinReporter:
     (PixelBufferMicroserviceVerticle.java:180-184): finished spans are
     queued and a background thread POSTs them to the Zipkin v2 JSON
     endpoint in batches. The queue is bounded; under backpressure spans
-    are dropped (counted), never blocking the serving path."""
+    are dropped (``tracing_spans_dropped_total``), never blocking the
+    serving path. The sink is a network dependency like any other: the
+    POST runs behind a ``tracing:zipkin`` breaker with a per-call
+    timeout and the ``tracing.zipkin`` fault point — a dead or hung
+    Zipkin costs dropped spans only, never a request (chaos-pinned)."""
 
     def __init__(self, url: str, service_name: str,
                  batch_size: int = 100, flush_interval_s: float = 1.0,
-                 max_queue: int = 10_000):
+                 max_queue: int = 10_000, post_timeout_s: float = 5.0):
         import queue
 
         self.url = url
         self.service_name = service_name
         self.batch_size = batch_size
         self.flush_interval_s = flush_interval_s
+        self.post_timeout_s = post_timeout_s
         self.dropped = 0
         self._queue: "queue.Queue[Optional[dict]]" = queue.Queue(max_queue)
         self._closed = False
+        # lazy import: tracing is imported by low-level modules that
+        # the resilience package itself depends on
+        from ..resilience.breaker import for_dependency
+
+        self._breaker = for_dependency("tracing:zipkin")
         self._thread = threading.Thread(
             target=self._run, name="zipkin-reporter", daemon=True
         )
         self._thread.start()
+
+    def _drop(self, n: int, reason: str) -> None:
+        self.dropped += n
+        SPANS_DROPPED.inc(n, reason=reason)
 
     def report(self, span: "Span") -> None:
         if self._closed:
@@ -135,22 +158,40 @@ class ZipkinReporter:
         try:
             self._queue.put_nowait(doc)
         except Exception:
-            self.dropped += 1
+            self._drop(1, "queue_full")
 
     def _post(self, batch: list) -> None:
         import json
+        import time as _time
         import urllib.request
 
+        from ..resilience.breaker import BreakerOpenError
+        from ..resilience.faultinject import INJECTOR
+
+        try:
+            self._breaker.allow()
+        except BreakerOpenError:
+            # sink known-dead: drop without burning a connect timeout
+            # per batch (the breaker half-opens on its own schedule)
+            self._drop(len(batch), "breaker_open")
+            return
         req = urllib.request.Request(
             self.url, data=json.dumps(batch).encode(),
             headers={"Content-Type": "application/json"},
             method="POST",
         )
+        t0 = _time.monotonic()
         try:
-            urllib.request.urlopen(req, timeout=5).close()
+            INJECTOR.fire("tracing.zipkin")  # reporter thread, never a loop
+            urllib.request.urlopen(req, timeout=self.post_timeout_s).close()
         except Exception as e:  # sink down: drop batch, keep going
-            self.dropped += len(batch)
+            self._breaker.record_failure()
+            self._drop(len(batch), "post_failed")
             log.debug("zipkin export failed: %s", e)
+        else:
+            self._breaker.record_success(
+                duration_s=_time.monotonic() - t0
+            )
 
     def _run(self) -> None:
         import queue
@@ -192,7 +233,7 @@ class ZipkinReporter:
             except Exception:
                 try:
                     self._queue.get_nowait()
-                    self.dropped += 1
+                    self._drop(1, "shutdown")
                 except Exception:
                     break
         self._thread.join(timeout=10)
@@ -293,15 +334,20 @@ def current_tracer() -> Tracer:
 
 
 def configure(
-    enabled: bool, log_spans: bool, zipkin_url: Optional[str] = None
+    enabled: bool, log_spans: bool, zipkin_url: Optional[str] = None,
+    tail: bool = False,
 ) -> None:
     """Reference reporter selection (:169-200): zipkin-url -> HTTP
     sender; enabled without URL -> log reporter; disabled -> noop
-    spans (no metrics, no export — the reference's :196-198)."""
+    spans (no live per-request span objects — the reference's
+    :196-198). ``tail=True`` (the flight recorder's mode) builds the
+    reporter even with live tracing off: the recorder materializes
+    KEPT records into retroactive spans through it, so the sink sees
+    only the tail-sampled traffic instead of every request."""
     TRACER.enabled = enabled
     TRACER.log_spans = log_spans and zipkin_url is None
     if TRACER.reporter is not None:
         TRACER.reporter.close()
         TRACER.reporter = None
-    if enabled and zipkin_url:
+    if (enabled or tail) and zipkin_url:
         TRACER.reporter = ZipkinReporter(zipkin_url, TRACER.service_name)

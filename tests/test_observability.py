@@ -1,0 +1,931 @@
+"""Flight-recorder observability plane (obs/): stage-completeness
+matrix over real HTTP serves, tail-sampler keep/drop decisions,
+requester<->owner trace continuity over a loopback two-replica
+cluster, OpenMetrics exemplar exposition validity, /debug/requests
+bounds + gating, and the dead-Zipkin chaos lane."""
+
+import asyncio
+import re
+import socket
+import time
+
+import numpy as np
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from omero_ms_pixel_buffer_tpu.auth.stores import MemorySessionStore
+from omero_ms_pixel_buffer_tpu.http.server import PixelBufferApp
+from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
+from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+    ImageRegistry,
+    PixelsService,
+)
+from omero_ms_pixel_buffer_tpu.obs import FlightRecorder, SliLayer
+from omero_ms_pixel_buffer_tpu.obs.recorder import STAGES
+from omero_ms_pixel_buffer_tpu.utils.config import Config, ConfigError
+from omero_ms_pixel_buffer_tpu.utils.metrics import REGISTRY, Registry
+from omero_ms_pixel_buffer_tpu.utils.tracing import (
+    TRACER,
+    ZipkinReporter,
+    configure as configure_tracing,
+)
+
+rng = np.random.default_rng(11)
+IMG = rng.integers(0, 60000, (1, 2, 2, 128, 128), dtype=np.uint16)
+AUTH = {"Cookie": "sessionid=cookie-1"}
+
+
+def _make_app(tmp_path, obs_overrides=None, extra=None):
+    img = str(tmp_path / "img.ome.tiff")
+    write_ome_tiff(img, IMG, tile_size=(64, 64), pyramid_levels=2)
+    registry = ImageRegistry()
+    registry.add(1, img)
+    raw = {
+        "session-store": {"type": "memory"},
+        "backend": {"batching": {"coalesce-window-ms": 1.0}},
+        "cache": {"prefetch": {"enabled": False}},
+        "obs": {"head-sample-rate": 1.0, **(obs_overrides or {})},
+    }
+    if extra:
+        for k, v in extra.items():
+            if isinstance(v, dict):
+                raw.setdefault(k, {}).update(v)
+            else:
+                raw[k] = v
+    config = Config.from_dict(raw)
+    return PixelBufferApp(
+        config,
+        pixels_service=PixelsService(registry),
+        session_store=MemorySessionStore({"cookie-1": "omero-key-1"}),
+    )
+
+
+@pytest.fixture
+def served(tmp_path, loop):
+    """(client, app_obj) with everything kept (head-sample-rate 1)."""
+    app_obj = _make_app(tmp_path)
+    client = TestClient(TestServer(app_obj.make_app()), loop=loop)
+    loop.run_until_complete(client.start_server())
+    yield client, app_obj
+    loop.run_until_complete(client.close())
+
+
+# ---------------------------------------------------------------------------
+# recorder unit behavior
+# ---------------------------------------------------------------------------
+
+
+class TestRecorder:
+    def test_fixed_slots_and_accumulation(self):
+        rec = FlightRecorder(head_sample_rate=1.0).start("/tile/1")
+        rec.stamp("read", 0.010)
+        rec.stamp("read", 0.005)
+        rec.stamp("encode", 0.002)
+        touched = dict(
+            (name, dur) for name, _, dur in rec.touched()
+        )
+        assert touched["read"] == pytest.approx(0.015)
+        assert touched["encode"] == pytest.approx(0.002)
+        assert set(touched) <= set(STAGES)
+
+    def test_unknown_stage_refused(self):
+        rec = FlightRecorder().start("/tile/1")
+        with pytest.raises(KeyError):
+            rec.stamp("not-a-stage", 0.1)
+
+    def test_wide_event_sums_within_slack(self):
+        recorder = FlightRecorder(head_sample_rate=1.0)
+        rec = recorder.start("/tile/1")
+        with rec.stage("resolve"):
+            time.sleep(0.01)
+        with rec.stage("read"):
+            time.sleep(0.02)
+        recorder.complete(rec, 200)
+        event = recorder.events()[0]
+        attributed = sum(event["stages_ms"].values())
+        assert attributed <= event["total_ms"] + 1.0
+        assert event["total_ms"] == pytest.approx(
+            attributed + event["unattributed_ms"], abs=0.1
+        )
+        assert event["stages_ms"]["read"] >= 15.0
+
+    def test_complete_is_idempotent(self):
+        recorder = FlightRecorder(head_sample_rate=1.0)
+        rec = recorder.start("/tile/1")
+        assert recorder.complete(rec, 200)
+        assert not recorder.complete(rec, 500)
+        assert len(recorder.events()) == 1
+
+    def test_disabled_recorder_mints_nothing(self):
+        recorder = FlightRecorder(enabled=False)
+        assert recorder.start("/tile/1") is None
+        assert recorder.complete(None, 200) is False
+
+
+class TestTailSampler:
+    def _one(self, recorder, status=200, tags=None, faults=(),
+             slow=False):
+        rec = recorder.start("/tile/1")
+        for k, v in (tags or {}).items():
+            rec.tag(k, v)
+        for point in faults:
+            rec.note_fault(point)
+        if slow:
+            rec.t0 -= 10.0  # fake a 10 s request
+        recorder.complete(rec, status)
+        return rec
+
+    def test_errors_always_kept(self):
+        recorder = FlightRecorder(head_sample_rate=0.0)
+        for status, outcome in (
+            # a bare 503 is a dependency that could not answer; only
+            # the scheduler/door shed tag makes it a "shed"
+            (500, "error"), (503, "unavailable"), (504, "timeout"),
+        ):
+            rec = self._one(recorder, status=status)
+            assert rec.kept and rec.keep_reason == "error"
+            assert rec.outcome == outcome
+        rec = self._one(
+            recorder, status=503, tags={"shed_at": "queue"}
+        )
+        assert rec.kept and rec.outcome == "shed"
+
+    def test_degraded_kept(self):
+        recorder = FlightRecorder(head_sample_rate=0.0)
+        rec = self._one(recorder, tags={"degraded": 1})
+        assert rec.kept and rec.keep_reason == "degraded"
+        assert rec.outcome == "degraded"
+
+    def test_slow_kept(self):
+        recorder = FlightRecorder(
+            head_sample_rate=0.0, slow_threshold_s=0.5
+        )
+        rec = self._one(recorder, slow=True)
+        assert rec.kept and rec.keep_reason == "slow"
+
+    def test_fault_kept(self):
+        recorder = FlightRecorder(head_sample_rate=0.0)
+        rec = self._one(recorder, faults=["io.range-get"])
+        assert rec.kept and rec.keep_reason == "fault"
+        assert recorder.events()[0]["faults"] == ["io.range-get"]
+
+    def test_healthy_fast_dropped_at_rate_zero(self):
+        recorder = FlightRecorder(head_sample_rate=0.0)
+        rec = self._one(recorder)
+        assert not rec.kept
+        assert recorder.events() == []
+        assert recorder.snapshot()["dropped"] == 1
+
+    def test_head_sampling_deterministic_per_trace_id(self):
+        """The SAME trace id keeps (or drops) on every recorder — the
+        cross-replica whole-trace property."""
+        a = FlightRecorder(head_sample_rate=0.3)
+        b = FlightRecorder(head_sample_rate=0.3)
+        decisions = []
+        for i in range(64):
+            ra = a.start("/tile/1")
+            rb = b.start("/tile/1", trace_id=ra.trace_id)
+            a.complete(ra, 200)
+            b.complete(rb, 200)
+            assert ra.kept == rb.kept
+            decisions.append(ra.kept)
+        assert any(decisions) and not all(decisions)
+
+    def test_ring_bounded(self):
+        recorder = FlightRecorder(head_sample_rate=1.0, ring_size=4)
+        for _ in range(10):
+            self._one(recorder)
+        assert len(recorder.events()) == 4
+        assert recorder.snapshot()["kept"] == 10
+
+
+class TestSli:
+    def test_burn_rate_math(self):
+        clock = [1000.0]
+        sli = SliLayer(budget_s=0.3, clock=lambda: clock[0])
+        # 90 good + 10 bad interactive -> bad_frac 0.1 -> burn 10.0
+        # (bad via 5 errors + 5 over-budget serves: both count)
+        for i in range(100):
+            sli.record(
+                "interactive",
+                0.5 if 5 <= i < 10 else 0.01,
+                error=i < 5,
+            )
+        rates = sli.burn_rates()
+        assert rates["5m"]["interactive"] == pytest.approx(10.0)
+        assert rates["1h"]["interactive"] == pytest.approx(10.0)
+        assert rates["5m"]["bulk"] == 0.0  # no data != incident
+        # outside the 5m window the short-window burn clears
+        clock[0] += 400.0
+        assert sli.burn_rates()["5m"]["interactive"] == 0.0
+        assert sli.burn_rates()["1h"]["interactive"] == pytest.approx(10.0)
+
+    def test_unknown_class_folds_to_interactive(self):
+        sli = SliLayer(budget_s=0.3)
+        sli.record("martian", 0.01)
+        assert sli.snapshot()["total"]["interactive"] == 1
+
+    def test_client_errors_never_dilute_the_sli(self):
+        """Review fix: fast 4xx refusals (scanner 403s, bad params)
+        stay OUT of the good/total ratio — they'd read a real latency
+        incident down to 'sustainable'."""
+        recorder = FlightRecorder(
+            head_sample_rate=0.0, slow_threshold_s=0.3,
+            sli=SliLayer(budget_s=0.3),
+        )
+        for status in (403, 404, 400):
+            recorder.complete(recorder.start("/tile/1"), status)
+        assert recorder.sli.snapshot()["total"]["interactive"] == 0
+        recorder.complete(recorder.start("/tile/1"), 200)
+        recorder.complete(recorder.start("/tile/1"), 503)
+        totals = recorder.sli.snapshot()
+        assert totals["total"]["interactive"] == 2
+        assert totals["good"]["interactive"] == 1
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics exposition + exemplars
+# ---------------------------------------------------------------------------
+
+_OM_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9][0-9.e+-]*"
+    r"( # \{[^{}]*\} -?[0-9][0-9.e+-]* [0-9][0-9.]*)?$"
+)
+
+
+def _validate_openmetrics(text: str) -> None:
+    """A hand-rolled validator for the OpenMetrics subset we emit (no
+    prometheus_client in the image): EOF terminator, line grammar,
+    counter-family naming, exemplars only on histogram buckets."""
+    lines = text.strip().split("\n")
+    assert lines[-1] == "# EOF"
+    families = {}
+    for line in lines[:-1]:
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            parts = line.split(" ", 3)
+            assert len(parts) >= 3, line
+            if parts[1] == "TYPE":
+                families[parts[2]] = parts[3]
+            continue
+        assert not line.startswith("#"), f"stray comment: {line}"
+        assert _OM_SAMPLE.match(line), f"bad sample line: {line}"
+        name = re.split(r"[{ ]", line, 1)[0]
+        if " # {" in line:
+            assert name.endswith("_bucket"), (
+                f"exemplar outside a histogram bucket: {line}"
+            )
+            assert 'le="' in line
+    # counter families must not end in _total; their samples must
+    for fam, kind in families.items():
+        if kind == "counter":
+            assert not fam.endswith("_total"), fam
+    for line in lines[:-1]:
+        if line.startswith("#"):
+            continue
+        name = re.split(r"[{ ]", line, 1)[0]
+        for fam, kind in families.items():
+            if kind == "counter" and name == fam + "_total":
+                break
+
+
+class TestOpenMetrics:
+    def test_exemplar_only_in_openmetrics(self):
+        r = Registry()
+        h = r.histogram("obs_t_seconds", "t")
+        h.observe(0.03, exemplar="deadbeef")
+        classic = r.exposition()
+        om = r.exposition(openmetrics=True)
+        assert "deadbeef" not in classic
+        assert "# EOF" not in classic
+        assert '# {trace_id="deadbeef"} 0.03' in om
+        assert om.strip().endswith("# EOF")
+
+    def test_last_exemplar_wins_per_bucket(self):
+        r = Registry()
+        h = r.histogram("obs_t2_seconds", "t")
+        h.observe(0.03, exemplar="first")
+        h.observe(0.04, exemplar="second")  # same 0.05 bucket
+        om = r.exposition(openmetrics=True)
+        assert "first" not in om and "second" in om
+
+    def test_counter_family_naming(self):
+        r = Registry()
+        r.counter("foo_total", "f").inc(kind="x")
+        om = r.exposition(openmetrics=True)
+        assert "# TYPE foo counter" in om
+        assert 'foo_total{kind="x"} 1.0' in om
+
+    def test_process_registry_validates(self):
+        # the REAL process registry, with whatever every suite already
+        # observed — the exposition itself must be valid OpenMetrics
+        _validate_openmetrics(REGISTRY.exposition(openmetrics=True))
+
+    def test_classic_exposition_unchanged_shape(self):
+        text = REGISTRY.exposition()
+        assert "# EOF" not in text and " # {" not in text
+
+
+# ---------------------------------------------------------------------------
+# stage completeness over real HTTP serves
+# ---------------------------------------------------------------------------
+
+
+class TestStageCompleteness:
+    async def test_miss_stamps_full_pipeline(self, served):
+        client, app_obj = served
+        resp = await client.get(
+            "/tile/1/0/0/0?x=0&y=0&w=64&h=64&format=png", headers=AUTH
+        )
+        assert resp.status == 200
+        event = app_obj.recorder.events()[0]
+        stages = event["stages_ms"]
+        for stage in ("auth", "cache_probe", "batch_wait", "resolve",
+                      "read", "encode", "frame"):
+            assert stage in stages, f"missing {stage}: {stages}"
+        assert event["outcome"] == "ok"
+        assert event["tags"]["priority"] == "interactive"
+        assert event["tags"]["cache"] == "miss"
+        assert sum(stages.values()) <= event["total_ms"] + 1.0
+
+    async def test_hit_stamps_probe_and_keeps_provenance(self, served):
+        client, app_obj = served
+        url = "/tile/1/0/0/0?x=0&y=0&w=64&h=64&format=png"
+        await client.get(url, headers=AUTH)
+        resp = await client.get(url, headers=AUTH)
+        assert resp.status == 200
+        assert resp.headers["X-Cache"] == "hit"
+        event = app_obj.recorder.events()[0]
+        assert event["tags"]["cache"] == "hit"
+        assert "cache_probe" in event["stages_ms"]
+        # a hit never runs the pipeline
+        assert "read" not in event["stages_ms"]
+
+    async def test_404_and_403_complete_records(self, served):
+        client, app_obj = served
+        resp = await client.get(
+            "/tile/999/0/0/0?w=8&h=8", headers=AUTH
+        )
+        assert resp.status == 404
+        assert app_obj.recorder.events()[0]["outcome"] == "client_error"
+        resp = await client.get("/tile/1/0/0/0?w=8&h=8")  # no cookie
+        assert resp.status == 403
+        assert app_obj.recorder.events()[0]["status"] == 403
+
+    async def test_router_404_records_client_status(self, served):
+        """Review fix: an unroutable serving path (aiohttp raises
+        HTTPNotFound before any handler) must record 404, not 500 —
+        scanner noise is a client outcome, never SLI error budget."""
+        client, app_obj = served
+        resp = await client.get("/tile/1/0/0", headers=AUTH)  # 3 segs
+        # the OPTIONS discovery catch-all claims every path, so an
+        # unroutable GET surfaces as 405 — still a router-raised
+        # HTTPException, still a client outcome
+        assert resp.status == 405
+        event = app_obj.recorder.events()[0]
+        assert event["status"] == 405
+        assert event["outcome"] == "client_error"
+
+    async def test_malformed_peer_trace_header_ignored(self, served):
+        """Review fix: a non-hex forwarded trace id is refused at
+        adoption (a fresh trace is minted) instead of poisoning the
+        sampler hash or the exposition."""
+        client, app_obj = served
+        resp = await client.get(
+            "/tile/1/0/0/0?w=8&h=8",
+            headers={
+                **AUTH,
+                "X-OMPB-Peer": "http://evil",
+                "X-OMPB-Trace-Id": "not-hex-at-all",
+            },
+        )
+        assert resp.status == 200
+        event = app_obj.recorder.events()[0]
+        assert event["trace_id"] != "not-hex-at-all"
+        assert len(event["trace_id"]) == 32
+
+    async def test_live_root_span_carries_record_span_id(self, served):
+        """Review fix: with live tracing on, the exported root span
+        must carry the record's span id — it is what the peer hop
+        propagates as the owner's parent."""
+        client, app_obj = served
+
+        class FakeReporter:
+            def __init__(self):
+                self.spans = []
+
+            def report(self, span):
+                self.spans.append(span)
+
+        fake = FakeReporter()
+        old_rep, old_en = TRACER.reporter, TRACER.enabled
+        TRACER.reporter, TRACER.enabled = fake, True
+        try:
+            resp = await client.get(
+                "/tile/1/0/1/0?w=8&h=8", headers=AUTH
+            )
+            assert resp.status == 200
+            event = app_obj.recorder.events()[0]
+            roots = [
+                s for s in fake.spans if s.name.startswith("http:")
+            ]
+            assert roots and roots[-1].span_id == event["span_id"]
+            assert roots[-1].trace_id == event["trace_id"]
+        finally:
+            TRACER.reporter, TRACER.enabled = old_rep, old_en
+
+    def test_deferred_exemplar_installs_only_when_kept(self):
+        """Review fix: deep-site exemplars (queue wait, io fetch,
+        device stages) observe mid-request — the trace id attaches at
+        completion, only for kept traces; a late note after a kept
+        completion attaches immediately."""
+        from omero_ms_pixel_buffer_tpu.obs.recorder import (
+            defer_exemplar,
+            record_scope,
+        )
+
+        reg = Registry()
+        hist = reg.histogram("deep_seconds", "t")
+        recorder = FlightRecorder(head_sample_rate=0.0)
+        # dropped record: exemplar never lands
+        dropped = recorder.start("/tile/1")
+        with record_scope(dropped):
+            hist.observe(0.02)
+            defer_exemplar(hist, 0.02)
+        recorder.complete(dropped, 200)
+        assert " # {" not in reg.exposition(openmetrics=True)
+        # kept record: exemplar lands at completion
+        kept = recorder.start("/tile/1")
+        with record_scope(kept):
+            hist.observe(0.02)
+            defer_exemplar(hist, 0.02)
+        recorder.complete(kept, 503)  # force-kept
+        om = reg.exposition(openmetrics=True)
+        assert kept.trace_id in om and dropped.trace_id not in om
+        # late note (device readback after completion): kept record
+        # attaches immediately, dropped record never
+        hist.observe(0.02)
+        with record_scope(kept):
+            defer_exemplar(hist, 0.8)  # unobserved series: no-op
+            defer_exemplar(hist, 0.02)
+        with record_scope(dropped):
+            defer_exemplar(hist, 0.02)
+        assert kept.trace_id in reg.exposition(openmetrics=True)
+
+    def test_dropped_record_leaves_no_exemplar(self):
+        """Review fix: a dropped record's trace id must not become a
+        bucket exemplar — the /debug ring could not answer the
+        pivot."""
+        recorder = FlightRecorder(head_sample_rate=0.0)
+        dropped = recorder.start("/tile/1")
+        with dropped.stage("read"):
+            pass
+        recorder.complete(dropped, 200)
+        kept = recorder.start("/tile/1")
+        kept.tag("degraded", 1)
+        with kept.stage("read"):
+            pass
+        recorder.complete(kept, 200)
+        om = REGISTRY.exposition(openmetrics=True)
+        assert dropped.trace_id not in om
+        assert kept.trace_id in om
+
+    async def test_504_kept_even_unsampled(self, tmp_path, loop):
+        app_obj = _make_app(
+            tmp_path,
+            obs_overrides={"head-sample-rate": 0.0},
+            extra={"resilience": {"request-budget-ms": 1}},
+        )
+        client = TestClient(TestServer(app_obj.make_app()), loop=loop)
+        await client.start_server()
+        try:
+            resp = await client.get(
+                "/tile/1/0/0/0?w=64&h=64", headers=AUTH
+            )
+            assert resp.status == 504
+            event = app_obj.recorder.events()[0]
+            assert event["outcome"] == "timeout"
+            assert event["kept_reason"] == "error"
+        finally:
+            await client.close()
+
+    async def test_door_shed_kept_even_unsampled(self, tmp_path, loop):
+        app_obj = _make_app(
+            tmp_path,
+            obs_overrides={"head-sample-rate": 0.0},
+            extra={
+                "slo": {"queue-size": 0},
+                "resilience": {"admission": {"max-inflight": 1}},
+            },
+        )
+        client = TestClient(TestServer(app_obj.make_app()), loop=loop)
+        await client.start_server()
+        try:
+            assert app_obj.admission.try_slot()  # saturate the gate
+            resp = await client.get(
+                "/tile/1/0/0/0?w=64&h=64", headers=AUTH
+            )
+            assert resp.status == 503
+            event = app_obj.recorder.events()[0]
+            assert event["outcome"] == "shed"
+            assert event["tags"]["shed_at"] == "door"
+            assert "door" in event["stages_ms"]
+        finally:
+            app_obj.admission.release()
+            await client.close()
+
+    async def test_stage_metrics_independent_of_tracing(self, served):
+        """Satellite: the KNOWN_GAPS closure — tracing is disabled in
+        this app (the default), yet stage histograms populate."""
+        client, app_obj = served
+        assert not app_obj.config.http_tracing_enabled
+        await client.get(
+            "/tile/1/0/0/0?x=64&y=64&w=64&h=64&format=png",
+            headers=AUTH,
+        )
+        text = (await (await client.get("/metrics")).text())
+        m = re.search(
+            r'request_stage_seconds_count\{stage="resolve"\} (\d+)',
+            text,
+        )
+        assert m and int(m.group(1)) > 0
+        assert "http_request_seconds" in text
+
+    async def test_exemplar_carries_ring_trace_id(self, served):
+        client, app_obj = served
+        await client.get(
+            "/tile/1/1/0/0?x=0&y=0&w=64&h=64&format=png", headers=AUTH
+        )
+        trace_ids = {e["trace_id"] for e in app_obj.recorder.events()}
+        resp = await client.get(
+            "/metrics",
+            headers={"Accept": "application/openmetrics-text"},
+        )
+        assert "openmetrics" in resp.headers["Content-Type"]
+        text = await resp.text()
+        _validate_openmetrics(text)
+        exemplar_tids = set(
+            re.findall(r'# \{trace_id="([0-9a-f]{32})"\}', text)
+        )
+        assert trace_ids & exemplar_tids
+
+    async def test_healthz_obs_and_burn_rates(self, served):
+        client, app_obj = served
+        await client.get("/tile/1/0/0/0?w=8&h=8", headers=AUTH)
+        body = await (await client.get("/healthz")).json()
+        assert body["obs"]["enabled"] is True
+        assert body["obs"]["kept"] >= 1
+        sli = body["obs"]["sli"]
+        assert sli["total"]["interactive"] >= 1
+        assert set(sli["burn_rates"]) == {"5m", "30m", "1h"}
+
+
+# ---------------------------------------------------------------------------
+# /debug/requests surface
+# ---------------------------------------------------------------------------
+
+
+class TestDebugSurface:
+    async def test_session_exempt_and_bounded(self, tmp_path, loop):
+        app_obj = _make_app(tmp_path, obs_overrides={"ring-size": 4})
+        client = TestClient(TestServer(app_obj.make_app()), loop=loop)
+        await client.start_server()
+        try:
+            for i in range(7):
+                await client.get(
+                    f"/tile/1/0/0/0?x={64 * (i % 2)}&y=0&w=64&h=64"
+                    f"&format=png&resolution={i % 2}",
+                    headers=AUTH,
+                )
+            # no cookie: the surface is session-exempt by design
+            resp = await client.get("/debug/requests")
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["count"] <= 4
+            assert body["ring_size"] == 4
+            assert body["kept"] >= 4
+            resp = await client.get("/debug/requests?limit=2")
+            assert (await resp.json())["count"] == 2
+            resp = await client.get("/debug/requests?limit=zebra")
+            assert resp.status == 400
+        finally:
+            await client.close()
+
+    async def test_detail_by_trace_id(self, served):
+        client, app_obj = served
+        await client.get("/tile/1/0/0/0?w=8&h=8", headers=AUTH)
+        tid = app_obj.recorder.events()[0]["trace_id"]
+        body = await (
+            await client.get(f"/debug/requests/{tid}")
+        ).json()
+        assert body["trace_id"] == tid
+        assert body["events"][0]["trace_id"] == tid
+        resp = await client.get("/debug/requests/" + "0" * 32)
+        assert resp.status == 404
+
+    async def test_disabled_obs_unmounts_surface(self, tmp_path, loop):
+        app_obj = _make_app(
+            tmp_path, obs_overrides={"enabled": False}
+        )
+        assert app_obj.recorder is None
+        client = TestClient(TestServer(app_obj.make_app()), loop=loop)
+        await client.start_server()
+        try:
+            resp = await client.get(
+                "/tile/1/0/0/0?w=8&h=8", headers=AUTH
+            )
+            assert resp.status == 200  # serving unaffected
+            # unmounted: no GET route (405 comes from the OPTIONS
+            # discovery catch-all claiming the path for OPTIONS only)
+            resp = await client.get("/debug/requests")
+            assert resp.status in (404, 405)
+        finally:
+            await client.close()
+
+
+# ---------------------------------------------------------------------------
+# cross-replica trace continuity (loopback two-replica cluster)
+# ---------------------------------------------------------------------------
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def _make_cluster(tmp_path):
+    img = str(tmp_path / "img.ome.tiff")
+    write_ome_tiff(img, IMG, tile_size=(64, 64), pyramid_levels=2)
+    ports = [_free_port() for _ in range(2)]
+    members = [f"http://127.0.0.1:{p}" for p in ports]
+    apps = []
+    for i, port in enumerate(ports):
+        registry = ImageRegistry()
+        registry.add(1, img)
+        config = Config.from_dict({
+            "session-store": {"type": "memory"},
+            "backend": {"batching": {"coalesce-window-ms": 1.0}},
+            "cache": {"prefetch": {"enabled": False}},
+            "obs": {"head-sample-rate": 1.0},
+            "cluster": {
+                "members": members,
+                "self": members[i],
+                "peer-timeout-ms": 2000,
+            },
+        })
+        app_obj = PixelBufferApp(
+            config,
+            pixels_service=PixelsService(registry),
+            session_store=MemorySessionStore({"cookie-1": "omero-key-1"}),
+        )
+        runner = web.AppRunner(app_obj.make_app())
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        await site.start()
+        apps.append((app_obj, runner, members[i]))
+
+    async def cleanup():
+        for _, runner, _ in apps:
+            await runner.cleanup()
+
+    return apps, cleanup
+
+
+class TestPeerTraceContinuity:
+    async def test_one_trace_spans_requester_and_owner(self, tmp_path):
+        """The tentpole's cluster half: a peer-served tile produces
+        ONE trace id, kept in BOTH replicas' rings — the requester's
+        event carries the peer stage + owner tag, the owner's event
+        carries the peer origin."""
+        apps, cleanup = await _make_cluster(tmp_path)
+        try:
+            import aiohttp
+
+            from omero_ms_pixel_buffer_tpu.tile_ctx import TileCtx
+
+            requester, _, requester_url = apps[0]
+            owner_app, _, owner_url = apps[1]
+            # pick a tile whose cache key the RING assigns to replica
+            # B — deterministic (ring placement depends on the random
+            # loopback ports, so probing a fixed few tiles can flake)
+            quality = requester.pipeline.encode_signature()
+            target = None
+            for z in (0, 1):
+                for c in (0, 1):
+                    for x in (0, 64):
+                        for y in (0, 64):
+                            params = {
+                                "imageId": "1", "z": str(z),
+                                "c": str(c), "t": "0", "x": str(x),
+                                "y": str(y), "w": "64", "h": "64",
+                                "format": "png",
+                            }
+                            key = TileCtx.from_params(
+                                params, None
+                            ).cache_key(quality)
+                            if requester.cache_plane.ring.owner(
+                                key
+                            ) == owner_url:
+                                target = (
+                                    f"/tile/1/{z}/{c}/0?x={x}&y={y}"
+                                    "&w=64&h=64&format=png"
+                                )
+                                break
+                        if target:
+                            break
+                    if target:
+                        break
+                if target:
+                    break
+            assert target, "ring assigned no probe key to replica B"
+            async with aiohttp.ClientSession(
+                cookies={"sessionid": "cookie-1"}
+            ) as session:
+                async with session.get(
+                    requester_url + target
+                ) as resp:
+                    assert resp.status == 200
+                    assert resp.headers.get("X-Cache") == "peer-hit"
+            req_event = next(
+                e for e in requester.recorder.events()
+                if e["tags"].get("cache") == "peer-hit"
+            )
+            assert "peer" in req_event["stages_ms"]
+            assert req_event["tags"]["peer_owner"] == owner_url
+            tid = req_event["trace_id"]
+            owner_events = owner_app.recorder.events(trace_id=tid)
+            assert owner_events, (
+                "owner kept no event for the forwarded trace"
+            )
+            assert owner_events[0]["peer_origin"] == requester_url
+            assert owner_events[0]["parent_span_id"] == (
+                req_event["span_id"]
+            )
+        finally:
+            await cleanup()
+
+
+# ---------------------------------------------------------------------------
+# Zipkin reporter resilience (satellite) + dead-sink chaos lane
+# ---------------------------------------------------------------------------
+
+
+class TestZipkinReporter:
+    def _span(self):
+        tracer_span = TRACER.start_span  # noqa: F841 - doc anchor
+        from omero_ms_pixel_buffer_tpu.obs.recorder import _RetroSpan
+
+        return _RetroSpan(
+            "a" * 32, "b" * 16, None, "t", time.time(), 0.01, {}
+        )
+
+    @pytest.mark.resilience
+    def test_dead_sink_drops_and_breaks(self):
+        from omero_ms_pixel_buffer_tpu.resilience.breaker import BOARD
+
+        dead = f"http://127.0.0.1:{_free_port()}/api/v2/spans"
+        reporter = ZipkinReporter(
+            dead, "svc", flush_interval_s=0.01, post_timeout_s=0.2
+        )
+        try:
+            before = reporter.dropped
+            # enough batches to trip the consecutive-failure rule
+            for _ in range(6):
+                reporter._post([{"traceId": "x"}])
+            assert reporter.dropped >= before + 6
+            assert reporter._breaker.state == "open"
+            assert "tracing:zipkin" in BOARD.snapshot()
+            # with the breaker open, a batch drops WITHOUT a connect
+            t0 = time.monotonic()
+            reporter._post([{"traceId": "y"}])
+            assert time.monotonic() - t0 < 0.05
+        finally:
+            reporter.close()
+            # the breaker is process-wide (BOARD): heal it so later
+            # reporter tests (test_zipkin) post instead of dropping
+            reporter._breaker.reset()
+
+    @pytest.mark.resilience
+    def test_bounded_queue_counts_drops(self):
+        dead = f"http://127.0.0.1:{_free_port()}/api/v2/spans"
+        reporter = ZipkinReporter(
+            dead, "svc", flush_interval_s=60.0, max_queue=4,
+            post_timeout_s=0.2,
+        )
+        try:
+            for _ in range(50):
+                reporter.report(self._span())
+            assert reporter.dropped >= 40
+        finally:
+            reporter.close()
+            reporter._breaker.reset()
+
+    @pytest.mark.resilience
+    async def test_dead_zipkin_never_blocks_serving(self, tmp_path, loop):
+        """Chaos lane: a dead Zipkin endpoint (tail reporter mode) —
+        requests keep serving, fast, and the reporter just drops."""
+        dead = f"http://127.0.0.1:{_free_port()}/api/v2/spans"
+        app_obj = _make_app(
+            tmp_path,
+            extra={"http-tracing": {
+                "enabled": False, "zipkin-url": dead,
+            }},
+        )
+        assert TRACER.reporter is not None  # tail mode built it
+        assert not TRACER.enabled
+        client = TestClient(TestServer(app_obj.make_app()), loop=loop)
+        await client.start_server()
+        try:
+            t0 = time.monotonic()
+            for _ in range(3):
+                resp = await client.get(
+                    "/tile/1/0/0/0?w=64&h=64&format=png", headers=AUTH
+                )
+                assert resp.status == 200
+            assert time.monotonic() - t0 < 5.0
+            assert app_obj.recorder.events()  # kept + ring intact
+        finally:
+            reporter = TRACER.reporter
+            await client.close()
+            configure_tracing(enabled=False, log_spans=False)
+            if reporter is not None:
+                reporter._breaker.reset()  # process-wide breaker
+
+    def test_retro_spans_reach_reporter_only_when_tracing_off(self):
+        class FakeReporter:
+            def __init__(self):
+                self.spans = []
+
+            def report(self, span):
+                self.spans.append(span)
+
+        recorder = FlightRecorder(head_sample_rate=1.0)
+        fake = FakeReporter()
+        old_rep, old_en = TRACER.reporter, TRACER.enabled
+        TRACER.reporter, TRACER.enabled = fake, False
+        try:
+            rec = recorder.start("/tile/1")
+            with rec.stage("read"):
+                pass
+            recorder.complete(rec, 200)
+            names = [s.name for s in fake.spans]
+            assert "http:/tile/1" in names
+            assert "stage:read" in names
+            root = fake.spans[0]
+            assert root.trace_id == rec.trace_id
+            # live tracing on: the recorder must NOT double-report
+            fake.spans.clear()
+            TRACER.enabled = True
+            rec2 = recorder.start("/tile/1")
+            recorder.complete(rec2, 200)
+            assert fake.spans == []
+        finally:
+            TRACER.reporter, TRACER.enabled = old_rep, old_en
+
+
+# ---------------------------------------------------------------------------
+# config validation
+# ---------------------------------------------------------------------------
+
+
+class TestObsConfig:
+    BASE = {"session-store": {"type": "memory"}}
+
+    def test_defaults(self):
+        config = Config.from_dict(self.BASE)
+        assert config.obs.enabled is True
+        assert config.obs.slow_threshold_ms == 300.0
+        assert config.obs.head_sample_rate == 0.01
+        assert config.obs.ring_size == 512
+
+    def test_values_parse(self):
+        config = Config.from_dict({
+            **self.BASE,
+            "obs": {
+                "enabled": False, "slow-threshold-ms": 150,
+                "head-sample-rate": 0.5, "ring-size": 32,
+            },
+        })
+        assert config.obs.enabled is False
+        assert config.obs.slow_threshold_ms == 150.0
+        assert config.obs.head_sample_rate == 0.5
+        assert config.obs.ring_size == 32
+
+    def test_unknown_key_fails(self):
+        with pytest.raises(ConfigError):
+            Config.from_dict(
+                {**self.BASE, "obs": {"slow-treshold-ms": 100}}
+            )
+
+    def test_bad_values_fail(self):
+        for block in (
+            {"head-sample-rate": 1.5},
+            {"head-sample-rate": "lots"},
+            {"ring-size": 0},
+            {"slow-threshold-ms": -1},
+        ):
+            with pytest.raises(ConfigError):
+                Config.from_dict({**self.BASE, "obs": block})

@@ -353,6 +353,10 @@ _RESILIENCE_SCOPE = (
     # to), but the scope pin means any future remote call added here
     # must arrive wrapped like every other edge
     "omero_ms_pixel_buffer_tpu/http/protocols/",
+    # the Zipkin span reporter (r16): a network client that escaped
+    # the rule for five rounds — its batch POST must carry the same
+    # breaker gate + fault point + per-call timeout as every edge
+    "omero_ms_pixel_buffer_tpu/utils/tracing.py",
 )
 
 _NET_PRIMITIVES: List[Tuple[Optional[str], str, str]] = [
