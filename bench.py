@@ -598,6 +598,315 @@ def bench_cache_plane(path: str, cache_dir: str) -> dict:
     return out
 
 
+def bench_cluster(cache_dir: str) -> dict:
+    """Cluster coordination plane (r17) section — three measurements,
+    two hard pins:
+
+    - ``failover``: a three-replica cluster (leases + replication
+      factor 2) serves a hot set twice, the owner of part of it is
+      KILLED and the shared L2 flushed (so only pushed replicas can
+      answer); the ring rebuild maps each orphaned key to exactly the
+      successor holding its replica. Pin ``cluster_ok_failover_hits``:
+      >= 0.8 post-crash hit rate on the replicated hot set (the
+      replication-factor-1 control records the ~0 baseline).
+    - ``join``: a cold replica joins a warm cluster; seconds until its
+      local cache holds >= 90% of the hot set via the one-round
+      warm-up transfer (pinned <= 5 s — one transfer round, not an
+      organic re-render).
+    - ``hedge``: cold misses against a wedged owner, hedged vs
+      unhedged p99. Pin ``cluster_ok_hedge_p99``: hedging must cut
+      the wedged-owner p99 to < 70% of the unhedged tail.
+    """
+    import socket
+
+    from aiohttp import ClientSession, web
+
+    from omero_ms_pixel_buffer_tpu.auth.stores import MemorySessionStore
+    from omero_ms_pixel_buffer_tpu.cache.plane.resp_stub import (
+        InMemoryRespServer,
+    )
+    from omero_ms_pixel_buffer_tpu.http.server import PixelBufferApp
+    from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
+    from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+        ImageRegistry,
+        PixelsService,
+    )
+    from omero_ms_pixel_buffer_tpu.tile_ctx import TileCtx
+    from omero_ms_pixel_buffer_tpu.utils.config import Config
+
+    out: dict = {}
+    headers = {"Cookie": "sessionid=bench-cookie"}
+    img_path = os.path.join(cache_dir, "cluster_fixture.ome.tiff")
+    if not os.path.exists(img_path):
+        rng_local = np.random.default_rng(23)
+        img = rng_local.integers(
+            0, 60000, (1, 1, 1, 512, 512), dtype=np.uint16
+        )
+        write_ome_tiff(
+            img_path, img, tile_size=(64, 64), pyramid_levels=2
+        )
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def tile_paths(n):
+        return [
+            f"/tile/1/0/0/0?x={64 * (i % 8)}&y={64 * (i // 8)}"
+            "&w=64&h=64&format=png"
+            for i in range(n)
+        ]
+
+    async def boot(members, self_url, port, resp_uri, extra):
+        registry = ImageRegistry()
+        registry.add(1, img_path)
+        cluster_block = {
+            "members": members, "self": self_url,
+            "peer-timeout-ms": 3000, **(extra or {}),
+        }
+        if resp_uri:
+            cluster_block["l2"] = {"uri": resp_uri}
+        config = Config.from_dict({
+            "session-store": {"type": "memory"},
+            "backend": {"batching": {"coalesce-window-ms": 1.0}},
+            "cache": {"prefetch": {"enabled": False}},
+            "cluster": cluster_block,
+        })
+        app_obj = PixelBufferApp(
+            config,
+            pixels_service=PixelsService(registry),
+            session_store=MemorySessionStore(
+                {"bench-cookie": "bench-key"}
+            ),
+        )
+        runner = web.AppRunner(app_obj.make_app(), access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        await site.start()
+        return app_obj, runner
+
+    def key_for(app_obj, path):
+        query = dict(
+            kv.split("=") for kv in path.split("?", 1)[1].split("&")
+        )
+        _, _, image_id, z, c, t = path.split("?", 1)[0].split("/")
+        ctx = TileCtx.from_params(
+            {"imageId": image_id, "z": z, "c": c, "t": t, **query},
+            None,
+        )
+        return ctx.cache_key(app_obj.pipeline.encode_signature())
+
+    n_hot = 24
+
+    async def failover(replication_factor: int) -> dict:
+        resp = InMemoryRespServer()
+        await resp.start()
+        ports = [free_port() for _ in range(3)]
+        members = [f"http://127.0.0.1:{p}" for p in ports]
+        nodes = []
+        for i, port in enumerate(ports):
+            nodes.append(await boot(
+                members, members[i], port, resp.uri,
+                {"lease-ttl-s": 0.5,
+                 "replication-factor": replication_factor},
+            ))
+        try:
+            await asyncio.sleep(0.4)  # leases discovered
+            paths = tile_paths(n_hot)
+            async with ClientSession() as http:
+                for path in paths:
+                    key = key_for(nodes[0][0], path)
+                    owner_url = nodes[0][0].cache_plane.ring.owner(key)
+                    owner = next(
+                        a for a, _r in nodes
+                        if a.cache_plane.self_url == owner_url
+                    )
+                    base = owner.cache_plane.self_url
+                    for _ in range(2):  # second touch crosses hot bar
+                        async with http.get(
+                            base + path, headers=headers
+                        ) as r:
+                            assert r.status == 200, await r.text()
+                await asyncio.sleep(0.6)  # pushes drain
+                victim_app, victim_runner = nodes[0]
+                victim_url = victim_app.cache_plane.self_url
+                survivors = nodes[1:]
+                victim_paths = [
+                    p for p in paths
+                    if survivors[0][0].cache_plane.ring.owner(
+                        key_for(survivors[0][0], p)
+                    ) == victim_url
+                ]
+                await victim_runner.cleanup()
+                for key in [
+                    k for k in resp.data
+                    if k.startswith(b"ompb:tile:")
+                ]:
+                    del resp.data[key]  # L2 cold: replicas or nothing
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline:
+                    if all(
+                        len(a.cache_plane.membership.members) == 2
+                        for a, _r in survivors
+                    ):
+                        break
+                    await asyncio.sleep(0.1)
+                hits = 0
+                for path in victim_paths:
+                    key = key_for(survivors[0][0], path)
+                    new_owner_url = (
+                        survivors[0][0].cache_plane.ring.owner(key)
+                    )
+                    new_owner = next(
+                        a for a, _r in survivors
+                        if a.cache_plane.self_url == new_owner_url
+                    )
+                    async with http.get(
+                        new_owner.cache_plane.self_url + path,
+                        headers=headers,
+                    ) as r:
+                        assert r.status == 200
+                        if r.headers.get("X-Cache") == "hit":
+                            hits += 1
+            return {
+                "orphaned_keys": len(victim_paths),
+                "post_crash_hits": hits,
+                "hit_rate": round(
+                    hits / max(1, len(victim_paths)), 3
+                ),
+            }
+        finally:
+            for _a, runner in nodes[1:]:
+                await runner.cleanup()
+            await resp.close()
+
+    out["failover"] = {
+        "replicated": asyncio.run(failover(2)),
+        "unreplicated": asyncio.run(failover(1)),
+    }
+
+    async def join_warm() -> dict:
+        resp = InMemoryRespServer()
+        await resp.start()
+        ports = [free_port() for _ in range(2)]
+        members = [f"http://127.0.0.1:{p}" for p in ports]
+        nodes = []
+        for i, port in enumerate(ports):
+            nodes.append(await boot(
+                members, members[i], port, resp.uri,
+                {"lease-ttl-s": 0.5, "replication-factor": 2},
+            ))
+        joiner = None
+        try:
+            await asyncio.sleep(0.4)
+            paths = tile_paths(n_hot)
+            async with ClientSession() as http:
+                for i, path in enumerate(paths):
+                    base = nodes[i % 2][0].cache_plane.self_url
+                    async with http.get(
+                        base + path, headers=headers
+                    ) as r:
+                        assert r.status == 200
+            port = free_port()
+            t0 = time.monotonic()
+            joiner = await boot(
+                [f"http://127.0.0.1:{port}"],
+                f"http://127.0.0.1:{port}", port, resp.uri,
+                {"lease-ttl-s": 0.5, "replication-factor": 2},
+            )
+            target = int(0.9 * n_hot)
+            warm_s = None
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if len(joiner[0].result_cache.memory) >= target:
+                    warm_s = time.monotonic() - t0
+                    break
+                await asyncio.sleep(0.05)
+            return {
+                "hot_set": n_hot,
+                "target_entries": target,
+                "warm_entries": len(joiner[0].result_cache.memory),
+                "join_to_90pct_warm_s": (
+                    round(warm_s, 3) if warm_s is not None else None
+                ),
+            }
+        finally:
+            if joiner is not None:
+                await joiner[1].cleanup()
+            for _a, runner in nodes:
+                await runner.cleanup()
+            await resp.close()
+
+    out["join"] = asyncio.run(join_warm())
+
+    async def hedge_run(enabled: bool) -> dict:
+        ports = [free_port() for _ in range(2)]
+        members = [f"http://127.0.0.1:{p}" for p in ports]
+        extra = {"hedge": {
+            "enabled": enabled, "min-ms": 10, "max-ms": 40,
+            "fallback-ms": 20,
+        }}
+        nodes = [
+            await boot(members, members[i], ports[i], None, extra)
+            for i in range(2)
+        ]
+        try:
+            a_app = nodes[0][0]
+            paths = [
+                p for p in tile_paths(64)
+                if a_app.cache_plane.ring.owner(key_for(a_app, p))
+                == members[0]
+            ][:24]
+            # wedge the owner: every render pays 150 ms
+            wedged = nodes[0][0]
+            inner_h = wedged.pipeline.handle
+            inner_b = wedged.pipeline.handle_batch
+            wedged.pipeline.handle = lambda c: (
+                time.sleep(0.15), inner_h(c)
+            )[1]
+            wedged.pipeline.handle_batch = lambda cs: (
+                time.sleep(0.15), inner_b(cs)
+            )[1]
+            lat = []
+            async with ClientSession() as http:
+                for path in paths:
+                    t0 = time.perf_counter()
+                    async with http.get(
+                        members[1] + path, headers=headers
+                    ) as r:
+                        assert r.status == 200
+                    lat.append(time.perf_counter() - t0)
+            ms = np.array(lat) * 1000.0
+            return {
+                "requests": len(lat),
+                "p50_ms": round(float(np.percentile(ms, 50)), 1),
+                "p99_ms": round(float(np.percentile(ms, 99)), 1),
+            }
+        finally:
+            for _a, runner in nodes:
+                await runner.cleanup()
+
+    # unhedged FIRST: its peer-stage observations are what the hedge
+    # policy's p99 then clamps against, mirroring production order
+    unhedged = asyncio.run(hedge_run(False))
+    hedged = asyncio.run(hedge_run(True))
+    out["hedge"] = {"unhedged": unhedged, "hedged": hedged}
+
+    rep_rate = out["failover"]["replicated"]["hit_rate"]
+    out["cluster_ok_failover_hits"] = rep_rate >= 0.8
+    join_s = out["join"]["join_to_90pct_warm_s"]
+    out["cluster_ok_join_warm"] = (
+        join_s is not None and join_s <= 5.0
+    )
+    out["cluster_ok_hedge_p99"] = (
+        hedged["p99_ms"] < unhedged["p99_ms"] * 0.7
+    )
+    return out
+
+
 def bench_overload(
     cache_dir: str,
     duration_s: float = 4.0,
@@ -1632,6 +1941,18 @@ def main():
             overload_stats = {"error": f"{type(e).__name__}: {e}"}
             log(f"overload bench failed: {e!r}")
 
+    # --- cluster coordination plane (r17): owner-kill failover on the
+    # replicated hot set, join-time warm-up, hedged vs unhedged peer
+    # p99 (cluster_ok_* pins)
+    cluster_stats: dict = {}
+    if os.environ.get("BENCH_CLUSTER", "1") != "0":
+        try:
+            cluster_stats = bench_cluster(cache_dir)
+            log(f"cluster: {cluster_stats}")
+        except Exception as e:
+            cluster_stats = {"error": f"{type(e).__name__}: {e}"}
+            log(f"cluster bench failed: {e!r}")
+
     # --- batched read plane (r14): cold remote reads over a loopback
     # HTTP object store — sequential vs parallel+coalesced, sharded
     # byte identity, requests-per-tile (io_ok_* pins)
@@ -1705,6 +2026,8 @@ def main():
         record["cache"] = cache_stats
     if plane_stats:
         record["cache_plane"] = plane_stats
+    if cluster_stats:
+        record["cluster"] = cluster_stats
     if overload_stats:
         record["overload"] = overload_stats
     if io_stats:
@@ -1773,6 +2096,22 @@ def main():
     if obs_stats and "warm_p50_penalty" in obs_stats:
         comparison["obs_warm_p50_penalty"] = (
             obs_stats["warm_p50_penalty"]
+        )
+    if cluster_stats and "failover" in cluster_stats:
+        comparison["cluster_failover_hit_rate"] = (
+            cluster_stats["failover"]["replicated"]["hit_rate"]
+        )
+        comparison["cluster_failover_hit_rate_unreplicated"] = (
+            cluster_stats["failover"]["unreplicated"]["hit_rate"]
+        )
+        comparison["cluster_join_warm_s"] = (
+            cluster_stats["join"]["join_to_90pct_warm_s"]
+        )
+        comparison["cluster_hedged_peer_p99_ms"] = (
+            cluster_stats["hedge"]["hedged"]["p99_ms"]
+        )
+        comparison["cluster_unhedged_peer_p99_ms"] = (
+            cluster_stats["hedge"]["unhedged"]["p99_ms"]
         )
     record["engine_comparison"] = comparison
     print(json.dumps(record))

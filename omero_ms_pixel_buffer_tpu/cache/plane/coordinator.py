@@ -5,27 +5,49 @@ the serving path (http/server._serve):
 
     RAM -> disk -> [plane: L2 -> peer(owner)] -> render
 
-and owns the outbound half of cluster invalidation (best-effort L2
+and owns the outbound half of cluster invalidation (epoch bump + L2
 DELs + peer purge fan-out). Construction is pure wiring from the
 validated ``cluster:`` config block; either half is optional — L2
 alone shares results through Redis, the ring alone gives render-once
 ownership without any external service.
 
+Since r17 the plane also hosts the cluster coordination loop
+(cluster/): lease-backed dynamic membership rebuilding the ring live,
+epoch stamps that make invalidation win every race, next-owner
+replication of the hot set with a join-time warm-up transfer,
+owner-side hedging off the observed peer p99, and the fleet brain
+exchange. All of it degrades: a dead Redis freezes the membership
+view, a dead peer skips its round, and the serving path never sees an
+exception.
+
 The whole object inherits the cache contract: no operation here may
-fail a request. ``fetch`` returns ``(None, None)`` on every failure
-path; ``publish`` and ``invalidate_image`` are fire-and-forget.
+fail a request. ``fetch`` returns misses on every failure path;
+``publish`` and ``invalidate_image`` are fire-and-forget.
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Optional, Tuple
 
+from ...cluster import (
+    EpochRegistry,
+    FleetBrains,
+    HedgePolicy,
+    HotSetReplicator,
+    MembershipManager,
+    RedisLink,
+    decode_transfer,
+    encode_transfer,
+    image_id_of,
+)
+from ...cluster.replicate import REPLICATION
 from ...obs.recorder import ambient_stage, current_record
 from ...utils.metrics import REGISTRY
 from ..result_cache import CachedTile
-from .l2 import RedisL2Tier
+from .l2 import RedisL2Tier, encode_entry
 from .peer import PEER_HEADER, PeerClient, filename_from_disposition
 from .ring import HashRing
 
@@ -34,6 +56,10 @@ log = logging.getLogger("omero_ms_pixel_buffer_tpu.cache.plane")
 PLANE_PURGES = REGISTRY.counter(
     "tile_cache_plane_purges_total",
     "Cluster invalidation fan-outs by target and outcome",
+)
+RING_VERSION = REGISTRY.gauge(
+    "cluster_ring_version",
+    "Monotonic ownership-ring rebuild count on this replica",
 )
 
 
@@ -46,29 +72,90 @@ class CachePlane:
         peer_timeout_s: float = 0.5,
         l2_uri: Optional[str] = None,
         l2_ttl_s: float = 3600.0,
+        lease_ttl_s: float = 0.0,
+        replication_factor: int = 1,
+        transfer_max_entries: int = 128,
+        hedge: Optional[HedgePolicy] = None,
+        secret: Optional[str] = None,
+        result_cache=None,
+        scheduler=None,
+        admission=None,
     ):
         self.self_url = self_url
-        self.l2 = RedisL2Tier(l2_uri, ttl_s=l2_ttl_s) if l2_uri else None
+        self.secret = secret
+        self.result_cache = result_cache
+        # the coordination link: the cluster's OWN connection to the
+        # shared Redis (lease scans must not head-of-line-block a
+        # serving-path L2 get) — built whenever the shared Redis
+        # exists, since epoch bumps want it even with static
+        # membership
+        self.link: Optional[RedisLink] = None
+        self.epochs: Optional[EpochRegistry] = None
+        if l2_uri:
+            self.link = RedisLink(l2_uri)
+            self.epochs = EpochRegistry(self.link)
+        self.l2 = (
+            RedisL2Tier(l2_uri, ttl_s=l2_ttl_s, epochs=self.epochs)
+            if l2_uri else None
+        )
         self.ring: Optional[HashRing] = None
         self.peers: Optional[PeerClient] = None
+        self.virtual_nodes = virtual_nodes
+        self.ring_version = 0
+        if self_url:
+            # the client exists whenever this replica has an identity
+            # — with dynamic membership the ring can appear AFTER
+            # construction (a peer's lease shows up in a scan), and
+            # every peer path must already have its client then
+            self.peers = PeerClient(
+                self_url, timeout_s=peer_timeout_s, secret=secret
+            )
         if members and self_url:
             self.ring = HashRing(members, virtual_nodes)
-            self.peers = PeerClient(self_url, timeout_s=peer_timeout_s)
+        self.membership: Optional[MembershipManager] = None
+        self.brains: Optional[FleetBrains] = None
+        if lease_ttl_s > 0 and self.link is not None and self_url:
+            self.membership = MembershipManager(
+                self.link, self_url, members or (self_url,),
+                lease_ttl_s, on_change=self._on_membership_change,
+            )
+            self.brains = FleetBrains(
+                self.link, self_url,
+                scheduler=scheduler, admission=admission,
+            )
+        self.replicator: Optional[HotSetReplicator] = None
+        if replication_factor > 1 and self.peers is not None:
+            self.replicator = HotSetReplicator(
+                self_url,
+                replication_factor=replication_factor,
+                transfer_max_entries=transfer_max_entries,
+            )
+        # gated on the CLIENT, not the ring: with dynamic membership
+        # the ring may only materialize after the first lease scan
+        self.hedge = hedge if (
+            hedge is not None and self.peers is not None
+        ) else None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._tasks: set = set()
+        self._warmed_up = False
 
     # -- lifecycle -----------------------------------------------------
 
     def start(self, loop: asyncio.AbstractEventLoop) -> None:
         """Capture the serving loop (invalidation listeners fire from
-        resolver threads and need somewhere to schedule the fan-out)."""
+        resolver threads and need somewhere to schedule the fan-out)
+        and start the coordination loop when membership is dynamic."""
         self._loop = loop
+        if self.membership is not None:
+            self._spawn(self._coord_loop())
 
     async def close(self) -> None:
         for task in list(self._tasks):
             task.cancel()
         if self.l2 is not None:
             await self.l2.close()
+        if self.link is not None:
+            await self.link.close()
 
     def _spawn(self, coro) -> None:
         """Fire-and-forget on the serving loop, exceptions consumed
@@ -84,6 +171,105 @@ class CachePlane:
 
         task.add_done_callback(_done)
 
+    # -- cluster coordination loop -------------------------------------
+
+    async def _coord_loop(self) -> None:
+        """The heartbeat: lease refresh + membership scan, brain
+        publish/collect, and — once, after the first successful
+        refresh — the join-time warm-up pull. One loop, one cadence;
+        each round degrades independently."""
+        membership = self.membership
+        first = True
+        while True:
+            ok = await membership.refresh_once()
+            if self.brains is not None:
+                await self.brains.publish_once(membership.interval_s)
+                await self.brains.collect_once(membership.members)
+            if first and ok:
+                first = False
+                # spawned, not awaited: warm-up pulls each peer under
+                # the full peer timeout — inline it would delay the
+                # NEXT lease refresh past the TTL on a slow fleet and
+                # flap the fresh joiner off every ring
+                self._spawn(self._warm_up_once())
+            await asyncio.sleep(membership.interval_s)
+
+    def _on_membership_change(self, added, removed, members) -> None:
+        """Rebuild the ownership ring from the new lease view. The
+        swap is a single reference assignment (readers mid-request
+        keep the ring they started with — bounded-disagreement
+        semantics cover the window)."""
+        try:
+            self.ring = HashRing(members, self.virtual_nodes)
+        except ValueError:
+            return  # empty view: keep the last ring
+        self.ring_version += 1
+        RING_VERSION.set(self.ring_version)
+        if self.replicator is not None:
+            # new ring, new successors: let hot keys re-replicate
+            self.replicator.ring_changed()
+        log.info(
+            "ownership ring rebuilt (v%d): %d members",
+            self.ring_version, len(members),
+        )
+
+    async def _warm_up_once(self) -> None:
+        """Join-time warm-up: a COLD replica (no manifest-warmed disk,
+        empty RAM) pulls each live peer's hottest entries once so it
+        serves warm within one transfer round. Any failure leaves it
+        exactly as cold as it already was."""
+        if (
+            self.replicator is None
+            or self.peers is None
+            or self.result_cache is None
+            or self._warmed_up
+        ):
+            return
+        cache = self.result_cache
+        try:
+            cold = len(cache.memory) == 0 and (
+                cache.disk is None or len(cache.disk) == 0
+            )
+        except Exception:
+            cold = False
+        if not cold:
+            return
+        self._warmed_up = True
+        members = (
+            self.membership.members if self.membership is not None
+            else (self.ring.members if self.ring is not None else ())
+        )
+        pulled = 0
+        for member in members:
+            if member == self.self_url:
+                continue
+            body = await self.peers.pull_transfer(
+                member, self.replicator.transfer_max_entries
+            )
+            if body is None:
+                continue
+            pulled += await self._absorb_transfer(body)
+        if pulled:
+            self.replicator.transfers_pulled += 1
+            log.info("join warm-up: absorbed %d hot entries", pulled)
+
+    async def _absorb_transfer(self, body: bytes) -> int:
+        from .l2 import decode_entry_epoch
+
+        cache = self.result_cache
+        stored = 0
+        for key, frame in decode_transfer(body):
+            entry, epoch = decode_entry_epoch(frame)
+            if entry is None:
+                continue
+            if self.epochs is not None and self.epochs.is_stale(
+                key, epoch
+            ):
+                continue
+            await cache.put(key, entry, generation=cache.generation())
+            stored += 1
+        return stored
+
     # -- serving path --------------------------------------------------
 
     async def fetch(
@@ -92,7 +278,10 @@ class CachePlane:
         path_qs: str,
         session_cookie: Optional[str],
         peer_originated: bool,
-    ) -> Tuple[Optional[CachedTile], Optional[str]]:
+    ) -> Tuple[
+        Optional[CachedTile], Optional[str], Optional[int],
+        Optional[asyncio.Task],
+    ]:
         """The between-miss-and-render consult: L2 first (cheapest
         shared copy), then one bounded GET to the key's owner — unless
         this request already IS a peer hop (the ``X-OMPB-Peer`` loop
@@ -100,14 +289,27 @@ class CachePlane:
         L2 microseconds ago, so re-checking here would spend a wasted
         Redis round trip inside the requester's peer-timeout window)
         or this replica owns the key (owners render; that's what
-        ownership means)."""
+        ownership means).
+
+        Returns ``(entry, provenance, epoch, pending_peer)``:
+
+        - ``epoch`` is the image epoch observed in the SAME round trip
+          as the L2 consult — the stamp the caller's eventual fill
+          must carry (captured before the render, so a purge landing
+          mid-flight outruns the fill by construction);
+        - ``pending_peer`` is a still-running peer fetch task when the
+          hedge policy fired (the owner ran past the observed p99):
+          the caller races its local render against it and serves
+          whichever finishes first. The caller OWNS the task —
+          consume or cancel it."""
         if peer_originated:
-            return None, None
+            return None, None, None, None
+        epoch = None
         if self.l2 is not None:
             with ambient_stage("l2"):
-                entry = await self.l2.get(key)
+                entry, epoch = await self.l2.get_with_epoch(key)
             if entry is not None:
-                return entry, "l2-hit"
+                return entry, "l2-hit", epoch, None
         if self.ring is not None:
             owner = self.ring.owner(key)
             if owner != self.self_url:
@@ -123,40 +325,180 @@ class CachePlane:
                         "span_id": rec.span_id,
                     }
                     rec.tag("peer_owner", owner)
-                with ambient_stage("peer"):
-                    result = await self.peers.fetch(
-                        owner, path_qs, session_cookie,
-                        trace_context=trace_context,
+                    if self.ring_version:
+                        rec.tag("ring_version", self.ring_version)
+                delay = (
+                    self.hedge.delay_s()
+                    if self.hedge is not None else None
+                )
+                if delay is None:
+                    with ambient_stage("peer"):
+                        result = await self.peers.fetch(
+                            owner, path_qs, session_cookie,
+                            trace_context=trace_context,
+                            epoch_hint=epoch,
+                        )
+                else:
+                    task = asyncio.get_running_loop().create_task(
+                        self._staged_peer_fetch(
+                            owner, path_qs, session_cookie,
+                            trace_context, epoch,
+                        )
                     )
-                if result is not None and result[0] == 200:
-                    status, headers, body = result
-                    entry = CachedTile(
-                        body,
-                        etag=headers.get("etag"),
-                        filename=filename_from_disposition(
-                            headers.get("content-disposition", "")
-                        ),
+                    done, pending = await asyncio.wait(
+                        {task}, timeout=delay
                     )
-                    return entry, "peer-hit"
-        return None, None
+                    if pending:
+                        # the owner ran past the observed p99: hand
+                        # the still-bounded fetch back so the caller
+                        # starts the local render NOW
+                        self.hedge.note("fired")
+                        if rec is not None:
+                            rec.tag("hedge", "fired")
+                        return None, None, epoch, task
+                    result = task.result()  # ompb-lint: disable=loop-block -- asyncio.Task already in asyncio.wait's done set: result() returns immediately, never blocks
+                entry = self.entry_from_peer_result(result)
+                if entry is not None:
+                    return entry, "peer-hit", epoch, None
+        return None, None, epoch, None
 
-    def publish(self, key: str, entry: CachedTile) -> None:
+    async def _staged_peer_fetch(
+        self, owner, path_qs, session_cookie, trace_context, epoch
+    ):
+        """The hedged peer fetch, stamped MANUALLY instead of via
+        ``ambient_stage``: a context manager would stamp on
+        CancelledError too, and a hedge-cancelled fetch would record
+        ~(delay + local render) — not the owner's true latency —
+        poisoning the very histogram the hedge delay is computed
+        from (each truncated sample drags the observed p99 toward
+        the delay itself). Cancelled fetches record nothing."""
+        rec = current_record()
+        t0 = time.perf_counter()
+        result = await self.peers.fetch(
+            owner, path_qs, session_cookie,
+            trace_context=trace_context, epoch_hint=epoch,
+        )
+        if rec is not None:
+            rec.stamp("peer", time.perf_counter() - t0)
+        return result
+
+    @staticmethod
+    def entry_from_peer_result(result) -> Optional[CachedTile]:
+        """A ``CachedTile`` from a completed peer exchange, or None
+        for any failure/non-200 (the caller renders locally)."""
+        if result is None or result[0] != 200:
+            return None
+        _status, headers, body = result
+        return CachedTile(
+            body,
+            etag=headers.get("etag"),
+            filename=filename_from_disposition(
+                headers.get("content-disposition", "")
+            ),
+        )
+
+    def publish(
+        self, key: str, entry: CachedTile,
+        epoch: Optional[int] = None,
+    ) -> None:
         """Write-through to the shared tier after a local render
         completes (called from the single-flight fill hook, so once
-        per flight no matter how many requests coalesced). Best-effort
-        and never awaited by the response path."""
-        if self.l2 is None:
+        per flight no matter how many requests coalesced), stamped
+        with the flight's pre-render epoch snapshot. Best-effort and
+        never awaited by the response path. Hot fills also replicate
+        to the ring successor(s)."""
+        if self.l2 is not None:
+            self._spawn(self.l2.put(key, entry, epoch=epoch))
+        self._maybe_replicate(key, entry, epoch)
+
+    def note_hit(self, key: str, entry: CachedTile) -> None:
+        """Serving-path hit hook: replication qualifies on frequency,
+        and most keys cross the hot bar on a HIT, not a fill. O(1)
+        when it declines (a set probe + a sketch read)."""
+        self._maybe_replicate(key, entry, None)
+
+    def _maybe_replicate(
+        self, key: str, entry: CachedTile, epoch: Optional[int]
+    ) -> None:
+        rep = self.replicator
+        if rep is None or self.ring is None:
             return
-        self._spawn(self.l2.put(key, entry))
+        estimate = None
+        cache = self.result_cache
+        if cache is not None:
+            admission = getattr(cache.memory, "admission", None)
+            if admission is not None:
+                estimate = admission.estimate(key)
+        if not rep.qualifies(key, estimate):
+            return
+        targets = rep.targets(self.ring, key)
+        if not targets:
+            return
+        rep.mark_pushed(key)
+        if epoch is None and self.epochs is not None:
+            image_id = image_id_of(key)
+            if image_id is not None:
+                epoch = self.epochs.known(image_id)
+        frame = encode_entry(entry, epoch=epoch)
+        self._spawn(self._push_replicas(key, frame, targets))
+
+    async def _push_replicas(self, key, frame, targets) -> None:
+        rep = self.replicator
+        for member in targets:
+            ok = await self.peers.push_replica(member, key, frame)
+            if ok:
+                rep.pushes += 1
+                REPLICATION.inc(op="push", outcome="ok")
+            else:
+                rep.push_errors += 1
+                REPLICATION.inc(op="push", outcome="error")
+
+    def hot_transfer_payload(self, limit: int) -> bytes:
+        """The outbound half of join warm-up: this replica's hottest
+        RAM entries, framed for the wire (the /internal/transfer
+        handler's body)."""
+        cache = self.result_cache
+        if cache is None or limit <= 0:
+            return b""
+        items = []
+        for key, entry in cache.hot_entries(limit):
+            epoch = None
+            if self.epochs is not None:
+                image_id = image_id_of(key)
+                if image_id is not None:
+                    epoch = self.epochs.known(image_id)
+            items.append((key, encode_entry(entry, epoch=epoch)))
+        if self.replicator is not None:
+            self.replicator.transfers_served += 1
+        REPLICATION.inc(op="transfer_serve", outcome="ok")
+        return encode_transfer(items)
+
+    def note_epoch(self, image_id: int, epoch: Optional[int]) -> None:
+        """Inbound epoch knowledge (purge fan-outs carry the new epoch
+        on the wire)."""
+        if self.epochs is not None and epoch is not None:
+            self.epochs.note(image_id, epoch)
+
+    def replica_push_stale(
+        self, key: str, epoch: Optional[int]
+    ) -> bool:
+        """Whether an inbound replica push predates this replica's
+        epoch knowledge of its image (an in-flight push racing a purge
+        fan-out must lose)."""
+        if self.epochs is None:
+            return False
+        return self.epochs.is_stale(key, epoch)
 
     # -- invalidation --------------------------------------------------
 
     def invalidate_image(self, image_id: int) -> None:
-        """Cluster half of an image purge: L2 DELs + peer purge
-        fan-out, scheduled on the serving loop (callable from any
-        thread — the metadata resolver's refresh thread fires
-        listeners). The caller's LOCAL purge has already happened
-        synchronously; nothing here can delay or fail it."""
+        """Cluster half of an image purge: epoch bump FIRST (the bump
+        is what makes the purge win every race — the DELs that follow
+        are space reclamation), then L2 DELs + peer purge fan-out,
+        scheduled on the serving loop (callable from any thread — the
+        metadata resolver's refresh thread fires listeners). The
+        caller's LOCAL purge has already happened synchronously;
+        nothing here can delay or fail it."""
         loop = self._loop
         if loop is None or loop.is_closed():
             return
@@ -168,16 +510,21 @@ class CachePlane:
             pass  # loop shutting down: local purge already done
 
     async def _invalidate_async(self, image_id: int) -> None:
+        epoch = None
+        if self.epochs is not None:
+            epoch = await self.epochs.bump(image_id)
         ops = []
         labels = []
         if self.l2 is not None:
             ops.append(self.l2.delete_image(image_id))
             labels.append("l2")
         if self.ring is not None:
-            for member in self.ring.members:
+            for member in self.members_view():
                 if member == self.self_url:
                     continue
-                ops.append(self.peers.purge(member, image_id))
+                ops.append(
+                    self.peers.purge(member, image_id, epoch=epoch)
+                )
                 labels.append("peer")
         if not ops:
             return
@@ -191,6 +538,15 @@ class CachePlane:
                 target=label, outcome="error" if failed else "ok"
             )
 
+    def members_view(self) -> tuple:
+        """The live member list: the lease view when membership is
+        dynamic, the ring's (bootstrap) list otherwise."""
+        if self.membership is not None:
+            return tuple(self.membership.members)
+        if self.ring is not None:
+            return tuple(self.ring.members)
+        return ()
+
     # -- observability -------------------------------------------------
 
     def snapshot(self) -> dict:
@@ -199,7 +555,32 @@ class CachePlane:
             out["l2"] = self.l2.snapshot()
         if self.ring is not None:
             out["ring"] = self.ring.snapshot()
+            out["ring"]["version"] = self.ring_version
             out["peer_breakers"] = self.peers.snapshot()
+        return out
+
+    def cluster_snapshot(self) -> dict:
+        """The /healthz ``cluster`` key: the coordination view."""
+        out: dict = {
+            "enabled": self.membership is not None
+            or self.replicator is not None
+            or self.hedge is not None,
+            "self": self.self_url,
+            "ring_version": self.ring_version,
+            "authenticated": bool(self.secret),
+        }
+        if self.link is not None:
+            out["coord_link"] = self.link.snapshot()
+        if self.membership is not None:
+            out["membership"] = self.membership.snapshot()
+        if self.epochs is not None:
+            out["epochs"] = self.epochs.snapshot()
+        if self.replicator is not None:
+            out["replication"] = self.replicator.snapshot()
+        if self.hedge is not None:
+            out["hedge"] = self.hedge.snapshot()
+        if self.brains is not None:
+            out["brains"] = self.brains.snapshot()
         return out
 
 

@@ -29,7 +29,7 @@ import asyncio
 import json
 import logging
 import time
-from typing import List, Optional
+from typing import List, Optional, Tuple
 from urllib.parse import urlparse
 
 from ...resilience.breaker import BreakerOpenError, for_dependency
@@ -49,38 +49,51 @@ _MAGIC = b"OMPB1"
 KEY_PREFIX = "ompb:tile:"
 
 
-def encode_entry(entry: CachedTile) -> bytes:
-    header = json.dumps(
-        {
-            "etag": entry.etag,
-            "fn": entry.filename,
-            "wall": time.time() - max(
-                0.0, time.monotonic() - entry.stored_at
-            ),
-        },
-        separators=(",", ":"),
-    ).encode()
+def encode_entry(
+    entry: CachedTile, epoch: Optional[int] = None
+) -> bytes:
+    header_fields = {
+        "etag": entry.etag,
+        "fn": entry.filename,
+        "wall": time.time() - max(
+            0.0, time.monotonic() - entry.stored_at
+        ),
+    }
+    if epoch is not None:
+        # the image epoch the writer observed BEFORE its render began
+        # (cluster/epochs.py) — a purge that lands mid-flight bumps
+        # past this stamp and the entry arrives already-stale
+        header_fields["ep"] = int(epoch)
+    header = json.dumps(header_fields, separators=(",", ":")).encode()
     return _MAGIC + len(header).to_bytes(4, "big") + header + entry.body
 
 
-def decode_entry(raw: bytes) -> Optional[CachedTile]:
-    """None on any framing problem — a corrupt L2 value is a miss,
-    never an error (and never served)."""
+def decode_entry_epoch(
+    raw: bytes,
+) -> Tuple[Optional[CachedTile], Optional[int]]:
+    """(entry, epoch stamp) — (None, None) on any framing problem: a
+    corrupt L2 value is a miss, never an error (and never served).
+    An unstamped entry (older writer) decodes with epoch None."""
     try:
         if not raw.startswith(_MAGIC):
-            return None
+            return None, None
         hlen = int.from_bytes(raw[5:9], "big")
         header = json.loads(raw[9:9 + hlen])
         body = bytes(raw[9 + hlen:])
         stored_at = time.monotonic() - max(
             0.0, time.time() - float(header.get("wall") or 0.0)
         )
+        epoch = header.get("ep")
         return CachedTile(
             body, etag=header.get("etag"),
             filename=header.get("fn") or "", stored_at=stored_at,
-        )
+        ), (int(epoch) if epoch is not None else None)
     except Exception:
-        return None
+        return None, None
+
+
+def decode_entry(raw: bytes) -> Optional[CachedTile]:
+    return decode_entry_epoch(raw)[0]
 
 
 class RedisL2Tier:
@@ -93,6 +106,7 @@ class RedisL2Tier:
         uri: str,
         ttl_s: float = 3600.0,
         key_prefix: str = KEY_PREFIX,
+        epochs=None,
     ):
         parsed = urlparse(uri)
         self.host = parsed.hostname or "localhost"
@@ -101,6 +115,12 @@ class RedisL2Tier:
         self.password = parsed.password
         self.ttl_s = ttl_s
         self.key_prefix = key_prefix
+        # epoch registry (cluster/epochs.py): when present, every GET
+        # becomes an MGET of (entry, image-epoch) in ONE round trip,
+        # stale-stamped entries read as misses, and PUTs stamp the
+        # writer's observed epoch — cluster invalidation stops being
+        # TTL-backstopped
+        self.epochs = epochs
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._lock = asyncio.Lock()
@@ -207,29 +227,73 @@ class RedisL2Tier:
     # -- tier operations (never raise) ---------------------------------
 
     async def get(self, key: str) -> Optional[CachedTile]:
+        entry, _epoch = await self.get_with_epoch(key)
+        return entry
+
+    async def get_with_epoch(
+        self, key: str
+    ) -> Tuple[Optional[CachedTile], Optional[int]]:
+        """(entry-or-None, current image epoch observed in the same
+        round trip). The epoch comes back even on a MISS — it is the
+        stamp the caller's eventual fill must carry, captured here,
+        before the render, so a purge landing mid-flight outruns the
+        fill by construction."""
+        image_id = None
+        if self.epochs is not None:
+            from ...cluster.epochs import epoch_key, image_id_of
+
+            image_id = image_id_of(key)
         try:
-            raw = await self._guarded(b"GET", self._key(key))
+            if image_id is not None:
+                raw, epoch_raw = await self._guarded(
+                    b"MGET", self._key(key), epoch_key(image_id)
+                )
+            else:
+                raw = await self._guarded(b"GET", self._key(key))
+                epoch_raw = None
         except BreakerOpenError:
             L2_REQUESTS.inc(op="get", outcome="breaker_open")
-            return None
+            return None, None
         except asyncio.CancelledError:
             raise
         except Exception:
             L2_REQUESTS.inc(op="get", outcome="error")
-            return None
+            return None, None
+        current_epoch = None
+        if epoch_raw is not None:
+            try:
+                current_epoch = int(epoch_raw)
+            except (TypeError, ValueError):
+                current_epoch = None
+        elif image_id is not None:
+            current_epoch = 0  # no counter yet: epoch zero
+        if current_epoch is not None and self.epochs is not None:
+            self.epochs.note(image_id, current_epoch)
         if raw is None:
             L2_REQUESTS.inc(op="get", outcome="miss")
-            return None
-        entry = decode_entry(raw)
+            return None, current_epoch
+        entry, entry_epoch = decode_entry_epoch(raw)
         if entry is None:
             L2_REQUESTS.inc(op="get", outcome="corrupt")
-            return None
+            return None, current_epoch
+        if current_epoch is not None and (
+            (entry_epoch or 0) < current_epoch
+        ):
+            # written before the image's latest purge: a stale-epoch
+            # read IS a miss — the TTL stops being the backstop
+            if self.epochs is not None:
+                self.epochs.count_stale()
+            L2_REQUESTS.inc(op="get", outcome="stale_epoch")
+            return None, current_epoch
         L2_REQUESTS.inc(op="get", outcome="hit")
-        return entry
+        return entry, current_epoch
 
-    async def put(self, key: str, entry: CachedTile) -> bool:
+    async def put(
+        self, key: str, entry: CachedTile,
+        epoch: Optional[int] = None,
+    ) -> bool:
         parts: List[bytes] = [
-            b"SET", self._key(key), encode_entry(entry),
+            b"SET", self._key(key), encode_entry(entry, epoch=epoch),
         ]
         if self.ttl_s > 0:
             parts += [b"PX", str(int(self.ttl_s * 1000)).encode()]

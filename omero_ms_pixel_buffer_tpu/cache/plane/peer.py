@@ -53,9 +53,19 @@ PEER_HEADER = "X-OMPB-Peer"
 # trace id + its root span id ride the peer GET, and the owner's
 # flight record JOINS the trace instead of minting a new one — one
 # trace spans requester and owner. Honored only together with the
-# peer marker (the same network-trust surface as /internal/*).
+# peer marker (the same network-trust surface as /internal/*, and —
+# with cluster.secret configured — HMAC-authenticated like it).
 TRACE_HEADER = "X-OMPB-Trace-Id"
 TRACE_PARENT_HEADER = "X-OMPB-Trace-Span"
+# The image epoch the requester observed in its L2 consult, forwarded
+# on the owner hop so the owner's fill stamps the REQUESTER's
+# pre-render snapshot (cluster/epochs.py) without an extra Redis RTT
+# on the owner's serving path. Purge fan-outs carry the new epoch on
+# the same header so receivers advance their local high-water mark.
+EPOCH_HEADER = "X-OMPB-Epoch"
+# Replica pushes (POST /internal/replica) name their cache key here —
+# result-cache keys are pure ASCII (img=..|..|q=..), header-safe.
+KEY_HEADER = "X-OMPB-Key"
 _MAX_BODY = 64 << 20  # hard bound on a peer reply body
 _FILENAME_RE = re.compile(r'filename="([^"]*)"')
 
@@ -70,9 +80,16 @@ class PeerClient:
     connections are per-call (Connection: close) — peer fetches are
     rare (only non-owner cold misses) so a pool would be dead weight."""
 
-    def __init__(self, self_url: str, timeout_s: float = 0.5):
+    def __init__(
+        self, self_url: str, timeout_s: float = 0.5,
+        secret: Optional[str] = None,
+    ):
         self.self_url = self_url
         self.timeout_s = timeout_s
+        # cluster.secret: every outbound exchange (peer fetch included
+        # — it carries the trusted peer marker) is HMAC-signed so the
+        # receiving side can reject forged cluster identity
+        self.secret = secret
         self._breakers: Dict[str, object] = {}
 
     def _breaker(self, member: str):
@@ -83,75 +100,129 @@ class PeerClient:
             self._breakers[member] = b
         return b
 
+    async def _bounded(
+        self,
+        member: str,
+        method: str,
+        path_qs: str,
+        session_cookie: Optional[str] = None,
+        trace_context: Optional[Dict[str, str]] = None,
+        body: bytes = b"",
+        extra_headers: Optional[Dict[str, str]] = None,
+        outcome_prefix: str = "",
+    ) -> Optional[Tuple[int, Dict[str, str], bytes]]:
+        """One guarded exchange — the shared breaker/fault/timeout
+        wrapper every peer operation rides. None on open breaker,
+        fault, timeout, or transport failure."""
+        breaker = self._breaker(member)
+        try:
+            breaker.allow()
+        except BreakerOpenError:
+            PEER_REQUESTS.inc(outcome=outcome_prefix + "breaker_open")
+            return None
+        t0 = time.monotonic()
+        try:
+            await INJECTOR.fire_async("cache.peer")
+            result = await asyncio.wait_for(
+                self._exchange(
+                    member, method, path_qs, session_cookie,
+                    trace_context=trace_context, body=body,
+                    extra_headers=extra_headers,
+                ),
+                self.timeout_s,
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            breaker.record_failure()
+            PEER_REQUESTS.inc(outcome=outcome_prefix + "error")
+            return None
+        breaker.record_success(duration_s=time.monotonic() - t0)
+        return result
+
     async def fetch(
         self,
         member: str,
         path_qs: str,
         session_cookie: Optional[str],
         trace_context: Optional[Dict[str, str]] = None,
+        epoch_hint: Optional[int] = None,
     ) -> Optional[Tuple[int, Dict[str, str], bytes]]:
         """GET ``path_qs`` from ``member``; ``(status, headers, body)``
         on an HTTP-complete exchange, None on any transport failure,
         timeout, or open breaker (the caller renders locally).
         ``trace_context`` ({trace_id, span_id}) injects the requester's
-        trace onto the hop so the owner's record joins it."""
-        breaker = self._breaker(member)
-        try:
-            breaker.allow()
-        except BreakerOpenError:
-            PEER_REQUESTS.inc(outcome="breaker_open")
-            return None
-        t0 = time.monotonic()
-        try:
-            await INJECTOR.fire_async("cache.peer")
-            result = await asyncio.wait_for(
-                self._exchange(
-                    member, "GET", path_qs, session_cookie,
-                    trace_context=trace_context,
-                ),
-                self.timeout_s,
-            )
-        except asyncio.CancelledError:
-            raise
-        except Exception:
-            breaker.record_failure()
-            PEER_REQUESTS.inc(outcome="error")
-            return None
-        breaker.record_success(duration_s=time.monotonic() - t0)
-        return result
+        trace onto the hop so the owner's record joins it;
+        ``epoch_hint`` forwards the requester's observed image epoch
+        so the owner's fill stamps the pre-render snapshot."""
+        extra = None
+        if epoch_hint is not None:
+            extra = {EPOCH_HEADER: str(int(epoch_hint))}
+        return await self._bounded(
+            member, "GET", path_qs, session_cookie,
+            trace_context=trace_context, extra_headers=extra,
+        )
 
-    async def purge(self, member: str, image_id: int) -> bool:
+    async def purge(
+        self, member: str, image_id: int,
+        epoch: Optional[int] = None,
+    ) -> bool:
         """Best-effort invalidation fan-out: POST the internal purge
-        endpoint on one peer. False (never an exception) on failure —
-        a dead peer must not block anyone's local purge."""
-        breaker = self._breaker(member)
-        try:
-            breaker.allow()
-        except BreakerOpenError:
-            PEER_REQUESTS.inc(outcome="purge_breaker_open")
+        endpoint on one peer (``epoch`` rides along so the receiver
+        advances its local epoch high-water mark). False (never an
+        exception) on failure — a dead peer must not block anyone's
+        local purge."""
+        extra = None
+        if epoch is not None:
+            extra = {EPOCH_HEADER: str(int(epoch))}
+        result = await self._bounded(
+            member, "POST", f"/internal/purge/{int(image_id)}",
+            extra_headers=extra, outcome_prefix="purge_",
+        )
+        if result is None:
             return False
-        t0 = time.monotonic()
-        try:
-            await INJECTOR.fire_async("cache.peer")
-            result = await asyncio.wait_for(
-                self._exchange(
-                    member, "POST", f"/internal/purge/{int(image_id)}",
-                    None,
-                ),
-                self.timeout_s,
-            )
-        except asyncio.CancelledError:
-            raise
-        except Exception:
-            breaker.record_failure()
-            PEER_REQUESTS.inc(outcome="purge_error")
-            return False
-        breaker.record_success(duration_s=time.monotonic() - t0)
-        ok = result is not None and result[0] == 200
+        ok = result[0] == 200
         PEER_REQUESTS.inc(
             outcome="purge_ok" if ok else "purge_rejected"
         )
         return ok
+
+    async def push_replica(
+        self, member: str, key: str, frame: bytes
+    ) -> bool:
+        """Next-owner replication: POST one hot entry's L2 frame to a
+        ring successor (cluster/replicate.py). Best-effort — False on
+        any failure or a non-200 answer."""
+        result = await self._bounded(
+            member, "POST", "/internal/replica",
+            body=frame, extra_headers={KEY_HEADER: key},
+            outcome_prefix="replica_",
+        )
+        if result is None:
+            return False  # _bounded already counted the failure
+        ok = result[0] == 200
+        PEER_REQUESTS.inc(
+            outcome="replica_ok" if ok else "replica_rejected"
+        )
+        return ok
+
+    async def pull_transfer(
+        self, member: str, limit: int
+    ) -> Optional[bytes]:
+        """Join-time warm-up: GET one peer's hot-set transfer payload.
+        None on any failure (the joiner simply starts cold toward that
+        peer)."""
+        result = await self._bounded(
+            member, "GET", f"/internal/transfer?limit={int(limit)}",
+            outcome_prefix="transfer_",
+        )
+        if result is None:
+            return None  # _bounded already counted the failure
+        if result[0] != 200:
+            PEER_REQUESTS.inc(outcome="transfer_rejected")
+            return None
+        PEER_REQUESTS.inc(outcome="transfer_ok")
+        return result[2]
 
     async def _exchange(
         self,
@@ -160,6 +231,8 @@ class PeerClient:
         path_qs: str,
         session_cookie: Optional[str],
         trace_context: Optional[Dict[str, str]] = None,
+        body: bytes = b"",
+        extra_headers: Optional[Dict[str, str]] = None,
     ) -> Tuple[int, Dict[str, str], bytes]:
         parsed = urlparse(member)
         host = parsed.hostname or "localhost"
@@ -172,8 +245,15 @@ class PeerClient:
                 f"{PEER_HEADER}: {self.self_url}",
                 "Connection: close",
                 "Accept-Encoding: identity",
-                "Content-Length: 0",
+                f"Content-Length: {len(body)}",
             ]
+            if self.secret:
+                from ...cluster.security import SIG_HEADER, sign
+
+                lines.append(
+                    f"{SIG_HEADER}: "
+                    f"{sign(self.secret, method, path_qs, body)}"
+                )
             if trace_context:
                 tid = trace_context.get("trace_id")
                 if tid:
@@ -181,10 +261,13 @@ class PeerClient:
                 sid = trace_context.get("span_id")
                 if sid:
                     lines.append(f"{TRACE_PARENT_HEADER}: {sid}")
+            if extra_headers:
+                for name, value in extra_headers.items():
+                    lines.append(f"{name}: {value}")
             if session_cookie:
                 lines.append(f"Cookie: sessionid={session_cookie}")
             writer.write(
-                ("\r\n".join(lines) + "\r\n\r\n").encode()
+                ("\r\n".join(lines) + "\r\n\r\n").encode() + body
             )
             await writer.drain()
             status_line = await reader.readline()

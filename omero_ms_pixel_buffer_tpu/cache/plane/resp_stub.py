@@ -130,6 +130,18 @@ class InMemoryRespServer:
             return b"+OK\r\n"
         if cmd == b"GET":
             return self._bulk(self._live(parts[1]))
+        if cmd == b"MGET":
+            out = b"*%d\r\n" % (len(parts) - 1)
+            for key in parts[1:]:
+                out += self._bulk(self._live(key))
+            return out
+        if cmd == b"INCR":
+            try:
+                value = int(self._live(parts[1]) or b"0") + 1
+            except ValueError:
+                return b"-ERR value is not an integer\r\n"
+            self.data[parts[1]] = (str(value).encode(), None)
+            return b":%d\r\n" % value
         if cmd == b"SET":
             expires = None
             i = 3

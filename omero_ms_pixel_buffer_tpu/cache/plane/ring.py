@@ -11,13 +11,17 @@ classic virtual-node ring keeps two properties the cluster needs:
   rolling config change does not cold-start the whole fleet's
   ownership map).
 
-The member list is static, from the validated ``cluster:`` config
-block — dynamic membership/gossip is documented future work
-(KNOWN_GAPS). Hashing is blake2b, deterministic across processes and
-platforms: every replica computes the identical ring from the
-identical config, which is the whole correctness argument for
-ownership (two replicas disagreeing on an owner merely costs a double
-render, never wrong bytes — keys carry the full encode signature).
+The ring itself is immutable; LIVENESS is layered on top. The member
+list starts from the validated ``cluster:`` config block and — with
+``cluster.lease-ttl-s`` > 0 — is replaced live by the lease-backed
+membership view (cluster/membership.py): every membership change
+swaps in a freshly built ring (stability means only the departed/
+arrived member's keys remap). Hashing is blake2b, deterministic
+across processes and platforms: every replica computes the identical
+ring from the identical member view, which is the whole correctness
+argument for ownership (two replicas disagreeing on an owner merely
+costs a double render, never wrong bytes — keys carry the full
+encode signature).
 """
 
 from __future__ import annotations
@@ -56,6 +60,23 @@ class HashRing:
         if idx == len(self._hashes):
             idx = 0
         return self._owners[idx]
+
+    def owners(self, key: str, n: int = 1) -> List[str]:
+        """The first ``n`` DISTINCT members clockwise of the key's
+        hash — the owner first, then its replication successors (the
+        classic consistent-hashing preference list: when the owner
+        leaves, the rebuilt ring maps the key to exactly the next
+        member on this list). Fewer than ``n`` when the ring is
+        smaller."""
+        start = bisect.bisect_right(self._hashes, _point(key))
+        found: List[str] = []
+        for i in range(len(self._owners)):
+            member = self._owners[(start + i) % len(self._owners)]
+            if member not in found:
+                found.append(member)
+                if len(found) >= n:
+                    break
+        return found
 
     def snapshot(self) -> dict:
         return {

@@ -203,6 +203,17 @@ class SegmentedLRU:
         with self._lock:
             return self._protected.get(key) or self._probation.get(key)
 
+    def items_snapshot(self) -> List[Tuple[str, CachedTile]]:
+        """A point-in-time (key, entry) list, protected tier first in
+        MRU order — the hot-set enumeration the cluster transfer
+        serves from. Entries are immutable once stored, so the
+        snapshot is safe to read lock-free afterward."""
+        with self._lock:
+            return (
+                list(reversed(self._protected.items()))
+                + list(reversed(self._probation.items()))
+            )
+
     def put(self, key: str, entry: CachedTile) -> List[Tuple[str, CachedTile]]:
         evicted: List[Tuple[str, CachedTile]] = []
         if entry.nbytes > self.max_bytes:
@@ -674,6 +685,35 @@ class TileResultCache:
             self.ttl_s > 0
             and time.monotonic() - stored_at > self.ttl_s
         )
+
+    def hot_entries(
+        self, limit: int = 128, max_bytes: int = 32 << 20
+    ) -> List[Tuple[str, CachedTile]]:
+        """The top-``limit`` RAM-resident entries by admission-sketch
+        frequency (protected-MRU order when no sketch is configured,
+        and as the tie-break) — the join-time warm-up transfer's
+        payload (cluster/replicate.py). Bounded in count AND bytes;
+        never touches disk. Empty on any failure (pass-through)."""
+        try:
+            items = self.memory.items_snapshot()
+            admission = self.memory.admission
+            if admission is not None:
+                # stable sort: equal estimates keep protected-MRU order
+                items.sort(
+                    key=lambda kv: admission.estimate(kv[0]),
+                    reverse=True,
+                )
+            out: List[Tuple[str, CachedTile]] = []
+            total = 0
+            for key, entry in items:
+                if len(out) >= limit or total + entry.nbytes > max_bytes:
+                    break
+                out.append((key, entry))
+                total += entry.nbytes
+            return out
+        except Exception:
+            log.exception("hot-set enumeration failed; empty transfer")
+            return []
 
     def generation(self) -> int:
         """Snapshot for ``put(..., generation=...)``: capture BEFORE

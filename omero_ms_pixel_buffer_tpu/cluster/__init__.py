@@ -1,0 +1,62 @@
+"""Cluster coordination plane (r17) — the self-organizing fleet.
+
+The cache plane (r11) made this service cluster-*aware*: a consistent-
+hash ring over a STATIC member list, a shared Redis L2 tier, and a
+bounded peer fetch. This package makes the fleet cluster-*managed*:
+
+- **membership** — coordination-free replica leases in the shared
+  Redis (heartbeat-refreshed, TTL-expired). ``cluster.members`` is the
+  bootstrap seed, not the truth: replicas join and leave without
+  rolling config changes, and each membership change rebuilds the
+  ownership ring live. Disagreement between two replicas' rings is
+  BOUNDED by construction: the peer marker is terminal (never a
+  forwarding loop), keys carry the full encode signature (never wrong
+  bytes), so the worst case is one extra render per key per
+  disagreement window.
+- **epochs** — generation stamps on shared-tier entries plus a purge-
+  time bump, so cluster invalidation stops being TTL-backstopped
+  best-effort: a stale-epoch L2 read IS a miss, and an in-flight fill
+  that raced a purge lands already-stale.
+- **replicate** — next-owner replication of TinyLFU-qualified hot
+  entries plus a join-time warm-up transfer, so an owner crash (or a
+  fresh autoscaled replica) doesn't cold-start its hot set.
+- **hedge** — owner-side hedging: when a peer fetch runs past the
+  observed peer-stage p99 (the flight recorder's histogram), start
+  the local render and serve whichever finishes first — tails through
+  partial outages cap at ~p99 + local render instead of the peer
+  timeout.
+- **brains** — per-replica scheduler pressure, service-time EWMA, and
+  open-breaker verdicts published through the same Redis, so shed/
+  degrade decisions and dead-dependency knowledge are fleet-wide.
+- **security** — HMAC authentication for the ``/internal/*`` peer
+  surface (closes the "trusts the network" gap when
+  ``cluster.secret`` is configured).
+
+Everything here inherits the cache plane's contract: no operation may
+fail a request; every network edge carries a breaker, a fault point,
+and a per-call timeout; every failure degrades to single-process
+behavior.
+"""
+
+from .brains import FleetBrains
+from .epochs import EpochRegistry, image_id_of
+from .hedge import HedgePolicy
+from .link import RedisLink
+from .membership import MembershipManager
+from .replicate import HotSetReplicator, decode_transfer, encode_transfer
+from .security import SIG_HEADER, sign, verify
+
+__all__ = [
+    "FleetBrains",
+    "EpochRegistry",
+    "image_id_of",
+    "HedgePolicy",
+    "RedisLink",
+    "MembershipManager",
+    "HotSetReplicator",
+    "encode_transfer",
+    "decode_transfer",
+    "SIG_HEADER",
+    "sign",
+    "verify",
+]

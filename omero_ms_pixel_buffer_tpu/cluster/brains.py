@@ -1,0 +1,225 @@
+"""Fleet-shared brains — one scheduler's knowledge, everyone's.
+
+The SLO scheduler (r13) and the breaker board are per-process: each
+replica re-discovers overload and dead dependencies alone, paying the
+full failure budget per process. This module shares the verdicts
+through the same Redis the leases live in, riding the membership
+heartbeat cadence:
+
+- **publish** — every heartbeat, this replica SETs
+  ``ompb:cluster:brain:<self-url>`` (PX-bounded at 3x the interval so
+  a dead replica's brain expires with its lease) with its scheduler
+  pressure (queue occupancy vs capacity), full-resolution service-
+  time EWMA, whether it is actively shedding, and the names of its
+  OPEN breakers;
+- **collect** — every heartbeat, MGET the live members' brains and
+  derive two fleet facts:
+
+  * **fleet pressure** — the mean of the peers' pressure readings,
+    fed to the local scheduler. A replica with spare capacity under a
+    saturated fleet is about to inherit spillover traffic; engaging
+    the hybrid-resolution degrade check early (instead of waiting for
+    its own queue to back up) keeps the fleet inside deadlines.
+  * **dead dependencies** — a dependency whose breaker a majority of
+    reporting peers hold OPEN marks the local breaker SUSPECT: the
+    next local failure trips it immediately instead of burning the
+    whole per-process failure budget re-learning what the fleet
+    already knows. Gossip alone never opens a breaker — a local
+    success clears the suspicion — so a wrong rumor costs nothing.
+
+Every failure degrades to per-process behavior: a publish/collect
+error skips the round and clears nothing.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Dict, List, Optional, Sequence
+
+from ..resilience.breaker import BOARD
+from ..utils.metrics import REGISTRY
+
+log = logging.getLogger("omero_ms_pixel_buffer_tpu.cluster")
+
+BRAIN_PREFIX = "ompb:cluster:brain:"
+
+FLEET_PRESSURE = REGISTRY.gauge(
+    "cluster_fleet_pressure",
+    "Mean peer scheduler pressure observed via the brain exchange",
+)
+BRAIN_ROUNDS = REGISTRY.counter(
+    "cluster_brain_rounds_total",
+    "Brain publish/collect rounds by op and outcome",
+)
+
+
+def brain_key(member: str) -> bytes:
+    return (BRAIN_PREFIX + member).encode()
+
+
+class FleetBrains:
+    def __init__(
+        self,
+        link,
+        self_url: str,
+        scheduler=None,
+        admission=None,
+        pressure_engage: float = 0.9,
+    ):
+        self.link = link
+        self.self_url = self_url
+        self.scheduler = scheduler
+        self.admission = admission
+        self.pressure_engage = pressure_engage
+        self.fleet: Dict[str, dict] = {}
+        self.fleet_pressure = 0.0
+        self.suspected: List[str] = []
+        self.publish_errors = 0
+        self.collect_errors = 0
+        self._last_shed_total = 0
+
+    # -- local view ----------------------------------------------------
+
+    def local_payload(self) -> dict:
+        pressure = 0.0
+        ewma_s = 0.0
+        shedding = False
+        sched = self.scheduler
+        if sched is not None:
+            if sched.queue_size > 0:
+                pressure = sched._waiting_total / sched.queue_size
+            ewma_s = sched._service_ewma
+            # "actively shedding" = sheds SINCE the last publish, not
+            # the lifetime counter (which reads true forever after
+            # one transient overload)
+            total = sum(sched.sheds)
+            shedding = total > self._last_shed_total
+            self._last_shed_total = total
+        adm = self.admission
+        if adm is not None and adm.max_inflight > 0:
+            pressure = max(pressure, adm.inflight / adm.max_inflight)
+        open_deps = [
+            name
+            for name, b in BOARD.snapshot().items()
+            if b.get("state") == "open"
+        ]
+        return {
+            "url": self.self_url,
+            "wall": time.time(),
+            "pressure": round(min(pressure, 4.0), 4),
+            "ewma_s": round(ewma_s, 6),
+            "shedding": shedding,
+            "open": open_deps,
+        }
+
+    # -- the exchange ---------------------------------------------------
+
+    async def publish_once(self, interval_s: float) -> bool:
+        payload = json.dumps(
+            self.local_payload(), separators=(",", ":")
+        ).encode()
+        ttl_ms = str(int(max(interval_s * 3.0, 1.0) * 1000)).encode()
+        try:
+            await self.link.command(
+                b"SET", brain_key(self.self_url), payload,
+                b"PX", ttl_ms,
+            )
+        except Exception:
+            self.publish_errors += 1
+            BRAIN_ROUNDS.inc(op="publish", outcome="error")
+            log.debug("brain publish failed", exc_info=True)
+            return False
+        BRAIN_ROUNDS.inc(op="publish", outcome="ok")
+        return True
+
+    async def collect_once(self, members: Sequence[str]) -> bool:
+        peers = [m for m in members if m != self.self_url]
+        if not peers:
+            self.fleet = {}
+            self._apply(0.0, [])
+            return True
+        try:
+            raw = await self.link.command(
+                b"MGET", *[brain_key(m) for m in peers]
+            )
+        except Exception:
+            self.collect_errors += 1
+            BRAIN_ROUNDS.inc(op="collect", outcome="error")
+            log.debug("brain collect failed", exc_info=True)
+            # a fleet we cannot hear reads as CALM: stale pressure
+            # must not keep the scheduler degrading (or breakers
+            # suspect) for the whole length of a Redis outage —
+            # per-process behavior is the degradation contract
+            self._apply(0.0, [])
+            return False
+        fleet: Dict[str, dict] = {}
+        for member, value in zip(peers, raw):
+            if value is None:
+                continue
+            try:
+                fleet[member] = json.loads(value)
+            except Exception:
+                continue  # a corrupt brain is an absent brain
+        self.fleet = fleet
+        pressures = [
+            float(b.get("pressure") or 0.0) for b in fleet.values()
+        ]
+        mean_pressure = (
+            sum(pressures) / len(pressures) if pressures else 0.0
+        )
+        # a dependency is fleet-dead when a STRICT majority of
+        # reporting peers hold its breaker open — one confused
+        # replica in a 3+ fleet is not the fleet (with exactly one
+        # reporting peer, that peer IS the fleet's voice, and
+        # suspicion still needs a local failure to confirm)
+        counts: Dict[str, int] = {}
+        for brain in fleet.values():
+            for dep in brain.get("open") or []:
+                if isinstance(dep, str):
+                    counts[dep] = counts.get(dep, 0) + 1
+        need = len(fleet) // 2 + 1
+        suspects = sorted(
+            dep for dep, n in counts.items() if n >= need
+        ) if fleet else []
+        self._apply(mean_pressure, suspects)
+        BRAIN_ROUNDS.inc(op="collect", outcome="ok")
+        return True
+
+    def _apply(
+        self, mean_pressure: float, suspects: List[str]
+    ) -> None:
+        self.fleet_pressure = mean_pressure
+        FLEET_PRESSURE.set(mean_pressure)
+        if self.scheduler is not None:
+            self.scheduler.note_fleet_pressure(
+                mean_pressure, engaged=(
+                    mean_pressure >= self.pressure_engage
+                ),
+            )
+        for dep in suspects:
+            if dep not in self.suspected:
+                log.info("fleet reports dependency open: %s", dep)
+            BOARD.create(dep).suspect()
+        for dep in self.suspected:
+            if dep not in suspects:
+                BOARD.create(dep).clear_suspect()
+        self.suspected = suspects
+
+    def snapshot(self) -> dict:
+        return {
+            "fleet_pressure": round(self.fleet_pressure, 4),
+            "suspected_deps": list(self.suspected),
+            "peers": {
+                url: {
+                    "pressure": b.get("pressure"),
+                    "ewma_s": b.get("ewma_s"),
+                    "shedding": b.get("shedding"),
+                    "open": b.get("open"),
+                }
+                for url, b in sorted(self.fleet.items())
+            },
+            "publish_errors": self.publish_errors,
+            "collect_errors": self.collect_errors,
+        }

@@ -1,0 +1,167 @@
+"""Coordination link — the cluster plane's own RESP2 connection.
+
+Membership leases, epoch bumps, and brain publishes all talk to the
+same Redis the L2 tier uses, but over their OWN connection: the L2
+client serializes commands under a lock, and a background membership
+SCAN must never head-of-line-block a serving-path tile GET (nor the
+other way around — a slow tile body must not delay a lease refresh
+past its TTL).
+
+Same client shape as the L2 tier and the auth store (no redis package
+in this environment): one connection, commands serialized, reconnect-
+once on transport error. The resilience contract matches every other
+remote edge — ``cluster:coord`` breaker, ``cluster.coord`` fault
+point, per-call io timeout. ``command`` RAISES on failure; every
+caller in this package degrades (keep the last-known ring, skip a
+brain round) rather than surfacing anything to a request.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional
+from urllib.parse import urlparse
+
+from ..resilience.breaker import for_dependency
+from ..resilience.faultinject import INJECTOR
+from ..resilience.timeouts import io_timeout_s
+
+log = logging.getLogger("omero_ms_pixel_buffer_tpu.cluster")
+
+
+class RedisLink:
+    """The guarded RESP2 exchange the coordination modules share."""
+
+    def __init__(self, uri: str):
+        parsed = urlparse(uri)
+        self.host = parsed.hostname or "localhost"
+        self.port = parsed.port or 6379
+        self.db = int(parsed.path.lstrip("/") or 0) if parsed.path else 0
+        self.password = parsed.password
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._lock = asyncio.Lock()
+        self.breaker = for_dependency("cluster:coord")
+
+    async def _connect(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port
+        )
+        if self.password:
+            await self._command(b"AUTH", self.password.encode())
+        if self.db:
+            await self._command(b"SELECT", str(self.db).encode())
+
+    async def _command(self, *parts: bytes):
+        w, r = self._writer, self._reader
+        out = b"*%d\r\n" % len(parts)
+        for p in parts:
+            out += b"$%d\r\n%s\r\n" % (len(p), p)
+        w.write(out)
+        await w.drain()
+        return await self._read_reply(r)
+
+    async def _read_reply(self, r: asyncio.StreamReader):
+        line = (await r.readline()).rstrip(b"\r\n")
+        if not line:
+            raise ConnectionError("redis connection closed")
+        marker, rest = line[:1], line[1:]
+        if marker in (b"+", b":"):
+            return rest
+        if marker == b"-":
+            raise RuntimeError(f"redis error: {rest.decode()}")
+        if marker == b"$":
+            n = int(rest)
+            if n == -1:
+                return None
+            data = await r.readexactly(n + 2)
+            return data[:-2]
+        if marker == b"*":
+            n = int(rest)
+            return [await self._read_reply(r) for _ in range(n)]
+        raise RuntimeError(f"unexpected redis reply: {line!r}")
+
+    async def _exchange(self, *parts: bytes):
+        async with self._lock:
+            if self._writer is None:
+                await self._connect()
+            try:
+                return await self._command(*parts)
+            except (ConnectionError, EOFError, OSError,
+                    asyncio.IncompleteReadError):
+                await self._reset()
+                return await self._command(*parts)
+
+    async def _reset(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+        await self._connect()
+
+    async def command(self, *parts: bytes):
+        """One guarded round trip: breaker gate, fault point, per-call
+        timeout, slow-call accounting. Raises on breaker-open, fault,
+        timeout, and transport error — callers degrade."""
+        self.breaker.allow()
+        t0 = time.monotonic()
+        try:
+            await INJECTOR.fire_async("cluster.coord")
+            timeout = io_timeout_s()
+            if timeout > 0:
+                result = await asyncio.wait_for(
+                    self._exchange(*parts), timeout
+                )
+            else:
+                result = await self._exchange(*parts)
+        except asyncio.TimeoutError:
+            # mid-protocol desync: drop the connection so the next
+            # call starts clean instead of reading a stale reply
+            async with self._lock:
+                if self._writer is not None:
+                    self._writer.close()
+                    self._writer = None
+            self.breaker.record_failure()
+            raise
+        except (ConnectionError, EOFError, OSError,
+                asyncio.IncompleteReadError):
+            self.breaker.record_failure()
+            raise
+        except RuntimeError:
+            # a redis ERROR reply is an answer — the store is up
+            self.breaker.record_success(duration_s=time.monotonic() - t0)
+            raise
+        self.breaker.record_success(duration_s=time.monotonic() - t0)
+        return result
+
+    async def scan_keys(self, pattern: bytes, limit: int = 4096) -> list:
+        """Cursor SCAN with a MATCH, bounded round trips; the live
+        keys as a list of bytes. Raises like ``command``."""
+        keys: list = []
+        cursor = b"0"
+        for _ in range(256):  # hard bound on SCAN round trips
+            reply = await self.command(
+                b"SCAN", cursor, b"MATCH", pattern, b"COUNT", b"512",
+            )
+            cursor, batch = reply[0], reply[1]
+            keys.extend(batch)
+            if cursor == b"0" or len(keys) >= limit:
+                break
+        return keys[:limit]
+
+    async def close(self) -> None:
+        if self._writer is not None:  # ompb-lint: disable=lock-discipline -- teardown path: taking the op lock could park close() behind a wedged exchange (the L2-tier close precedent)
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except Exception:
+                pass
+            self._writer = None
+
+    def snapshot(self) -> dict:
+        return {
+            "host": self.host,
+            "port": self.port,
+            "breaker": self.breaker.state,
+        }

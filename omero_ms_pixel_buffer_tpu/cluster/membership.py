@@ -1,0 +1,158 @@
+"""Dynamic membership — coordination-free replica leases.
+
+Each replica maintains one lease key in the shared Redis
+(``ompb:cluster:member:<self-url>``), SET with a PX of
+``cluster.lease-ttl-s`` and refreshed every ttl/3. Membership IS the
+set of live leases: no coordinator, no consensus, no gossip protocol
+— a replica that stops heartbeating (crash, partition, scale-down)
+expires out of everyone's view within one TTL, and a fresh replica
+appears within one refresh interval. ``cluster.members`` from the
+config is only the BOOTSTRAP seed: the ring starts there so a replica
+is never memberless, and the first successful scan replaces it with
+the lease truth.
+
+Failure posture: every refresh failure (Redis down, breaker open,
+fault) keeps the LAST KNOWN member set — a Redis outage freezes the
+fleet topology rather than collapsing every ring to a singleton (which
+would stampede every replica into rendering everything locally). The
+freeze is symmetric: all replicas stop observing changes together, so
+disagreement stays bounded.
+
+Ring-disagreement cost is bounded by construction, not by the lease
+protocol: two replicas with different member views merely disagree
+about ownership, which costs at most one extra render per key per
+disagreement window (the peer marker is terminal — never a loop — and
+keys carry the full encode signature — never wrong bytes). The chaos
+suite pins all three properties.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from collections import deque
+from typing import Callable, Optional, Sequence, Tuple
+
+from ..utils.metrics import REGISTRY
+
+log = logging.getLogger("omero_ms_pixel_buffer_tpu.cluster")
+
+MEMBER_PREFIX = "ompb:cluster:member:"
+
+MEMBERSHIP_EVENTS = REGISTRY.counter(
+    "cluster_membership_events_total",
+    "Membership changes observed by this replica, by event",
+)
+
+
+class MembershipManager:
+    """The lease heartbeat + scan loop. Event-loop affine (runs as one
+    task on the serving loop); ``snapshot`` may be called from
+    anywhere (reads of loop-written scalars)."""
+
+    def __init__(
+        self,
+        link,
+        self_url: str,
+        seed: Sequence[str],
+        lease_ttl_s: float,
+        on_change: Optional[Callable] = None,
+        clock=time.monotonic,
+    ):
+        self.link = link
+        self.self_url = self_url
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.interval_s = max(self.lease_ttl_s / 3.0, 0.05)
+        self.on_change = on_change
+        self._clock = clock
+        self.members: Tuple[str, ...] = tuple(
+            sorted(set(seed) | {self_url})
+        )
+        self.seeded = True  # still on the bootstrap list
+        self.refreshes = 0
+        self.refresh_failures = 0
+        self.last_refresh: Optional[float] = None
+        self.events: deque = deque(maxlen=32)
+
+    def _lease_key(self) -> bytes:
+        return (MEMBER_PREFIX + self.self_url).encode()
+
+    async def refresh_once(self) -> bool:
+        """One heartbeat round: refresh this replica's lease, scan the
+        live lease set, apply any membership change. False (and the
+        last-known set is kept) on any failure."""
+        try:
+            payload = json.dumps(
+                {"url": self.self_url, "wall": time.time()},
+                separators=(",", ":"),
+            ).encode()
+            await self.link.command(
+                b"SET", self._lease_key(), payload,
+                b"PX", str(int(self.lease_ttl_s * 1000)).encode(),
+            )
+            keys = await self.link.scan_keys(
+                (MEMBER_PREFIX + "*").encode()
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            self.refresh_failures += 1
+            MEMBERSHIP_EVENTS.inc(event="refresh_error")
+            log.debug("membership refresh failed; keeping last-known "
+                      "member set", exc_info=True)
+            return False
+        live = {
+            key.decode("utf-8", "replace")[len(MEMBER_PREFIX):]
+            for key in keys
+        }
+        live.add(self.self_url)  # our own SET may race the scan
+        self._apply(tuple(sorted(live)))
+        self.refreshes += 1
+        self.seeded = False
+        self.last_refresh = self._clock()
+        return True
+
+    def _apply(self, new: Tuple[str, ...]) -> None:
+        if new == self.members:
+            return
+        old = set(self.members)
+        added = sorted(set(new) - old)
+        removed = sorted(old - set(new))
+        self.members = new
+        now = time.time()
+        for url in added:
+            self.events.append({"event": "join", "url": url, "ts": now})
+            MEMBERSHIP_EVENTS.inc(event="join")
+            log.info("cluster member joined: %s", url)
+        for url in removed:
+            self.events.append({"event": "leave", "url": url, "ts": now})
+            MEMBERSHIP_EVENTS.inc(event="leave")
+            log.info("cluster member left: %s", url)
+        if self.on_change is not None:
+            try:
+                self.on_change(added, removed, new)
+            except Exception:
+                log.exception("membership on_change hook failed")
+
+    async def run(self) -> None:
+        """The heartbeat loop (the owner creates the task and cancels
+        it at close)."""
+        while True:
+            await self.refresh_once()
+            await asyncio.sleep(self.interval_s)
+
+    def snapshot(self) -> dict:
+        age = None
+        if self.last_refresh is not None:
+            age = round(self._clock() - self.last_refresh, 3)
+        return {
+            "members": list(self.members),
+            "lease_ttl_s": self.lease_ttl_s,
+            "seeded": self.seeded,
+            "refreshes": self.refreshes,
+            "refresh_failures": self.refresh_failures,
+            "last_refresh_age_s": age,
+            "events": list(self.events),
+        }

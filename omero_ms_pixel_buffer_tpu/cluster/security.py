@@ -1,0 +1,81 @@
+"""HMAC authentication for the peer surface.
+
+``/internal/*`` (purge fan-out, hot-entry replication, warm-up
+transfer) and the ``X-OMPB-Peer``-marked serving hops were a pure
+network-trust surface — any process that could reach the port could
+purge caches or pull the hot set (the KNOWN_GAPS "trusts the network"
+item). With ``cluster.secret`` configured, every such request must
+carry
+
+    X-OMPB-Sig: v1:<unix-ts>:<hex hmac-sha256>
+
+where the MAC covers ``method \\n path?query \\n ts \\n sha256(body)``
+under the shared secret. Verification is constant-time
+(``hmac.compare_digest``) and bounded by a clock-skew window, so a
+captured signature cannot be replayed outside it (replay WITHIN the
+window re-executes an idempotent purge/fetch — accepted scope,
+documented). Without a secret the surface keeps its previous posture:
+the peer marker is required and deploy-time network policy is the
+boundary.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import time
+from typing import Optional
+
+SIG_HEADER = "X-OMPB-Sig"
+DEFAULT_SKEW_S = 30.0
+_VERSION = "v1"
+
+
+def _mac(
+    secret: str, method: str, path_qs: str, ts: str, body: bytes
+) -> str:
+    message = "\n".join(
+        (method.upper(), path_qs, ts, hashlib.sha256(body).hexdigest())
+    ).encode()
+    return hmac.new(secret.encode(), message, hashlib.sha256).hexdigest()
+
+
+def sign(
+    secret: str,
+    method: str,
+    path_qs: str,
+    body: bytes = b"",
+    now: Optional[float] = None,
+) -> str:
+    """The ``X-OMPB-Sig`` header value for one outbound exchange."""
+    ts = str(int(time.time() if now is None else now))
+    return f"{_VERSION}:{ts}:{_mac(secret, method, path_qs, ts, body)}"
+
+
+def verify(
+    secret: str,
+    header_value: Optional[str],
+    method: str,
+    path_qs: str,
+    body: bytes = b"",
+    skew_s: float = DEFAULT_SKEW_S,
+    now: Optional[float] = None,
+) -> bool:
+    """True iff ``header_value`` authenticates the exchange: well-
+    formed, inside the clock-skew window, and a constant-time MAC
+    match. Never raises — a malformed header is simply False."""
+    if not secret or not header_value:
+        return False
+    parts = header_value.split(":")
+    if len(parts) != 3 or parts[0] != _VERSION:
+        return False
+    _, ts, mac = parts
+    try:
+        ts_val = float(ts)
+    except (TypeError, ValueError):
+        return False
+    wall = time.time() if now is None else now
+    if abs(wall - ts_val) > skew_s:
+        return False
+    expected = _mac(secret, method, path_qs, ts, body)
+    return hmac.compare_digest(expected, mac)

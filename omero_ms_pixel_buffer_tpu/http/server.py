@@ -36,10 +36,14 @@ from ..auth.omero_session import (
 )
 from ..auth.stores import OmeroWebSessionStore, make_session_store
 from ..cache.plane.peer import (
+    EPOCH_HEADER,
+    KEY_HEADER,
     PEER_HEADER,
     TRACE_HEADER,
     TRACE_PARENT_HEADER,
 )
+from ..cluster.security import SIG_HEADER
+from ..cluster.security import verify as verify_cluster_sig
 from ..cache.prefetch import ViewportPrefetcher
 from ..cache.result_cache import (
     CachedTile,
@@ -156,10 +160,17 @@ def obs_middleware(app_obj: "PixelBufferApp"):
         ):
             return await handler(request)
         trace_id = parent = None
-        if PEER_HEADER in request.headers:
+        if PEER_HEADER in request.headers and _peer_claim_verified(
+            app_obj, request
+        ):
             # adopt the forwarded trace only when it LOOKS like one of
             # ours (lowercase hex): a malformed id would poison the
-            # deterministic keep-hash and every downstream exposition
+            # deterministic keep-hash and every downstream exposition.
+            # With cluster.secret configured the peer claim must ALSO
+            # carry a valid signature — this middleware runs OUTSIDE
+            # the cluster guard (so the guard's 403s complete records)
+            # and must not adopt attacker-chosen trace ids from a
+            # request the guard is about to reject
             trace_id = _valid_trace_id(
                 request.headers.get(TRACE_HEADER)
             )
@@ -198,6 +209,32 @@ def obs_middleware(app_obj: "PixelBufferApp"):
             recorder.complete(rec, status)
 
     return middleware
+
+
+def _peer_claim_verified(app_obj, request: web.Request) -> bool:
+    """Whether a peer-marked request's cluster identity checks out
+    for trust decisions made OUTSIDE the guard middleware (trace
+    adoption). Serving-path peer hops are bodiless GETs, so the
+    signature verifies over an empty body. Without a secret the r11
+    posture holds: network policy is the boundary."""
+    secret = app_obj.config.cluster.secret
+    if not secret:
+        return True
+    return verify_cluster_sig(
+        secret,
+        request.headers.get(SIG_HEADER),
+        request.method,
+        request.path_qs,
+        b"",
+    )
+
+
+def _parse_epoch(value):
+    """The forwarded image epoch, or None when absent/malformed."""
+    try:
+        return int(value) if value is not None else None
+    except (TypeError, ValueError):
+        return None
 
 
 def _valid_trace_id(value, length: int = 32):
@@ -390,19 +427,16 @@ def overload_gate_middleware(app_obj: "PixelBufferApp"):
                 rec.stamp("door", time.perf_counter() - t_door)
             return await handler(request)
         cache = app_obj.result_cache
-        if cache is not None and request.path.startswith("/tile/"):
-            try:
-                params = dict(request.match_info)
-                params.update(request.query)
-                probe_ctx = TileCtx.from_params(params, None)
-                if cache.contains_any_tier(probe_ctx.cache_key(
-                    app_obj.pipeline.encode_signature()
-                )):
-                    if rec is not None:
-                        rec.stamp("door", time.perf_counter() - t_door)
-                    return await handler(request)
-            except TileError:
-                pass  # malformed params: the handler owns the 400
+        if cache is not None and request.path.startswith(
+            ("/tile/", "/render/")
+        ):
+            probe_key = app_obj._door_probe_key(request)
+            if probe_key is not None and cache.contains_any_tier(
+                probe_key
+            ):
+                if rec is not None:
+                    rec.stamp("door", time.perf_counter() - t_door)
+                return await handler(request)
         sched.shed_at_door(priority)
         if rec is not None:
             rec.stamp("door", time.perf_counter() - t_door)
@@ -416,6 +450,52 @@ def overload_gate_middleware(app_obj: "PixelBufferApp"):
                 )
             },
         )
+
+    return middleware
+
+
+def cluster_guard_middleware(app_obj: "PixelBufferApp"):
+    """The peer-surface authentication gate (cluster/security). Two
+    request classes claim cluster identity: ``/internal/*`` (purge
+    fan-out, replica push, warm-up transfer) and anything carrying the
+    ``X-OMPB-Peer`` marker (the owner hop, whose marker short-circuits
+    L2 re-checks and is what the trace-adoption trust rides on).
+
+    With ``cluster.secret`` configured, BOTH must present a valid
+    ``X-OMPB-Sig`` — HMAC over (method, path?query, timestamp,
+    body-digest), constant-time compared, clock-skew bounded — or they
+    answer 403 before any handler runs. Without a secret the previous
+    posture holds: ``/internal/*`` requires the peer marker and
+    deploy-time network policy is the boundary (KNOWN_GAPS documents
+    the residual trust). Normal browser traffic never carries either
+    marker and never pays this check."""
+
+    @web.middleware
+    async def middleware(request: web.Request, handler):
+        secret = app_obj.config.cluster.secret
+        is_internal = request.path.startswith("/internal/")
+        claims_peer = PEER_HEADER in request.headers
+        if not (is_internal or claims_peer):
+            return await handler(request)
+        if secret:
+            body = b""
+            if request.can_read_body:
+                # aiohttp memoizes the payload: the handler's own
+                # read() gets the same bytes back
+                body = await request.read()
+            if not verify_cluster_sig(
+                secret,
+                request.headers.get(SIG_HEADER),
+                request.method,
+                request.path_qs,
+                body,
+            ):
+                return web.Response(
+                    status=403, text="invalid cluster signature"
+                )
+        elif is_internal and not claims_peer:
+            return web.Response(status=403, text="peer requests only")
+        return await handler(request)
 
     return middleware
 
@@ -664,7 +744,21 @@ class PixelBufferApp:
             cl = config.cluster
             if cl.plane_enabled:
                 from ..cache.plane import CachePlane
+                from ..cluster import HedgePolicy
 
+                hedge = None
+                if cl.hedge.enabled:
+                    peer_timeout_s = cl.peer_timeout_ms / 1000.0
+                    hedge = HedgePolicy(
+                        enabled=True,
+                        quantile=cl.hedge.quantile,
+                        min_s=cl.hedge.min_ms / 1000.0,
+                        max_s=cl.hedge.max_ms / 1000.0,
+                        fallback_s=(
+                            cl.hedge.fallback_ms / 1000.0
+                            or peer_timeout_s / 2.0
+                        ),
+                    )
                 self.cache_plane = CachePlane(
                     members=cl.members,
                     self_url=cl.self_url,
@@ -672,6 +766,14 @@ class PixelBufferApp:
                     peer_timeout_s=cl.peer_timeout_ms / 1000.0,
                     l2_uri=cl.l2.uri,
                     l2_ttl_s=cl.l2.ttl_s,
+                    lease_ttl_s=cl.lease_ttl_s,
+                    replication_factor=cl.replication_factor,
+                    transfer_max_entries=cl.transfer_max_entries,
+                    hedge=hedge,
+                    secret=cl.secret,
+                    result_cache=self.result_cache,
+                    scheduler=self.scheduler,
+                    admission=self.admission,
                 )
             if cc.prefetch.enabled:
                 self.prefetcher = ViewportPrefetcher(
@@ -756,12 +858,26 @@ class PixelBufferApp:
             # every excess request costs a session lookup + cluster
             # cache consult before the scheduler can refuse it
             middlewares.insert(0, overload_gate_middleware(self))
+        if self.cache_plane is not None:
+            # authenticate the peer surface BEFORE the door gate (a
+            # forged /internal/* or peer-marked request must not pay
+            # the probe machinery) but INSIDE the obs middleware, so
+            # the 403 still completes a flight record — obs gates its
+            # own trace adoption on the same signature check
+            middlewares.insert(0, cluster_guard_middleware(self))
         if self.recorder is not None:
             # outermost: door sheds, auth 503s, and 403s all complete
             # a record — "every outcome leaves a trace" is the
             # completeness contract the obs tests pin
             middlewares.insert(0, obs_middleware(self))
-        app = web.Application(middlewares=middlewares)
+        # request-body bound: the only inbound bodies are replica
+        # pushes (/internal/replica — one L2-framed cache entry), so
+        # size the cap to the cache's own entry bound instead of
+        # aiohttp's 1 MiB default silently 413ing large-tile pushes
+        max_body = (self.config.cache.max_entry_kb << 10) + 65536
+        app = web.Application(
+            middlewares=middlewares, client_max_size=max_body
+        )
         app.router.add_get("/metrics", handle_metrics)
         app.router.add_get("/healthz", self.handle_healthz)
         if self.recorder is not None:
@@ -779,6 +895,12 @@ class PixelBufferApp:
         if self.cache_plane is not None:
             app.router.add_post(
                 "/internal/purge/{imageId}", self.handle_internal_purge
+            )
+            app.router.add_post(
+                "/internal/replica", self.handle_internal_replica
+            )
+            app.router.add_get(
+                "/internal/transfer", self.handle_internal_transfer
             )
         if self.config.render.enabled:
             app.router.add_get(
@@ -801,6 +923,69 @@ class PixelBufferApp:
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         return app
+
+    def _door_probe_key(self, request: web.Request) -> Optional[str]:
+        """The cache key the overload door gate probes for its
+        hit exemption, or None when the request can't be keyed
+        cheaply (malformed params — the handler owns the 400, so the
+        arrival sheds like any other would-shed request).
+
+        Two fixes over the original pre-auth probe (KNOWN_GAPS
+        "Operational"): w/h=0 full-plane spellings NORMALIZE first —
+        via ``peek_extent``, the open-buffer cache peek, so the probe
+        never blocks or does I/O — and ``/render/`` requests parse
+        their spec (pure grammar + LUT-registry lookup, no I/O
+        either) instead of being categorically unprobeable. A tile
+        cached under its explicit spelling therefore passes the door
+        under genuine overflow whichever spelling (or dialect
+        grammar) asks for it. A failed extent peek leaves the region
+        unnormalized — exactly the old probe, which still matches
+        explicitly-spelled entries."""
+        try:
+            if request.path.startswith("/render/"):
+                # match_info only — the ``c`` QUERY param is the
+                # render channel grammar, not the path's channel
+                # index (mirrors handle_get_render exactly)
+                probe_ctx = TileCtx.from_params(
+                    dict(request.match_info), None
+                )
+                spec, err = self.build_render_spec(
+                    request.query, probe_ctx.c
+                )
+                if err is not None:
+                    return None
+                probe_ctx.render = spec
+                probe_ctx.format = spec.format
+                if self._apply_region_params(
+                    probe_ctx, request.query
+                ) is not None:
+                    return None
+            else:
+                params = dict(request.match_info)
+                params.update(request.query)
+                probe_ctx = TileCtx.from_params(params, None)
+            region = probe_ctx.region
+            if region.width == 0 or region.height == 0:
+                extent = None
+                svc = self.pixels_service
+                if hasattr(svc, "peek_extent"):
+                    extent = svc.peek_extent(
+                        probe_ctx.image_id, probe_ctx.resolution
+                    )
+                if extent is not None:
+                    # the resolve_region contract verbatim (w==0 ->
+                    # sizeX regardless of x), mirroring
+                    # _normalize_region so both spellings probe the
+                    # one shared entry
+                    if region.width == 0:
+                        region.width = extent[0]
+                    if region.height == 0:
+                        region.height = extent[1]
+            return probe_ctx.cache_key(
+                self.pipeline.encode_signature()
+            )
+        except TileError:
+            return None
 
     def _mesh_manager(self):
         """The live MeshManager, when the device path has built one
@@ -900,10 +1085,16 @@ class PixelBufferApp:
             if self.recorder is not None
             else {"enabled": False}
         )
+        cluster_health = (
+            self.cache_plane.cluster_snapshot()
+            if self.cache_plane is not None
+            else {"enabled": False}
+        )
         body = {
             "status": "degraded" if degraded else "ok",
             "uptime_s": round(time.time() - self._started_at, 1),
             "obs": obs_health,
+            "cluster": cluster_health,
             "breakers": breakers,
             "admission": admission,
             "slo": slo_health,
@@ -1141,7 +1332,10 @@ class PixelBufferApp:
                       exc_info=True)
             return False
 
-    def _cache_filler(self, key: str, full_res_key: Optional[str] = None):
+    def _cache_filler(
+        self, key: str, full_res_key: Optional[str] = None,
+        epoch: Optional[int] = None,
+    ):
         """The request_coalesced on_result hook: memoize exactly once
         per flight (no matter how many requests coalesced) and stamp
         the ETag onto the shared reply so every waiter's response
@@ -1154,7 +1348,13 @@ class PixelBufferApp:
         pyramid level exists, so the flight may come back with FULL-
         resolution bytes — those must land under the full-resolution
         key, or every later degraded-permit request would hit the
-        |deg=N entry and tag an undegraded body ``X-OMPB-Degraded``."""
+        |deg=N entry and tag an undegraded body ``X-OMPB-Degraded``.
+
+        ``epoch`` is the image epoch observed BEFORE this flight's
+        render began (the plane fetch's L2 round trip, or the peer
+        hop's forwarded header): the L2 write-through stamps it, so a
+        cluster purge that lands mid-flight makes this fill
+        stale-on-arrival (cluster/epochs.py)."""
         cache = self.result_cache
         generation = cache.generation()
 
@@ -1172,14 +1372,16 @@ class PixelBufferApp:
             await cache.put(target, entry, generation=generation)
             if self.cache_plane is not None:
                 # write-through to the shared L2 tier, once per flight
-                # (fire-and-forget: Redis must never cost the reply)
-                self.cache_plane.publish(target, entry)
+                # (fire-and-forget: Redis must never cost the reply),
+                # epoch-stamped with the pre-render snapshot
+                self.cache_plane.publish(target, entry, epoch=epoch)
 
         return fill
 
     async def _fetch_tile(
         self, ctx: TileCtx, key: str,
         full_res_key: Optional[str] = None,
+        epoch: Optional[int] = None,
     ) -> Message:
         """The shared miss path: coalesced bus request, memoized on
         completion. ``key`` is the content key; the flight dedupes on
@@ -1187,7 +1389,7 @@ class PixelBufferApp:
         caller's ACL check."""
         quality = self.pipeline.encode_signature()
         on_result = (
-            self._cache_filler(key, full_res_key)
+            self._cache_filler(key, full_res_key, epoch)
             if self.result_cache is not None else None
         )
         return await self.bus.request_coalesced(
@@ -1197,6 +1399,83 @@ class PixelBufferApp:
             timeout_ms=self.config.event_bus_send_timeout_ms,
             on_result=on_result,
         )
+
+    async def _hedged_fetch(
+        self, request: web.Request, ctx: TileCtx, key: str,
+        full_res_key: Optional[str], epoch: Optional[int],
+        pending: asyncio.Task, generation: Optional[int], inm: str,
+    ):
+        """The hedge race (cluster/hedge.py): the peer fetch ran past
+        the observed p99, so the local render starts NOW and whichever
+        finishes first serves. Returns ``(reply, None)`` when the
+        local render wins (the normal miss path continues) or
+        ``(None, response)`` when the peer's bytes arrive first.
+
+        A peer win cancels only OUR wait on the coalesced flight (a
+        waiter's cancellation never kills the flight — followers and
+        the cache fill are unaffected) and admits the peer entry under
+        the pre-fetch generation snapshot so a racing purge still
+        wins. Either way the loser's work lands in the caches it was
+        already headed for: the bounded one-extra-render cost the
+        membership layer documents, spent deliberately."""
+        plane = self.cache_plane
+        hedge = plane.hedge
+        rec = request.get("obs.rec")
+        fetch_task = asyncio.ensure_future(
+            self._fetch_tile(ctx, key, full_res_key, epoch)
+        )
+        try:
+            done, _ = await asyncio.wait(
+                {fetch_task, pending},
+                return_when=asyncio.FIRST_COMPLETED,
+            )
+            if pending in done and fetch_task not in done:
+                result = pending.result()  # ompb-lint: disable=loop-block -- asyncio.Task already in asyncio.wait's done set: result() returns immediately, never blocks
+                if result is not None and result[1].get(
+                    "x-ompb-degraded"
+                ):
+                    # the owner was under enough pressure to serve its
+                    # OWN hybrid-resolution fallback: those bytes
+                    # belong under a |deg key we can't reconstruct
+                    # here — discard and let the local render decide
+                    result = None
+                entry = plane.entry_from_peer_result(result)
+                if entry is not None and (
+                    await self._authorize_cached(ctx)
+                ):
+                    hedge.note("peer_win")
+                    if rec is not None:
+                        rec.tag("hedge", "peer_win")
+                    fetch_task.cancel()
+                    if self.result_cache is not None:
+                        # the peer fetch rode the ORIGINAL path, so
+                        # these are full-resolution bytes — they must
+                        # land under the full-res key even when this
+                        # request's permit switched `key` to |deg=1
+                        # (the _cache_filler target invariant)
+                        await self.result_cache.put(
+                            full_res_key if full_res_key is not None
+                            else key,
+                            entry, generation=generation,
+                        )
+                    if inm and etag_matches(inm, entry.etag):
+                        return None, web.Response(
+                            status=304,
+                            headers=self._cache_headers(entry.etag),
+                        )
+                    return None, self._tile_response(
+                        ctx, entry.body, entry.filename, entry.etag,
+                        x_cache="peer-hit",
+                    )
+                hedge.note("peer_failed")
+            reply = await fetch_task
+            hedge.note("local_win")
+            if rec is not None:
+                rec.tag("hedge", "local_win")
+            return reply, None
+        finally:
+            if not pending.done():
+                pending.cancel()
 
     async def _prefetch_fetch(self, ctx: TileCtx, key: str) -> None:
         """The prefetcher's fetch hook: identical machinery to a real
@@ -1266,15 +1545,76 @@ class PixelBufferApp:
     async def handle_internal_purge(self, request: web.Request) -> web.Response:
         """Inbound half of the purge fan-out. Requires the peer
         header (the same loop guard as tile forwarding: a peer-
-        originated purge is terminal here)."""
+        originated purge is terminal here; the cluster guard
+        middleware has already authenticated it when a secret is
+        configured). The forwarded epoch advances this replica's
+        local high-water mark so an in-flight replica push against
+        the purged image is rejected without a Redis round trip."""
         if PEER_HEADER not in request.headers:
             return web.Response(status=403, text="peer requests only")
         try:
             image_id = int(request.match_info["imageId"])
         except (TypeError, ValueError):
             return web.Response(status=400, text="bad image id")
+        epoch_raw = request.headers.get(EPOCH_HEADER)
+        if epoch_raw is not None:
+            try:
+                self.cache_plane.note_epoch(image_id, int(epoch_raw))
+            except (TypeError, ValueError):
+                pass  # a malformed epoch is an absent epoch
         self._invalidate_local(image_id)
         return web.json_response({"purged": image_id})
+
+    async def handle_internal_replica(self, request: web.Request) -> web.Response:
+        """Inbound next-owner replication (cluster/replicate.py): one
+        hot entry, framed exactly like an L2 value (epoch stamp
+        included), admitted into the LOCAL result cache so an owner
+        crash finds the hot set already resident here. A push whose
+        epoch predates a purge this replica has seen is dropped —
+        replication must never resurrect invalidated bytes."""
+        if PEER_HEADER not in request.headers:
+            return web.Response(status=403, text="peer requests only")
+        if self.result_cache is None:
+            return web.Response(status=503, text="cache disabled")
+        key = request.headers.get(KEY_HEADER)
+        if not key:
+            return web.Response(status=400, text="missing key header")
+        from ..cache.plane.l2 import decode_entry_epoch
+
+        body = await request.read()
+        entry, epoch = decode_entry_epoch(body)
+        if entry is None:
+            return web.Response(status=400, text="malformed frame")
+        plane = self.cache_plane
+        if plane.replica_push_stale(key, epoch):
+            if plane.replicator is not None:
+                plane.replicator.rejected_stale += 1
+            return web.json_response({"stored": False, "stale": True})
+        await self.result_cache.put(
+            key, entry, generation=self.result_cache.generation()
+        )
+        if plane.replicator is not None:
+            plane.replicator.received += 1
+        return web.json_response({"stored": True})
+
+    async def handle_internal_transfer(self, request: web.Request) -> web.Response:
+        """Outbound half of join-time warm-up: this replica's hottest
+        RAM entries as one bounded, length-prefixed payload. The
+        joiner pulls each live peer once and serves warm within one
+        transfer round."""
+        if PEER_HEADER not in request.headers:
+            return web.Response(status=403, text="peer requests only")
+        limit = self.config.cluster.transfer_max_entries
+        raw = request.query.get("limit")
+        if raw is not None:
+            try:
+                limit = min(limit, max(0, int(raw)))
+            except (TypeError, ValueError):
+                return web.Response(status=400, text="bad limit")
+        payload = self.cache_plane.hot_transfer_payload(limit)
+        return web.Response(
+            body=payload, content_type="application/octet-stream"
+        )
 
     def _full_plane_extent(self, ctx: TileCtx):
         """(size_x, size_y) of the ctx's plane at its resolution
@@ -1475,23 +1815,43 @@ class PixelBufferApp:
         inm = request.headers.get("If-None-Match", "")
         key = None
         plane_entry = plane_source = None
+        plane_epoch = None
+        plane_pending = None
+        plane_generation = None
         if cache is not None:
             key = ctx.cache_key(self.pipeline.encode_signature())
             with obs_recorder.ambient_stage("cache_probe"):
                 entry = await cache.get(key)
+            if entry is not None and self.cache_plane is not None:
+                # hot-set replication qualifies on frequency, and most
+                # keys cross the bar on a HIT, not a fill (O(1) when
+                # it declines)
+                self.cache_plane.note_hit(key, entry)
             if entry is None and self.cache_plane is not None:
                 # the cluster consult, between local miss and render:
                 # shared L2 first, then one bounded GET to the key's
                 # owner. Generation snapshot BEFORE the network hop —
                 # an invalidation racing the fetch must block the
                 # local re-admission (the disk-tier precedent).
-                generation = cache.generation()
-                plane_entry, plane_source = await self.cache_plane.fetch(
+                peer_originated = PEER_HEADER in request.headers
+                generation = plane_generation = cache.generation()
+                (
+                    plane_entry, plane_source, plane_epoch,
+                    plane_pending,
+                ) = await self.cache_plane.fetch(
                     key,
                     request.path_qs,
                     request.cookies.get("sessionid"),
-                    peer_originated=PEER_HEADER in request.headers,
+                    peer_originated=peer_originated,
                 )
+                if peer_originated and plane_epoch is None:
+                    # owner side of a peer hop: the requester forwards
+                    # the epoch IT observed before the hop, so this
+                    # replica's fill stamps the requester's pre-render
+                    # snapshot without an extra Redis round trip
+                    plane_epoch = _parse_epoch(
+                        request.headers.get(EPOCH_HEADER)
+                    )
                 if plane_entry is not None:
                     if await self._authorize_cached(ctx):
                         await cache.put(
@@ -1620,9 +1980,21 @@ class PixelBufferApp:
                             )
             try:
                 if key is not None:
-                    reply = await self._fetch_tile(
-                        ctx, key, full_res_key
-                    )
+                    if plane_pending is not None:
+                        # the hedge fired: race the local render
+                        # against the still-in-flight peer fetch and
+                        # serve whichever finishes first
+                        reply, early = await self._hedged_fetch(
+                            request, ctx, key, full_res_key,
+                            plane_epoch, plane_pending,
+                            plane_generation, inm,
+                        )
+                        if early is not None:
+                            return early
+                    else:
+                        reply = await self._fetch_tile(
+                            ctx, key, full_res_key, plane_epoch
+                        )
                 else:
                     # cache.enabled: false disables the WHOLE
                     # subsystem, single-flight included — operators
@@ -1637,6 +2009,10 @@ class PixelBufferApp:
                 return self._failure_response(request, e)
             served = True
         finally:
+            if plane_pending is not None and not plane_pending.done():
+                # every exit cancels an unconsumed hedge task (the
+                # degraded-hit early returns, acquire sheds, failures)
+                plane_pending.cancel()
             if permit is not None:
                 # failed requests don't train the service-time EWMA: a
                 # fast-failing burst (404 loop, open breaker) would

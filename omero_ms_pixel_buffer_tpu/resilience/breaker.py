@@ -111,6 +111,11 @@ class CircuitBreaker:
         self._opened_at = 0.0
         self._probes_in_flight = 0
         self._probe_admitted_at = 0.0
+        # fleet-gossip advisory (cluster/brains): peers report this
+        # dependency dead. Suspicion never opens by itself — the NEXT
+        # local failure trips immediately (the failure budget was
+        # already spent fleet-wide); a local success clears it.
+        self._suspect = False
         self._stats = {"rejected": 0, "opened": 0}
         BREAKER_STATE.set(0, dependency=name)
 
@@ -191,6 +196,7 @@ class CircuitBreaker:
                 self._transition(CLOSED)
                 return
             self._consecutive_failures = 0
+            self._suspect = False  # a live answer disproves the rumor
             self._outcomes.append((False, slow))
             if slow and len(self._outcomes) >= self.min_calls:
                 rate = sum(
@@ -208,6 +214,12 @@ class CircuitBreaker:
                 return
             self._consecutive_failures += 1
             self._outcomes.append((True, False))
+            if self._suspect:
+                # the fleet already held this dependency open; one
+                # local confirmation is all it takes
+                self._suspect = False
+                self._transition(OPEN)
+                return
             if self._consecutive_failures >= self.failure_threshold:
                 self._transition(OPEN)
                 return
@@ -227,6 +239,18 @@ class CircuitBreaker:
         half-open trial, just driven by a clock instead of traffic)."""
         with self._lock:
             self._transition(CLOSED)
+
+    def suspect(self) -> None:
+        """Fleet-gossip advisory (cluster/brains): a majority of peers
+        hold this dependency's breaker open. Sensitize, never open:
+        the next LOCAL failure trips immediately."""
+        with self._lock:
+            if self._state == CLOSED:
+                self._suspect = True
+
+    def clear_suspect(self) -> None:
+        with self._lock:
+            self._suspect = False
 
     # -- conveniences --------------------------------------------------
 
@@ -270,6 +294,7 @@ class CircuitBreaker:
                 state = HALF_OPEN
             return {
                 "state": state,
+                "suspect": self._suspect,
                 "consecutive_failures": self._consecutive_failures,
                 "window_failures": sum(
                     1 for f, _s in self._outcomes if f
@@ -374,6 +399,12 @@ class NullBreaker:
         pass
 
     def heal(self) -> None:
+        pass
+
+    def suspect(self) -> None:
+        pass
+
+    def clear_suspect(self) -> None:
         pass
 
     def call(self, fn, *args, **kwargs):

@@ -315,6 +315,17 @@ class SloScheduler:
         self._credits = list(self.class_weights)
         self._seq = itertools.count()
         self._service_ewma = 0.0
+        # cluster-brains advisory (cluster/brains.py): the mean of the
+        # PEERS' queue pressure. When the fleet is saturated
+        # (``fleet_engaged``), this replica is about to inherit
+        # spillover traffic — treat even immediate grants as contended
+        # for the degrade check, so tight-deadline work starts serving
+        # the hybrid-resolution fallback BEFORE the local queue backs
+        # up. Advisory only: it never sheds, never queues, and decays
+        # to normal the moment the brains report calm (or stop
+        # reporting — a dead Redis reads as pressure 0).
+        self.fleet_pressure = 0.0
+        self.fleet_engaged = False
         # counters (per class)
         self.classified = [0, 0, 0]
         self.sheds = [0, 0, 0]
@@ -339,7 +350,9 @@ class SloScheduler:
         service time. The moment pressure clears, requests grant
         immediately again and the flag drops on its own (the
         disengage contract)."""
-        if not self.degrade_enabled or deadline is None or not contended:
+        if not self.degrade_enabled or deadline is None:
+            return False
+        if not contended and not self.fleet_engaged:
             return False
         if self._service_ewma <= 0.0:
             return False
@@ -404,6 +417,13 @@ class SloScheduler:
             if self._waiting[lower] > 0:
                 return False
         return True
+
+    def note_fleet_pressure(
+        self, pressure: float, engaged: bool = False
+    ) -> None:
+        """Cluster-brains hook (any thread — two scalar writes)."""
+        self.fleet_pressure = max(0.0, float(pressure))
+        self.fleet_engaged = bool(engaged)
 
     def shed_at_door(self, priority: int) -> None:
         """Record a pre-auth door shed (the overload gate's 503) in
@@ -587,6 +607,8 @@ class SloScheduler:
             ),
             "service_ewma_ms": round(self._service_ewma * 1000.0, 3),
             "class_weights": list(self.class_weights),
+            "fleet_pressure": round(self.fleet_pressure, 4),
+            "fleet_engaged": self.fleet_engaged,
         }
 
 

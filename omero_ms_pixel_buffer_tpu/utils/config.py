@@ -296,18 +296,44 @@ class ClusterL2Config:
 
 
 @dataclasses.dataclass
+class ClusterHedgeConfig:
+    """Owner-side hedging (cluster/hedge.py): start the local render
+    when a peer fetch runs past the observed peer-stage quantile.
+    ``fallback_ms`` 0 means half the peer timeout (used before the
+    stage histogram has any samples)."""
+
+    enabled: bool = False
+    quantile: float = 0.99
+    min_ms: float = 20.0
+    max_ms: float = 250.0
+    fallback_ms: float = 0.0
+
+
+@dataclasses.dataclass
 class ClusterConfig:
     """The cluster: block — the distributed cache plane
-    (cache/plane/). ``members`` is the STATIC replica list (every
-    replica must configure the identical list — the consistent-hash
-    ring is computed locally from it); ``self_url`` identifies this
-    replica in that list and enables the ownership ring + peer fetch.
-    An empty block (the default) keeps the service single-process."""
+    (cache/plane/) and, since r17, the coordination plane (cluster/).
+    ``members`` seeds the consistent-hash ring; ``self_url``
+    identifies this replica on it and enables peer fetch. With
+    ``lease_ttl_s`` > 0 the seed is only the BOOTSTRAP view: replicas
+    hold heartbeat-refreshed leases in the shared Redis and the ring
+    rebuilds live as leases appear/expire. ``replication_factor`` >= 2
+    pushes TinyLFU-hot entries to the ring successor(s) and enables
+    the join-time warm-up transfer; ``secret`` HMAC-authenticates the
+    /internal/* peer surface. An empty block (the default) keeps the
+    service single-process."""
 
     members: tuple = ()
     self_url: Optional[str] = None
     virtual_nodes: int = 64
     peer_timeout_ms: float = 500.0
+    lease_ttl_s: float = 0.0
+    replication_factor: int = 1
+    transfer_max_entries: int = 128
+    secret: Optional[str] = None
+    hedge: ClusterHedgeConfig = dataclasses.field(
+        default_factory=ClusterHedgeConfig
+    )
     l2: ClusterL2Config = dataclasses.field(
         default_factory=ClusterL2Config
     )
@@ -795,6 +821,8 @@ class Config:
         cl = raw.get("cluster") or {}
         unknown = set(cl) - {
             "members", "self", "virtual-nodes", "peer-timeout-ms", "l2",
+            "lease-ttl-s", "replication-factor", "transfer-max-entries",
+            "secret", "hedge",
         }
         if unknown:
             raise ConfigError(
@@ -861,11 +889,63 @@ class Config:
             raise ConfigError(
                 f"Invalid value for 'cluster.l2.uri': {l2_uri!r}"
             )
+        lease_ttl_s = _num(cl, "lease-ttl-s", 0.0, 0.0)
+        if lease_ttl_s > 0 and not l2_uri:
+            raise ConfigError(
+                "'cluster.lease-ttl-s' needs 'cluster.l2.uri' — "
+                "replica leases live in the shared Redis"
+            )
+        replication_factor = _num(cl, "replication-factor", 1, 1, int)
+        if replication_factor > 1 and not members:
+            raise ConfigError(
+                "'cluster.replication-factor' > 1 needs "
+                "'cluster.members' — replication targets come from "
+                "the ownership ring"
+            )
+        secret = cl.get("secret")
+        if secret is not None and (
+            not isinstance(secret, str) or not secret.strip()
+        ):
+            raise ConfigError(
+                "'cluster.secret' must be a non-empty string"
+            )
+        hedge_raw = cl.get("hedge") or {}
+        unknown = set(hedge_raw) - {
+            "enabled", "quantile", "min-ms", "max-ms", "fallback-ms",
+        }
+        if unknown:
+            raise ConfigError(
+                f"Unknown keys in 'cluster.hedge' block: "
+                f"{sorted(unknown)}"
+            )
+        hedge_enabled = hedge_raw.get("enabled", False)
+        if not isinstance(hedge_enabled, bool):
+            raise ConfigError(
+                "'cluster.hedge.enabled' must be a boolean"
+            )
+        hedge_quantile = _num(hedge_raw, "quantile", 0.99, 0.0)
+        if not 0.0 < hedge_quantile < 1.0:
+            raise ConfigError(
+                "'cluster.hedge.quantile' must be inside (0, 1)"
+            )
         return ClusterConfig(
             members=tuple(members),
             self_url=self_url,
             virtual_nodes=_num(cl, "virtual-nodes", 64, 1, int),
             peer_timeout_ms=_num(cl, "peer-timeout-ms", 500.0, 1.0),
+            lease_ttl_s=lease_ttl_s,
+            replication_factor=replication_factor,
+            transfer_max_entries=_num(
+                cl, "transfer-max-entries", 128, 0, int
+            ),
+            secret=secret,
+            hedge=ClusterHedgeConfig(
+                enabled=hedge_enabled,
+                quantile=hedge_quantile,
+                min_ms=_num(hedge_raw, "min-ms", 20.0, 0.0),
+                max_ms=_num(hedge_raw, "max-ms", 250.0, 1.0),
+                fallback_ms=_num(hedge_raw, "fallback-ms", 0.0, 0.0),
+            ),
             l2=ClusterL2Config(
                 uri=l2_uri,
                 ttl_s=_num(l2_raw, "ttl-s", 3600.0, 0.0),

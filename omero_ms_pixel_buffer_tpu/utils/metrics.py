@@ -122,6 +122,33 @@ class Histogram:
                     exemplar, value, time.time()
                 )
 
+    def quantile(self, q: float, **labels) -> Optional[float]:
+        """Upper-bound estimate of the ``q`` quantile for one
+        labelset: the smallest bucket upper edge at which the
+        cumulative count reaches ``q x total``. None before any
+        observation. An answer in the +Inf bucket resolves to the
+        largest finite edge — the histogram cannot see past its
+        buckets, and callers (the cluster hedge policy) clamp anyway.
+        Coarse by construction (bucket resolution), cheap by
+        construction (one pass over ~14 buckets)."""
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            counts = self._counts.get(key)
+            if counts is None:
+                return None
+            counts = list(counts)
+        total = sum(counts)
+        if total <= 0:
+            return None
+        target = q * total
+        cum = 0
+        for edge, count in zip(self.buckets, counts):
+            cum += count
+            if cum >= target and edge != float("inf"):
+                return float(edge)
+        finite = [b for b in self.buckets if b != float("inf")]
+        return float(finite[-1]) if finite else None
+
     def attach_exemplar(
         self, value: float, exemplar: str, **labels
     ) -> None:

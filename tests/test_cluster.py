@@ -1,0 +1,1484 @@
+"""Cluster coordination plane (cluster/): the r17 layers.
+
+Unit lanes: HMAC security (sign/verify, skew, tamper), epoch registry
+(stamps, staleness, bumps), histogram quantile, hedge delay math,
+ring preference lists (the successor property replication relies on),
+replicator qualification + transfer framing, membership leases against
+the RESP stub, fleet brains (pressure + breaker suspicion), breaker
+suspect semantics, scheduler fleet-degrade, cluster config validation.
+
+Chaos lanes (``-m resilience``): a THREE-replica loopback cluster —
+lease expiry mid-traffic, join warm-up byte identity, an epoch-stamped
+purge beating an in-flight L2 fill, hedged peer fetch under a wedged
+owner, split-brain bounded disagreement, owner-kill failover on a
+replicated hot set, and the 403 matrix for the authenticated peer
+surface.
+"""
+
+import asyncio
+import json
+import socket
+import time
+
+import numpy as np
+import pytest
+from aiohttp import ClientSession, web
+
+from omero_ms_pixel_buffer_tpu.auth.stores import MemorySessionStore
+from omero_ms_pixel_buffer_tpu.cache.plane.l2 import (
+    RedisL2Tier,
+    decode_entry_epoch,
+    encode_entry,
+)
+from omero_ms_pixel_buffer_tpu.cache.plane.resp_stub import (
+    InMemoryRespServer,
+)
+from omero_ms_pixel_buffer_tpu.cache.plane.ring import HashRing
+from omero_ms_pixel_buffer_tpu.cache.result_cache import CachedTile
+from omero_ms_pixel_buffer_tpu.cluster import (
+    EpochRegistry,
+    FleetBrains,
+    HedgePolicy,
+    HotSetReplicator,
+    MembershipManager,
+    RedisLink,
+    decode_transfer,
+    encode_transfer,
+    image_id_of,
+)
+from omero_ms_pixel_buffer_tpu.cluster.security import (
+    SIG_HEADER,
+    sign,
+    verify,
+)
+from omero_ms_pixel_buffer_tpu.http.server import PixelBufferApp
+from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
+from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+    ImageRegistry,
+    PixelsService,
+)
+from omero_ms_pixel_buffer_tpu.resilience.breaker import (
+    BOARD,
+    CircuitBreaker,
+)
+from omero_ms_pixel_buffer_tpu.resilience import faultinject
+from omero_ms_pixel_buffer_tpu.resilience.faultinject import INJECTOR
+from omero_ms_pixel_buffer_tpu.resilience.scheduler import (
+    SloScheduler,
+)
+from omero_ms_pixel_buffer_tpu.resilience.timeouts import set_io_timeout
+from omero_ms_pixel_buffer_tpu.resilience import AdmissionController
+from omero_ms_pixel_buffer_tpu.tile_ctx import TileCtx
+from omero_ms_pixel_buffer_tpu.utils.config import Config, ConfigError
+from omero_ms_pixel_buffer_tpu.utils.metrics import Histogram
+
+rng = np.random.default_rng(17)
+IMG = rng.integers(0, 60000, (1, 1, 2, 256, 256), dtype=np.uint16)
+AUTH = {"Cookie": "sessionid=ck"}
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    INJECTOR.clear()
+    yield
+    INJECTOR.clear()
+    BOARD.reset()
+    set_io_timeout(5.0)
+
+
+# ---------------------------------------------------------------------------
+# security: the HMAC peer surface
+# ---------------------------------------------------------------------------
+
+class TestSecurity:
+    def test_sign_verify_round_trip(self):
+        header = sign("s3cret", "POST", "/internal/purge/7", b"body")
+        assert verify(
+            "s3cret", header, "POST", "/internal/purge/7", b"body"
+        )
+
+    def test_wrong_secret_rejected(self):
+        header = sign("s3cret", "GET", "/internal/transfer")
+        assert not verify("other", header, "GET", "/internal/transfer")
+
+    def test_tampered_fields_rejected(self):
+        header = sign("s", "POST", "/internal/replica", b"frame")
+        assert not verify("s", header, "POST", "/internal/replica",
+                          b"other-frame")
+        assert not verify("s", header, "GET", "/internal/replica",
+                          b"frame")
+        assert not verify("s", header, "POST", "/internal/purge/1",
+                          b"frame")
+
+    def test_clock_skew_window(self):
+        now = time.time()
+        header = sign("s", "GET", "/x", now=now - 3600)
+        assert not verify("s", header, "GET", "/x", now=now)
+        header = sign("s", "GET", "/x", now=now - 10)
+        assert verify("s", header, "GET", "/x", now=now)
+        # future-dated outside the window fails too
+        header = sign("s", "GET", "/x", now=now + 3600)
+        assert not verify("s", header, "GET", "/x", now=now)
+
+    def test_malformed_headers_never_raise(self):
+        for bad in (None, "", "v1", "v1:abc", "v2:1:aa", "v1:x:y",
+                    "v1:" + "9" * 400 + ":zz"):
+            assert not verify("s", bad, "GET", "/x")
+
+
+# ---------------------------------------------------------------------------
+# epochs
+# ---------------------------------------------------------------------------
+
+class TestEpochs:
+    def test_image_id_parsing(self):
+        assert image_id_of("img=42|z=0|c=0|q=x") == 42
+        assert image_id_of("weird-key") is None
+        assert image_id_of("") is None
+
+    def test_note_known_monotonic(self):
+        reg = EpochRegistry()
+        assert reg.known(5) == 0
+        reg.note(5, 3)
+        reg.note(5, 1)  # regressions ignored
+        assert reg.known(5) == 3
+
+    def test_staleness(self):
+        reg = EpochRegistry()
+        reg.note(7, 2)
+        assert reg.is_stale("img=7|z=0", None)      # unstamped = 0
+        assert reg.is_stale("img=7|z=0", 1)
+        assert not reg.is_stale("img=7|z=0", 2)
+        assert not reg.is_stale("img=8|z=0", None)  # unknown image
+        assert reg.stale_reads == 2
+
+    def test_entry_epoch_round_trip(self):
+        entry = CachedTile(b"tile-bytes", filename="t.png")
+        frame = encode_entry(entry, epoch=9)
+        got, epoch = decode_entry_epoch(frame)
+        assert got.body == b"tile-bytes"
+        assert got.etag == entry.etag
+        assert epoch == 9
+        got, epoch = decode_entry_epoch(encode_entry(entry))
+        assert got.body == b"tile-bytes"
+        assert epoch is None  # unstamped writer
+
+    async def test_bump_against_stub(self):
+        server = InMemoryRespServer()
+        await server.start()
+        link = RedisLink(server.uri)
+        reg = EpochRegistry(link)
+        try:
+            assert await reg.bump(3) == 1
+            assert await reg.bump(3) == 2
+            assert reg.known(3) == 2
+        finally:
+            await link.close()
+            await server.close()
+
+    @pytest.mark.resilience
+    async def test_bump_degrades_without_redis(self):
+        link = RedisLink("redis://127.0.0.1:1")  # nobody listening
+        reg = EpochRegistry(link)
+        assert await reg.bump(3) is None
+        # the LOCAL high-water mark still advanced: this replica's own
+        # pushes/reads observe the purge even with Redis down
+        assert reg.known(3) == 1
+        await link.close()
+
+
+# ---------------------------------------------------------------------------
+# histogram quantile + hedge policy
+# ---------------------------------------------------------------------------
+
+class TestHistogramQuantile:
+    def test_empty_is_none(self):
+        h = Histogram("q_test_1", "t")
+        assert h.quantile(0.99, stage="peer") is None
+
+    def test_upper_bound_estimate(self):
+        h = Histogram("q_test_2", "t")
+        for _ in range(99):
+            h.observe(0.004, stage="peer")
+        h.observe(2.0, stage="peer")
+        assert h.quantile(0.5, stage="peer") == 0.005
+        assert h.quantile(0.99, stage="peer") == 0.005
+        assert h.quantile(0.999, stage="peer") == 2.5
+
+    def test_inf_bucket_resolves_to_largest_edge(self):
+        h = Histogram("q_test_3", "t")
+        h.observe(99.0)  # beyond every finite bucket
+        assert h.quantile(0.5) == 10.0
+
+
+class TestHedgePolicy:
+    def test_disabled_is_none(self):
+        assert HedgePolicy(enabled=False).delay_s() is None
+
+    def test_fallback_when_no_samples(self, monkeypatch):
+        p = HedgePolicy(enabled=True, min_s=0.01, max_s=0.5,
+                        fallback_s=0.2)
+        monkeypatch.setattr(
+            HedgePolicy, "_observed_quantile", lambda self: None
+        )
+        assert p.delay_s() == 0.2
+
+    def test_clamping(self, monkeypatch):
+        p = HedgePolicy(enabled=True, min_s=0.05, max_s=0.25)
+        monkeypatch.setattr(
+            HedgePolicy, "_observed_quantile", lambda self: 0.001
+        )
+        assert p.delay_s() == 0.05
+        monkeypatch.setattr(
+            HedgePolicy, "_observed_quantile", lambda self: 3.0
+        )
+        assert p.delay_s() == 0.25
+        monkeypatch.setattr(
+            HedgePolicy, "_observed_quantile", lambda self: 0.1
+        )
+        assert p.delay_s() == 0.1
+
+
+# ---------------------------------------------------------------------------
+# ring preference lists
+# ---------------------------------------------------------------------------
+
+class TestRingOwners:
+    MEMBERS = [f"http://replica-{i}:80" for i in range(5)]
+
+    def test_owners_distinct_and_lead_with_owner(self):
+        ring = HashRing(self.MEMBERS)
+        for i in range(50):
+            owners = ring.owners(f"img=1|x={i}", 3)
+            assert owners[0] == ring.owner(f"img=1|x={i}")
+            assert len(owners) == 3
+            assert len(set(owners)) == 3
+
+    def test_owners_capped_by_ring_size(self):
+        ring = HashRing(self.MEMBERS[:2])
+        assert len(ring.owners("k", 5)) == 2
+
+    def test_successor_becomes_owner_after_departure(self):
+        """THE replication property: when the owner leaves, the
+        rebuilt ring maps each of its keys to exactly the next member
+        on the old preference list — so the replica pushed there
+        before the crash is a hit after it."""
+        ring = HashRing(self.MEMBERS)
+        for i in range(100):
+            key = f"img=1|z=0|x={i}"
+            owner, successor = ring.owners(key, 2)
+            survivors = [m for m in self.MEMBERS if m != owner]
+            rebuilt = HashRing(survivors)
+            assert rebuilt.owner(key) == successor
+
+
+# ---------------------------------------------------------------------------
+# replicator + transfer framing
+# ---------------------------------------------------------------------------
+
+class TestReplicator:
+    def test_targets_exclude_self(self):
+        ring = HashRing(TestRingOwners.MEMBERS)
+        key = "img=1|z=0|x=1"
+        owner = ring.owner(key)
+        rep = HotSetReplicator(owner, replication_factor=3)
+        targets = rep.targets(ring, key)
+        assert owner not in targets
+        assert len(targets) == 2
+        assert targets == ring.owners(key, 3)[1:]
+
+    def test_qualification_and_push_dedupe(self):
+        rep = HotSetReplicator("self", replication_factor=2,
+                               hot_threshold=3)
+        assert not rep.qualifies("k", 2)   # below the bar
+        assert rep.qualifies("k", 3)
+        assert rep.qualifies("k", None)    # no sketch: all fills hot
+        rep.mark_pushed("k")
+        assert not rep.qualifies("k", 99)  # once per ring
+        rep.ring_changed()
+        assert rep.qualifies("k", 3)       # new successors: re-push
+
+    def test_factor_one_never_qualifies(self):
+        rep = HotSetReplicator("self", replication_factor=1)
+        assert not rep.qualifies("k", 99)
+
+    def test_transfer_round_trip(self):
+        items = [
+            (f"img={i}|z=0", f"frame-{i}".encode() * 10)
+            for i in range(5)
+        ]
+        assert decode_transfer(encode_transfer(items)) == items
+
+    def test_transfer_torn_tail_keeps_prefix(self):
+        body = encode_transfer([("k1", b"f1"), ("k2", b"f2")])
+        assert decode_transfer(body[:-3]) == [("k1", b"f1")]
+        assert decode_transfer(b"") == []
+        assert decode_transfer(b"\xff\xff\xff\xff") == []
+
+
+# ---------------------------------------------------------------------------
+# membership against the RESP stub
+# ---------------------------------------------------------------------------
+
+class TestMembership:
+    async def _link(self, server):
+        return RedisLink(server.uri)
+
+    async def test_leases_discover_each_other(self):
+        server = InMemoryRespServer()
+        await server.start()
+        links = [RedisLink(server.uri) for _ in range(3)]
+        urls = [f"http://r{i}:80" for i in range(3)]
+        managers = [
+            MembershipManager(links[i], urls[i], [urls[i]], 5.0)
+            for i in range(3)
+        ]
+        try:
+            for m in managers:
+                assert await m.refresh_once()
+            # the second round sees everyone's lease
+            for m in managers:
+                await m.refresh_once()
+                assert list(m.members) == sorted(urls)
+                assert not m.seeded
+        finally:
+            for link in links:
+                await link.close()
+            await server.close()
+
+    async def test_lease_expiry_removes_member(self):
+        server = InMemoryRespServer()
+        await server.start()
+        link_a = RedisLink(server.uri)
+        link_b = RedisLink(server.uri)
+        changes = []
+        a = MembershipManager(
+            link_a, "http://a:80", ["http://a:80"], 0.2,
+            on_change=lambda add, rm, mem: changes.append((add, rm)),
+        )
+        b = MembershipManager(link_b, "http://b:80", ["http://b:80"],
+                              0.2)
+        try:
+            await b.refresh_once()
+            await a.refresh_once()
+            assert "http://b:80" in a.members
+            # b stops heartbeating; its lease expires within one TTL
+            await asyncio.sleep(0.25)
+            await a.refresh_once()
+            assert "http://b:80" not in a.members
+            joins = [c for c in changes if "http://b:80" in c[0]]
+            leaves = [c for c in changes if "http://b:80" in c[1]]
+            assert joins and leaves
+        finally:
+            await link_a.close()
+            await link_b.close()
+            await server.close()
+
+    @pytest.mark.resilience
+    async def test_redis_down_keeps_last_known_view(self):
+        server = InMemoryRespServer()
+        await server.start()
+        link = RedisLink(server.uri)
+        m = MembershipManager(
+            link, "http://a:80", ["http://a:80", "http://seed:80"],
+            5.0,
+        )
+        try:
+            assert await m.refresh_once()
+            before = m.members
+            await server.close()
+            assert not await m.refresh_once()
+            assert m.members == before  # frozen, not collapsed
+            assert m.refresh_failures == 1
+        finally:
+            await link.close()
+
+
+# ---------------------------------------------------------------------------
+# brains: fleet pressure + dependency suspicion
+# ---------------------------------------------------------------------------
+
+class TestBrains:
+    async def test_publish_collect_round(self):
+        server = InMemoryRespServer()
+        await server.start()
+        links = [RedisLink(server.uri) for _ in range(2)]
+        urls = ["http://a:80", "http://b:80"]
+        sched = SloScheduler(AdmissionController(max_inflight=4),
+                             queue_size=8)
+        a = FleetBrains(links[0], urls[0], scheduler=sched)
+        b = FleetBrains(links[1], urls[1])
+        try:
+            assert await a.publish_once(1.0)
+            assert await b.publish_once(1.0)
+            assert await a.collect_once(urls)
+            assert urls[1] in a.fleet
+            assert a.fleet[urls[1]]["pressure"] == 0.0
+        finally:
+            for link in links:
+                await link.close()
+            await server.close()
+
+    async def test_fleet_pressure_reaches_scheduler(self):
+        server = InMemoryRespServer()
+        await server.start()
+        links = [RedisLink(server.uri) for _ in range(2)]
+        sched = SloScheduler(AdmissionController(max_inflight=4),
+                             queue_size=8)
+        a = FleetBrains(links[0], "http://a:80", scheduler=sched)
+        b = FleetBrains(links[1], "http://b:80")
+        try:
+            # fake a saturated peer brain
+            payload = b.local_payload()
+            payload["pressure"] = 1.0
+            await links[1].command(
+                b"SET", b"ompb:cluster:brain:http://b:80",
+                json.dumps(payload).encode(),
+            )
+            await a.collect_once(["http://a:80", "http://b:80"])
+            assert sched.fleet_pressure == 1.0
+            assert sched.fleet_engaged
+            # calm peer: disengages
+            payload["pressure"] = 0.0
+            await links[1].command(
+                b"SET", b"ompb:cluster:brain:http://b:80",
+                json.dumps(payload).encode(),
+            )
+            await a.collect_once(["http://a:80", "http://b:80"])
+            assert not sched.fleet_engaged
+        finally:
+            for link in links:
+                await link.close()
+            await server.close()
+
+    async def test_majority_open_dep_suspects_local_breaker(self):
+        server = InMemoryRespServer()
+        await server.start()
+        link = RedisLink(server.uri)
+        a = FleetBrains(link, "http://a:80")
+        try:
+            for url in ("http://b:80", "http://c:80"):
+                await link.command(
+                    b"SET", b"ompb:cluster:brain:" + url.encode(),
+                    json.dumps({
+                        "pressure": 0.0, "open": ["postgres:main"],
+                    }).encode(),
+                )
+            await a.collect_once(
+                ["http://a:80", "http://b:80", "http://c:80"]
+            )
+            assert a.suspected == ["postgres:main"]
+            breaker = BOARD.create("postgres:main")
+            assert breaker.snapshot()["suspect"]
+            # ONE local failure trips a suspected breaker
+            breaker.record_failure()
+            assert breaker.state == "open"
+        finally:
+            await link.close()
+            await server.close()
+
+    async def test_collect_failure_decays_fleet_state(self):
+        """Redis dying mid-outage must NOT freeze a saturated fleet
+        view: stale pressure degrading an idle replica for the whole
+        outage would invert the degradation contract (per-process
+        behavior is the fallback)."""
+        server = InMemoryRespServer()
+        await server.start()
+        link = RedisLink(server.uri)
+        sched = SloScheduler(AdmissionController(max_inflight=4),
+                             queue_size=8)
+        a = FleetBrains(link, "http://a:80", scheduler=sched)
+        try:
+            await link.command(
+                b"SET", b"ompb:cluster:brain:http://b:80",
+                json.dumps({"pressure": 1.0, "open": []}).encode(),
+            )
+            await a.collect_once(["http://a:80", "http://b:80"])
+            assert sched.fleet_engaged
+        finally:
+            await link.close()
+            await server.close()
+        # the stub is gone: the failed round reads as a calm fleet
+        assert not await a.collect_once(
+            ["http://a:80", "http://b:80"]
+        )
+        assert not sched.fleet_engaged
+        assert sched.fleet_pressure == 0.0
+
+    async def test_two_replica_fleet_single_peer_is_quorum(self):
+        """With exactly one reporting peer, that peer IS the fleet's
+        voice (suspicion still needs a local failure to confirm); at
+        three reporting peers the bar is a strict majority of 2."""
+        server = InMemoryRespServer()
+        await server.start()
+        link = RedisLink(server.uri)
+        a = FleetBrains(link, "http://a:80")
+        try:
+            await link.command(
+                b"SET", b"ompb:cluster:brain:http://b:80",
+                json.dumps({"open": ["redis:sess"]}).encode(),
+            )
+            await a.collect_once(["http://a:80", "http://b:80"])
+            assert a.suspected == ["redis:sess"]
+        finally:
+            await link.close()
+            await server.close()
+
+    async def test_minority_report_does_not_suspect(self):
+        server = InMemoryRespServer()
+        await server.start()
+        link = RedisLink(server.uri)
+        a = FleetBrains(link, "http://a:80")
+        try:
+            await link.command(
+                b"SET", b"ompb:cluster:brain:http://b:80",
+                json.dumps({"open": ["redis:sess"]}).encode(),
+            )
+            await link.command(
+                b"SET", b"ompb:cluster:brain:http://c:80",
+                json.dumps({"open": []}).encode(),
+            )
+            await link.command(
+                b"SET", b"ompb:cluster:brain:http://d:80",
+                json.dumps({"open": []}).encode(),
+            )
+            await a.collect_once([
+                "http://a:80", "http://b:80", "http://c:80",
+                "http://d:80",
+            ])
+            assert a.suspected == []
+        finally:
+            await link.close()
+            await server.close()
+
+
+class TestBreakerSuspect:
+    def test_suspect_trips_on_first_failure(self):
+        b = CircuitBreaker("dep", failure_threshold=5)
+        b.suspect()
+        b.record_failure()
+        assert b.state == "open"
+
+    def test_success_clears_suspicion(self):
+        b = CircuitBreaker("dep", failure_threshold=5)
+        b.suspect()
+        b.record_success()
+        b.record_failure()
+        assert b.state == "closed"  # back to the full budget
+
+    def test_clear_suspect(self):
+        b = CircuitBreaker("dep", failure_threshold=5)
+        b.suspect()
+        b.clear_suspect()
+        b.record_failure()
+        assert b.state == "closed"
+
+    def test_gossip_alone_never_opens(self):
+        b = CircuitBreaker("dep", failure_threshold=5)
+        for _ in range(10):
+            b.suspect()
+        assert b.state == "closed"
+        b.allow()  # still admits traffic
+
+
+class TestSchedulerFleetDegrade:
+    async def test_fleet_engaged_degrades_uncontended_grants(self):
+        from omero_ms_pixel_buffer_tpu.resilience import Deadline
+
+        sched = SloScheduler(AdmissionController(max_inflight=4),
+                             queue_size=8, degrade_factor=1.5)
+        sched._service_ewma = 0.5  # estimated service: 500 ms
+        tight = Deadline.after(0.2)
+        # uncontended + calm fleet: full resolution
+        assert not sched._degrade_flag(tight, contended=False)
+        sched.note_fleet_pressure(1.0, engaged=True)
+        assert sched._degrade_flag(tight, contended=False)
+        # roomy deadline stays full-res even engaged
+        assert not sched._degrade_flag(
+            Deadline.after(5.0), contended=False
+        )
+        sched.note_fleet_pressure(0.0, engaged=False)
+        assert not sched._degrade_flag(tight, contended=False)
+
+
+# ---------------------------------------------------------------------------
+# cluster config validation
+# ---------------------------------------------------------------------------
+
+class TestClusterConfigExtensions:
+    BASE = {
+        "cluster": {
+            "members": ["http://a:1", "http://b:2"],
+            "self": "http://a:1",
+            "l2": {"uri": "redis://localhost:6379"},
+        },
+    }
+
+    def _cfg(self, **cluster_extra):
+        raw = {
+            "session-store": {"type": "memory"},
+            "cluster": {**self.BASE["cluster"], **cluster_extra},
+        }
+        return Config.from_dict(raw)
+
+    def test_valid_extensions(self):
+        cfg = self._cfg(**{
+            "lease-ttl-s": 5.0, "replication-factor": 2,
+            "transfer-max-entries": 64, "secret": "s3cret",
+            "hedge": {"enabled": True, "min-ms": 10, "max-ms": 100},
+        })
+        cl = cfg.cluster
+        assert cl.lease_ttl_s == 5.0
+        assert cl.replication_factor == 2
+        assert cl.transfer_max_entries == 64
+        assert cl.secret == "s3cret"
+        assert cl.hedge.enabled and cl.hedge.min_ms == 10.0
+
+    def test_defaults_off(self):
+        cfg = Config.from_dict({"session-store": {"type": "memory"}})
+        cl = cfg.cluster
+        assert cl.lease_ttl_s == 0.0
+        assert cl.replication_factor == 1
+        assert cl.secret is None
+        assert not cl.hedge.enabled
+
+    def test_lease_requires_l2(self):
+        with pytest.raises(ConfigError, match="lease-ttl-s"):
+            Config.from_dict({
+                "session-store": {"type": "memory"},
+                "cluster": {
+                    "members": ["http://a:1"], "self": "http://a:1",
+                    "lease-ttl-s": 5.0,
+                },
+            })
+
+    def test_replication_requires_members(self):
+        with pytest.raises(ConfigError, match="replication-factor"):
+            Config.from_dict({
+                "session-store": {"type": "memory"},
+                "cluster": {
+                    "l2": {"uri": "redis://x"},
+                    "replication-factor": 2,
+                },
+            })
+
+    def test_unknown_hedge_key_fails(self):
+        with pytest.raises(ConfigError, match="hedge"):
+            self._cfg(hedge={"enabled": True, "typo-ms": 5})
+
+    def test_bad_quantile_fails(self):
+        with pytest.raises(ConfigError, match="quantile"):
+            self._cfg(hedge={"enabled": True, "quantile": 1.5})
+
+    def test_bad_secret_fails(self):
+        with pytest.raises(ConfigError, match="secret"):
+            self._cfg(secret="   ")
+
+
+# ---------------------------------------------------------------------------
+# the three-replica loopback cluster
+# ---------------------------------------------------------------------------
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _Replica:
+    def __init__(self, app_obj, url, runner):
+        self.app = app_obj
+        self.url = url
+        self.runner = runner
+        self.renders = []
+        self.dead = False
+
+    def count_renders(self):
+        inner_handle = self.app.pipeline.handle
+        inner_batch = self.app.pipeline.handle_batch
+
+        def handle(ctx):
+            self.renders.append(1)
+            return inner_handle(ctx)
+
+        def handle_batch(ctxs, **kw):
+            self.renders.extend([1] * len(ctxs))
+            return inner_batch(ctxs, **kw)
+
+        self.app.pipeline.handle = handle
+        self.app.pipeline.handle_batch = handle_batch
+
+    async def kill(self):
+        if not self.dead:
+            self.dead = True
+            await self.runner.cleanup()
+
+
+async def _boot_replica(
+    img_path, members, self_url, port, resp_uri, cluster_extra=None,
+    cache_overrides=None,
+):
+    registry = ImageRegistry()
+    registry.add(1, img_path)
+    cluster_block = {
+        "members": members,
+        "self": self_url,
+        "peer-timeout-ms": 3000,
+    }
+    if resp_uri:
+        cluster_block["l2"] = {"uri": resp_uri}
+    if cluster_extra:
+        cluster_block.update(cluster_extra)
+    config = Config.from_dict({
+        "session-store": {"type": "memory"},
+        "backend": {"batching": {"coalesce-window-ms": 1.0}},
+        "cache": {
+            "prefetch": {"enabled": False},
+            **(cache_overrides or {}),
+        },
+        "cluster": cluster_block,
+    })
+    app_obj = PixelBufferApp(
+        config,
+        pixels_service=PixelsService(registry),
+        session_store=MemorySessionStore({"ck": "omero-key-1"}),
+    )
+    runner = web.AppRunner(app_obj.make_app())
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", port)
+    await site.start()
+    replica = _Replica(app_obj, self_url, runner)
+    replica.count_renders()
+    return replica
+
+
+async def _make_cluster(
+    tmp_path, n=3, cluster_extra=None, cache_overrides=None, l2=True,
+    member_views=None,
+):
+    """Boot ``n`` replicas (aiohttp TCPSite on loopback) sharing one
+    image fixture and one RESP stub. ``member_views`` overrides each
+    replica's seed list (the split-brain lever)."""
+    img_path = str(tmp_path / "img.ome.tiff")
+    write_ome_tiff(img_path, IMG, tile_size=(64, 64), pyramid_levels=2)
+    resp = None
+    if l2:
+        resp = InMemoryRespServer()
+        await resp.start()
+    ports = [_free_port() for _ in range(n)]
+    members = [f"http://127.0.0.1:{p}" for p in ports]
+    replicas = []
+    for i, port in enumerate(ports):
+        view = (
+            member_views[i] if member_views is not None else members
+        )
+        replicas.append(await _boot_replica(
+            img_path, view, members[i], port,
+            resp.uri if resp else None,
+            cluster_extra=cluster_extra,
+            cache_overrides=cache_overrides,
+        ))
+
+    async def cleanup():
+        for r in replicas:
+            await r.kill()
+        if resp is not None:
+            await resp.close()
+
+    return replicas, resp, cleanup
+
+
+def _tile_paths(n):
+    return [
+        f"/tile/1/0/0/0?x={64 * (i % 4)}&y={64 * (i // 4)}&w=64&h=64"
+        f"&format=png"
+        for i in range(n)
+    ]
+
+
+def _hold_pipeline(replica, seconds):
+    """Delay every render on one replica (single-lane AND batch
+    paths) — the wedged/held-owner lever."""
+    pipeline = replica.app.pipeline
+    inner_handle = pipeline.handle
+    inner_batch = pipeline.handle_batch
+
+    def held(ctx):
+        time.sleep(seconds)
+        return inner_handle(ctx)
+
+    def held_batch(ctxs, **kw):
+        time.sleep(seconds)
+        return inner_batch(ctxs, **kw)
+
+    pipeline.handle = held
+    pipeline.handle_batch = held_batch
+
+
+def _key_for(app_obj, path):
+    """The cache key a tile path resolves to on ``app_obj``."""
+    query = dict(
+        kv.split("=") for kv in path.split("?", 1)[1].split("&")
+    )
+    _, _, image_id, z, c, t = path.split("?", 1)[0].split("/")
+    params = {"imageId": image_id, "z": z, "c": c, "t": t, **query}
+    ctx = TileCtx.from_params(params, None)
+    return ctx.cache_key(app_obj.pipeline.encode_signature())
+
+
+async def _get(http, url, headers=AUTH):
+    async def _one():
+        async with http.get(url, headers=headers) as r:
+            # keep the CIMultiDict: header case is transport detail
+            return r.status, await r.read(), r.headers.copy()
+
+    # hard client-side bound: a wedged replica must fail the test
+    # loudly, never hang the suite
+    return await asyncio.wait_for(_one(), 30.0)
+
+
+# -- membership churn -------------------------------------------------------
+
+class TestMembershipChurn:
+    @pytest.mark.resilience
+    async def test_lease_expiry_mid_traffic(self, tmp_path):
+        """A replica dying mid-traffic expires off the ring within one
+        lease TTL; survivors keep serving throughout (an extra render
+        per disagreed key is the whole cost)."""
+        replicas, resp, cleanup = await _make_cluster(
+            tmp_path, n=3, cluster_extra={"lease-ttl-s": 0.6},
+        )
+        try:
+            await asyncio.sleep(0.5)  # leases discovered
+            plane = replicas[0].app.cache_plane
+            assert len(plane.membership.members) == 3
+            paths = _tile_paths(8)
+            async with ClientSession() as http:
+                for i, path in enumerate(paths):
+                    status, _b, _h = await _get(
+                        http, replicas[i % 3].url + path
+                    )
+                    assert status == 200
+                await replicas[2].kill()
+                deadline = time.monotonic() + 5.0
+                # traffic continues while the lease expires
+                while time.monotonic() < deadline:
+                    for r in replicas[:2]:
+                        status, _b, _h = await _get(
+                            http, r.url + paths[0]
+                        )
+                        assert status == 200
+                    if len(plane.membership.members) == 2:
+                        break
+                    await asyncio.sleep(0.2)
+            assert len(plane.membership.members) == 2
+            assert replicas[2].url not in plane.membership.members
+            assert plane.ring_version >= 1
+            events = [
+                e["event"] for e in plane.membership.events
+                if e["url"] == replicas[2].url
+            ]
+            assert "leave" in events
+        finally:
+            await cleanup()
+
+    @pytest.mark.resilience
+    async def test_join_warm_up_byte_identity(self, tmp_path):
+        """A replica joining an established cluster pulls the hot set
+        within ONE transfer round and serves it byte-identically —
+        ETags included — without rendering."""
+        replicas, resp, cleanup = await _make_cluster(
+            tmp_path, n=2,
+            cluster_extra={
+                "lease-ttl-s": 0.6, "replication-factor": 2,
+            },
+        )
+        joiner = None
+        try:
+            await asyncio.sleep(0.5)
+            paths = _tile_paths(6)
+            expect = {}
+            async with ClientSession() as http:
+                for i, path in enumerate(paths):
+                    status, body, headers = await _get(
+                        http, replicas[i % 2].url + path
+                    )
+                    assert status == 200
+                    expect[path] = (body, headers["ETag"])
+            # a fresh replica joins the same lease space
+            port = _free_port()
+            joiner = await _boot_replica(
+                str(tmp_path / "img.ome.tiff"),
+                [f"http://127.0.0.1:{port}"],
+                f"http://127.0.0.1:{port}", port, resp.uri,
+                cluster_extra={
+                    "lease-ttl-s": 0.6, "replication-factor": 2,
+                },
+            )
+            joiner.count_renders()
+            await asyncio.sleep(0.6)  # first refresh + warm-up round
+            warm = len(joiner.app.result_cache.memory)
+            assert warm >= len(paths), warm
+            # flush the shared L2 so a hit can only come from the
+            # transferred local copy
+            for key in [
+                k for k in resp.data if k.startswith(b"ompb:tile:")
+            ]:
+                del resp.data[key]
+            async with ClientSession() as http:
+                for path in paths:
+                    status, body, headers = await _get(
+                        http, joiner.url + path
+                    )
+                    assert status == 200
+                    assert headers.get("X-Cache") == "hit"
+                    assert (body, headers["ETag"]) == expect[path]
+            assert len(joiner.renders) == 0
+        finally:
+            if joiner is not None:
+                await joiner.kill()
+            await cleanup()
+
+    @pytest.mark.resilience
+    async def test_owner_kill_replicated_hot_set_stays_warm(
+        self, tmp_path
+    ):
+        """The acceptance pin: kill the owner of a replicated hot set
+        (with the shared L2 cold, so only the pushed replicas can
+        answer) — the ring rebuild maps each key to exactly the
+        successor that holds its replica, and >= 80% of the re-
+        requests are hits."""
+        replicas, resp, cleanup = await _make_cluster(
+            tmp_path, n=3,
+            cluster_extra={
+                "lease-ttl-s": 0.6, "replication-factor": 2,
+            },
+        )
+        try:
+            await asyncio.sleep(0.5)
+            paths = _tile_paths(12)
+            async with ClientSession() as http:
+                # touch every tile TWICE through its owner: the second
+                # (hit) crosses the TinyLFU hot bar and pushes to the
+                # ring successor
+                for path in paths:
+                    key = _key_for(replicas[0].app, path)
+                    owner_url = replicas[0].app.cache_plane.ring.owner(
+                        key
+                    )
+                    owner = next(
+                        r for r in replicas if r.url == owner_url
+                    )
+                    for _ in range(2):
+                        status, _b, _h = await _get(
+                            http, owner.url + path
+                        )
+                        assert status == 200
+                await asyncio.sleep(0.5)  # pushes drain
+                received = sum(
+                    r.app.cache_plane.replicator.received
+                    for r in replicas
+                )
+                assert received > 0
+                victim = replicas[0]
+                victim_keys = [
+                    p for p in paths
+                    if replicas[1].app.cache_plane.ring.owner(
+                        _key_for(replicas[1].app, p)
+                    ) == victim.url
+                ]
+                assert victim_keys  # the workload touched its range
+                await victim.kill()
+                # L2 cold: replication is the only warm copy
+                for key in [
+                    k for k in resp.data
+                    if k.startswith(b"ompb:tile:")
+                ]:
+                    del resp.data[key]
+                # survivors observe the lease expire + rebuild
+                deadline = time.monotonic() + 5.0
+                survivors = replicas[1:]
+                while time.monotonic() < deadline:
+                    if all(
+                        len(r.app.cache_plane.membership.members) == 2
+                        for r in survivors
+                    ):
+                        break
+                    await asyncio.sleep(0.1)
+                hits = 0
+                for path in victim_keys:
+                    key = _key_for(survivors[0].app, path)
+                    new_owner_url = (
+                        survivors[0].app.cache_plane.ring.owner(key)
+                    )
+                    new_owner = next(
+                        r for r in survivors
+                        if r.url == new_owner_url
+                    )
+                    status, _b, headers = await _get(
+                        http, new_owner.url + path
+                    )
+                    assert status == 200
+                    if headers.get("X-Cache") == "hit":
+                        hits += 1
+                rate = hits / len(victim_keys)
+                assert rate >= 0.8, (hits, len(victim_keys))
+        finally:
+            await cleanup()
+
+    @pytest.mark.resilience
+    async def test_split_brain_bounded_disagreement(self, tmp_path):
+        """Two replicas with DISAGREEING member views: every tile
+        still serves 200 with identical bytes/ETags from both, no
+        forwarding loop forms, and the whole cost is bounded at one
+        extra render per key (total renders <= 2x unique tiles)."""
+        img_path = str(tmp_path / "img.ome.tiff")
+        write_ome_tiff(
+            img_path, IMG, tile_size=(64, 64), pyramid_levels=2
+        )
+        # explicit disagreement: A sees only itself, B sees both
+        ports = [_free_port(), _free_port()]
+        members = [f"http://127.0.0.1:{p}" for p in ports]
+        a = await _boot_replica(
+            img_path, [members[0]], members[0], ports[0], None,
+        )
+        b = await _boot_replica(
+            img_path, members, members[1], ports[1], None,
+        )
+        try:
+            paths = _tile_paths(8)
+            bodies = {}
+            async with ClientSession() as http:
+                for path in paths:
+                    sa, body_a, ha = await _get(http, a.url + path)
+                    sb, body_b, hb = await _get(http, b.url + path)
+                    assert (sa, sb) == (200, 200)
+                    assert body_a == body_b
+                    assert ha["ETag"] == hb["ETag"]
+                    bodies[path] = body_a
+            total = len(a.renders) + len(b.renders)
+            assert len(paths) <= total <= 2 * len(paths), total
+        finally:
+            await a.kill()
+            await b.kill()
+
+
+# -- epochs over the wire ---------------------------------------------------
+
+class TestEpochInvalidation:
+    @pytest.mark.resilience
+    async def test_epoch_purge_beats_in_flight_fill(self, tmp_path):
+        """A purge landing while a fill is mid-render wins: the fill
+        reaches L2 stamped with the PRE-purge epoch and every
+        epoch-aware reader treats it as a miss — invalidation is no
+        longer TTL-backstopped."""
+        replicas, resp, cleanup = await _make_cluster(tmp_path, n=2)
+        try:
+            path = _tile_paths(1)[0]
+            key = _key_for(replicas[0].app, path)
+            owner_url = replicas[0].app.cache_plane.ring.owner(key)
+            owner = next(r for r in replicas if r.url == owner_url)
+            other = next(r for r in replicas if r.url != owner_url)
+            _hold_pipeline(owner, 0.4)  # hold renders past the purge
+            async with ClientSession() as http:
+                task = asyncio.ensure_future(
+                    _get(http, owner.url + path)
+                )
+                await asyncio.sleep(0.1)  # mid-render
+                owner.app._invalidate_image(1)  # bump + fan-out
+                status, body, _h = await task
+                assert status == 200
+                await asyncio.sleep(0.3)  # fill's L2 publish drains
+                # the stale fill IS physically in Redis ...
+                raw_tier = RedisL2Tier(resp.uri)
+                raw = await raw_tier._guarded(
+                    b"GET", raw_tier._key(key)
+                )
+                await raw_tier.close()
+                assert raw is not None
+                entry, stamp = decode_entry_epoch(raw)
+                assert entry is not None
+                assert (stamp or 0) == 0  # pre-purge snapshot
+                # ... but every epoch-aware reader calls it a miss:
+                # the OTHER replica re-renders instead of serving it
+                before = len(other.renders) + len(owner.renders)
+                status, _b, headers = await _get(
+                    http, other.url + path
+                )
+                assert status == 200
+                assert headers.get("X-Cache") != "l2-hit"
+                after = len(other.renders) + len(owner.renders)
+                assert after == before + 1
+        finally:
+            await cleanup()
+
+    @pytest.mark.resilience
+    async def test_purge_fan_out_carries_epoch(self, tmp_path):
+        """Peer purges advance the receiver's local epoch high-water
+        mark, so an in-flight replica push against a just-purged image
+        is rejected without a Redis round trip."""
+        replicas, resp, cleanup = await _make_cluster(
+            tmp_path, n=2,
+            cluster_extra={"replication-factor": 2},
+        )
+        try:
+            receiver = replicas[1]
+            plane0 = replicas[0].app.cache_plane
+            replicas[0].app._invalidate_image(1)
+            deadline = time.monotonic() + 3.0
+            while time.monotonic() < deadline:
+                if receiver.app.cache_plane.epochs.known(1) >= 1:
+                    break
+                await asyncio.sleep(0.05)
+            assert receiver.app.cache_plane.epochs.known(1) >= 1
+            # a push stamped with the pre-purge epoch is stale here
+            assert receiver.app.cache_plane.replica_push_stale(
+                "img=1|z=0", 0
+            )
+            assert plane0.epochs.known(1) >= 1
+        finally:
+            await cleanup()
+
+
+# -- hedging ----------------------------------------------------------------
+
+class TestHedging:
+    @pytest.mark.resilience
+    async def test_hedged_fetch_under_wedged_owner(self, tmp_path):
+        """The owner wedges mid-render: the non-owner's peer fetch
+        runs past the hedge delay, the local render starts, wins, and
+        the request completes far inside the peer timeout."""
+        replicas, resp, cleanup = await _make_cluster(
+            tmp_path, n=2, l2=False,
+            cluster_extra={"hedge": {
+                "enabled": True, "min-ms": 30, "max-ms": 80,
+                "fallback-ms": 60,
+            }},
+        )
+        try:
+            path = _tile_paths(1)[0]
+            key = _key_for(replicas[0].app, path)
+            owner_url = replicas[0].app.cache_plane.ring.owner(key)
+            owner = next(r for r in replicas if r.url == owner_url)
+            other = next(r for r in replicas if r.url != owner_url)
+            _hold_pipeline(owner, 1.2)  # the owner wedges
+            t0 = time.monotonic()
+            async with ClientSession() as http:
+                status, body, headers = await _get(
+                    http, other.url + path
+                )
+            elapsed = time.monotonic() - t0
+            assert status == 200
+            assert elapsed < 1.0, elapsed  # far under wedge + timeout
+            hedge = other.app.cache_plane.hedge
+            assert hedge.outcomes["fired"] >= 1
+            assert hedge.outcomes["local_win"] >= 1
+            assert len(other.renders) >= 1  # the hedge rendered here
+        finally:
+            await cleanup()
+
+    @pytest.mark.resilience
+    async def test_hedge_peer_win_serves_peer_bytes(self, tmp_path):
+        """The mirror case: the owner answers AFTER the hedge fires
+        but BEFORE the local render finishes — the peer's bytes serve
+        and the local flight is abandoned mid-wait (never killed)."""
+        replicas, resp, cleanup = await _make_cluster(
+            tmp_path, n=2, l2=False,
+            cluster_extra={"hedge": {
+                "enabled": True, "min-ms": 10, "max-ms": 40,
+                "fallback-ms": 20,
+            }},
+        )
+        try:
+            path = _tile_paths(1)[0]
+            key = _key_for(replicas[0].app, path)
+            owner_url = replicas[0].app.cache_plane.ring.owner(key)
+            owner = next(r for r in replicas if r.url == owner_url)
+            other = next(r for r in replicas if r.url != owner_url)
+            async with ClientSession() as http:
+                # warm the owner so its answer is a fast cache hit —
+                # but make the NON-owner's local render glacial
+                status, owner_body, owner_h = await _get(
+                    http, owner.url + path
+                )
+                assert status == 200
+                _hold_pipeline(other, 1.0)  # glacial local render
+                # owner round trips take ~ms; delay the exchange past
+                # the hedge window with injected latency
+                INJECTOR.install(
+                    "cache.peer", faultinject.latency(0.08)
+                )
+                t0 = time.monotonic()
+                status, body, headers = await _get(
+                    http, other.url + path
+                )
+                elapsed = time.monotonic() - t0
+            assert status == 200
+            assert body == owner_body
+            assert headers["ETag"] == owner_h["ETag"]
+            assert headers.get("X-Cache") == "peer-hit"
+            assert elapsed < 0.9, elapsed
+            hedge = other.app.cache_plane.hedge
+            assert hedge.outcomes["fired"] >= 1
+            assert hedge.outcomes["peer_win"] >= 1
+        finally:
+            INJECTOR.clear()
+            await cleanup()
+
+
+class TestRingAppearsLater:
+    async def test_dynamic_only_config_builds_peer_client(self):
+        """A replica configured with ONLY itself + leases (the
+        autoscaling shape: no static peer list) must still be able to
+        peer-fetch once the first scan discovers a peer — the client
+        exists from construction; only the ring is membership-fed."""
+        from omero_ms_pixel_buffer_tpu.cache.plane import CachePlane
+
+        server = InMemoryRespServer()
+        await server.start()
+        plane = CachePlane(
+            members=("http://a:80",),
+            self_url="http://a:80",
+            l2_uri=server.uri,
+            lease_ttl_s=5.0,
+        )
+        try:
+            assert plane.peers is not None
+            assert plane.membership is not None
+            # a peer's lease appears: the rebuild must leave every
+            # peer path (fetch/purge/push) with a live client
+            plane._on_membership_change(
+                ["http://b:80"], [],
+                ("http://a:80", "http://b:80"),
+            )
+            assert plane.ring is not None
+            assert len(plane.ring.members) == 2
+            assert plane.ring_version == 1
+        finally:
+            await plane.close()
+            await server.close()
+
+
+# -- the authenticated peer surface -----------------------------------------
+
+class TestClusterAuth:
+    @pytest.mark.resilience
+    async def test_unauthenticated_internal_surface_403s(
+        self, tmp_path
+    ):
+        """With a secret configured, every /internal/* spelling —
+        purge, replica push, transfer — answers 403 without a valid
+        signature, peer marker or not; and a forged peer marker on a
+        serving path 403s too."""
+        replicas, resp, cleanup = await _make_cluster(
+            tmp_path, n=2,
+            cluster_extra={"secret": "fleet-secret"},
+        )
+        try:
+            url = replicas[0].url
+            async with ClientSession() as http:
+                # no signature at all
+                for method, path, body in (
+                    ("POST", "/internal/purge/1", b""),
+                    ("POST", "/internal/replica", b"frame"),
+                    ("GET", "/internal/transfer", b""),
+                ):
+                    async with http.request(
+                        method, url + path, data=body,
+                        headers={"X-OMPB-Peer": "forged"},
+                    ) as r:
+                        assert r.status == 403, path
+                # garbage signature
+                async with http.post(
+                    url + "/internal/purge/1",
+                    headers={
+                        "X-OMPB-Peer": "forged",
+                        SIG_HEADER: "v1:123:deadbeef",
+                    },
+                ) as r:
+                    assert r.status == 403
+                # stale timestamp (outside the skew window)
+                stale = sign(
+                    "fleet-secret", "POST", "/internal/purge/1",
+                    b"", now=time.time() - 3600,
+                )
+                async with http.post(
+                    url + "/internal/purge/1",
+                    headers={
+                        "X-OMPB-Peer": "x", SIG_HEADER: stale,
+                    },
+                ) as r:
+                    assert r.status == 403
+                # a forged peer marker on a SERVING path — 403, and
+                # the forged trace id is NEVER adopted into the
+                # flight recorder (the obs middleware runs OUTSIDE
+                # the guard so the 403 still records, but adoption
+                # is gated on the same signature check)
+                forged_tid = "f" * 32
+                async with http.get(
+                    url + _tile_paths(1)[0],
+                    headers={
+                        **AUTH,
+                        "X-OMPB-Peer": "forged",
+                        "X-OMPB-Trace-Id": forged_tid,
+                        "X-OMPB-Trace-Span": "a" * 16,
+                    },
+                ) as r:
+                    assert r.status == 403
+                recorder = replicas[0].app.recorder
+                assert all(
+                    e["trace_id"] != forged_tid
+                    for e in recorder.events()
+                )
+                # correctly signed: accepted
+                good = sign(
+                    "fleet-secret", "POST", "/internal/purge/1", b""
+                )
+                async with http.post(
+                    url + "/internal/purge/1",
+                    headers={"X-OMPB-Peer": "x", SIG_HEADER: good},
+                ) as r:
+                    assert r.status == 200
+        finally:
+            await cleanup()
+
+    @pytest.mark.resilience
+    async def test_signed_cluster_still_serves_and_purges(
+        self, tmp_path
+    ):
+        """The whole plane keeps working WITH authentication on: peer
+        fetches carry valid signatures, purge fan-out lands, and a
+        browser request (no cluster identity) never pays the check."""
+        replicas, resp, cleanup = await _make_cluster(
+            tmp_path, n=2,
+            cluster_extra={"secret": "fleet-secret"},
+        )
+        try:
+            paths = _tile_paths(4)
+            async with ClientSession() as http:
+                for i, path in enumerate(paths):
+                    s1, b1, h1 = await _get(
+                        http, replicas[i % 2].url + path
+                    )
+                    s2, b2, h2 = await _get(
+                        http, replicas[(i + 1) % 2].url + path
+                    )
+                    assert (s1, s2) == (200, 200)
+                    assert b1 == b2 and h1["ETag"] == h2["ETag"]
+                # purge fan-out (signed) reaches the peer
+                replicas[0].app._invalidate_image(1)
+                await asyncio.sleep(0.3)
+                assert len(replicas[1].app.result_cache.memory) == 0
+        finally:
+            await cleanup()
+
+    async def test_no_secret_keeps_peer_marker_posture(
+        self, tmp_path
+    ):
+        """Without a secret the previous posture holds: /internal/*
+        requires the peer marker (403 without), network policy is the
+        boundary."""
+        replicas, resp, cleanup = await _make_cluster(tmp_path, n=2)
+        try:
+            async with ClientSession() as http:
+                async with http.post(
+                    replicas[0].url + "/internal/purge/1"
+                ) as r:
+                    assert r.status == 403
+                async with http.get(
+                    replicas[0].url + "/internal/transfer"
+                ) as r:
+                    assert r.status == 403
+                async with http.post(
+                    replicas[0].url + "/internal/purge/1",
+                    headers={"X-OMPB-Peer": "peer"},
+                ) as r:
+                    assert r.status == 200
+        finally:
+            await cleanup()
+
+
+# -- replica push over the wire ---------------------------------------------
+
+class TestReplicaPush:
+    @pytest.mark.resilience
+    async def test_stale_push_rejected(self, tmp_path):
+        """An inbound replica push whose epoch stamp predates a purge
+        this replica has seen is dropped — replication can never
+        resurrect invalidated bytes."""
+        replicas, resp, cleanup = await _make_cluster(
+            tmp_path, n=2,
+            cluster_extra={"replication-factor": 2},
+        )
+        try:
+            receiver = replicas[1]
+            receiver.app.cache_plane.epochs.note(1, 5)
+            frame = encode_entry(
+                CachedTile(b"stale-bytes", filename="t.png"), epoch=4
+            )
+            async with ClientSession() as http:
+                async with http.post(
+                    receiver.url + "/internal/replica",
+                    data=frame,
+                    headers={
+                        "X-OMPB-Peer": "peer",
+                        "X-OMPB-Key": "img=1|z=0|stale",
+                    },
+                ) as r:
+                    assert r.status == 200
+                    payload = await r.json()
+            assert payload == {"stored": False, "stale": True}
+            assert receiver.app.result_cache.contains(
+                "img=1|z=0|stale"
+            ) is False
+            # a fresh-epoch push stores
+            frame = encode_entry(
+                CachedTile(b"fresh-bytes", filename="t.png"), epoch=5
+            )
+            async with ClientSession() as http:
+                async with http.post(
+                    receiver.url + "/internal/replica",
+                    data=frame,
+                    headers={
+                        "X-OMPB-Peer": "peer",
+                        "X-OMPB-Key": "img=1|z=0|fresh",
+                    },
+                ) as r:
+                    assert (await r.json()) == {"stored": True}
+            assert receiver.app.result_cache.contains(
+                "img=1|z=0|fresh"
+            )
+        finally:
+            await cleanup()
+
+    @pytest.mark.resilience
+    async def test_healthz_reports_cluster(self, tmp_path):
+        replicas, resp, cleanup = await _make_cluster(
+            tmp_path, n=2,
+            cluster_extra={
+                "lease-ttl-s": 0.6, "replication-factor": 2,
+                "secret": "s",
+                "hedge": {"enabled": True},
+            },
+        )
+        try:
+            await asyncio.sleep(0.4)
+            async with ClientSession() as http:
+                async with http.get(
+                    replicas[0].url + "/healthz"
+                ) as r:
+                    health = await r.json()
+            cluster = health["cluster"]
+            assert cluster["enabled"]
+            assert cluster["authenticated"]
+            assert cluster["membership"]["lease_ttl_s"] == 0.6
+            assert len(cluster["membership"]["members"]) == 2
+            assert cluster["replication"]["factor"] == 2
+            assert cluster["hedge"]["enabled"]
+            assert "brains" in cluster
+            assert "epochs" in cluster
+            assert "coord_link" in cluster
+        finally:
+            await cleanup()

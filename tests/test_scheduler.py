@@ -1111,6 +1111,105 @@ class TestOverloadDoorGate:
             gate.set()
             await client.close()
 
+    async def _saturate(self, app_obj, client, gate):
+        """Fill the one execution slot and the one queue seat with
+        gated misses; returns the futures to release at teardown."""
+        inner = app_obj.pipeline.handle
+
+        def gated(ctx):
+            gate.wait(10.0)
+            return inner(ctx)
+
+        app_obj.pipeline.handle = gated
+        occ = asyncio.ensure_future(
+            client.get(self._url(32, 0), headers=AUTH)
+        )
+        await asyncio.sleep(0.1)
+        waiter = asyncio.ensure_future(
+            client.get(self._url(0, 32), headers=AUTH)
+        )
+        await asyncio.sleep(0.05)  # queue genuinely full
+        return occ, waiter
+
+    async def test_door_exempts_normalized_w0_spelling(
+        self, tmp_path, loop
+    ):
+        """The door probe normalizes w/h=0 full-plane defaulting the
+        way _serve does (via the open-buffer extent peek), so a tile
+        cached under its EXPLICIT spelling no longer door-sheds when
+        the w=0 spelling asks for it under genuine overflow — the
+        KNOWN_GAPS unnormalized-probe item."""
+        gate = threading.Event()
+        app_obj, client = await _make_app(
+            tmp_path,
+            resilience={"admission": {"max-inflight": 1}},
+            slo={"queue-size": 1, "degrade": False},
+            workers=2, cache=True,
+        )
+        try:
+            # fill under the EXPLICIT full-plane spelling (the serve
+            # path normalizes w=0 to this same key, and opens the
+            # buffer the door's extent peek answers from)
+            r = await client.get(
+                "/tile/1/0/0/0?x=0&y=0&w=64&h=64&format=png",
+                headers=AUTH,
+            )
+            assert r.status == 200
+            occ, waiter = await self._saturate(app_obj, client, gate)
+            # the w=0 spelling of the SAME tile passes the door
+            r = await client.get(
+                "/tile/1/0/0/0?w=0&h=0&format=png", headers=AUTH
+            )
+            assert r.status == 200
+            assert r.headers.get("X-Cache") == "hit"
+            # an uncached tile still sheds
+            r = await client.get(self._url(32, 32), headers=AUTH)
+            assert r.status == 503
+            gate.set()
+            r0, r1 = await asyncio.gather(occ, waiter)
+            assert (r0.status, r1.status) == (200, 200)
+        finally:
+            gate.set()
+            await client.close()
+
+    async def test_door_exempts_cached_render_tiles(
+        self, tmp_path, loop
+    ):
+        """/render requests parse their spec at the door (pure
+        grammar + LUT registry — no I/O) instead of being
+        categorically unprobeable: a cached rendered tile passes the
+        door under genuine overflow like any raw hit."""
+        gate = threading.Event()
+        app_obj, client = await _make_app(
+            tmp_path,
+            resilience={"admission": {"max-inflight": 1}},
+            slo={"queue-size": 1, "degrade": False},
+            workers=2, cache=True,
+        )
+        render_url = (
+            "/render/1/0/0/0?x=0&y=0&w=32&h=32&c=1|0:65535$FF0000"
+        )
+        try:
+            r = await client.get(render_url, headers=AUTH)
+            assert r.status == 200  # fills the render cache entry
+            occ, waiter = await self._saturate(app_obj, client, gate)
+            r = await client.get(render_url, headers=AUTH)
+            assert r.status == 200
+            assert r.headers.get("X-Cache") == "hit"
+            # an uncached render spec still sheds at the door
+            r = await client.get(
+                "/render/1/0/0/0?x=32&y=0&w=32&h=32"
+                "&c=1|0:65535$FF0000",
+                headers=AUTH,
+            )
+            assert r.status == 503
+            gate.set()
+            r0, r1 = await asyncio.gather(occ, waiter)
+            assert (r0.status, r1.status) == (200, 200)
+        finally:
+            gate.set()
+            await client.close()
+
 
 @pytest.mark.resilience
 class TestSweepDemotionHttp:

@@ -357,6 +357,11 @@ _RESILIENCE_SCOPE = (
     # the rule for five rounds — its batch POST must carry the same
     # breaker gate + fault point + per-call timeout as every edge
     "omero_ms_pixel_buffer_tpu/utils/tracing.py",
+    # the cluster coordination plane (r17): the coordination RESP
+    # link is the one raw network primitive here (membership leases,
+    # epoch bumps, and brain exchanges all ride it); every future
+    # remote call added to this package must arrive wrapped too
+    "omero_ms_pixel_buffer_tpu/cluster/",
 )
 
 _NET_PRIMITIVES: List[Tuple[Optional[str], str, str]] = [
