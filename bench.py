@@ -907,6 +907,292 @@ def bench_cluster(cache_dir: str) -> dict:
     return out
 
 
+def bench_lifecycle(cache_dir: str) -> dict:
+    """Fleet lifecycle plane (r18) section — two drives, two pins:
+
+    - ``rolling_restart``: a three-replica cluster (leases +
+      replication + graceful drain) is restarted one replica at a
+      time under live traffic: each replica drains (lease marker,
+      full-RAM handoff, quiesce, lease release), is killed, the
+      shared L2's tile keys are FLUSHED (so the handed-off RAM
+      copies are the only warm source), and a replacement boots on
+      the same identity and warms via the join transfer. Pin
+      ``cluster_ok_drain_zero_errors``: ZERO serving 5xx across the
+      whole drive AND warm-hit rate >= 0.95 — a planned leave rides
+      the warm path, not the crash path (the crash-path bench above
+      pins only >= 0.8).
+    - ``repair``: a hot entry whose replica push is deliberately
+      dropped is healed by the anti-entropy digest exchange. Pin
+      ``cluster_ok_repair_convergence``: repaired within ONE
+      rotation over the peers (<= 2 rounds in a 3-replica fleet).
+    """
+    import socket
+
+    from aiohttp import ClientSession, web
+
+    from omero_ms_pixel_buffer_tpu.auth.stores import MemorySessionStore
+    from omero_ms_pixel_buffer_tpu.cache.plane.resp_stub import (
+        InMemoryRespServer,
+    )
+    from omero_ms_pixel_buffer_tpu.http.server import PixelBufferApp
+    from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
+    from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+        ImageRegistry,
+        PixelsService,
+    )
+    from omero_ms_pixel_buffer_tpu.tile_ctx import TileCtx
+    from omero_ms_pixel_buffer_tpu.utils.config import Config
+
+    out: dict = {}
+    headers = {"Cookie": "sessionid=bench-cookie"}
+    peer_headers = {**headers, "X-OMPB-Peer": "bench-ops"}
+    img_path = os.path.join(cache_dir, "cluster_fixture.ome.tiff")
+    if not os.path.exists(img_path):
+        rng_local = np.random.default_rng(23)
+        img = rng_local.integers(
+            0, 60000, (1, 1, 1, 512, 512), dtype=np.uint16
+        )
+        write_ome_tiff(
+            img_path, img, tile_size=(64, 64), pyramid_levels=2
+        )
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def tile_paths(n):
+        return [
+            f"/tile/1/0/0/0?x={64 * (i % 8)}&y={64 * (i // 8)}"
+            "&w=64&h=64&format=png"
+            for i in range(n)
+        ]
+
+    def key_for(app_obj, path):
+        query = dict(
+            kv.split("=") for kv in path.split("?", 1)[1].split("&")
+        )
+        _, _, image_id, z, c, t = path.split("?", 1)[0].split("/")
+        ctx = TileCtx.from_params(
+            {"imageId": image_id, "z": z, "c": c, "t": t, **query},
+            None,
+        )
+        return ctx.cache_key(app_obj.pipeline.encode_signature())
+
+    def lifecycle_block(extra=None):
+        return {
+            "lease-ttl-s": 0.5, "replication-factor": 2,
+            "drain": {"deadline-s": 5, "signal": False},
+            **(extra or {}),
+        }
+
+    async def boot(members, self_url, port, resp_uri, extra):
+        registry = ImageRegistry()
+        registry.add(1, img_path)
+        cluster_block = {
+            "members": members, "self": self_url,
+            "peer-timeout-ms": 3000, **(extra or {}),
+        }
+        if resp_uri:
+            cluster_block["l2"] = {"uri": resp_uri}
+        config = Config.from_dict({
+            "session-store": {"type": "memory"},
+            "backend": {"batching": {"coalesce-window-ms": 1.0}},
+            "cache": {"prefetch": {"enabled": False}},
+            "cluster": cluster_block,
+        })
+        app_obj = PixelBufferApp(
+            config,
+            pixels_service=PixelsService(registry),
+            session_store=MemorySessionStore(
+                {"bench-cookie": "bench-key"}
+            ),
+        )
+        runner = web.AppRunner(app_obj.make_app(), access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        await site.start()
+        return app_obj, runner
+
+    n_hot = 16
+    warm_sources = ("hit", "l2-hit", "peer-hit")
+
+    async def rolling_restart() -> dict:
+        resp = InMemoryRespServer()
+        await resp.start()
+        ports = [free_port() for _ in range(3)]
+        members = [f"http://127.0.0.1:{p}" for p in ports]
+        nodes = []
+        for i, port in enumerate(ports):
+            nodes.append(await boot(
+                members, members[i], port, resp.uri,
+                lifecycle_block(),
+            ))
+        statuses: list = []
+        sources: list = []
+        try:
+            await asyncio.sleep(0.4)  # leases discovered
+            paths = tile_paths(n_hot)
+            async with ClientSession() as http:
+                for path in paths:  # warm every replica
+                    for app_obj, _r in nodes:
+                        async with http.get(
+                            app_obj.cache_plane.self_url + path,
+                            headers=headers,
+                        ) as r:
+                            assert r.status == 200, await r.text()
+
+                async def traffic_round(live):
+                    for path in paths:
+                        for app_obj, _r in live:
+                            async with http.get(
+                                app_obj.cache_plane.self_url + path,
+                                headers=headers,
+                            ) as r:
+                                await r.read()
+                                statuses.append(r.status)
+                                sources.append(
+                                    r.headers.get("X-Cache")
+                                )
+
+                handoff_pushed = 0
+                for i in range(3):
+                    victim_app, victim_runner = nodes[i]
+                    victim_url = victim_app.cache_plane.self_url
+                    survivors = [
+                        n for j, n in enumerate(nodes) if j != i
+                    ]
+
+                    async def _drain():
+                        async with http.post(
+                            victim_url + "/internal/drain?wait=1",
+                            headers=peer_headers,
+                        ) as r:
+                            return r.status, await r.json()
+
+                    drain_task = asyncio.ensure_future(_drain())
+                    while not drain_task.done():
+                        await traffic_round(survivors)
+                        await asyncio.sleep(0.02)
+                    status, drained = await drain_task
+                    assert status == 200, drained
+                    handoff_pushed += drained["stats"]["handoff"][
+                        "pushed"
+                    ]
+                    await victim_runner.cleanup()
+                    for key in [
+                        k for k in resp.data
+                        if k.startswith(b"ompb:tile:")
+                    ]:
+                        del resp.data[key]
+                    for _ in range(2):
+                        await traffic_round(survivors)
+                    nodes[i] = await boot(
+                        members, victim_url, ports[i], resp.uri,
+                        lifecycle_block(),
+                    )
+                    deadline = time.monotonic() + 6.0
+                    while time.monotonic() < deadline:
+                        if all(
+                            len(a.cache_plane.membership.members) == 3
+                            for a, _r in nodes
+                        ):
+                            break
+                        await traffic_round(survivors)
+                        await asyncio.sleep(0.1)
+            errors = sum(1 for s in statuses if s >= 500)
+            warm = sum(1 for s in sources if s in warm_sources)
+            return {
+                "requests": len(statuses),
+                "serving_errors": errors,
+                "warm_hits": warm,
+                "warm_hit_rate": round(warm / max(1, len(sources)), 3),
+                "handoff_pushed": handoff_pushed,
+            }
+        finally:
+            for _a, runner in nodes:
+                try:
+                    await runner.cleanup()
+                except Exception:
+                    pass
+            await resp.close()
+
+    out["rolling_restart"] = asyncio.run(rolling_restart())
+
+    async def repair_drive() -> dict:
+        resp = InMemoryRespServer()
+        await resp.start()
+        ports = [free_port() for _ in range(3)]
+        members = [f"http://127.0.0.1:{p}" for p in ports]
+        nodes = []
+        for i, port in enumerate(ports):
+            nodes.append(await boot(
+                members, members[i], port, resp.uri,
+                lifecycle_block({"repair": {"interval-s": 60}}),
+            ))
+        try:
+            await asyncio.sleep(0.4)
+            apps = {
+                a.cache_plane.self_url: a for a, _r in nodes
+            }
+            plane0 = nodes[0][0].cache_plane
+            target = None
+            for path in tile_paths(n_hot):
+                key = key_for(nodes[0][0], path)
+                owners = plane0.ring.owners(key, 2)
+                if len(owners) == 2:
+                    target = (path, key, owners[0], owners[1])
+                    break
+            path, key, owner_url, succ_url = target
+            owner, succ = apps[owner_url], apps[succ_url]
+
+            async def lost_push(*a, **k):
+                return None
+
+            owner.cache_plane._push_replicas = lost_push
+            async with ClientSession() as http:
+                for _ in range(2):  # second touch crosses the hot bar
+                    async with http.get(
+                        owner_url + path, headers=headers
+                    ) as r:
+                        assert r.status == 200
+            rounds = 0
+            repaired = False
+            for _ in range(2):  # one rotation over the peers
+                rounds += 1
+                await succ.cache_plane.repair_round()
+                if succ.result_cache.contains(key):
+                    repaired = True
+                    break
+            return {
+                "repaired": repaired,
+                "rounds_to_converge": rounds if repaired else None,
+                "round_bound": 2,
+                "repairer": succ.cache_plane.repairer.snapshot(),
+            }
+        finally:
+            for _a, runner in nodes:
+                await runner.cleanup()
+            await resp.close()
+
+    out["repair"] = asyncio.run(repair_drive())
+
+    rr = out["rolling_restart"]
+    out["cluster_ok_drain_zero_errors"] = (
+        rr["serving_errors"] == 0
+        and rr["warm_hit_rate"] >= 0.95
+        and rr["requests"] > 0
+    )
+    out["cluster_ok_repair_convergence"] = (
+        out["repair"]["repaired"]
+        and out["repair"]["rounds_to_converge"]
+        <= out["repair"]["round_bound"]
+    )
+    return out
+
+
 def bench_overload(
     cache_dir: str,
     duration_s: float = 4.0,
@@ -1953,6 +2239,19 @@ def main():
             cluster_stats = {"error": f"{type(e).__name__}: {e}"}
             log(f"cluster bench failed: {e!r}")
 
+    # --- fleet lifecycle plane (r18): rolling restart under traffic
+    # (graceful drain + handoff + join warm-up) and anti-entropy
+    # repair convergence (cluster_ok_drain_zero_errors /
+    # cluster_ok_repair_convergence pins)
+    lifecycle_stats: dict = {}
+    if os.environ.get("BENCH_LIFECYCLE", "1") != "0":
+        try:
+            lifecycle_stats = bench_lifecycle(cache_dir)
+            log(f"lifecycle: {lifecycle_stats}")
+        except Exception as e:
+            lifecycle_stats = {"error": f"{type(e).__name__}: {e}"}
+            log(f"lifecycle bench failed: {e!r}")
+
     # --- batched read plane (r14): cold remote reads over a loopback
     # HTTP object store — sequential vs parallel+coalesced, sharded
     # byte identity, requests-per-tile (io_ok_* pins)
@@ -2028,6 +2327,8 @@ def main():
         record["cache_plane"] = plane_stats
     if cluster_stats:
         record["cluster"] = cluster_stats
+    if lifecycle_stats:
+        record["lifecycle"] = lifecycle_stats
     if overload_stats:
         record["overload"] = overload_stats
     if io_stats:
@@ -2112,6 +2413,16 @@ def main():
         )
         comparison["cluster_unhedged_peer_p99_ms"] = (
             cluster_stats["hedge"]["unhedged"]["p99_ms"]
+        )
+    if lifecycle_stats and "rolling_restart" in lifecycle_stats:
+        comparison["cluster_drain_serving_errors"] = (
+            lifecycle_stats["rolling_restart"]["serving_errors"]
+        )
+        comparison["cluster_drain_warm_hit_rate"] = (
+            lifecycle_stats["rolling_restart"]["warm_hit_rate"]
+        )
+        comparison["cluster_repair_rounds_to_converge"] = (
+            lifecycle_stats["repair"]["rounds_to_converge"]
         )
     record["engine_comparison"] = comparison
     print(json.dumps(record))

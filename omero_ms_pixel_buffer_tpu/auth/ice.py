@@ -273,7 +273,22 @@ class IceSessionValidator(SessionValidator):
         t0 = time.monotonic()  # slow-call input (chaos latency included)
         try:
             await INJECTOR.fire_async("auth.ice")
-            joined, _reason = await self._client.create_session(key, key)
+            try:
+                joined, _reason = await self._client.create_session(
+                    key, key
+                )
+            except (ConnectionError, EOFError, OSError,
+                    asyncio.IncompleteReadError):
+                # reconnect-once (the wire-client recovery every
+                # other remote edge has): each attempt dials a fresh
+                # connection, so a stale NAT mapping or a router
+                # restart between keepalives costs one redial, not a
+                # user-visible auth failure. Timeouts deliberately do
+                # NOT retry — a silent router would park the worker
+                # for a second full window.
+                joined, _reason = await self._client.create_session(
+                    key, key
+                )
         except ServiceUnavailableError:
             raise
         except Exception:

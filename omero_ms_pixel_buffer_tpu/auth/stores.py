@@ -28,6 +28,7 @@ from ..errors import ServiceUnavailableError
 from ..resilience.breaker import BreakerOpenError, for_dependency
 from ..resilience.faultinject import INJECTOR
 from ..resilience.timeouts import io_timeout_s
+from ..utils.connstate import ConnState
 from .django import decode_session_payload, extract_omero_session_key
 
 # Store-down (breaker open / backend unreachable) raises
@@ -77,24 +78,27 @@ class RedisSessionStore(OmeroWebSessionStore):
             ":1:django.contrib.sessions.cached_db{sid}",
             "{sid}",
         ]
-        self._reader: Optional[asyncio.StreamReader] = None
-        self._writer: Optional[asyncio.StreamWriter] = None
+        # transport state in the one holder (utils/connstate):
+        # exchanges run under the op lock, teardown runs lock-free
+        # off the terminal `closed` flag
+        self._conn = ConnState()
         self._lock = asyncio.Lock()
         self.breaker = for_dependency(
             f"session-store:redis:{self.host}:{self.port}"
         )
 
     async def _connect(self) -> None:
-        self._reader, self._writer = await asyncio.open_connection(
+        reader, writer = await asyncio.open_connection(
             self.host, self.port
         )
+        self._conn.attach(reader, writer)
         if self.password:
             await self._command(b"AUTH", self.password.encode())
         if self.db:
             await self._command(b"SELECT", str(self.db).encode())
 
     async def _command(self, *parts: bytes):
-        w, r = self._writer, self._reader
+        w, r = self._conn.writer, self._conn.reader
         out = b"*%d\r\n" % len(parts)
         for p in parts:
             out += b"$%d\r\n%s\r\n" % (len(p), p)
@@ -123,9 +127,7 @@ class RedisSessionStore(OmeroWebSessionStore):
         raise RuntimeError(f"unexpected redis reply: {line!r}")
 
     async def _reset(self) -> None:
-        if self._writer is not None:
-            self._writer.close()  # drop the dead/desynced transport
-            self._writer = None
+        self._conn.drop()  # the dead/desynced transport
         await self._connect()
 
     async def get_omero_session_key(self, session_id: str) -> Optional[str]:
@@ -150,12 +152,10 @@ class RedisSessionStore(OmeroWebSessionStore):
             else:
                 result = await self._faulted_lookup(session_id)
         except asyncio.TimeoutError:
-            # mid-protocol connection is desynced: drop it (under the
-            # lock — the cancelled lookup has released it)
-            async with self._lock:
-                if self._writer is not None:
-                    self._writer.close()
-                    self._writer = None
+            # mid-protocol connection is desynced: drop it (the
+            # cancelled lookup has released the lock; the holder's
+            # drop is a lock-free atomic swap either way)
+            self._conn.drop()
             self.breaker.record_failure()
             raise
         except (ConnectionError, EOFError, OSError,
@@ -182,7 +182,9 @@ class RedisSessionStore(OmeroWebSessionStore):
 
     async def _lookup(self, session_id: str) -> Optional[str]:
         async with self._lock:
-            if self._writer is None:
+            if self._conn.closed:
+                raise ConnectionError("session store closed")
+            if not self._conn.connected:
                 await self._connect()
             for pattern in self.key_patterns:
                 key = pattern.format(sid=session_id)
@@ -203,13 +205,15 @@ class RedisSessionStore(OmeroWebSessionStore):
         return None
 
     async def close(self) -> None:
-        if self._writer is not None:
-            self._writer.close()
+        """Terminal teardown: lock-free closed-flag + drop (utils/
+        connstate) — never parked behind a wedged lookup; a lookup
+        arriving later raises instead of reconnecting."""
+        writer = self._conn.close()
+        if writer is not None:
             try:
-                await self._writer.wait_closed()
+                await writer.wait_closed()
             except Exception:
                 pass
-            self._writer = None
 
 
 class EchoSessionStore(OmeroWebSessionStore):

@@ -16,7 +16,13 @@ Since r17 the plane also hosts the cluster coordination loop
 epoch stamps that make invalidation win every race, next-owner
 replication of the hot set with a join-time warm-up transfer,
 owner-side hedging off the observed peer p99, and the fleet brain
-exchange. All of it degrades: a dead Redis freezes the membership
+exchange. Since r18 it owns the fleet-lifecycle mechanics too: the
+graceful-drain steps (lease marker, full-RAM handoff to the
+post-drain owners, lease release) the DrainCoordinator sequences,
+the low-duty anti-entropy repair loop (digest exchange with one
+rotating peer per round), and the quality-demotion sink (a quorum-
+demoted replica leaves every ownership ring until its signals
+recover). All of it degrades: a dead Redis freezes the membership
 view, a dead peer skips its round, and the serving path never sees an
 exception.
 
@@ -33,16 +39,20 @@ import time
 from typing import Optional, Tuple
 
 from ...cluster import (
+    AntiEntropyRepairer,
     EpochRegistry,
     FleetBrains,
     HedgePolicy,
     HotSetReplicator,
     MembershipManager,
     RedisLink,
+    build_digest,
     decode_transfer,
     encode_transfer,
     image_id_of,
+    parse_digest,
 )
+from ...cluster.repair import REPAIR_PULLED, REPAIR_ROUNDS
 from ...cluster.replicate import REPLICATION
 from ...obs.recorder import ambient_stage, current_record
 from ...utils.metrics import REGISTRY
@@ -80,6 +90,10 @@ class CachePlane:
         result_cache=None,
         scheduler=None,
         admission=None,
+        repair_interval_s: float = 0.0,
+        repair_max_keys: int = 64,
+        quality=None,
+        suspicion=None,
     ):
         self.self_url = self_url
         self.secret = secret
@@ -112,6 +126,14 @@ class CachePlane:
             )
         if members and self_url:
             self.ring = HashRing(members, virtual_nodes)
+        # fleet lifecycle state (r18): replicas the quality quorum
+        # demoted (never owners until restored) and this replica's own
+        # draining flag (set by the drain protocol; excludes self from
+        # its own ring so final fills route to the post-drain owners)
+        self.demoted: frozenset = frozenset()
+        self.draining = False
+        self.quality = quality
+        self.suspicion = suspicion
         self.membership: Optional[MembershipManager] = None
         self.brains: Optional[FleetBrains] = None
         if lease_ttl_s > 0 and self.link is not None and self_url:
@@ -122,6 +144,12 @@ class CachePlane:
             self.brains = FleetBrains(
                 self.link, self_url,
                 scheduler=scheduler, admission=admission,
+                quality=quality, suspicion=suspicion,
+                peer_failures_source=(
+                    self.peers.take_failures
+                    if self.peers is not None else None
+                ),
+                on_demote=self._on_demote,
             )
         self.replicator: Optional[HotSetReplicator] = None
         if replication_factor > 1 and self.peers is not None:
@@ -129,6 +157,20 @@ class CachePlane:
                 self_url,
                 replication_factor=replication_factor,
                 transfer_max_entries=transfer_max_entries,
+            )
+        # anti-entropy repair (cluster/repair.py): only meaningful
+        # over replication — without a factor there is nothing the
+        # contract says this replica should hold for anyone else
+        self.repairer: Optional[AntiEntropyRepairer] = None
+        if (
+            repair_interval_s > 0
+            and self.replicator is not None
+            and self_url
+        ):
+            self.repairer = AntiEntropyRepairer(
+                self_url,
+                interval_s=repair_interval_s,
+                max_keys=repair_max_keys,
             )
         # gated on the CLIENT, not the ring: with dynamic membership
         # the ring may only materialize after the first lease scan
@@ -138,20 +180,38 @@ class CachePlane:
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._tasks: set = set()
         self._warmed_up = False
+        self._closed = False
 
     # -- lifecycle -----------------------------------------------------
 
     def start(self, loop: asyncio.AbstractEventLoop) -> None:
         """Capture the serving loop (invalidation listeners fire from
         resolver threads and need somewhere to schedule the fan-out)
-        and start the coordination loop when membership is dynamic."""
+        and start the coordination loop when membership is dynamic
+        (plus the low-duty anti-entropy loop when repair is on)."""
         self._loop = loop
         if self.membership is not None:
             self._spawn(self._coord_loop())
+        if self.repairer is not None:
+            self._spawn(self._repair_loop())
 
     async def close(self) -> None:
-        for task in list(self._tasks):
+        # the closed flag FIRST: `asyncio.wait_for` (< 3.12) can
+        # SWALLOW a cancellation that races its inner future's
+        # completion (bpo-42130) — on a loopback fleet the coord
+        # exchanges complete in microseconds, so a cancel landing
+        # mid-heartbeat has a real chance of being eaten, and a
+        # cancel-only close would leave the loop heartbeating a
+        # closed link forever. The background loops re-check the
+        # flag every round, so even a swallowed cancel exits at the
+        # next loop top; the bounded wait drains them without
+        # letting a pathological case park shutdown.
+        self._closed = True
+        tasks = [t for t in self._tasks if not t.done()]
+        for task in tasks:
             task.cancel()
+        if tasks:
+            await asyncio.wait(tasks, timeout=2.0)
         if self.l2 is not None:
             await self.l2.close()
         if self.link is not None:
@@ -180,9 +240,9 @@ class CachePlane:
         each round degrades independently."""
         membership = self.membership
         first = True
-        while True:
+        while not self._closed:
             ok = await membership.refresh_once()
-            if self.brains is not None:
+            if self.brains is not None and not self._closed:
                 await self.brains.publish_once(membership.interval_s)
                 await self.brains.collect_once(membership.members)
             if first and ok:
@@ -195,12 +255,41 @@ class CachePlane:
             await asyncio.sleep(membership.interval_s)
 
     def _on_membership_change(self, added, removed, members) -> None:
-        """Rebuild the ownership ring from the new lease view. The
+        self._rebuild_ring(members)
+
+    def _on_demote(self, demoted: frozenset) -> None:
+        """Quality-quorum sink (cluster/suspect via brains): demoted
+        replicas stay in the member view (they serve on) but leave
+        the ownership ring until the quorum dissolves."""
+        if demoted == self.demoted:
+            return
+        self.demoted = demoted
+        self._rebuild_ring()
+
+    def _ring_eligible(self, members=None) -> tuple:
+        """Who may OWN keys right now: the live member view minus
+        draining replicas (planned leave announced), minus quality-
+        demoted replicas, minus self while this replica drains."""
+        eligible = set(
+            members if members is not None else self.members_view()
+        )
+        if self.membership is not None:
+            eligible -= set(self.membership.draining)
+        eligible -= set(self.demoted)
+        if self.draining and self.self_url in eligible:
+            eligible.discard(self.self_url)
+        return tuple(sorted(eligible))
+
+    def _rebuild_ring(self, members=None) -> None:
+        """Rebuild the ownership ring from the eligible view. The
         swap is a single reference assignment (readers mid-request
         keep the ring they started with — bounded-disagreement
-        semantics cover the window)."""
+        semantics cover the window). An EMPTY eligible view keeps the
+        last ring: lifecycle filters must never collapse routing to
+        nothing."""
+        eligible = self._ring_eligible(members)
         try:
-            self.ring = HashRing(members, self.virtual_nodes)
+            self.ring = HashRing(eligible, self.virtual_nodes)
         except ValueError:
             return  # empty view: keep the last ring
         self.ring_version += 1
@@ -208,9 +297,13 @@ class CachePlane:
         if self.replicator is not None:
             # new ring, new successors: let hot keys re-replicate
             self.replicator.ring_changed()
+        if self.repairer is not None:
+            # ownership moved: stale digest checksums must not skip
+            # peers whose holdings-for-us just changed
+            self.repairer.ring_changed()
         log.info(
-            "ownership ring rebuilt (v%d): %d members",
-            self.ring_version, len(members),
+            "ownership ring rebuilt (v%d): %d owners",
+            self.ring_version, len(eligible),
         )
 
     async def _warm_up_once(self) -> None:
@@ -268,6 +361,229 @@ class CachePlane:
                 continue
             await cache.put(key, entry, generation=cache.generation())
             stored += 1
+        return stored
+
+    # -- graceful drain (cluster/lifecycle.py owns the timeline) -------
+
+    def drain_propagation_s(self) -> float:
+        """How long the drain waits after announcing so peers observe
+        the marker (one heartbeat interval, with margin) before the
+        handoff lands at the post-drain owners."""
+        if self.membership is not None:
+            return self.membership.interval_s * 1.5
+        return 0.05  # static membership: nothing to propagate
+
+    async def begin_drain(self) -> bool:
+        """Drain step 1: announce the planned leave. The local ring
+        rebuilds WITHOUT self immediately (final fills and the
+        handoff both route to the post-drain owners); the lease
+        marker makes every peer do the same within one heartbeat."""
+        self.draining = True
+        announced = False
+        if self.membership is not None:
+            announced = await self.membership.mark_draining()
+        self._rebuild_ring()
+        return announced
+
+    async def handoff_hot_set(
+        self, deadline: float, clock=time.monotonic
+    ) -> dict:
+        """Drain step 2: the FULL RAM hot set — not just the TinyLFU-
+        qualified slice replication already pushed — grouped by post-
+        drain owner and POSTed as transfer-framed batches. Bounded by
+        the transfer byte cap per target and the drain deadline
+        overall (``deadline`` and ``clock`` share the drain
+        coordinator's clock domain); a dead target costs its batch
+        (those keys re-render once at the new owner), never the
+        drain."""
+        cache = self.result_cache
+        stats = {"entries": 0, "targets": 0, "pushed": 0, "errors": 0}
+        if (
+            cache is None or self.peers is None or self.ring is None
+            or not self.ring.members
+        ):
+            return stats
+        try:
+            items = cache.memory.items_snapshot()
+        except Exception:
+            return stats
+        by_target: dict = {}
+        for key, entry in items:
+            target = self.ring.owner(key)
+            if target == self.self_url:
+                continue  # ring still thinks we own it: nowhere to go
+            epoch = None
+            if self.epochs is not None:
+                image_id = image_id_of(key)
+                if image_id is not None:
+                    epoch = self.epochs.known(image_id)
+            by_target.setdefault(target, []).append(
+                (key, encode_entry(entry, epoch=epoch))
+            )
+        stats["entries"] = sum(len(v) for v in by_target.values())
+        stats["targets"] = len(by_target)
+        for target, entries in by_target.items():
+            if clock() >= deadline:
+                stats["errors"] += 1
+                log.warning("drain handoff: deadline expired with "
+                            "%s unpushed", target)
+                continue
+            payload = encode_transfer(entries)
+            ok = await self.peers.push_handoff(target, payload)
+            if ok:
+                stats["pushed"] += len(entries)
+                REPLICATION.inc(op="handoff", outcome="ok")
+            else:
+                stats["errors"] += 1
+                REPLICATION.inc(op="handoff", outcome="error")
+        return stats
+
+    async def release_lease(self) -> bool:
+        """Drain step 4: leave the fleet for good."""
+        if self.membership is not None:
+            return await self.membership.release_lease()
+        return True
+
+    async def absorb_handoff(self, body: bytes) -> int:
+        """Inbound half of the drain handoff: transfer-framed entries
+        from a draining peer, admitted through the same epoch-checked
+        path as a join warm-up (a handoff can never resurrect purged
+        bytes)."""
+        stored = await self._absorb_transfer(body)
+        if self.replicator is not None:
+            self.replicator.received += stored
+        REPLICATION.inc(op="handoff_recv", outcome="ok")
+        return stored
+
+    # -- anti-entropy repair (cluster/repair.py) -----------------------
+
+    def digest_limit(self) -> int:
+        if self.replicator is not None:
+            return max(
+                self.replicator.transfer_max_entries,
+                self.repairer.max_keys if self.repairer else 0,
+            )
+        return self.repairer.max_keys if self.repairer else 64
+
+    def digest_payload(self, limit: int) -> bytes:
+        """The /internal/digest response: a compact (key, epoch)
+        summary of this replica's hottest RAM entries — what the
+        replication contract says its successors should hold."""
+        cache = self.result_cache
+        if cache is None or limit <= 0:
+            return build_digest([])
+        items = []
+        for key, _entry in cache.hot_entries(limit):
+            epoch = None
+            if self.epochs is not None:
+                image_id = image_id_of(key)
+                if image_id is not None:
+                    epoch = self.epochs.known(image_id)
+            items.append((key, epoch))
+        if self.repairer is not None:
+            self.repairer.digests_served += 1
+        return build_digest(items)
+
+    async def pull_payload(self, keys: list) -> bytes:
+        """The /internal/pull response: the requested entries (those
+        present locally), transfer-framed and byte-bounded. The key
+        count is bounded by the digest limit — a peer can never ask
+        for more than a digest could have named."""
+        cache = self.result_cache
+        out = []
+        if cache is not None:
+            for key in list(keys)[: self.digest_limit()]:
+                if not isinstance(key, str):
+                    continue
+                entry = await cache.get(key)
+                if entry is None:
+                    continue
+                epoch = None
+                if self.epochs is not None:
+                    image_id = image_id_of(key)
+                    if image_id is not None:
+                        epoch = self.epochs.known(image_id)
+                out.append((key, encode_entry(entry, epoch=epoch)))
+        return encode_transfer(out)
+
+    async def _repair_loop(self) -> None:
+        """The low-duty anti-entropy cadence: one digest exchange
+        with one rotating peer per interval. Every failure skips the
+        round — repair never competes with serving and never fails
+        anything."""
+        rep = self.repairer
+        while not self._closed:
+            await asyncio.sleep(rep.interval_s)
+            if self.draining or self._closed:
+                continue  # a leaving replica repairs nothing
+            try:
+                await self.repair_round()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.debug("repair round failed", exc_info=True)
+
+    async def repair_round(self) -> int:
+        """One anti-entropy round; how many entries were pulled (the
+        chaos suite drives this directly to pin convergence)."""
+        rep = self.repairer
+        if rep is None or self.peers is None or self.ring is None:
+            return 0
+        candidates = [
+            m for m in self._ring_eligible() if m != self.self_url
+        ]
+        peer = rep.next_peer(candidates)
+        if peer is None:
+            return 0
+        rep.rounds += 1
+        body = await self.peers.get_digest(peer, self.digest_limit())
+        if body is None:
+            REPAIR_ROUNDS.inc(outcome="digest_error")
+            return 0
+        digest = parse_digest(body)
+        if digest is None:
+            REPAIR_ROUNDS.inc(outcome="corrupt")
+            return 0
+        if rep.unchanged(peer, digest["sum"]):
+            rep.skipped_unchanged += 1
+            rep.last_round_pulled = 0
+            REPAIR_ROUNDS.inc(outcome="unchanged")
+            return 0
+        cache = self.result_cache
+        factor = (
+            self.replicator.replication_factor
+            if self.replicator is not None else 1
+        )
+        wanted = rep.select_missing(
+            peer, digest["entries"], self.ring, factor,
+            has_local=(
+                cache.contains_any_tier if cache is not None
+                else lambda _k: True
+            ),
+            is_stale=(
+                self.epochs.is_stale if self.epochs is not None
+                else lambda _k, _e: False
+            ),
+        )
+        if not wanted:
+            rep.last_round_pulled = 0
+            rep.note_synced(peer, digest["sum"])
+            REPAIR_ROUNDS.inc(outcome="in_sync")
+            return 0
+        frames = await self.peers.pull_keys(peer, wanted)
+        if frames is None:
+            rep.pull_errors += 1
+            REPAIR_ROUNDS.inc(outcome="pull_error")
+            return 0
+        stored = await self._absorb_transfer(frames)
+        rep.pulled += stored
+        rep.last_round_pulled = stored
+        if stored:
+            REPAIR_PULLED.inc(stored)
+            log.info("anti-entropy: pulled %d entries from %s",
+                     stored, peer)
+        rep.note_synced(peer, digest["sum"])
+        REPAIR_ROUNDS.inc(outcome="repaired")
         return stored
 
     # -- serving path --------------------------------------------------
@@ -568,7 +884,15 @@ class CachePlane:
             "self": self.self_url,
             "ring_version": self.ring_version,
             "authenticated": bool(self.secret),
+            "draining": self.draining,
+            "demoted": sorted(self.demoted),
         }
+        if self.repairer is not None:
+            out["repair"] = self.repairer.snapshot()
+        if self.quality is not None:
+            out["quality"] = self.quality.snapshot()
+        if self.suspicion is not None and self.suspicion.enabled:
+            out["suspicion"] = self.suspicion.snapshot()
         if self.link is not None:
             out["coord_link"] = self.link.snapshot()
         if self.membership is not None:

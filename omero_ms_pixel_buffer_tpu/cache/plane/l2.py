@@ -35,6 +35,7 @@ from urllib.parse import urlparse
 from ...resilience.breaker import BreakerOpenError, for_dependency
 from ...resilience.faultinject import INJECTOR
 from ...resilience.timeouts import io_timeout_s
+from ...utils.connstate import ConnState
 from ...utils.metrics import REGISTRY
 from ..result_cache import CachedTile
 
@@ -121,24 +122,27 @@ class RedisL2Tier:
         # writer's observed epoch — cluster invalidation stops being
         # TTL-backstopped
         self.epochs = epochs
-        self._reader: Optional[asyncio.StreamReader] = None
-        self._writer: Optional[asyncio.StreamWriter] = None
+        # transport state in the one holder (utils/connstate):
+        # exchanges run under the op lock, teardown runs lock-free
+        # off the terminal `closed` flag
+        self._conn = ConnState()
         self._lock = asyncio.Lock()
         self.breaker = for_dependency("cache:l2")
 
     # -- RESP2 plumbing (the auth-store client shape) ------------------
 
     async def _connect(self) -> None:
-        self._reader, self._writer = await asyncio.open_connection(
+        reader, writer = await asyncio.open_connection(
             self.host, self.port
         )
+        self._conn.attach(reader, writer)
         if self.password:
             await self._command(b"AUTH", self.password.encode())
         if self.db:
             await self._command(b"SELECT", str(self.db).encode())
 
     async def _command(self, *parts: bytes):
-        w, r = self._writer, self._reader
+        w, r = self._conn.writer, self._conn.reader
         out = b"*%d\r\n" % len(parts)
         for p in parts:
             out += b"$%d\r\n%s\r\n" % (len(p), p)
@@ -167,15 +171,17 @@ class RedisL2Tier:
         raise RuntimeError(f"unexpected redis reply: {line!r}")
 
     async def _reset(self) -> None:
-        if self._writer is not None:
-            self._writer.close()
-            self._writer = None
+        self._conn.drop()
         await self._connect()
 
     async def _exchange(self, *parts: bytes):
-        """One serialized command with reconnect-once semantics."""
+        """One serialized command with reconnect-once semantics. A
+        CLOSED tier raises (reads as a miss upstream) instead of
+        reconnecting under the owner's teardown."""
         async with self._lock:
-            if self._writer is None:
+            if self._conn.closed:
+                raise ConnectionError("l2 tier closed")
+            if not self._conn.connected:
                 await self._connect()
             try:
                 return await self._command(*parts)
@@ -201,11 +207,9 @@ class RedisL2Tier:
                 result = await self._exchange(*parts)
         except asyncio.TimeoutError:
             # mid-protocol connection is desynced: drop it so the next
-            # call starts clean instead of reading a stale reply
-            async with self._lock:
-                if self._writer is not None:
-                    self._writer.close()
-                    self._writer = None
+            # call starts clean instead of reading a stale reply (the
+            # holder's drop is a lock-free atomic swap)
+            self._conn.drop()
             self.breaker.record_failure()
             raise
         except (ConnectionError, EOFError, OSError,
@@ -335,13 +339,14 @@ class RedisL2Tier:
         return removed
 
     async def close(self) -> None:
-        if self._writer is not None:  # ompb-lint: disable=lock-discipline -- teardown path: taking the op lock here could park close() behind a wedged exchange (the auth-store close precedent)
-            self._writer.close()
+        """Terminal teardown: lock-free closed-flag + drop (utils/
+        connstate) — never parked behind a wedged exchange."""
+        writer = self._conn.close()
+        if writer is not None:
             try:
-                await self._writer.wait_closed()
+                await writer.wait_closed()
             except Exception:
                 pass
-            self._writer = None
 
     def snapshot(self) -> dict:
         return {

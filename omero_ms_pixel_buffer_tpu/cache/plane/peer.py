@@ -91,6 +91,10 @@ class PeerClient:
         # receiving side can reject forged cluster identity
         self.secret = secret
         self._breakers: Dict[str, object] = {}
+        # per-member failure counts since the last take — the quality-
+        # suspicion signal (cluster/suspect): a peer this client keeps
+        # failing against is observably sick whatever its lease says
+        self._failures: Dict[str, int] = {}
 
     def _breaker(self, member: str):
         b = self._breakers.get(member)
@@ -135,10 +139,20 @@ class PeerClient:
             raise
         except Exception:
             breaker.record_failure()
+            self._failures[member] = self._failures.get(member, 0) + 1
             PEER_REQUESTS.inc(outcome=outcome_prefix + "error")
             return None
         breaker.record_success(duration_s=time.monotonic() - t0)
         return result
+
+    def take_failures(self) -> Dict[str, int]:
+        """Per-member failure counts since the last take (reset on
+        read) — one brain-heartbeat window's worth of peer-observed
+        sickness (cluster/suspect.py). Breaker-open rejections do NOT
+        count: an open breaker already stopped observing, and counting
+        its fast-fails would keep a recovered peer demoted forever."""
+        taken, self._failures = self._failures, {}
+        return taken
 
     async def fetch(
         self,
@@ -224,6 +238,63 @@ class PeerClient:
         PEER_REQUESTS.inc(outcome="transfer_ok")
         return result[2]
 
+    async def push_handoff(self, member: str, payload: bytes) -> bool:
+        """Graceful-drain handoff (cluster/lifecycle.py): POST one
+        transfer-framed batch of this replica's RAM hot set to a
+        post-drain owner. Best-effort — a dead successor costs its
+        batch (those keys re-render once), never the drain."""
+        result = await self._bounded(
+            member, "POST", "/internal/handoff",
+            body=payload, outcome_prefix="handoff_",
+        )
+        if result is None:
+            return False
+        ok = result[0] == 200
+        PEER_REQUESTS.inc(
+            outcome="handoff_ok" if ok else "handoff_rejected"
+        )
+        return ok
+
+    async def get_digest(
+        self, member: str, limit: int
+    ) -> Optional[bytes]:
+        """Anti-entropy round, step 1 (cluster/repair.py): one peer's
+        compact hot-set digest. None on any failure (the round is
+        skipped; the next rotation retries)."""
+        result = await self._bounded(
+            member, "GET", f"/internal/digest?limit={int(limit)}",
+            outcome_prefix="digest_",
+        )
+        if result is None or result[0] != 200:
+            if result is not None:
+                PEER_REQUESTS.inc(outcome="digest_rejected")
+            return None
+        PEER_REQUESTS.inc(outcome="digest_ok")
+        return result[2]
+
+    async def pull_keys(
+        self, member: str, keys: list
+    ) -> Optional[bytes]:
+        """Anti-entropy round, step 3: the missing entries, transfer-
+        framed. The key list rides a JSON body (cache keys are long;
+        a query string would not bound them)."""
+        import json as _json
+
+        body = _json.dumps({"keys": list(keys)}).encode()
+        result = await self._bounded(
+            member, "POST", "/internal/pull",
+            body=body, extra_headers={
+                "Content-Type": "application/json"
+            },
+            outcome_prefix="pull_",
+        )
+        if result is None or result[0] != 200:
+            if result is not None:
+                PEER_REQUESTS.inc(outcome="pull_rejected")
+            return None
+        PEER_REQUESTS.inc(outcome="pull_ok")
+        return result[2]
+
     async def _exchange(
         self,
         member: str,
@@ -237,7 +308,7 @@ class PeerClient:
         parsed = urlparse(member)
         host = parsed.hostname or "localhost"
         port = parsed.port or 80
-        reader, writer = await asyncio.open_connection(host, port)
+        reader, writer = await asyncio.open_connection(host, port)  # ompb-lint: disable=resilience-coverage -- deliberately single-attempt: every peer op has a cheap local fallback (render locally, skip the round, expire by TTL) that a retry would only delay — the short peer timeout IS the tail bound, and a redial would spend it twice
         try:
             lines = [
                 f"{method} {path_qs} HTTP/1.1",
@@ -250,9 +321,13 @@ class PeerClient:
             if self.secret:
                 from ...cluster.security import SIG_HEADER, sign
 
+                # peer= the X-OMPB-Peer value this request carries:
+                # the claimed identity is inside the MAC, so a
+                # captured signature cannot be replayed under a
+                # rotated peer name
                 lines.append(
                     f"{SIG_HEADER}: "
-                    f"{sign(self.secret, method, path_qs, body)}"
+                    f"{sign(self.secret, method, path_qs, body, peer=self.self_url)}"
                 )
             if trace_context:
                 tid = trace_context.get("trace_id")

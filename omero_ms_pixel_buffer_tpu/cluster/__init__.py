@@ -30,7 +30,27 @@ bounded peer fetch. This package makes the fleet cluster-*managed*:
   degrade decisions and dead-dependency knowledge are fleet-wide.
 - **security** — HMAC authentication for the ``/internal/*`` peer
   surface (closes the "trusts the network" gap when
-  ``cluster.secret`` is configured).
+  ``cluster.secret`` is configured); replay-proof since r18 — a
+  per-exchange nonce joins the signature and a bounded per-peer
+  nonce cache rejects verbatim replays inside the skew window.
+
+The r18 lifecycle + repair plane makes the fleet self-*healing*:
+
+- **lifecycle** — graceful drain (SIGTERM / signed
+  ``POST /internal/drain``): a planned leave publishes a draining
+  marker on the lease, hands the FULL RAM hot set to the post-drain
+  owners over the transfer framing, quiesces in-flight renders under
+  a bounded deadline, and releases the lease — a rolling restart
+  rides a zero-5xx warm path instead of the crash path.
+- **repair** — low-duty anti-entropy: a bounded digest exchange with
+  one rotating peer per round pulls replicated entries this replica
+  missed (lost push, evicted copy, joined mid-burst), converging
+  within one rotation and never competing with serving.
+- **suspect** — quality-based suspicion riding the brain exchange:
+  per-replica serve-quality signals (error rate, p99 vs fleet
+  median, peer-observed failures) and a strict-majority quorum
+  demote a sick-but-heartbeating replica to non-owner until its
+  signals recover.
 
 Everything here inherits the cache plane's contract: no operation may
 fail a request; every network edge carries a breaker, a fault point,
@@ -41,22 +61,32 @@ behavior.
 from .brains import FleetBrains
 from .epochs import EpochRegistry, image_id_of
 from .hedge import HedgePolicy
+from .lifecycle import DrainCoordinator
 from .link import RedisLink
 from .membership import MembershipManager
+from .repair import AntiEntropyRepairer, build_digest, parse_digest
 from .replicate import HotSetReplicator, decode_transfer, encode_transfer
-from .security import SIG_HEADER, sign, verify
+from .security import NonceCache, SIG_HEADER, sign, verify
+from .suspect import QualityTracker, SuspicionPolicy
 
 __all__ = [
     "FleetBrains",
     "EpochRegistry",
     "image_id_of",
     "HedgePolicy",
+    "DrainCoordinator",
     "RedisLink",
     "MembershipManager",
+    "AntiEntropyRepairer",
+    "build_digest",
+    "parse_digest",
     "HotSetReplicator",
     "encode_transfer",
     "decode_transfer",
+    "NonceCache",
     "SIG_HEADER",
     "sign",
     "verify",
+    "QualityTracker",
+    "SuspicionPolicy",
 ]

@@ -13,7 +13,14 @@ heartbeat cadence:
   time EWMA, whether it is actively shedding, and the names of its
   OPEN breakers;
 - **collect** — every heartbeat, MGET the live members' brains and
-  derive two fleet facts:
+  derive the fleet facts below.
+
+Since r18 the payload also carries SERVE QUALITY (request/error
+counts since the last publish plus a rolling p99 — cluster/suspect)
+and this replica's VERDICTS about its peers; a strict majority of bad
+verdicts demotes a replica to non-owner (it keeps serving, the ring
+stops routing at it) until its signals recover — the "heartbeats but
+serves garbage" detector the lease protocol cannot be. Fleet facts:
 
   * **fleet pressure** — the mean of the peers' pressure readings,
     fed to the local scheduler. A replica with spare capacity under a
@@ -67,15 +74,29 @@ class FleetBrains:
         scheduler=None,
         admission=None,
         pressure_engage: float = 0.9,
+        quality=None,
+        suspicion=None,
+        peer_failures_source=None,
+        on_demote=None,
     ):
         self.link = link
         self.self_url = self_url
         self.scheduler = scheduler
         self.admission = admission
         self.pressure_engage = pressure_engage
+        # quality-based suspicion (cluster/suspect.py): the local
+        # serve-quality tracker feeding the payload, the verdict +
+        # quorum policy, the peer-client failure counters, and the
+        # demotion sink (the cache plane's ring rebuild)
+        self.quality = quality
+        self.suspicion = suspicion
+        self.peer_failures_source = peer_failures_source
+        self.on_demote = on_demote
         self.fleet: Dict[str, dict] = {}
         self.fleet_pressure = 0.0
         self.suspected: List[str] = []
+        self.my_verdicts: List[str] = []
+        self.demoted: List[str] = []
         self.publish_errors = 0
         self.collect_errors = 0
         self._last_shed_total = 0
@@ -105,7 +126,7 @@ class FleetBrains:
             for name, b in BOARD.snapshot().items()
             if b.get("state") == "open"
         ]
-        return {
+        payload = {
             "url": self.self_url,
             "wall": time.time(),
             "pressure": round(min(pressure, 4.0), 4),
@@ -113,6 +134,16 @@ class FleetBrains:
             "shedding": shedding,
             "open": open_deps,
         }
+        if self.quality is not None:
+            # serve-quality window (requests/errors since last
+            # publish, rolling p99) — the suspicion signal
+            payload["q"] = self.quality.take_window()
+        if self.suspicion is not None and self.suspicion.enabled:
+            # verdicts computed at the LAST collect round (publish
+            # precedes collect in the heartbeat — one round of lag,
+            # which the quorum absorbs)
+            payload["bad"] = list(self.my_verdicts)
+        return payload
 
     # -- the exchange ---------------------------------------------------
 
@@ -150,8 +181,9 @@ class FleetBrains:
             log.debug("brain collect failed", exc_info=True)
             # a fleet we cannot hear reads as CALM: stale pressure
             # must not keep the scheduler degrading (or breakers
-            # suspect) for the whole length of a Redis outage —
-            # per-process behavior is the degradation contract
+            # suspect — or a peer DEMOTED) for the whole length of a
+            # Redis outage — per-process behavior is the degradation
+            # contract
             self._apply(0.0, [])
             return False
         fleet: Dict[str, dict] = {}
@@ -183,12 +215,29 @@ class FleetBrains:
         suspects = sorted(
             dep for dep, n in counts.items() if n >= need
         ) if fleet else []
-        self._apply(mean_pressure, suspects)
+        verdicts: List[str] = []
+        demoted: List[str] = []
+        if self.suspicion is not None and self.suspicion.enabled:
+            failures = {}
+            if self.peer_failures_source is not None:
+                try:
+                    failures = self.peer_failures_source() or {}
+                except Exception:
+                    failures = {}
+            verdicts = self.suspicion.verdicts(fleet, failures)
+            demoted = self.suspicion.demoted(
+                fleet, verdicts, tuple(members)
+            )
+        self._apply(mean_pressure, suspects, verdicts, demoted)
         BRAIN_ROUNDS.inc(op="collect", outcome="ok")
         return True
 
     def _apply(
-        self, mean_pressure: float, suspects: List[str]
+        self,
+        mean_pressure: float,
+        suspects: List[str],
+        verdicts: Optional[List[str]] = None,
+        demoted: Optional[List[str]] = None,
     ) -> None:
         self.fleet_pressure = mean_pressure
         FLEET_PRESSURE.set(mean_pressure)
@@ -206,11 +255,38 @@ class FleetBrains:
             if dep not in suspects:
                 BOARD.create(dep).clear_suspect()
         self.suspected = suspects
+        # quality demotions: recomputed from scratch every round (a
+        # quorum that dissolves restores the replica next heartbeat;
+        # a collect failure decays to no demotions at all)
+        self.my_verdicts = list(verdicts or [])
+        new_demoted = list(demoted or [])
+        if new_demoted != self.demoted:
+            for url in new_demoted:
+                if url not in self.demoted:
+                    from .suspect import DEMOTIONS
+
+                    DEMOTIONS.inc()
+                    log.warning(
+                        "quality quorum demoted replica: %s", url
+                    )
+            for url in self.demoted:
+                if url not in new_demoted:
+                    log.info("replica restored to ring: %s", url)
+            self.demoted = new_demoted
+            if self.on_demote is not None:
+                try:
+                    self.on_demote(frozenset(new_demoted))
+                except Exception:
+                    log.exception("demotion hook failed")
+        else:
+            self.demoted = new_demoted
 
     def snapshot(self) -> dict:
         return {
             "fleet_pressure": round(self.fleet_pressure, 4),
             "suspected_deps": list(self.suspected),
+            "my_verdicts": list(self.my_verdicts),
+            "demoted": list(self.demoted),
             "peers": {
                 url: {
                     "pressure": b.get("pressure"),

@@ -27,6 +27,7 @@ from urllib.parse import urlparse
 from ..resilience.breaker import for_dependency
 from ..resilience.faultinject import INJECTOR
 from ..resilience.timeouts import io_timeout_s
+from ..utils.connstate import ConnState
 
 log = logging.getLogger("omero_ms_pixel_buffer_tpu.cluster")
 
@@ -40,22 +41,25 @@ class RedisLink:
         self.port = parsed.port or 6379
         self.db = int(parsed.path.lstrip("/") or 0) if parsed.path else 0
         self.password = parsed.password
-        self._reader: Optional[asyncio.StreamReader] = None
-        self._writer: Optional[asyncio.StreamWriter] = None
+        # transport state in the one holder (utils/connstate):
+        # exchanges run under the op lock, teardown runs lock-free
+        # off the terminal `closed` flag
+        self._conn = ConnState()
         self._lock = asyncio.Lock()
         self.breaker = for_dependency("cluster:coord")
 
     async def _connect(self) -> None:
-        self._reader, self._writer = await asyncio.open_connection(
+        reader, writer = await asyncio.open_connection(
             self.host, self.port
         )
+        self._conn.attach(reader, writer)
         if self.password:
             await self._command(b"AUTH", self.password.encode())
         if self.db:
             await self._command(b"SELECT", str(self.db).encode())
 
     async def _command(self, *parts: bytes):
-        w, r = self._writer, self._reader
+        w, r = self._conn.writer, self._conn.reader
         out = b"*%d\r\n" % len(parts)
         for p in parts:
             out += b"$%d\r\n%s\r\n" % (len(p), p)
@@ -85,7 +89,9 @@ class RedisLink:
 
     async def _exchange(self, *parts: bytes):
         async with self._lock:
-            if self._writer is None:
+            if self._conn.closed:
+                raise ConnectionError("coordination link closed")
+            if not self._conn.connected:
                 await self._connect()
             try:
                 return await self._command(*parts)
@@ -95,9 +101,7 @@ class RedisLink:
                 return await self._command(*parts)
 
     async def _reset(self) -> None:
-        if self._writer is not None:
-            self._writer.close()
-            self._writer = None
+        self._conn.drop()
         await self._connect()
 
     async def command(self, *parts: bytes):
@@ -117,11 +121,9 @@ class RedisLink:
                 result = await self._exchange(*parts)
         except asyncio.TimeoutError:
             # mid-protocol desync: drop the connection so the next
-            # call starts clean instead of reading a stale reply
-            async with self._lock:
-                if self._writer is not None:
-                    self._writer.close()
-                    self._writer = None
+            # call starts clean instead of reading a stale reply (the
+            # holder's drop is a lock-free atomic swap)
+            self._conn.drop()
             self.breaker.record_failure()
             raise
         except (ConnectionError, EOFError, OSError,
@@ -151,13 +153,14 @@ class RedisLink:
         return keys[:limit]
 
     async def close(self) -> None:
-        if self._writer is not None:  # ompb-lint: disable=lock-discipline -- teardown path: taking the op lock could park close() behind a wedged exchange (the L2-tier close precedent)
-            self._writer.close()
+        """Terminal teardown: lock-free closed-flag + drop (utils/
+        connstate) — never parked behind a wedged exchange."""
+        writer = self._conn.close()
+        if writer is not None:
             try:
-                await self._writer.wait_closed()
+                await writer.wait_closed()
             except Exception:
                 pass
-            self._writer = None
 
     def snapshot(self) -> dict:
         return {

@@ -29,6 +29,7 @@ from urllib.parse import parse_qs, unquote, urlparse
 from ..resilience.breaker import BreakerOpenError, for_dependency
 from ..resilience.faultinject import INJECTOR
 from ..resilience.timeouts import io_timeout_s
+from ..utils.connstate import ConnState
 
 
 class PostgresError(RuntimeError):
@@ -164,9 +165,11 @@ class PostgresClient:
     ):
         self.host, self.port = host, port
         self.user, self.password, self.database = user, password, database
-        self._reader: Optional[asyncio.StreamReader] = None
-        self._writer: Optional[asyncio.StreamWriter] = None
-        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        # all transport state lives in the one holder (utils/
+        # connstate): exchanges run under the op lock, teardown runs
+        # lock-free off the terminal `closed` flag — no attribute is
+        # ever guarded on one path and bare on another
+        self._conn = ConnState()
         self._lock = asyncio.Lock()
         # per-connection breaker: a wedged/refusing Postgres fails
         # queries fast instead of stacking connect timeouts, and the
@@ -186,14 +189,14 @@ class PostgresClient:
     # -- framing -----------------------------------------------------------
 
     def _send(self, type_byte: bytes, payload: bytes) -> None:
-        self._writer.write(
+        self._conn.writer.write(
             type_byte + struct.pack("!I", len(payload) + 4) + payload
         )
 
     async def _recv(self) -> Tuple[bytes, bytes]:
-        head = await self._reader.readexactly(5)
+        head = await self._conn.reader.readexactly(5)
         (length,) = struct.unpack("!I", head[1:5])
-        payload = await self._reader.readexactly(length - 4)
+        payload = await self._conn.reader.readexactly(length - 4)
         return head[:1], payload
 
     # -- connect / auth ----------------------------------------------------
@@ -206,17 +209,19 @@ class PostgresClient:
             # silently (sslmode=require already hard-errors in
             # parse_dsn; sslmode=disable records operator intent).
             _warn_plaintext_once(self.host)
-        self._reader, self._writer = await asyncio.open_connection(
+        reader, writer = await asyncio.open_connection(
             self.host, self.port
         )
-        self._loop = asyncio.get_running_loop()
+        self._conn.attach(
+            reader, writer, loop=asyncio.get_running_loop()
+        )
         params = (
             b"user\x00" + self.user.encode() + b"\x00"
             b"database\x00" + self.database.encode() + b"\x00\x00"
         )
         startup = struct.pack("!II", len(params) + 8, 196608) + params
-        self._writer.write(startup)
-        await self._writer.drain()
+        writer.write(startup)
+        await writer.drain()
         await self._authenticate()
         # drain ParameterStatus/BackendKeyData until ReadyForQuery
         while True:
@@ -285,7 +290,7 @@ class PostgresClient:
                 raise PostgresError(
                     {"M": f"unsupported auth method {code}"}
                 )
-            await self._writer.drain()
+            await self._conn.writer.drain()
 
     @staticmethod
     def _error_fields(payload: bytes) -> Dict[str, str]:
@@ -303,13 +308,14 @@ class PostgresClient:
         # Cached connection AND lock are bound to the loop they were
         # created on; callers using short-lived loops (asyncio.run per
         # call) must get fresh ones, not primitives whose futures
-        # belong to a closed loop.
+        # belong to a closed loop. The affinity check MUST precede the
+        # lock — the lock itself may belong to a closed loop and can't
+        # be awaited; the holder's drop() is loop-free by design.
         running = asyncio.get_running_loop()
-        # ompb-lint: disable=lock-discipline -- loop-affinity check MUST precede the lock: the lock itself may belong to a closed loop and can't be awaited
-        if self._loop is not None and self._loop is not running:
+        conn_loop = self._conn.loop
+        if conn_loop is not None and conn_loop is not running:
             await self.close_nowait()
             self._lock = asyncio.Lock()
-        self._loop = running  # ompb-lint: disable=lock-discipline -- same pre-lock affinity bookkeeping
         try:
             self.breaker.allow()
         except BreakerOpenError as e:
@@ -370,9 +376,14 @@ class PostgresClient:
     async def _exchange(self, sql, params):
         """One guarded exchange (fault point + lazy connect + the
         reconnect-once retry); the caller holds the lock and bounds
-        the whole thing with the per-call timeout."""
+        the whole thing with the per-call timeout. A CLOSED client
+        raises instead of reconnecting — a query racing (or trailing)
+        ``close`` must not silently resurrect the transport the owner
+        just tore down."""
         await INJECTOR.fire_async("db.postgres")
-        if self._writer is None:
+        if self._conn.closed:
+            raise ConnectionError("postgres client closed")
+        if not self._conn.connected:
             await self.connect()
         try:
             return await self._query_locked(sql, params)
@@ -398,7 +409,7 @@ class PostgresClient:
         self._send(b"B", bind)
         self._send(b"E", b"\x00" + struct.pack("!I", 0))
         self._send(b"S", b"")
-        await self._writer.drain()
+        await self._conn.writer.drain()
 
         rows: List[Tuple[Optional[str], ...]] = []
         error: Optional[PostgresError] = None
@@ -426,26 +437,27 @@ class PostgresClient:
             # 'C' CommandComplete, 'n' NoData, 'N' Notice: skip
 
     async def close_nowait(self) -> None:
-        if self._writer is not None:
-            try:
-                self._writer.close()
-            except RuntimeError:
-                pass  # transport's loop already closed
-            self._writer = None
-            self._reader = None
-            self._loop = None
+        """Drop the transport (reconnect allowed later): the mid-
+        protocol reset path. Lock-free by design — it runs exactly
+        when the op lock may belong to a dead loop (the affinity
+        reset) or be held by the wedged exchange being reset."""
+        self._conn.drop()
 
     async def close(self) -> None:
-        if self._writer is not None:
+        """Terminal teardown: best-effort Terminate, then the lock-
+        free closed-flag + drop (utils/connstate). A query in flight
+        fails like a transport error; a query arriving later raises
+        instead of reconnecting."""
+        conn = self._conn
+        if conn.connected:
             try:
                 self._send(b"X", b"")  # Terminate
-                await self._writer.drain()
+                await conn.writer.drain()
             except Exception:
                 pass
-            self._writer.close()
+        writer = conn.close()
+        if writer is not None:
             try:
-                await self._writer.wait_closed()
+                await writer.wait_closed()
             except Exception:
                 pass
-            self._writer = None
-            self._reader = None

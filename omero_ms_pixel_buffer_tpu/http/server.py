@@ -42,7 +42,7 @@ from ..cache.plane.peer import (
     TRACE_HEADER,
     TRACE_PARENT_HEADER,
 )
-from ..cluster.security import SIG_HEADER
+from ..cluster.security import SIG_HEADER, NonceCache
 from ..cluster.security import verify as verify_cluster_sig
 from ..cache.prefetch import ViewportPrefetcher
 from ..cache.result_cache import (
@@ -220,13 +220,7 @@ def _peer_claim_verified(app_obj, request: web.Request) -> bool:
     secret = app_obj.config.cluster.secret
     if not secret:
         return True
-    return verify_cluster_sig(
-        secret,
-        request.headers.get(SIG_HEADER),
-        request.method,
-        request.path_qs,
-        b"",
-    )
+    return app_obj.verify_cluster_request(request, b"")
 
 
 def _parse_epoch(value):
@@ -483,19 +477,54 @@ def cluster_guard_middleware(app_obj: "PixelBufferApp"):
                 # aiohttp memoizes the payload: the handler's own
                 # read() gets the same bytes back
                 body = await request.read()
-            if not verify_cluster_sig(
-                secret,
-                request.headers.get(SIG_HEADER),
-                request.method,
-                request.path_qs,
-                body,
-            ):
+            if not app_obj.verify_cluster_request(request, body):
                 return web.Response(
                     status=403, text="invalid cluster signature"
                 )
         elif is_internal and not claims_peer:
             return web.Response(status=403, text="peer requests only")
         return await handler(request)
+
+    return middleware
+
+
+def quality_middleware(app_obj: "PixelBufferApp"):
+    """Serve-quality accounting for the suspicion signal
+    (cluster/suspect.QualityTracker): every serving-path completion —
+    hits, misses, sheds, guard 403s, router 404s — notes its status
+    and wall latency. Installed OUTERMOST (outside even the flight
+    recorder) only when the cluster plane is on; a replica whose
+    front is melting down must not be able to hide it from the
+    fleet by failing before the bookkeeping."""
+
+    @web.middleware
+    async def middleware(request: web.Request, handler):
+        quality = app_obj.quality
+        if (
+            quality is None
+            or not request.path.startswith(SERVING_PREFIXES)
+            or request.method == "OPTIONS"
+        ):
+            return await handler(request)
+        t0 = time.perf_counter()
+        status = 500
+        try:
+            response = await handler(request)
+            status = response.status
+            return response
+        except web.HTTPException as e:
+            status = e.status
+            raise
+        except asyncio.CancelledError:
+            # a client hanging up mid-request (viewport pan aborting
+            # its tile fetches) says nothing about THIS replica's
+            # health — counting it as a 500 would let an aggressive
+            # viewer's aborts quorum-demote a healthy replica
+            status = None
+            raise
+        finally:
+            if status is not None:
+                quality.note(status, time.perf_counter() - t0)
 
     return middleware
 
@@ -718,6 +747,12 @@ class PixelBufferApp:
         self.result_cache: Optional[TileResultCache] = None
         self.prefetcher: Optional[ViewportPrefetcher] = None
         self.cache_plane = None
+        self.quality = None
+        self.drainer = None
+        self._sigterm_installed = False
+        # replay guard for the HMAC peer surface (cluster/security):
+        # nonces accepted inside the skew window, bounded per peer
+        self.cluster_nonces = NonceCache()
         if cc.enabled:
             admission = None
             if cc.tinylfu.enabled:
@@ -744,7 +779,12 @@ class PixelBufferApp:
             cl = config.cluster
             if cl.plane_enabled:
                 from ..cache.plane import CachePlane
-                from ..cluster import HedgePolicy
+                from ..cluster import (
+                    DrainCoordinator,
+                    HedgePolicy,
+                    QualityTracker,
+                    SuspicionPolicy,
+                )
 
                 hedge = None
                 if cl.hedge.enabled:
@@ -759,6 +799,14 @@ class PixelBufferApp:
                             or peer_timeout_s / 2.0
                         ),
                     )
+                self.quality = QualityTracker()
+                suspicion = SuspicionPolicy(
+                    enabled=cl.suspect.enabled,
+                    error_rate=cl.suspect.error_rate,
+                    p99_factor=cl.suspect.p99_factor,
+                    min_requests=cl.suspect.min_requests,
+                    peer_failures=cl.suspect.peer_failures,
+                )
                 self.cache_plane = CachePlane(
                     members=cl.members,
                     self_url=cl.self_url,
@@ -774,6 +822,20 @@ class PixelBufferApp:
                     result_cache=self.result_cache,
                     scheduler=self.scheduler,
                     admission=self.admission,
+                    repair_interval_s=cl.repair.interval_s,
+                    repair_max_keys=cl.repair.max_keys,
+                    quality=self.quality,
+                    suspicion=suspicion,
+                )
+                # the planned-leave protocol (cluster/lifecycle.py):
+                # SIGTERM or POST /internal/drain runs it; the
+                # coordinator owns the timeline, the plane the
+                # mechanics
+                self.drainer = DrainCoordinator(
+                    self.cache_plane,
+                    deadline_s=cl.drain.deadline_s,
+                    admission=self.admission,
+                    scheduler=self.scheduler,
                 )
             if cc.prefetch.enabled:
                 self.prefetcher = ViewportPrefetcher(
@@ -870,11 +932,21 @@ class PixelBufferApp:
             # a record — "every outcome leaves a trace" is the
             # completeness contract the obs tests pin
             middlewares.insert(0, obs_middleware(self))
-        # request-body bound: the only inbound bodies are replica
-        # pushes (/internal/replica — one L2-framed cache entry), so
-        # size the cap to the cache's own entry bound instead of
-        # aiohttp's 1 MiB default silently 413ing large-tile pushes
+        if self.quality is not None:
+            # outside even the recorder: the suspicion signal must
+            # see every serving outcome, whatever layer produced it
+            middlewares.insert(0, quality_middleware(self))
+        # request-body bound: inbound bodies are replica pushes
+        # (/internal/replica — one L2-framed cache entry) and, with
+        # the lifecycle plane, drain-handoff / repair-pull batches
+        # (transfer-framed, hard-capped at the transfer byte bound) —
+        # size the cap accordingly instead of aiohttp's 1 MiB default
+        # silently 413ing them
         max_body = (self.config.cache.max_entry_kb << 10) + 65536
+        if self.cache_plane is not None:
+            from ..cluster.replicate import MAX_TRANSFER_BYTES
+
+            max_body = max(max_body, MAX_TRANSFER_BYTES + 65536)
         app = web.Application(
             middlewares=middlewares, client_max_size=max_body
         )
@@ -901,6 +973,18 @@ class PixelBufferApp:
             )
             app.router.add_get(
                 "/internal/transfer", self.handle_internal_transfer
+            )
+            app.router.add_post(
+                "/internal/handoff", self.handle_internal_handoff
+            )
+            app.router.add_get(
+                "/internal/digest", self.handle_internal_digest
+            )
+            app.router.add_post(
+                "/internal/pull", self.handle_internal_pull
+            )
+            app.router.add_post(
+                "/internal/drain", self.handle_internal_drain
             )
         if self.config.render.enabled:
             app.router.add_get(
@@ -987,6 +1071,30 @@ class PixelBufferApp:
         except TileError:
             return None
 
+    def verify_cluster_request(
+        self, request: web.Request, body: bytes
+    ) -> bool:
+        """One signature verdict per request, memoized on the request
+        object: the obs middleware (trace adoption) and the cluster
+        guard both need it, and the nonce cache consumes a nonce on
+        first acceptance — verifying the same header twice would read
+        the second check as a replay and 403 every legitimately
+        signed peer hop."""
+        cached = request.get("cluster.sig_ok")
+        if cached is not None:
+            return cached
+        ok = verify_cluster_sig(
+            self.config.cluster.secret,
+            request.headers.get(SIG_HEADER),
+            request.method,
+            request.path_qs,
+            body,
+            nonce_cache=self.cluster_nonces,
+            peer=request.headers.get(PEER_HEADER, "-"),
+        )
+        request["cluster.sig_ok"] = ok
+        return ok
+
     def _mesh_manager(self):
         """The live MeshManager, when the device path has built one
         (the prober's lookup hook — the dispatcher is lazy, so this
@@ -1006,12 +1114,57 @@ class PixelBufferApp:
             # the plane needs the serving loop: invalidation listeners
             # fire from resolver threads and schedule their fan-out here
             self.cache_plane.start(asyncio.get_running_loop())
+        if (
+            self.drainer is not None
+            and self.config.cluster.drain.signal
+        ):
+            # SIGTERM = planned leave: run the drain protocol, THEN
+            # the normal graceful exit (aiohttp's own handler would
+            # stop serving immediately — the crash path)
+            import signal as _signal
+
+            try:
+                asyncio.get_running_loop().add_signal_handler(
+                    _signal.SIGTERM, self._on_sigterm
+                )
+                self._sigterm_installed = True
+            except (NotImplementedError, RuntimeError, ValueError):
+                pass  # non-unix / nested loop: endpoint-only drains
+
+    def _on_sigterm(self) -> None:
+        asyncio.ensure_future(self._drain_then_exit())
+
+    async def _drain_then_exit(self) -> None:
+        try:
+            await self.drainer.drain()
+        except Exception:
+            log.exception("drain on SIGTERM failed; exiting anyway")
+        finally:
+            from aiohttp.web_runner import GracefulExit
+
+            def _raise() -> None:
+                raise GracefulExit()  # ompb-lint: disable=error-taxonomy -- not a request path: a bare loop callback raising GracefulExit is exactly how aiohttp's own signal handler stops web.run_app
+
+            # raising from a bare callback propagates out of
+            # run_forever — exactly how aiohttp's own signal handler
+            # stops web.run_app, now one drain later
+            asyncio.get_running_loop().call_soon(_raise)
 
     async def _on_cleanup(self, app) -> None:
         # stop() analog (:298-308): worker, session store, pixel
         # buffers, then the span reporter/sender
         if self.watchdog is not None:
             self.watchdog.stop()
+        if self._sigterm_installed:
+            import signal as _signal
+
+            try:
+                asyncio.get_running_loop().remove_signal_handler(
+                    _signal.SIGTERM
+                )
+            except (NotImplementedError, RuntimeError, ValueError):
+                pass
+            self._sigterm_installed = False
         if self.mesh_prober is not None:
             self.mesh_prober.stop()
         if self.prefetcher is not None:
@@ -1090,6 +1243,10 @@ class PixelBufferApp:
             if self.cache_plane is not None
             else {"enabled": False}
         )
+        if self.drainer is not None:
+            cluster_health["drain"] = self.drainer.snapshot()
+        if self.config.cluster.secret:
+            cluster_health["nonces"] = self.cluster_nonces.snapshot()
         body = {
             "status": "degraded" if degraded else "ok",
             "uptime_s": round(time.time() - self._started_at, 1),
@@ -1615,6 +1772,87 @@ class PixelBufferApp:
         return web.Response(
             body=payload, content_type="application/octet-stream"
         )
+
+    async def handle_internal_handoff(self, request: web.Request) -> web.Response:
+        """Inbound half of the graceful-drain handoff: a draining
+        peer's RAM hot set (transfer framing), absorbed through the
+        same epoch-checked path as a join warm-up — so a rolling
+        restart keeps the fleet's warm-hit rate instead of paying a
+        re-render per key."""
+        if PEER_HEADER not in request.headers:
+            return web.Response(status=403, text="peer requests only")
+        if self.cache_plane is None or self.result_cache is None:
+            return web.Response(status=503, text="cache disabled")
+        body = await request.read()
+        stored = await self.cache_plane.absorb_handoff(body)
+        return web.json_response({"stored": stored})
+
+    async def handle_internal_digest(self, request: web.Request) -> web.Response:
+        """Anti-entropy digest (cluster/repair.py): a compact
+        (key, epoch) summary of this replica's hottest RAM entries,
+        checksummed so an unchanged peer costs one comparison."""
+        if PEER_HEADER not in request.headers:
+            return web.Response(status=403, text="peer requests only")
+        limit = self.cache_plane.digest_limit()
+        raw = request.query.get("limit")
+        if raw is not None:
+            try:
+                limit = min(limit, max(0, int(raw)))
+            except (TypeError, ValueError):
+                return web.Response(status=400, text="bad limit")
+        return web.Response(
+            body=self.cache_plane.digest_payload(limit),
+            content_type="application/json",
+        )
+
+    async def handle_internal_pull(self, request: web.Request) -> web.Response:
+        """Anti-entropy pull: the requested entries (those present
+        locally), transfer-framed. Key count and payload bytes are
+        both bounded — a repair round can never be made expensive by
+        its peer."""
+        if PEER_HEADER not in request.headers:
+            return web.Response(status=403, text="peer requests only")
+        if self.cache_plane is None:
+            return web.Response(status=503, text="cache disabled")
+        import json as _json
+
+        try:
+            parsed = _json.loads(await request.read())
+            keys = parsed.get("keys")
+        except Exception:
+            keys = None
+        if not isinstance(keys, list):
+            return web.Response(status=400, text="bad key list")
+        payload = await self.cache_plane.pull_payload(keys)
+        return web.Response(
+            body=payload, content_type="application/octet-stream"
+        )
+
+    async def handle_internal_drain(self, request: web.Request) -> web.Response:
+        """Operator-side drain trigger: run (or join) the planned-
+        leave protocol. ``?wait=1`` answers when the drain completes
+        (the rolling-restart driver's lever — the caller then knows
+        the hot set is handed off and the lease released before it
+        stops the process); without it the drain runs in the
+        background and the current state comes back immediately.
+        Idempotent — a second POST joins the first run."""
+        if PEER_HEADER not in request.headers:
+            return web.Response(status=403, text="peer requests only")
+        if self.drainer is None:
+            return web.Response(status=503, text="no cluster plane")
+        wait = request.query.get("wait", "").strip().lower() in (
+            "1", "true", "yes"
+        )
+        if wait:
+            stats = await self.drainer.drain()
+            return web.json_response(
+                {"state": self.drainer.state, "stats": stats}
+            )
+        task = asyncio.ensure_future(self.drainer.drain())
+        # consume the result if nobody ever polls ("Task exception
+        # was never retrieved" guard; the protocol itself degrades)
+        task.add_done_callback(lambda t: t.cancelled() or t.exception())
+        return web.json_response(self.drainer.snapshot())
 
     def _full_plane_extent(self, ctx: TileCtx):
         """(size_x, size_y) of the ctx's plane at its resolution

@@ -326,6 +326,12 @@ class SloScheduler:
         # reporting — a dead Redis reads as pressure 0).
         self.fleet_pressure = 0.0
         self.fleet_engaged = False
+        # graceful drain (cluster/lifecycle.py): a draining replica
+        # finishes REAL work at full resolution — it stops minting
+        # new degraded permits (the degraded entries would be handed
+        # off to nobody and die with the process) but never sheds or
+        # queues differently: the zero-5xx rolling-restart contract
+        self.draining = False
         # counters (per class)
         self.classified = [0, 0, 0]
         self.sheds = [0, 0, 0]
@@ -351,6 +357,8 @@ class SloScheduler:
         immediately again and the flag drops on its own (the
         disengage contract)."""
         if not self.degrade_enabled or deadline is None:
+            return False
+        if self.draining:
             return False
         if not contended and not self.fleet_engaged:
             return False
@@ -424,6 +432,11 @@ class SloScheduler:
         """Cluster-brains hook (any thread — two scalar writes)."""
         self.fleet_pressure = max(0.0, float(pressure))
         self.fleet_engaged = bool(engaged)
+
+    def note_draining(self, draining: bool) -> None:
+        """Drain-protocol hook (cluster/lifecycle.py): one scalar
+        write; see the field comment for the policy."""
+        self.draining = bool(draining)
 
     def shed_at_door(self, priority: int) -> None:
         """Record a pre-auth door shed (the overload gate's 503) in
@@ -609,6 +622,7 @@ class SloScheduler:
             "class_weights": list(self.class_weights),
             "fleet_pressure": round(self.fleet_pressure, 4),
             "fleet_engaged": self.fleet_engaged,
+            "draining": self.draining,
         }
 
 

@@ -310,18 +310,62 @@ class ClusterHedgeConfig:
 
 
 @dataclasses.dataclass
+class ClusterDrainConfig:
+    """Graceful drain (cluster/lifecycle.py): the planned-leave
+    protocol SIGTERM (and a signed POST /internal/drain) triggers.
+    ``deadline_s`` bounds the whole protocol — marker propagation,
+    hot-set handoff, in-flight quiescence; ``signal`` installs the
+    SIGTERM handler (off leaves SIGTERM as an immediate stop — the
+    crash path the fleet already survives)."""
+
+    deadline_s: float = 10.0
+    signal: bool = True
+
+
+@dataclasses.dataclass
+class ClusterRepairConfig:
+    """Anti-entropy repair (cluster/repair.py): ``interval_s`` > 0
+    runs the low-duty digest-exchange loop (one rotating peer per
+    round); ``max_keys`` bounds the entries pulled per round (the
+    transfer byte cap bounds the payload independently)."""
+
+    interval_s: float = 0.0
+    max_keys: int = 64
+
+
+@dataclasses.dataclass
+class ClusterSuspectConfig:
+    """Quality-based suspicion (cluster/suspect.py): a replica whose
+    self-reported error rate crosses ``error_rate``, whose p99
+    exceeds ``p99_factor`` x the fleet median, or against whom a
+    peer's client failed ``peer_failures``+ times in a heartbeat
+    window earns a BAD verdict; a strict majority of verdicts demotes
+    it to non-owner until its signals recover. ``min_requests`` is
+    the self-report floor below which signals are too thin to
+    judge."""
+
+    enabled: bool = False
+    error_rate: float = 0.5
+    p99_factor: float = 3.0
+    min_requests: int = 8
+    peer_failures: int = 3
+
+
+@dataclasses.dataclass
 class ClusterConfig:
     """The cluster: block — the distributed cache plane
-    (cache/plane/) and, since r17, the coordination plane (cluster/).
-    ``members`` seeds the consistent-hash ring; ``self_url``
-    identifies this replica on it and enables peer fetch. With
-    ``lease_ttl_s`` > 0 the seed is only the BOOTSTRAP view: replicas
-    hold heartbeat-refreshed leases in the shared Redis and the ring
-    rebuilds live as leases appear/expire. ``replication_factor`` >= 2
-    pushes TinyLFU-hot entries to the ring successor(s) and enables
-    the join-time warm-up transfer; ``secret`` HMAC-authenticates the
-    /internal/* peer surface. An empty block (the default) keeps the
-    service single-process."""
+    (cache/plane/), the coordination plane (cluster/, r17), and the
+    lifecycle + repair plane (r18). ``members`` seeds the consistent-
+    hash ring; ``self_url`` identifies this replica on it and enables
+    peer fetch. With ``lease_ttl_s`` > 0 the seed is only the
+    BOOTSTRAP view: replicas hold heartbeat-refreshed leases in the
+    shared Redis and the ring rebuilds live as leases appear/expire.
+    ``replication_factor`` >= 2 pushes TinyLFU-hot entries to the
+    ring successor(s) and enables the join-time warm-up transfer;
+    ``secret`` HMAC-authenticates the /internal/* peer surface
+    (nonce-stamped, replay-proof). ``drain``/``repair``/``suspect``
+    configure the self-healing lifecycle. An empty block (the
+    default) keeps the service single-process."""
 
     members: tuple = ()
     self_url: Optional[str] = None
@@ -336,6 +380,15 @@ class ClusterConfig:
     )
     l2: ClusterL2Config = dataclasses.field(
         default_factory=ClusterL2Config
+    )
+    drain: ClusterDrainConfig = dataclasses.field(
+        default_factory=ClusterDrainConfig
+    )
+    repair: ClusterRepairConfig = dataclasses.field(
+        default_factory=ClusterRepairConfig
+    )
+    suspect: ClusterSuspectConfig = dataclasses.field(
+        default_factory=ClusterSuspectConfig
     )
 
     @property
@@ -822,7 +875,7 @@ class Config:
         unknown = set(cl) - {
             "members", "self", "virtual-nodes", "peer-timeout-ms", "l2",
             "lease-ttl-s", "replication-factor", "transfer-max-entries",
-            "secret", "hedge",
+            "secret", "hedge", "drain", "repair", "suspect",
         }
         if unknown:
             raise ConfigError(
@@ -928,6 +981,59 @@ class Config:
             raise ConfigError(
                 "'cluster.hedge.quantile' must be inside (0, 1)"
             )
+        drain_raw = cl.get("drain") or {}
+        unknown = set(drain_raw) - {"deadline-s", "signal"}
+        if unknown:
+            raise ConfigError(
+                f"Unknown keys in 'cluster.drain' block: "
+                f"{sorted(unknown)}"
+            )
+        drain_signal = drain_raw.get("signal", True)
+        if not isinstance(drain_signal, bool):
+            raise ConfigError(
+                "'cluster.drain.signal' must be a boolean"
+            )
+        repair_raw = cl.get("repair") or {}
+        unknown = set(repair_raw) - {"interval-s", "max-keys"}
+        if unknown:
+            raise ConfigError(
+                f"Unknown keys in 'cluster.repair' block: "
+                f"{sorted(unknown)}"
+            )
+        repair_interval_s = _num(repair_raw, "interval-s", 0.0, 0.0)
+        if repair_interval_s > 0 and replication_factor < 2:
+            raise ConfigError(
+                "'cluster.repair.interval-s' needs "
+                "'cluster.replication-factor' >= 2 — anti-entropy "
+                "repairs the replication contract; without one there "
+                "is nothing to repair"
+            )
+        suspect_raw = cl.get("suspect") or {}
+        unknown = set(suspect_raw) - {
+            "enabled", "error-rate", "p99-factor", "min-requests",
+            "peer-failures",
+        }
+        if unknown:
+            raise ConfigError(
+                f"Unknown keys in 'cluster.suspect' block: "
+                f"{sorted(unknown)}"
+            )
+        suspect_enabled = suspect_raw.get("enabled", False)
+        if not isinstance(suspect_enabled, bool):
+            raise ConfigError(
+                "'cluster.suspect.enabled' must be a boolean"
+            )
+        if suspect_enabled and lease_ttl_s <= 0:
+            raise ConfigError(
+                "'cluster.suspect.enabled' needs "
+                "'cluster.lease-ttl-s' — suspicion rides the fleet-"
+                "brain exchange, which rides the lease heartbeat"
+            )
+        suspect_error_rate = _num(suspect_raw, "error-rate", 0.5, 0.0)
+        if not 0.0 < suspect_error_rate <= 1.0:
+            raise ConfigError(
+                "'cluster.suspect.error-rate' must be inside (0, 1]"
+            )
         return ClusterConfig(
             members=tuple(members),
             self_url=self_url,
@@ -949,6 +1055,25 @@ class Config:
             l2=ClusterL2Config(
                 uri=l2_uri,
                 ttl_s=_num(l2_raw, "ttl-s", 3600.0, 0.0),
+            ),
+            drain=ClusterDrainConfig(
+                deadline_s=_num(drain_raw, "deadline-s", 10.0, 0.1),
+                signal=drain_signal,
+            ),
+            repair=ClusterRepairConfig(
+                interval_s=repair_interval_s,
+                max_keys=_num(repair_raw, "max-keys", 64, 1, int),
+            ),
+            suspect=ClusterSuspectConfig(
+                enabled=suspect_enabled,
+                error_rate=suspect_error_rate,
+                p99_factor=_num(suspect_raw, "p99-factor", 3.0, 1.0),
+                min_requests=_num(
+                    suspect_raw, "min-requests", 8, 1, int
+                ),
+                peer_failures=_num(
+                    suspect_raw, "peer-failures", 3, 1, int
+                ),
             ),
         )
 

@@ -183,7 +183,7 @@ class ZipkinReporter:
         t0 = _time.monotonic()
         try:
             INJECTOR.fire("tracing.zipkin")  # reporter thread, never a loop
-            urllib.request.urlopen(req, timeout=self.post_timeout_s).close()
+            urllib.request.urlopen(req, timeout=self.post_timeout_s).close()  # ompb-lint: disable=resilience-coverage -- deliberately single-attempt: spans are droppable telemetry and the contract is "a dead sink costs fast drops, never a parked reporter thread" — a retry would hold the bounded queue's drain hostage to a sink that just proved slow
         except Exception as e:  # sink down: drop batch, keep going
             self._breaker.record_failure()
             self._drop(len(batch), "post_failed")

@@ -62,5 +62,36 @@ def pytest_pyfunc_call(pyfuncitem):
     if loop is not None:
         loop.run_until_complete(fn(**kwargs))
     else:
-        asyncio.run(fn(**kwargs))
+        # Leftover-task reaper: ``asyncio.run``'s own teardown
+        # cancels leftovers and then waits WITHOUT a bound — a task
+        # that survives cancellation (e.g. a cancel swallowed by
+        # wait_for's completion race, bpo-42130) wedges the whole
+        # suite silently. Reap here with a timeout instead, so a
+        # stuck task is a NAMED failure with its stack, not a hung
+        # CI job.
+        async def _main():
+            try:
+                await fn(**kwargs)
+            finally:
+                cur = asyncio.current_task()
+                pending = [
+                    t for t in asyncio.all_tasks() if t is not cur
+                ]
+                for t in pending:
+                    t.cancel()
+                if pending:
+                    _done, still = await asyncio.wait(
+                        pending, timeout=20
+                    )
+                    if still:
+                        import sys
+                        for t in still:
+                            print("STUCK TASK:", t, file=sys.stderr)
+                            t.print_stack(file=sys.stderr)
+                        raise RuntimeError(
+                            f"{len(still)} task(s) survived "
+                            "cancellation for 20s — see stderr"
+                        )
+
+        asyncio.run(_main())
     return True

@@ -1,7 +1,7 @@
 # ompb-lint: scope=resilience-coverage
-"""Clean corpus: the remote GET flows through a breaker gate and a
-fault-injection point (in a caller — guard markers propagate over the
-module-local call graph)."""
+"""Clean corpus: the remote GET flows through a breaker gate, a
+fault-injection point, and a reconnect-once retry (in a caller —
+guard markers propagate over the module-local call graph)."""
 
 import http.client
 
@@ -34,6 +34,11 @@ def raw_get(host, key):
 def guarded_get(host, key):
     breaker.allow()
     INJECTOR.fire("store.fixture")
-    body = raw_get(host, key)
+    try:
+        body = raw_get(host, key)
+    except OSError:
+        # reconnect-once: the retry marker the rule requires on at
+        # least one caller path
+        body = raw_get(host, key)
     breaker.record_success()
     return body

@@ -1,0 +1,38 @@
+# ompb-lint: scope=resilience-coverage
+"""Seeded resilience-coverage violation (retry flavor, r18): the
+remote GET is breaker-gated, fault-injected, AND timeout-bounded, but
+NO caller path carries a retry policy — one transient transport error
+surfaces as a request failure instead of a redial."""
+
+import http.client
+
+
+class _Breaker:
+    def allow(self):
+        pass
+
+    def record_success(self, duration_s=None):
+        pass
+
+
+class _Injector:
+    def fire(self, point):
+        pass
+
+
+breaker = _Breaker()
+INJECTOR = _Injector()
+
+
+def raw_get(host, key):
+    conn = http.client.HTTPConnection(host, timeout=2)  # SEEDED: resilience-coverage (no retry)
+    conn.request("GET", "/" + key)
+    return conn.getresponse().read()
+
+
+def guarded_get(host, key):
+    breaker.allow()
+    INJECTOR.fire("store.fixture")
+    body = raw_get(host, key)
+    breaker.record_success()
+    return body

@@ -13,6 +13,15 @@ purge beating an in-flight L2 fill, hedged peer fetch under a wedged
 owner, split-brain bounded disagreement, owner-kill failover on a
 replicated hot set, and the 403 matrix for the authenticated peer
 surface.
+
+r18 fleet lifecycle lanes: drain coordinator state machine, repair
+digest/diff/rotation, quality tracker + suspicion quorum math,
+lifecycle config validation, and the chaos drives — a rolling restart
+of all three replicas under live traffic (zero 5xx, >= 0.95 warm
+hits with the L2 flushed after every kill, lease/ring reconvergence),
+anti-entropy healing a deliberately-dropped replica push within one
+rotation, verbatim-replayed and v1 signatures 403ing, and an error-
+storm replica demoted off the ring then restored.
 """
 
 import asyncio
@@ -36,18 +45,25 @@ from omero_ms_pixel_buffer_tpu.cache.plane.resp_stub import (
 from omero_ms_pixel_buffer_tpu.cache.plane.ring import HashRing
 from omero_ms_pixel_buffer_tpu.cache.result_cache import CachedTile
 from omero_ms_pixel_buffer_tpu.cluster import (
+    AntiEntropyRepairer,
+    DrainCoordinator,
     EpochRegistry,
     FleetBrains,
     HedgePolicy,
     HotSetReplicator,
     MembershipManager,
+    QualityTracker,
     RedisLink,
+    SuspicionPolicy,
+    build_digest,
     decode_transfer,
     encode_transfer,
     image_id_of,
+    parse_digest,
 )
 from omero_ms_pixel_buffer_tpu.cluster.security import (
     SIG_HEADER,
+    NonceCache,
     sign,
     verify,
 )
@@ -124,6 +140,95 @@ class TestSecurity:
         for bad in (None, "", "v1", "v1:abc", "v2:1:aa", "v1:x:y",
                     "v1:" + "9" * 400 + ":zz"):
             assert not verify("s", bad, "GET", "/x")
+
+    def test_v1_scheme_rejected(self):
+        """The r17 nonce-less scheme is refused outright — keeping it
+        verifiable would keep the replay window open."""
+        import hashlib
+        import hmac as hmac_mod
+
+        ts = str(int(time.time()))
+        message = "\n".join(
+            ("GET", "/x", ts, hashlib.sha256(b"").hexdigest())
+        ).encode()
+        mac = hmac_mod.new(b"s", message, hashlib.sha256).hexdigest()
+        assert not verify("s", f"v1:{ts}:{mac}", "GET", "/x")
+
+    def test_replay_rejected_with_nonce_cache(self):
+        cache = NonceCache()
+        header = sign("s", "POST", "/internal/purge/7", b"b",
+                      peer="p1")
+        assert verify("s", header, "POST", "/internal/purge/7", b"b",
+                      nonce_cache=cache, peer="p1")
+        # the verbatim header again, inside the skew window: replay
+        assert not verify("s", header, "POST", "/internal/purge/7",
+                          b"b", nonce_cache=cache, peer="p1")
+        assert cache.replays_rejected == 1
+
+    def test_rotated_peer_name_cannot_dodge_the_nonce_cache(self):
+        """The claimed peer identity is INSIDE the MAC: a captured
+        signature re-presented under a different X-OMPB-Peer value
+        fails the MAC check, so the per-peer nonce keying cannot be
+        dodged (and invented peer names cannot flood the per-peer
+        bounds)."""
+        cache = NonceCache()
+        header = sign("s", "POST", "/internal/purge/7", b"b",
+                      peer="replica-a")
+        assert verify("s", header, "POST", "/internal/purge/7", b"b",
+                      nonce_cache=cache, peer="replica-a")
+        for rotated in ("attacker-x", "replica-b", "-", ""):
+            assert not verify(
+                "s", header, "POST", "/internal/purge/7", b"b",
+                nonce_cache=cache, peer=rotated,
+            )
+
+    def test_fresh_signatures_never_collide(self):
+        """Two signings of the same request mint distinct nonces — a
+        legitimate re-send is not a replay."""
+        cache = NonceCache()
+        h1 = sign("s", "POST", "/internal/purge/7", peer="p")
+        h2 = sign("s", "POST", "/internal/purge/7", peer="p")
+        assert h1 != h2
+        assert verify("s", h1, "POST", "/internal/purge/7",
+                      nonce_cache=cache, peer="p")
+        assert verify("s", h2, "POST", "/internal/purge/7",
+                      nonce_cache=cache, peer="p")
+
+    def test_invalid_mac_never_burns_a_nonce(self):
+        """Garbage traffic must not churn the cache: the nonce is
+        recorded only after the MAC checks out, so an attacker cannot
+        pre-burn a nonce it sniffed before the real request lands."""
+        cache = NonceCache()
+        header = sign("s", "GET", "/x", peer="p")
+        parts = header.split(":")
+        forged = ":".join(parts[:3] + ["0" * 64])
+        assert not verify("s", forged, "GET", "/x",
+                          nonce_cache=cache, peer="p")
+        assert verify("s", header, "GET", "/x",
+                      nonce_cache=cache, peer="p")
+
+    def test_nonce_cache_bounded_per_peer(self):
+        cache = NonceCache(max_peers=2, max_per_peer=4)
+        now = time.time()
+        for i in range(10):
+            assert not cache.seen_or_record("a", f"n{i}", now=now)
+        snap = cache.snapshot()
+        assert snap["nonces"] <= 4
+        # one peer's flood never evicts another's replay protection
+        assert not cache.seen_or_record("b", "nb", now=now)
+        for i in range(10):
+            cache.seen_or_record("a", f"m{i}", now=now)
+        assert cache.seen_or_record("b", "nb", now=now)  # still replay
+
+    def test_nonce_expiry_prunes(self):
+        cache = NonceCache(skew_s=1.0)
+        t0 = time.time()
+        assert not cache.seen_or_record("p", "n1", now=t0)
+        # inside the window: replay
+        assert cache.seen_or_record("p", "n1", now=t0 + 0.5)
+        # far past the window the entry is pruned (the timestamp
+        # check would reject such a stale header anyway)
+        assert not cache.seen_or_record("p", "n1", now=t0 + 10.0)
 
 
 # ---------------------------------------------------------------------------
@@ -1332,9 +1437,11 @@ class TestClusterAuth:
                     e["trace_id"] != forged_tid
                     for e in recorder.events()
                 )
-                # correctly signed: accepted
+                # correctly signed (peer identity inside the MAC):
+                # accepted
                 good = sign(
-                    "fleet-secret", "POST", "/internal/purge/1", b""
+                    "fleet-secret", "POST", "/internal/purge/1", b"",
+                    peer="x",
                 )
                 async with http.post(
                     url + "/internal/purge/1",
@@ -1480,5 +1587,814 @@ class TestReplicaPush:
             assert "brains" in cluster
             assert "epochs" in cluster
             assert "coord_link" in cluster
+        finally:
+            await cleanup()
+
+
+# ---------------------------------------------------------------------------
+# r18 fleet lifecycle: drain coordinator (unit)
+# ---------------------------------------------------------------------------
+
+class _FakePlane:
+    """Duck-typed CachePlane for the drain state machine."""
+
+    def __init__(self):
+        self.calls = []
+
+    def drain_propagation_s(self):
+        return 0.0
+
+    async def begin_drain(self):
+        self.calls.append("begin")
+        return True
+
+    async def handoff_hot_set(self, deadline, clock=None):
+        self.calls.append("handoff")
+        return {"entries": 3, "targets": 1, "pushed": 3, "errors": 0}
+
+    async def release_lease(self):
+        self.calls.append("release")
+        return True
+
+
+class TestDrainCoordinator:
+    async def test_protocol_order_and_idempotence(self):
+        plane = _FakePlane()
+        adm = AdmissionController(max_inflight=4)
+        dc = DrainCoordinator(plane, deadline_s=2.0, admission=adm)
+        r1, r2 = await asyncio.gather(dc.drain(), dc.drain())
+        # concurrent triggers share one protocol run and one answer
+        assert r1 == r2
+        assert plane.calls == ["begin", "handoff", "release"]
+        assert dc.state == "drained"
+        assert r1["quiesced"] is True
+        assert r1["handoff"]["pushed"] == 3
+
+    async def test_quiescence_waits_for_inflight(self):
+        plane = _FakePlane()
+        adm = AdmissionController(max_inflight=4)
+        assert adm.try_slot()
+        dc = DrainCoordinator(plane, deadline_s=5.0, admission=adm)
+
+        async def finish_later():
+            await asyncio.sleep(0.25)
+            adm.release()
+
+        task = asyncio.ensure_future(finish_later())
+        stats = await dc.drain()
+        await task
+        assert stats["quiesced"] is True
+        assert stats["took_s"] >= 0.2
+
+    async def test_deadline_bounds_stuck_inflight(self):
+        plane = _FakePlane()
+        adm = AdmissionController(max_inflight=4)
+        assert adm.try_slot()  # never released: a wedged render
+        dc = DrainCoordinator(plane, deadline_s=0.4, admission=adm)
+        stats = await dc.drain()
+        # the drain completes ANYWAY — bounded beats complete; the
+        # straggler rides the crash path the fleet already survives
+        assert stats["quiesced"] is False
+        assert dc.state == "drained"
+        assert plane.calls == ["begin", "handoff", "release"]
+        adm.release()
+
+    async def test_scheduler_stops_degrading_while_draining(self):
+        from omero_ms_pixel_buffer_tpu.resilience import Deadline
+
+        adm = AdmissionController(max_inflight=4)
+        sched = SloScheduler(adm, queue_size=8)
+        sched._service_ewma = 1.0
+        tight = Deadline.after(0.01)
+        assert sched._degrade_flag(tight, contended=True)
+        sched.note_draining(True)
+        assert not sched._degrade_flag(tight, contended=True)
+        assert sched.snapshot()["draining"] is True
+        sched.note_draining(False)
+        assert sched._degrade_flag(tight, contended=True)
+
+
+# ---------------------------------------------------------------------------
+# r18 fleet lifecycle: anti-entropy repair (unit)
+# ---------------------------------------------------------------------------
+
+class TestRepairDigest:
+    def test_digest_round_trip(self):
+        items = [("img=1|a", 3), ("img=2|b", None), ("img=1|c", 0)]
+        parsed = parse_digest(build_digest(items))
+        assert parsed is not None
+        assert [e["k"] for e in parsed["entries"]] == [
+            "img=1|a", "img=2|b", "img=1|c"
+        ]
+        assert [e["ep"] for e in parsed["entries"]] == [3, None, 0]
+        # the checksum is stable and content-sensitive
+        assert parsed["sum"] == parse_digest(build_digest(items))["sum"]
+        assert parsed["sum"] != parse_digest(
+            build_digest(items[:2])
+        )["sum"]
+
+    def test_corrupt_digests_are_none(self):
+        for bad in (b"", b"{", b"[]", b'{"entries": 3}',
+                    b'{"entries": [{"ep": 1}]}'):
+            out = parse_digest(bad)
+            assert out is None or out["entries"] == []
+
+    def test_select_missing_honors_the_replication_contract(self):
+        ring = HashRing(("http://a", "http://b", "http://c"), 64)
+        rep = AntiEntropyRepairer("http://b", max_keys=64)
+        keys = [f"img=1|k{i}" for i in range(200)]
+        # entries where a owns and b is the configured successor
+        expected = [
+            k for k in keys
+            if ring.owners(k, 2)[0] == "http://a"
+            and "http://b" in ring.owners(k, 2)[1:]
+        ]
+        digest = [{"k": k, "ep": None} for k in keys]
+        wanted = rep.select_missing(
+            "http://a", digest, ring, 2,
+            has_local=lambda k: False,
+            is_stale=lambda k, e: False,
+        )
+        assert wanted == expected[: len(wanted)]
+        assert set(wanted) <= set(expected)
+        # locally-present and epoch-stale entries never pull
+        assert rep.select_missing(
+            "http://a", digest, ring, 2,
+            has_local=lambda k: True,
+            is_stale=lambda k, e: False,
+        ) == []
+        assert rep.select_missing(
+            "http://a", digest, ring, 2,
+            has_local=lambda k: False,
+            is_stale=lambda k, e: True,
+        ) == []
+        # factor 1: no replication contract, nothing to repair
+        assert rep.select_missing(
+            "http://a", digest, ring, 1,
+            has_local=lambda k: False,
+            is_stale=lambda k, e: False,
+        ) == []
+
+    def test_select_missing_bounded(self):
+        ring = HashRing(("http://a", "http://b"), 64)
+        rep = AntiEntropyRepairer("http://b", max_keys=5)
+        digest = [
+            {"k": key, "ep": None}
+            for key in (f"img=1|k{i}" for i in range(500))
+            if ring.owners(key, 2)[0] == "http://a"
+        ]
+        wanted = rep.select_missing(
+            "http://a", digest, ring, 2,
+            has_local=lambda k: False,
+            is_stale=lambda k, e: False,
+        )
+        assert len(wanted) <= 5
+
+    def test_unchanged_only_after_successful_sync(self):
+        rep = AntiEntropyRepairer("http://b")
+        assert not rep.unchanged("http://a", 42)
+        # NOT recorded yet: a failed pull must not make the next
+        # round skip the holes it failed to fill
+        assert not rep.unchanged("http://a", 42)
+        rep.note_synced("http://a", 42)
+        assert rep.unchanged("http://a", 42)
+        rep.ring_changed()
+        assert not rep.unchanged("http://a", 42)
+
+    def test_unchanged_skip_is_bounded(self):
+        """The peer's checksum says nothing about LOCAL evictions —
+        after MAX_SKIPS consecutive skips the round re-diffs, so a
+        copy this replica dropped still heals in bounded rounds."""
+        rep = AntiEntropyRepairer("http://b")
+        rep.note_synced("http://a", 42)
+        skipped = 0
+        for _ in range(rep.MAX_SKIPS + 1):
+            if rep.unchanged("http://a", 42):
+                skipped += 1
+        assert skipped == rep.MAX_SKIPS
+        # the forced re-diff round resets the streak
+        rep.note_synced("http://a", 42)
+        assert rep.unchanged("http://a", 42)
+
+    def test_next_peer_rotates(self):
+        rep = AntiEntropyRepairer("http://b")
+        peers = ["http://a", "http://c"]
+        seen = [rep.next_peer(peers) for _ in range(4)]
+        assert seen == ["http://a", "http://c"] * 2
+        assert rep.next_peer([]) is None
+        assert rep.next_peer(["http://b"]) is None  # only self
+
+
+# ---------------------------------------------------------------------------
+# r18 fleet lifecycle: quality suspicion (unit)
+# ---------------------------------------------------------------------------
+
+class TestQualityTracker:
+    def test_window_counters_reset_on_take(self):
+        q = QualityTracker()
+        for _ in range(6):
+            q.note(200, 0.01)
+        q.note(500, 0.5)
+        q.note(503, 0.2)
+        w = q.take_window()
+        assert w["n"] == 8 and w["err"] == 2
+        assert q.take_window()["n"] == 0
+
+    def test_p99_rolls_across_windows(self):
+        q = QualityTracker()
+        for _ in range(99):
+            q.note(200, 0.010)
+        q.note(200, 1.0)
+        assert q.take_window()["p99_ms"] >= 900.0
+        # the latency sample is rolling — the next window still has a
+        # p99 even before new traffic
+        assert q.take_window().get("p99_ms") is not None
+
+    def test_4xx_is_not_an_error(self):
+        q = QualityTracker()
+        q.note(403, 0.01)
+        q.note(404, 0.01)
+        assert q.take_window()["err"] == 0
+
+
+class TestSuspicionPolicy:
+    def _brain(self, n=20, err=0, p99=10.0, bad=()):
+        return {
+            "q": {"n": n, "err": err, "p99_ms": p99},
+            "bad": list(bad),
+        }
+
+    def test_error_rate_verdict(self):
+        pol = SuspicionPolicy(enabled=True, error_rate=0.5)
+        fleet = {
+            "http://a": self._brain(),
+            "http://b": self._brain(err=15),
+        }
+        assert pol.verdicts(fleet, {}) == ["http://b"]
+
+    def test_p99_vs_fleet_median_verdict(self):
+        pol = SuspicionPolicy(enabled=True, p99_factor=3.0)
+        fleet = {
+            "http://a": self._brain(p99=10.0),
+            "http://b": self._brain(p99=12.0),
+            "http://c": self._brain(p99=200.0),
+        }
+        assert pol.verdicts(fleet, {}) == ["http://c"]
+
+    def test_min_requests_floor(self):
+        """Too-thin self-reports are never judged — a replica that
+        served 2 requests and failed one is noise, not a verdict."""
+        pol = SuspicionPolicy(enabled=True, min_requests=8)
+        fleet = {"http://b": self._brain(n=2, err=2)}
+        assert pol.verdicts(fleet, {}) == []
+
+    def test_peer_failure_verdict_catches_silent_sickness(self):
+        """The replica too sick to even self-report rides the peer-
+        observed clause."""
+        pol = SuspicionPolicy(enabled=True, peer_failures=3)
+        fleet = {"http://b": {"q": None}}
+        assert pol.verdicts(fleet, {"http://b": 3}) == ["http://b"]
+        assert pol.verdicts(fleet, {"http://b": 2}) == []
+
+    def test_peer_with_no_brain_at_all_is_still_judged(self):
+        """A replica whose brain key is ABSENT (expired, publish
+        failing, wedged before first publish) must still earn a
+        verdict from this collector's own observed failures — the
+        silent ones are exactly who the clause exists for."""
+        pol = SuspicionPolicy(enabled=True, peer_failures=3)
+        assert pol.verdicts({}, {"http://c": 3}) == ["http://c"]
+        fleet = {"http://a": self._brain()}
+        assert pol.verdicts(fleet, {"http://c": 5}) == ["http://c"]
+
+    def test_demotion_needs_strict_majority(self):
+        pol = SuspicionPolicy(enabled=True)
+        members = ("http://a", "http://b", "http://c")
+        # 3 reporters (2 peer brains + self): need 2 votes
+        fleet = {
+            "http://a": self._brain(bad=["http://c"]),
+            "http://b": self._brain(),
+        }
+        assert pol.demoted(fleet, [], members) == []  # 1 vote
+        assert pol.demoted(
+            fleet, ["http://c"], members
+        ) == ["http://c"]  # 2 votes
+        # disabled: never demotes
+        off = SuspicionPolicy(enabled=False)
+        assert off.demoted(fleet, ["http://c"], members) == []
+
+    def test_demotion_never_empties_the_ring(self):
+        pol = SuspicionPolicy(enabled=True)
+        members = ("http://a", "http://b")
+        fleet = {
+            "http://a": self._brain(bad=["http://a", "http://b"]),
+            "http://b": self._brain(bad=["http://a", "http://b"]),
+        }
+        out = pol.demoted(
+            fleet, ["http://a", "http://b"], members
+        )
+        assert len(out) <= len(members) - 1
+
+
+# ---------------------------------------------------------------------------
+# r18 config validation: drain / repair / suspect blocks
+# ---------------------------------------------------------------------------
+
+class TestLifecycleConfig:
+    BASE = {
+        "session-store": {"type": "memory"},
+        "cluster": {
+            "members": ["http://a:1", "http://b:2"],
+            "self": "http://a:1",
+            "replication-factor": 2,
+            "l2": {"uri": "redis://localhost:6379/0"},
+            "lease-ttl-s": 5,
+        },
+    }
+
+    def _with(self, **cluster_extra):
+        raw = json.loads(json.dumps(self.BASE))
+        raw["cluster"].update(cluster_extra)
+        return Config.from_dict(raw)
+
+    def test_valid_lifecycle_blocks(self):
+        config = self._with(
+            drain={"deadline-s": 3, "signal": False},
+            repair={"interval-s": 2.5, "max-keys": 16},
+            suspect={"enabled": True, "error-rate": 0.4,
+                     "p99-factor": 2.0, "min-requests": 4,
+                     "peer-failures": 2},
+        )
+        assert config.cluster.drain.deadline_s == 3
+        assert config.cluster.drain.signal is False
+        assert config.cluster.repair.interval_s == 2.5
+        assert config.cluster.repair.max_keys == 16
+        assert config.cluster.suspect.enabled
+        assert config.cluster.suspect.error_rate == 0.4
+
+    def test_defaults(self):
+        config = self._with()
+        assert config.cluster.drain.deadline_s == 10.0
+        assert config.cluster.drain.signal is True
+        assert config.cluster.repair.interval_s == 0.0
+        assert not config.cluster.suspect.enabled
+
+    def test_unknown_keys_fail(self):
+        for block in ("drain", "repair", "suspect"):
+            with pytest.raises(ConfigError):
+                self._with(**{block: {"typo-key": 1}})
+
+    def test_repair_requires_replication(self):
+        raw = json.loads(json.dumps(self.BASE))
+        raw["cluster"]["replication-factor"] = 1
+        raw["cluster"]["repair"] = {"interval-s": 1}
+        with pytest.raises(ConfigError):
+            Config.from_dict(raw)
+
+    def test_suspect_requires_leases(self):
+        raw = json.loads(json.dumps(self.BASE))
+        del raw["cluster"]["lease-ttl-s"]
+        raw["cluster"]["suspect"] = {"enabled": True}
+        with pytest.raises(ConfigError):
+            Config.from_dict(raw)
+
+    def test_bad_values_fail(self):
+        with pytest.raises(ConfigError):
+            self._with(drain={"deadline-s": 0})
+        with pytest.raises(ConfigError):
+            self._with(drain={"signal": "yes"})
+        with pytest.raises(ConfigError):
+            self._with(suspect={"enabled": True, "error-rate": 0})
+        with pytest.raises(ConfigError):
+            self._with(repair={"max-keys": 0})
+
+
+# ---------------------------------------------------------------------------
+# r18 chaos: rolling restart — the zero-5xx planned-leave pin
+# ---------------------------------------------------------------------------
+
+WARM_SOURCES = ("hit", "l2-hit", "peer-hit")
+
+
+class TestRollingRestart:
+    @pytest.mark.resilience
+    async def test_rolling_restart_zero_5xx_warm_hits(self, tmp_path):
+        """Drain each of three replicas in sequence under live
+        traffic: zero 5xx anywhere, warm-hit rate >= 0.95 across the
+        whole drive (the handoff + join warm-up carrying the hot set
+        through every restart — the L2 tile keys are flushed after
+        each kill so shared Redis can't mask a lost hot set), and the
+        lease/ring view reconverging to three members after every
+        step."""
+        replicas, resp, cleanup = await _make_cluster(
+            tmp_path, n=3,
+            cluster_extra={
+                "lease-ttl-s": 0.6, "replication-factor": 2,
+                "drain": {"deadline-s": 5, "signal": False},
+            },
+        )
+        img_path = str(tmp_path / "img.ome.tiff")
+        paths = _tile_paths(8)
+        statuses = []
+        sources = []
+        peer_headers = {**AUTH, "X-OMPB-Peer": "ops"}
+        try:
+            await asyncio.sleep(0.5)  # leases discovered
+            etags = {}
+            async with ClientSession() as http:
+                # warm every path on every replica (RAM + L2 copies)
+                for path in paths:
+                    for r in replicas:
+                        status, _b, h = await _get(http, r.url + path)
+                        assert status == 200
+                        etags.setdefault(path, h.get("ETag"))
+                        assert h.get("ETag") == etags[path]
+
+                async def traffic_round(live):
+                    for path in paths:
+                        for r in live:
+                            status, _b, h = await _get(
+                                http, r.url + path
+                            )
+                            statuses.append(status)
+                            sources.append(h.get("X-Cache"))
+                            if status == 200:
+                                assert h.get("ETag") == etags[path]
+
+                for i in range(3):
+                    victim = replicas[i]
+                    survivors = [
+                        r for j, r in enumerate(replicas) if j != i
+                    ]
+                    # the draining replica itself keeps serving: the
+                    # marker moves ownership, not traffic
+                    async def _drain(url):
+                        async def _one():
+                            async with http.post(
+                                url + "/internal/drain?wait=1",
+                                headers=peer_headers,
+                            ) as r:
+                                return r.status, await r.read()
+                        return await asyncio.wait_for(_one(), 30.0)
+
+                    drain_task = asyncio.ensure_future(
+                        _drain(victim.url)
+                    )
+                    while not drain_task.done():
+                        await traffic_round(survivors)
+                        status, _b, _h = await _get(
+                            http, victim.url + paths[0]
+                        )
+                        statuses.append(status)
+                        await asyncio.sleep(0.05)
+                    status, body = await drain_task
+                    assert status == 200
+                    drained = json.loads(body)
+                    assert drained["state"] == "drained"
+                    assert drained["stats"]["handoff"]["pushed"] > 0
+                    await victim.kill()
+                    # flush the shared tier's tile keys: from here the
+                    # handed-off RAM copies are the ONLY warm source
+                    # for the victim's keys
+                    for key in [
+                        k for k in resp.data
+                        if k.startswith(b"ompb:tile:")
+                    ]:
+                        del resp.data[key]
+                    for _ in range(3):
+                        await traffic_round(survivors)
+                    # rolling restart: the replacement boots on the
+                    # same identity and warms via the join transfer
+                    replicas[i] = await _boot_replica(
+                        img_path,
+                        [r.url for r in replicas],
+                        victim.url,
+                        int(victim.url.rsplit(":", 1)[1]),
+                        resp.uri,
+                        cluster_extra={
+                            "lease-ttl-s": 0.6,
+                            "replication-factor": 2,
+                            "drain": {"deadline-s": 5,
+                                      "signal": False},
+                        },
+                    )
+                    deadline = time.monotonic() + 6.0
+                    while time.monotonic() < deadline:
+                        views = [
+                            len(r.app.cache_plane.membership.members)
+                            for r in replicas if not r.dead
+                        ]
+                        if all(v == 3 for v in views):
+                            break
+                        await traffic_round(survivors)
+                        await asyncio.sleep(0.1)
+                    assert all(
+                        len(r.app.cache_plane.membership.members) == 3
+                        for r in replicas if not r.dead
+                    )
+            # THE pins: a planned leave is not a crash
+            assert statuses, "no traffic was driven"
+            assert all(s < 500 for s in statuses), (
+                f"5xx during rolling restart: "
+                f"{[s for s in statuses if s >= 500]}"
+            )
+            warm = sum(1 for s in sources if s in WARM_SOURCES)
+            warm_rate = warm / max(1, len(sources))
+            assert warm_rate >= 0.95, (
+                f"warm-hit rate {warm_rate:.3f} over {len(sources)} "
+                f"requests (sources: {set(sources)})"
+            )
+        finally:
+            await cleanup()
+
+    @pytest.mark.resilience
+    async def test_drain_endpoint_requires_peer_marker(self, tmp_path):
+        replicas, resp, cleanup = await _make_cluster(
+            tmp_path, n=2, cluster_extra={"lease-ttl-s": 0.6},
+        )
+        try:
+            async with ClientSession() as http:
+                async with http.post(
+                    replicas[0].url + "/internal/drain"
+                ) as r:
+                    assert r.status == 403
+                async with http.get(
+                    replicas[0].url + "/healthz"
+                ) as r:
+                    health = await r.json()
+            assert health["cluster"]["drain"]["state"] == "serving"
+            assert health["slo"]["draining"] is False
+        finally:
+            await cleanup()
+
+
+# ---------------------------------------------------------------------------
+# r18 chaos: anti-entropy repair convergence
+# ---------------------------------------------------------------------------
+
+class TestAntiEntropyChaos:
+    @pytest.mark.resilience
+    async def test_missed_push_repaired_within_one_rotation(
+        self, tmp_path
+    ):
+        """A deliberately-dropped replica push is healed by the
+        digest exchange within one rotation over the peers (<= 2
+        rounds in a 3-replica fleet), byte-identical; once converged,
+        the next round is a checksum-skip costing one digest GET."""
+        replicas, resp, cleanup = await _make_cluster(
+            tmp_path, n=3,
+            cluster_extra={
+                "lease-ttl-s": 0.6, "replication-factor": 2,
+                # the loop cadence is irrelevant here: rounds are
+                # driven by hand for determinism
+                "repair": {"interval-s": 60, "max-keys": 32},
+            },
+        )
+        try:
+            await asyncio.sleep(0.5)
+            plane0 = replicas[0].app.cache_plane
+            by_url = {r.url: r for r in replicas}
+
+            # a path owned by some replica A with successor B
+            target = None
+            for path in _tile_paths(16):
+                key = _key_for(replicas[0].app, path)
+                owners = plane0.ring.owners(key, 2)
+                if len(owners) == 2:
+                    target = (path, key, owners[0], owners[1])
+                    break
+            assert target is not None
+            path, key, owner_url, succ_url = target
+            owner = by_url[owner_url]
+            succ = by_url[succ_url]
+
+            # sabotage: the owner's push never leaves the building
+            async def lost_push(*a, **k):
+                return None
+
+            owner.app.cache_plane._push_replicas = lost_push
+            async with ClientSession() as http:
+                for _ in range(2):  # second touch crosses the hot bar
+                    status, _b, h = await _get(
+                        http, owner_url + path
+                    )
+                    assert status == 200
+                    etag = h.get("ETag")
+            assert owner.app.result_cache.contains(key)
+            assert not succ.app.result_cache.contains(key)
+
+            succ_plane = succ.app.cache_plane
+            pulled = 0
+            rounds = 0
+            for _ in range(2):  # one full rotation over the peers
+                rounds += 1
+                pulled += await succ_plane.repair_round()
+                if succ.app.result_cache.contains(key):
+                    break
+            assert succ.app.result_cache.contains(key), (
+                f"not repaired after {rounds} rounds"
+            )
+            assert pulled >= 1
+            entry = await succ.app.result_cache.get(key)
+            assert entry.etag == etag  # byte-identity via validator
+            snap = succ_plane.repairer.snapshot()
+            assert snap["pulled"] >= 1
+
+            # converged: a full rotation of rounds is digest-GETs only
+            before = snap["pulled"]
+            for _ in range(2):
+                await succ_plane.repair_round()
+            snap = succ_plane.repairer.snapshot()
+            assert snap["pulled"] == before
+            assert snap["skipped_unchanged"] + snap["rounds"] > 0
+        finally:
+            await cleanup()
+
+
+# ---------------------------------------------------------------------------
+# r18 chaos: replay-proof peer surface
+# ---------------------------------------------------------------------------
+
+class TestNonceReplayHTTP:
+    @pytest.mark.resilience
+    async def test_replayed_signature_403s(self, tmp_path):
+        """A captured ``X-OMPB-Sig`` re-presented verbatim fails even
+        INSIDE the clock-skew window — the r17 replay hole. Fresh
+        signatures for the same request keep working."""
+        replicas, resp, cleanup = await _make_cluster(
+            tmp_path, n=2,
+            cluster_extra={"lease-ttl-s": 0.6, "secret": "s3"},
+        )
+        try:
+            await asyncio.sleep(0.4)
+            url = replicas[0].url
+            path_qs = "/internal/purge/1"
+            captured = sign("s3", "POST", path_qs,
+                            peer="attacker-replay")
+            headers = {
+                "X-OMPB-Peer": "attacker-replay",
+                SIG_HEADER: captured,
+            }
+            async with ClientSession() as http:
+                async with http.post(
+                    url + path_qs, headers=headers
+                ) as r:
+                    assert r.status == 200  # the original lands once
+                async with http.post(
+                    url + path_qs, headers=headers
+                ) as r:
+                    assert r.status == 403  # the replay never does
+                # a fresh signature (new nonce) still works
+                async with http.post(
+                    url + path_qs, headers={
+                        "X-OMPB-Peer": "attacker-replay",
+                        SIG_HEADER: sign(
+                            "s3", "POST", path_qs,
+                            peer="attacker-replay",
+                        ),
+                    },
+                ) as r:
+                    assert r.status == 200
+                # replays counted for operators
+                async with http.get(url + "/healthz") as r:
+                    health = await r.json()
+                assert health["cluster"]["nonces"][
+                    "replays_rejected"
+                ] >= 1
+        finally:
+            await cleanup()
+
+    @pytest.mark.resilience
+    async def test_v1_signature_403s_over_http(self, tmp_path):
+        """An r17-era (nonce-less) signature is dead on arrival: the
+        replay closure refuses the whole scheme, not just repeats."""
+        import hashlib
+        import hmac as hmac_mod
+
+        replicas, resp, cleanup = await _make_cluster(
+            tmp_path, n=2,
+            cluster_extra={"lease-ttl-s": 0.6, "secret": "s3"},
+        )
+        try:
+            url = replicas[0].url
+            path_qs = "/internal/transfer?limit=4"
+            ts = str(int(time.time()))
+            message = "\n".join(
+                ("GET", path_qs, ts, hashlib.sha256(b"").hexdigest())
+            ).encode()
+            mac = hmac_mod.new(
+                b"s3", message, hashlib.sha256
+            ).hexdigest()
+            async with ClientSession() as http:
+                async with http.get(
+                    url + path_qs, headers={
+                        "X-OMPB-Peer": "old-replica",
+                        SIG_HEADER: f"v1:{ts}:{mac}",
+                    },
+                ) as r:
+                    assert r.status == 403
+        finally:
+            await cleanup()
+
+    @pytest.mark.resilience
+    async def test_signed_cluster_traffic_unaffected(self, tmp_path):
+        """The replay guard never taxes legitimate traffic: a signed
+        two-replica cluster replicates, transfers, and peer-serves
+        exactly as before (every outbound exchange mints its own
+        nonce)."""
+        replicas, resp, cleanup = await _make_cluster(
+            tmp_path, n=2,
+            cluster_extra={
+                "lease-ttl-s": 0.6, "replication-factor": 2,
+                "secret": "s3",
+            },
+        )
+        try:
+            await asyncio.sleep(0.5)
+            paths = _tile_paths(6)
+            async with ClientSession() as http:
+                for path in paths:
+                    for r in replicas:
+                        status, _b, _h = await _get(
+                            http, r.url + path
+                        )
+                        assert status == 200
+                await asyncio.sleep(0.5)  # pushes drain, signed
+            rep = (
+                replicas[0].app.cache_plane.replicator.snapshot()[
+                    "pushed"
+                ]
+                + replicas[1].app.cache_plane.replicator.snapshot()[
+                    "pushed"
+                ]
+            )
+            assert rep > 0
+        finally:
+            await cleanup()
+
+
+# ---------------------------------------------------------------------------
+# r18 chaos: quality-based suspicion demotes a sick replica
+# ---------------------------------------------------------------------------
+
+class TestQualityDemotionChaos:
+    @pytest.mark.resilience
+    async def test_error_storm_demotes_then_recovers(self, tmp_path):
+        """A replica serving a 5xx storm (but heartbeating fine) is
+        demoted off the ring by its peers' quorum within a few brain
+        rounds, keeps its lease the whole time, and is restored once
+        its signals recover."""
+        replicas, resp, cleanup = await _make_cluster(
+            tmp_path, n=3,
+            cluster_extra={
+                "lease-ttl-s": 0.6,
+                "suspect": {"enabled": True, "min-requests": 8,
+                            "error-rate": 0.5},
+            },
+        )
+        try:
+            await asyncio.sleep(0.5)
+            sick = replicas[2]
+            observers = replicas[:2]
+
+            async def error_storm(seconds):
+                deadline = time.monotonic() + seconds
+                while time.monotonic() < deadline:
+                    for _ in range(10):
+                        sick.app.quality.note(500, 0.01)
+                    await asyncio.sleep(0.1)
+
+            storm = asyncio.ensure_future(error_storm(6.0))
+            try:
+                deadline = time.monotonic() + 6.0
+                while time.monotonic() < deadline:
+                    if all(
+                        sick.url in r.app.cache_plane.demoted
+                        for r in observers
+                    ):
+                        break
+                    await asyncio.sleep(0.1)
+            finally:
+                storm.cancel()
+            for r in observers:
+                plane = r.app.cache_plane
+                assert sick.url in plane.demoted, (
+                    plane.brains.snapshot()
+                )
+                # demoted = off the RING, not out of the fleet
+                assert sick.url not in plane.ring.members
+                assert sick.url in plane.membership.members
+            # recovery: windows with no errors dissolve the quorum
+            deadline = time.monotonic() + 6.0
+            while time.monotonic() < deadline:
+                if all(
+                    sick.url not in r.app.cache_plane.demoted
+                    for r in observers
+                ):
+                    break
+                await asyncio.sleep(0.1)
+            for r in observers:
+                plane = r.app.cache_plane
+                assert sick.url not in plane.demoted
+                assert sick.url in plane.ring.members
         finally:
             await cleanup()

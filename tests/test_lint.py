@@ -78,6 +78,15 @@ class TestSeededViolations:
         assert [f.rule for f in found] == ["resilience-coverage"]
         assert "per-call timeout" in found[0].message
 
+    def test_resilience_coverage_requires_retry(self, seeded):
+        """r18: breaker + fault point + timeout still don't suffice —
+        the rule also demands retry evidence (resilient_get, a
+        retry-named wrapper, or the reconnect-once try/except shape)
+        on some caller path."""
+        found = seeded["no_retry.py"]
+        assert [f.rule for f in found] == ["resilience-coverage"]
+        assert "retry policy" in found[0].message
+
     def test_jax_hotpath(self, seeded):
         found = seeded["hotpath_sync.py"]
         assert all(f.rule == "jax-hotpath" for f in found)

@@ -409,6 +409,41 @@ def _has_timeout_marker(fn: FunctionInfo) -> bool:
     return False
 
 
+def _has_retry_marker(fn: FunctionInfo) -> bool:
+    """Retry-policy evidence (the KNOWN_GAPS "does not require the
+    retry wrapper" item): a call through ``resilient_get`` / the old
+    ``_get_with_retry`` name / anything retry-named, or the
+    reconnect-once shape — a ``try`` whose except handler re-issues a
+    call the try body made (the wire clients' drop-and-redo recovery:
+    one transient transport error heals in place instead of failing
+    the request)."""
+    for call in fn.calls:
+        if call.name in ("_get_with_retry", "resilient_get"):
+            return True
+        if "retry" in call.name.lower():
+            return True
+    node = getattr(fn, "node", None)
+    if node is None:
+        return False
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Try):
+            continue
+        tried: Set[Tuple[Optional[str], str]] = set()
+        for stmt in sub.body:
+            for c in ast.walk(stmt):
+                if isinstance(c, ast.Call):
+                    tried.add(_base_of(c.func))
+        for handler in sub.handlers:
+            for stmt in handler.body:
+                for c in ast.walk(stmt):
+                    if (
+                        isinstance(c, ast.Call)
+                        and _base_of(c.func) in tried
+                    ):
+                        return True
+    return False
+
+
 def check_resilience_coverage(
     project: Project, indexes: Dict[str, ModuleIndex]
 ) -> List[Finding]:
@@ -420,34 +455,37 @@ def check_resilience_coverage(
             continue
         idx = indexes[sf.path]
         # markers a function *transitively contains* (itself + loose
-        # same-module callees): (breaker, injection, timeout)
-        contains: Dict[str, Tuple[bool, bool, bool]] = {}
+        # same-module callees): (breaker, injection, timeout, retry)
+        contains: Dict[str, Tuple[bool, bool, bool, bool]] = {}
 
         def markers_of(
             fn: FunctionInfo, stack: Set[str]
-        ) -> Tuple[bool, bool, bool]:
+        ) -> Tuple[bool, bool, bool, bool]:
             if fn.qualname in contains:
                 return contains[fn.qualname]
             if fn.qualname in stack:
-                return (False, False, False)
+                return (False, False, False, False)
             stack.add(fn.qualname)
-            brk, inj, tmo = (
+            marks = (
                 _has_breaker_marker(fn),
                 _has_injection_marker(fn),
                 _has_timeout_marker(fn),
+                _has_retry_marker(fn),
             )
-            if not (brk and inj and tmo):
+            if not all(marks):
                 for call in fn.calls:
                     for callee in idx.resolve_loose(call):
-                        b2, i2, t2 = markers_of(callee, stack)
-                        brk, inj, tmo = brk or b2, inj or i2, tmo or t2
-                        if brk and inj and tmo:
+                        sub = markers_of(callee, stack)
+                        marks = tuple(
+                            a or b for a, b in zip(marks, sub)
+                        )
+                        if all(marks):
                             break
-                    if brk and inj and tmo:
+                    if all(marks):
                         break
             stack.discard(fn.qualname)
-            contains[fn.qualname] = (brk, inj, tmo)
-            return brk, inj, tmo
+            contains[fn.qualname] = marks
+            return marks
 
         # reverse edges (loose): callee bare name -> caller functions
         callers: Dict[str, Set[str]] = {}
@@ -459,11 +497,11 @@ def check_resilience_coverage(
                         fn.qualname
                     )
 
-        def coverage(fn: FunctionInfo) -> Tuple[bool, bool, bool]:
+        def coverage(fn: FunctionInfo) -> Tuple[bool, bool, bool, bool]:
             """OR of markers over the function and every caller path
             (the rule only *admits* guards, so over-connecting is
             safe)."""
-            brk = inj = tmo = False
+            marks = (False, False, False, False)
             seen: Set[str] = set()
             frontier = [fn.qualname]
             while frontier:
@@ -471,19 +509,19 @@ def check_resilience_coverage(
                 if q in seen:
                     continue
                 seen.add(q)
-                b2, i2, t2 = markers_of(by_qual[q], set())
-                brk, inj, tmo = brk or b2, inj or i2, tmo or t2
-                if brk and inj and tmo:
-                    return brk, inj, tmo
+                sub = markers_of(by_qual[q], set())
+                marks = tuple(a or b for a, b in zip(marks, sub))
+                if all(marks):
+                    return marks
                 frontier.extend(callers.get(q, ()))
-            return brk, inj, tmo
+            return marks
 
         for fn in idx.functions:
             for call in fn.calls:
                 desc = _match_blocking(call, _NET_PRIMITIVES)
                 if desc is None:
                     continue
-                brk, inj, tmo = coverage(fn)
+                brk, inj, tmo, rty = coverage(fn)
                 if not (brk and inj):
                     findings.append(Finding(
                         "resilience-coverage", sf.path, call.line,
@@ -500,6 +538,18 @@ def check_resilience_coverage(
                         "the exchange with asyncio.wait_for (or a "
                         "timeout= argument) so a silent dependency "
                         "can't park the caller",
+                    ))
+                elif not rty:
+                    findings.append(Finding(
+                        "resilience-coverage", sf.path, call.line,
+                        f"remote I/O ({desc}) in '{fn.name}' has no "
+                        "retry policy on any caller path — route one "
+                        "caller through resilient_get / a retry "
+                        "wrapper (or a reconnect-once recovery) so a "
+                        "single transient transport error doesn't "
+                        "surface as a request failure; if single-"
+                        "attempt is the design, suppress with the "
+                        "justification",
                     ))
     return findings
 
