@@ -1741,14 +1741,20 @@ def bench_obs(cache_dir: str, n: int = 240) -> dict:
     return asyncio.run(run())
 
 
-def build_render_fixture(root: str, size: int = 2048):
-    """3-channel uint16 fixture for the rendered-tile section."""
+def build_render_fixture(root: str, size: int = 2048, depth: int = 1):
+    """3-channel uint16 fixture for the rendered-tile section;
+    ``depth`` > 1 writes a z-stack (shifted copies of the base
+    pattern) for projection-burst sections."""
     from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
 
-    path = os.path.join(root, f"bench_render_{size}.ome.tiff")
+    path = os.path.join(
+        root,
+        f"bench_render_{size}.ome.tiff" if depth == 1
+        else f"bench_render_{size}_z{depth}.ome.tiff",
+    )
     if os.path.exists(path):
         return path
-    log(f"writing {size}x{size} 3-channel render fixture...")
+    log(f"writing {size}x{size} 3-channel z={depth} render fixture...")
     rng = np.random.default_rng(31)
     yy, xx = np.mgrid[0:size, 0:size].astype(np.float32)
     chans = []
@@ -1762,6 +1768,11 @@ def build_render_fixture(root: str, size: int = 2048):
             (base + rng.normal(0, 90, (size, size))).clip(0, 4095)
         )
     data = np.stack(chans).astype(np.uint16)[None, :, None]
+    if depth > 1:
+        data = np.concatenate(
+            [np.roll(data, 17 * z, axis=-1) for z in range(depth)],
+            axis=2,
+        )
     write_ome_tiff(path, data, tile_size=(512, 512), compression="zlib")
     return path
 
@@ -1825,6 +1836,129 @@ def bench_render(
             log(f"[render] {label} failed: {e!r}")
         finally:
             service.close()
+    return out
+
+
+def bench_supertile(
+    cache_dir: str, engine: str, size: int = 1024, tile: int = 64,
+    grid: int = 4, rounds: int = 3, depth: int = 4,
+) -> dict:
+    """Super-tile plane (r19) section — a 4x4 DZI-row burst (one
+    spec, one resolution, grid-adjacent tiles; a 3-channel intmax
+    z-projection over ``depth`` planes, the viewer burst shape where
+    the shared plane gather is largest — every independent tile
+    re-gathers and re-projects the whole z-range) rendered two ways:
+
+    - ``independent``: every tile through its own ``handle()`` call —
+      the literal "independently rendered tile" the byte-identity
+      contract is pinned against (each pays its own gather,
+      projection, composite, and dispatch);
+    - ``fused``: the same tiles stamped by the batcher's adjacency
+      bucketing and served through one ``handle_batch`` — ONE plane
+      gather over the bounding rectangle, ONE projection + composite,
+      carved per-tile encodes.
+
+    Two pins (recorded per engine; the CI smoke fails on either):
+    ``supertile_ok_speedup`` — the fused burst serves >= 2x the
+    independent tiles/s on the headline engine; and
+    ``supertile_ok_identical`` — fused bytes == independent bytes on
+    EVERY engine that ran (the contract that lets fused tiles share
+    ETags and cache entries).
+
+    Default operating point: 64px tiles over a z=4 stack — the
+    regime where the per-tile gather/projection/dispatch the fusion
+    eliminates dominates. At 256px+ tiles on the CPU backend the
+    per-tile deflate floor (untouched by fusion) dominates instead
+    and the ratio compresses toward 1; KNOWN_GAPS records that
+    honestly."""
+    import time as _t
+
+    from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+        ImageRegistry,
+        PixelsService,
+    )
+    from omero_ms_pixel_buffer_tpu.models.tile_pipeline import TilePipeline
+    from omero_ms_pixel_buffer_tpu.render.model import RenderSpec
+    from omero_ms_pixel_buffer_tpu.render.supertile import (
+        BurstHint,
+        assign_supertiles,
+    )
+    from omero_ms_pixel_buffer_tpu.tile_ctx import RegionDef, TileCtx
+
+    path = build_render_fixture(cache_dir, size, depth=depth)
+    registry = ImageRegistry()
+    registry.add(1, path)
+    params = {
+        "c": "1|0:4095$FF0000,2|0:4095$00FF00,3|0:4095$0000FF",
+    }
+    if depth > 1:
+        params["p"] = f"intmax|0:{depth - 1}"
+    spec = RenderSpec.from_params(params)
+    hint = BurstHint(tile, tile)
+
+    def burst_ctxs():
+        return [
+            TileCtx(
+                image_id=1, z=0, c=0, t=0,
+                region=RegionDef(col * tile, row * tile, tile, tile),
+                format="png", omero_session_key="bench", render=spec,
+                burst=hint,
+            )
+            for row in range(grid) for col in range(grid)
+        ]
+
+    out: dict = {}
+    identical = True
+    engines = ["host"] if engine == "host" else ["host", engine]
+    for label in engines:
+        service = PixelsService(registry)
+        try:
+            pipe = TilePipeline(
+                service, engine=label, buckets=(tile,),
+                device_deflate=(label != "host"),
+            )
+            pipe.mesh = None  # the fused composite is single-device
+            # warm both shapes: per-tile jit/native paths AND the
+            # fused super-tile program
+            warm_ind = [pipe.handle(c) for c in burst_ctxs()]
+            assert all(b is not None for b in warm_ind)
+            warm_ctxs = burst_ctxs()
+            assign_supertiles(warm_ctxs, max_pixels=(grid * tile) ** 2)
+            warm_fused = pipe.handle_batch(warm_ctxs)
+            if warm_fused != warm_ind:
+                identical = False
+                log(f"[supertile] {label}: FUSED BYTES DIVERGED")
+            t0 = _t.perf_counter()
+            for _ in range(rounds):
+                for ctx in burst_ctxs():
+                    assert pipe.handle(ctx) is not None
+            ind_tps = rounds * grid * grid / (_t.perf_counter() - t0)
+            t0 = _t.perf_counter()
+            for _ in range(rounds):
+                ctxs = burst_ctxs()
+                assign_supertiles(
+                    ctxs, max_pixels=(grid * tile) ** 2
+                )
+                res = pipe.handle_batch(ctxs)
+                assert all(b is not None for b in res)
+            fused_tps = rounds * grid * grid / (_t.perf_counter() - t0)
+            out[label] = {
+                "independent_tiles_per_sec": round(ind_tps, 2),
+                "fused_tiles_per_sec": round(fused_tps, 2),
+                "speedup": round(fused_tps / max(ind_tps, 1e-9), 3),
+            }
+            log(f"[supertile] {label}: {out[label]}")
+            pipe.close()
+        except Exception as e:
+            out[label] = {"error": f"{type(e).__name__}: {e}"}
+            identical = False
+            log(f"[supertile] {label} failed: {e!r}")
+        finally:
+            service.close()
+    headline = engines[-1]
+    speedup = (out.get(headline) or {}).get("speedup")
+    out["supertile_ok_speedup"] = bool(speedup and speedup >= 2.0)
+    out["supertile_ok_identical"] = identical
     return out
 
 
@@ -2294,6 +2428,18 @@ def main():
             analysis_stats = {"error": f"{type(e).__name__}: {e}"}
             log(f"analysis bench failed: {e!r}")
 
+    # --- super-tile plane (r19): 4x4 DZI-row projection burst fused
+    # vs independent (supertile_ok_speedup >= 2x +
+    # supertile_ok_identical pins) -------------------------------------
+    supertile_stats: dict = {}
+    if os.environ.get("BENCH_SUPERTILE", "1") != "0":
+        try:
+            supertile_stats = bench_supertile(cache_dir, pipe.engine)
+            log(f"supertile: {supertile_stats}")
+        except Exception as e:
+            supertile_stats = {"error": f"{type(e).__name__}: {e}"}
+            log(f"supertile bench failed: {e!r}")
+
     if os.environ.get("BENCH_SUBS", "1") != "0":
         try:
             sub_benches(pipe, service, size, cache_dir)
@@ -2339,6 +2485,8 @@ def main():
         record["render"] = render_stats
     if analysis_stats:
         record["analysis"] = analysis_stats
+    if supertile_stats:
+        record["supertile"] = supertile_stats
     if device_stats:
         record["device"] = device_stats
     # explicit host-vs-device table so the next round can read WHICH
@@ -2360,6 +2508,14 @@ def main():
         comparison["masked_overhead_ratio"] = (
             analysis_stats["masked_overhead_ratio"]
         )
+    for label, stats in supertile_stats.items():
+        if isinstance(stats, dict) and "fused_tiles_per_sec" in stats:
+            comparison[f"supertile_fused_{label}"] = (
+                stats["fused_tiles_per_sec"]
+            )
+            comparison[f"supertile_independent_{label}"] = (
+                stats["independent_tiles_per_sec"]
+            )
     micro = device_stats.get("micro") or {}
     for k in (
         "deflate_gbps", "pack_gbps", "pack_speedup_vs_gather",

@@ -75,11 +75,19 @@ class BatchingTileWorker:
         coalesce_window_ms: float = 2.0,
         max_queue: int = 4096,
         workers: Optional[int] = None,
+        supertile=None,
     ):
         self.pipeline = pipeline
         self.session_validator = session_validator
         self.max_batch = max_batch
         self.coalesce_window_ms = coalesce_window_ms
+        # Super-tile adjacency bucketing (config ``supertile:``, r19):
+        # adjacency detection lives HERE, at the one point that sees a
+        # whole coalesced batch — spatially adjacent render lanes of
+        # one (image, spec, resolution) get a shared group stamp the
+        # pipeline turns into ONE plane gather + ONE composite. None
+        # disables (every lane keeps the independent path).
+        self.supertile = supertile
         # worker_pool_size analog: how many coalesced batches may be in
         # flight on the executor at once (2 x CPUs default, matching
         # the reference's worker-verticle instance count)
@@ -322,6 +330,31 @@ class BatchingTileWorker:
                 canonical.append((c, f))
         batch = canonical
         ctxs = [b[0] for b in batch]
+        if (
+            len(ctxs) >= 2
+            and self.supertile is not None
+            and getattr(self.supertile, "enabled", False)
+        ):
+            # bucket by spatial NEIGHBORHOOD, not just shape: adjacent
+            # render lanes of one (image, spec, resolution) — a pan or
+            # DZI/IIIF burst — share a SuperTileGroup stamp, bounded
+            # by the configured bounding-rect pixel budget. Stamping
+            # is advisory: the pipeline re-validates before fusing,
+            # and a bucketing failure costs only the fusion.
+            try:
+                from ..render.supertile import assign_supertiles
+
+                assign_supertiles(
+                    ctxs,
+                    max_pixels=self.supertile.max_pixels,
+                    min_lanes=self.supertile.min_lanes,
+                    min_coverage=self.supertile.coverage,
+                )
+            except Exception:
+                log.exception(
+                    "super-tile bucketing failed; lanes serve "
+                    "independently"
+                )
         if (
             len(batch) == 1
             and ctxs[0].render is None

@@ -124,13 +124,20 @@ async def serve_translated(
     h: int,
     resolution: Optional[int],
     overrides: Optional[dict] = None,
+    burst=None,
 ) -> web.Response:
     """The shared tail of every adapter tile handler: build the SAME
     ctx + spec a native ``/render`` request with these params builds
     (rendering query params — ``c``/``m``/``maps``/``q``/``roi``/
     ``z``/``t`` — ride along verbatim; ``overrides`` force the
     dialect's own format/model), then serve through the one path.
-    Identical ctx => identical cache key => shared entries + ETags."""
+    Identical ctx => identical cache key => shared entries + ETags.
+
+    ``burst`` (r19) is the dialect's known burst geometry — a
+    ``render.supertile.BurstHint`` naming the tile grid (a DZI level
+    row is a known rectangle) — annotated onto the ctx so the
+    batcher's super-tile bucketing doesn't rediscover adjacency.
+    Transient: it never joins a key and never changes bytes."""
     q = dict(request.query)
     q.update(overrides or {})
     try:
@@ -154,6 +161,7 @@ async def serve_translated(
     ctx.format = spec.format
     ctx.region = RegionDef(x, y, w, h)
     ctx.resolution = resolution
+    ctx.burst = burst
     return await app_obj._serve(request, ctx)
 
 

@@ -130,9 +130,15 @@ def register_dzi(router, app_obj, cfg) -> None:
         if placed is None:
             return web.Response(status=404, text="No such tile")
         res, x, y, w, h = placed
+        from ...render.supertile import BurstHint
+
+        # a DZI level row is a known rectangle on the TileSize grid —
+        # the burst hint lets the batcher's super-tile bucketing
+        # cluster a zoom/pan burst without rediscovering the geometry
         return await serve_translated(
             app_obj, request, image_id, x, y, w, h, res,
             overrides={"format": fmt},
+            burst=BurstHint(tile_size, tile_size),
         )
 
     router.add_get(r"/dzi/{imageId:\d+}.dzi", handle_descriptor)

@@ -251,9 +251,15 @@ def register_iiif(router, app_obj, cfg) -> None:
             return web.Response(status=501, text=e.message)
         overrides["format"] = fmt
         lx, ly, lw, lh = dict(candidates)[res]
+        from ...render.supertile import BurstHint
+
+        # the advertised tile grid: viewers fetching info.json tiles
+        # land on it, and the batcher's super-tile bucketing clusters
+        # them O(n); off-grid regions fall back to the pairwise sweep
         return await serve_translated(
             app_obj, request, image_id, lx, ly, lw, lh,
             res, overrides,
+            burst=BurstHint(cfg.tile_size, cfg.tile_size),
         )
 
     router.add_get(r"/iiif/{imageId:\d+}/info.json", handle_info)

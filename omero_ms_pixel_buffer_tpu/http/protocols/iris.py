@@ -117,10 +117,15 @@ def register_iris(router, app_obj, cfg) -> None:
             return web.Response(status=404, text="No such tile")
         col, row = tile % x_tiles, tile // x_tiles
         x, y = col * tile_size, row * tile_size
+        from ...render.supertile import BurstHint
+
+        # an Iris layer is a known flat tile grid — make the burst
+        # geometry explicit for the batcher's super-tile bucketing
         return await serve_translated(
             app_obj, request, image_id, x, y,
             min(tile_size, lw - x), min(tile_size, lh - y),
             res, overrides={"format": fmt},
+            burst=BurstHint(tile_size, tile_size),
         )
 
     router.add_get(r"/iris/{imageId:\d+}/metadata", handle_metadata)

@@ -739,6 +739,10 @@ class PixelBufferApp:
             max_batch=batching.max_batch,
             coalesce_window_ms=batching.coalesce_window_ms,
             workers=config.effective_worker_pool_size,
+            # super-tile fusion (r19): the batcher stamps spatially
+            # adjacent render lanes; the pipeline fuses their gather +
+            # composite and carves byte-identical per-tile results
+            supertile=config.supertile,
         )
         self.bus = EventBus()
         self.bus.consumer(GET_TILE_EVENT, self.worker.handle)
@@ -853,6 +857,9 @@ class PixelBufferApp:
                         or self.request_budget_s
                     ),
                     lookahead=cc.prefetch.lookahead,
+                    # r19: whole-viewport speculation — the predicted
+                    # band feeds the super-tile path at prefetch class
+                    viewport_span=cc.prefetch.viewport_span,
                     # bounds math at prediction time: the motion
                     # stream's first tile already opened the image's
                     # buffer, so its level extent answers from cache —

@@ -315,17 +315,26 @@ class DeviceEncodeDispatcher:
         deflate_mode: str,
         lanes: Sequence[int],
         sizes: Sequence[Tuple[int, int]],
+        mask=None,
+        staged: bool = False,
     ) -> "concurrent.futures.Future":
         """Enqueue one RENDER group (render/engine): ``planes`` is a
-        host (B, C, H, W) unsigned channel batch; the fused composite
-        + filter + deflate program runs as ONE dispatch and the
+        host (B, C, H, W) unsigned channel batch — or an already
+        device-resident one (plane-cache projection crops,
+        ``staged=True``, which skips the H2D stage). ``mask`` is an
+        optional (B, H, W) uint8 ROI batch multiplied into the
+        composite on device (the r19 mask queue wiring — masked lanes
+        no longer detour to the host mirror). The fused composite +
+        filter + deflate program runs as ONE dispatch and the
         readback worker frames RGB8 PNGs. Same queue semantics as
         ``submit``; with a serving mesh the group shards across chips
-        through ``sharded_render_filter_deflate`` instead."""
+        through ``sharded_render_filter_deflate`` instead (masked and
+        staged groups stay single-device — the shard_map chain does
+        not carry them)."""
         return self._enqueue(
             self._stage_render_group,
             planes, index_tables, color_luts, rows, row_bytes,
-            filter_mode, deflate_mode, lanes, sizes,
+            filter_mode, deflate_mode, lanes, sizes, mask, staged,
         )
 
     def _enqueue(self, stage_fn, *args) -> "concurrent.futures.Future":
@@ -498,13 +507,17 @@ class DeviceEncodeDispatcher:
 
     def _stage_render_group(
         self, planes, index_tables, color_luts, rows, row_bytes,
-        filter_mode, deflate_mode, lanes, sizes,
+        filter_mode, deflate_mode, lanes, sizes, mask=None,
+        staged=False,
     ):
         import jax
 
-        if self.mesh_manager is not None:
+        if self.mesh_manager is not None and mask is None and not staged:
             # same rationale as the raw-tile mesh path: block inside
-            # the managed dispatch so a sick chip degrades the mesh
+            # the managed dispatch so a sick chip degrades the mesh.
+            # Masked and staged (device-resident) groups stay on the
+            # single-device path below — the shard_map render chain
+            # carries neither, and correctness beats width here.
             return self._readback.submit(
                 self._tid_bound(self._mesh_render_group),
                 planes, index_tables, color_luts, rows, row_bytes,
@@ -513,14 +526,21 @@ class DeviceEncodeDispatcher:
         from ..render.engine import fused_render_filter_deflate_batch
 
         t0 = time.perf_counter()
-        batch_dev = jax.device_put(planes)
-        jax.block_until_ready(batch_dev)  # ompb-lint: disable=jax-hotpath -- H2D stage boundary: waits on the transfer engine, overlapped with earlier groups' compute
-        t_h2d = time.perf_counter()
+        if staged:
+            batch_dev, mask_dev = planes, mask
+            t_h2d = time.perf_counter()
+        else:
+            batch_dev = jax.device_put(planes)
+            mask_dev = None if mask is None else jax.device_put(mask)
+            # blocking on the INPUT transfer only: earlier groups'
+            # compute keeps the device busy meanwhile
+            jax.block_until_ready(batch_dev)  # ompb-lint: disable=jax-hotpath -- H2D stage boundary: waits on the transfer engine, overlapped with earlier groups' compute
+            t_h2d = time.perf_counter()
         _observe_stage(t_h2d - t0, "h2d")
         streams, lengths = fused_render_filter_deflate_batch(
             batch_dev, index_tables, color_luts, rows, row_bytes,
             filter_mode=filter_mode, mode=deflate_mode,
-            packer=self._packer,
+            packer=self._packer, mask=mask_dev,
         )
         t_dispatch = time.perf_counter()
         self._note_launch(t_dispatch)

@@ -103,13 +103,18 @@ class RenderLane:
     engines — the TABLE spec/dtype (quantized float/int32 lanes build
     their tables over the u16 bin space with windows erased, because
     the windows are already baked into the host quantization) and the
-    rasterized ROI mask, when the spec carries shapes."""
+    rasterized ROI mask, when the spec carries shapes. ``device``
+    marks a stack that is ALREADY a device array (plane-cache
+    projection crops kept resident, r19) — staged into its fused
+    group with jnp ops and submitted ``staged=True``, never pulled
+    back to the host."""
 
-    __slots__ = ("stack", "tspec", "tdtype", "mask")
+    __slots__ = ("stack", "tspec", "tdtype", "mask", "device")
 
-    def __init__(self, stack, tspec, tdtype, mask=None):
+    def __init__(self, stack, tspec, tdtype, mask=None, device=False):
         self.stack, self.tspec, self.tdtype = stack, tspec, tdtype
         self.mask = mask
+        self.device = device
 
 
 class DeferredTile:
@@ -264,6 +269,13 @@ class TilePipeline:
         from ..render.masks import MaskRasterCache
 
         self._mask_cache = MaskRasterCache()
+        # r19 observability: host pulls of plane-cache projection
+        # crops. The device-resident path keeps crops in HBM end to
+        # end, so a warm projection pan holds this at zero (the
+        # regression test pins it). Bare int on purpose: racing
+        # increments may undercount, but zero-vs-nonzero — the pinned
+        # signal — is exact.
+        self._proj_host_pulls = 0
 
     def close(self) -> None:
         """Release owned threads: the encode pool and (if the device
@@ -351,6 +363,7 @@ class TilePipeline:
             ),
             "lut_dir": self.lut_dir,
             "masks": self._mask_cache.snapshot(),
+            "projection_host_pulls": self._proj_host_pulls,
         }
 
     def analysis_snapshot(self) -> dict:
@@ -1165,18 +1178,42 @@ class TilePipeline:
         stacks become 413 markers."""
         from ..render.engine import (
             RENDER_FALLBACK,
-            default_window,
             quantizable_dtype,
-            quantize_to_u16,
             renderable_dtype,
-            unsigned_view,
         )
-        from ..render.projection import project
         from ..resilience.faultinject import INJECTOR
 
         pending: List[Tuple[List[int], object]] = []
         stacks: Dict[int, RenderLane] = {}
+        # -- super-tile fusion (r19): spatially adjacent lanes the
+        # batcher stamped execute as ONE plane gather + ONE composite,
+        # carved back into per-lane encodes. Handled lanes leave
+        # ``idxs``; any lane (or whole group) the fusion declines
+        # falls through to the independent path below unchanged. With
+        # a serving mesh, lanes keep the per-lane sharded path — the
+        # fused composite is a single-device program, and idling n-1
+        # chips to fuse would be a de-optimization.
+        fused_done: set = set()
+        mesh = self._get_mesh() if self.use_device else None
+        if mesh is None:
+            st_groups: Dict[int, List[int]] = {}
+            st_order: List[int] = []
+            for i in idxs:
+                tok = getattr(ctxs[i], "supertile", None)
+                if tok is not None:
+                    if id(tok) not in st_groups:
+                        st_order.append(id(tok))
+                    st_groups.setdefault(id(tok), []).append(i)
+            for gid in st_order:
+                done = self._supertile_group(
+                    st_groups[gid], resolved, ctxs, results,
+                    use_fused, pending, stacks,
+                )
+                fused_done.update(done)
+            if fused_done:
+                idxs = [i for i in idxs if i not in fused_done]
         plans: Dict[int, tuple] = {}
+        lane_dev: Dict[int, bool] = {}
         by_image: Dict[Tuple[int, int], List[int]] = {}
         for i in idxs:
             rt, ctx = resolved[i], ctxs[i]
@@ -1277,9 +1314,29 @@ class TilePipeline:
                         # read and flip bytes after plane admission
                         and resolved[i].meta.dtype.itemsize <= 4
                     )
+                    # r19: keep fully-resident lanes' crops ON device —
+                    # project + composite + deflate chain without a
+                    # host round trip. Needs the fused encode path
+                    # (the host mirror consumes host arrays), the
+                    # gather-table dtype (unsigned_view is a no-op),
+                    # no quantization (host float math), no ROI mask
+                    # raster (host-built), and a bucket to land in.
+                    want_dev = (
+                        use_hbm
+                        and use_fused
+                        and not _q
+                        and ctxs[i].render.format == "png"
+                        and not ctxs[i].render.masks
+                        and resolved[i].meta.dtype.kind == "u"
+                        and self._bucket(resolved[i].w, resolved[i].h)
+                        is not None
+                    )
+                    lane_dev[i] = want_dev
                     for j, coord in enumerate(coords):
                         arr = (
-                            self._plane_cache_region(buf, level, coord)
+                            self._plane_cache_region(
+                                buf, level, coord, device=want_dev
+                            )
                             if use_hbm else None
                         )
                         if arr is not None:
@@ -1315,6 +1372,45 @@ class TilePipeline:
                         continue  # a read slot failed -> 404
                     rt = resolved[i]
                     spec = ctxs[i].render
+                    if lane_dev.get(i):
+                        if all(
+                            not isinstance(p, np.ndarray)
+                            for p in lane_planes
+                        ):
+                            # every slot is a resident crop: stack +
+                            # project on device, stay resident (r19 —
+                            # the warm-projection-pan zero-pull path)
+                            try:
+                                from ..render.projection import (
+                                    project_jax,
+                                )
+
+                                stack_d = jnp.stack(
+                                    lane_planes
+                                ).reshape(
+                                    len(chans), len(zts), rt.h, rt.w
+                                )
+                                if spec.projection is not None:
+                                    stack_d = project_jax(
+                                        stack_d, spec.projection
+                                    )
+                                else:
+                                    stack_d = stack_d[:, 0]
+                                stacks[i] = RenderLane(
+                                    stack_d, spec, rt.meta.dtype,
+                                    None, device=True,
+                                )
+                                continue
+                            except Exception:
+                                log.exception(
+                                    "device-resident staging failed "
+                                    "for lane %d; host staging", i
+                                )
+                        # mixed cold pan (or the fallback above):
+                        # materialize the resident slots once, counted
+                        lane_planes = [
+                            self._pull_crop(p) for p in lane_planes
+                        ]
                     try:
                         if upscale is not None:
                             ys, xs, crh, crw = upscale
@@ -1325,31 +1421,13 @@ class TilePipeline:
                             stack = np.stack(lane_planes).reshape(
                                 len(chans), len(zts), rt.h, rt.w
                             )
-                        tspec, tdtype = spec, rt.meta.dtype
-                        if quantized:
-                            # window each channel onto the u16 bin
-                            # space on the HOST (engine byte-identity:
-                            # every engine gathers identical indices);
-                            # projection then runs in the integer
-                            # domain like any 16-bit image
-                            q = np.empty(stack.shape, dtype=np.uint16)
-                            for ci, ch in enumerate(chans):
-                                win = (
-                                    ch.window
-                                    if ch.window is not None
-                                    else default_window(rt.meta.dtype)
-                                )
-                                q[ci] = quantize_to_u16(stack[ci], win)
-                            stack = q
-                            tspec = spec.without_windows()
-                            tdtype = np.dtype(np.uint16)
-                        if spec.projection is not None:
-                            stack = project(
-                                stack, spec.projection,
-                                device=use_fused,
-                            )
-                        else:
-                            stack = stack[:, 0]
+                        # quantize/project/unsign through the ONE
+                        # shared staging tail (byte identity with the
+                        # super-tile path depends on it)
+                        stack, tspec, tdtype = self._stage_stack(
+                            stack, spec, chans, rt.meta.dtype,
+                            device_project=use_fused,
+                        )
                         mask = None
                         if spec.masks:
                             mask = self._mask_cache.get(
@@ -1357,8 +1435,7 @@ class TilePipeline:
                                 (rt.x, rt.y, rt.w, rt.h),
                             )
                         stacks[i] = RenderLane(
-                            unsigned_view(np.ascontiguousarray(stack)),
-                            tspec, tdtype, mask,
+                            stack, tspec, tdtype, mask,
                         )
                     except Exception:
                         log.exception(
@@ -1366,18 +1443,20 @@ class TilePipeline:
                         )
 
         # encode groups: (spec signature, TABLE dtype, real size,
-        # bucket) — one fused dispatch per group, one jit
-        # specialization per (shape, C). Masked lanes serve through
-        # the host mirror (byte-identical by the engine contract; the
-        # fused mask chain is validated but not queue-wired yet —
-        # KNOWN_GAPS r15), as do JPEG and over-bucket lanes.
+        # bucket, masked?, device-resident?) — one fused dispatch per
+        # group, one jit specialization per (shape, C). Masked lanes
+        # ride the fused dispatch too since r19 (``submit_render``
+        # carries the (B, H, W) mask batch; the device multiply is
+        # pinned byte-identical to the host mirror). JPEG and
+        # over-bucket lanes still serve through the host mirror.
         groups: Dict[Tuple, List[int]] = {}
         for i, lane in stacks.items():
+            if i in fused_done:
+                continue  # super-tile lanes already executed/queued
             rt, spec = resolved[i], ctxs[i].render
             bucket = (
                 self._bucket(rt.w, rt.h)
                 if use_fused and spec.format == "png"
-                and lane.mask is None
                 else None
             )
             if bucket is None:
@@ -1389,12 +1468,16 @@ class TilePipeline:
                 (
                     spec.signature(), lane.tdtype.str,
                     (rt.w, rt.h), bucket,
+                    lane.mask is not None, lane.device,
                 ),
                 [],
             ).append(i)
 
         fmode = self._render_filter_mode()
-        for (sig, tdtype_str, (w, h), (bw, bh)), lanes in groups.items():
+        for (
+            (sig, tdtype_str, (w, h), (bw, bh), has_mask, is_dev),
+            lanes,
+        ) in groups.items():
             lane0 = stacks[lanes[0]]
             try:
                 # the chaos seam: failing `render.engine` here proves
@@ -1404,16 +1487,39 @@ class TilePipeline:
                     lane0.tspec, np.dtype(tdtype_str)
                 )
                 c = tables.shape[0]
-                batch = np.zeros(
-                    (len(lanes), c, bh, bw), dtype=lane0.stack.dtype
-                )
-                for j, i in enumerate(lanes):
-                    batch[j, :, :h, :w] = stacks[i].stack
+                if is_dev:
+                    # device-resident stacks (plane-cache projection
+                    # crops): pad into the bucket with jnp ops — the
+                    # lanes never touch the host
+                    batch = jnp.stack(
+                        [stacks[i].stack for i in lanes]
+                    )
+                    if (h, w) != (bh, bw):
+                        batch = jnp.pad(
+                            batch,
+                            ((0, 0), (0, 0), (0, bh - h), (0, bw - w)),
+                        )
+                else:
+                    batch = np.zeros(
+                        (len(lanes), c, bh, bw), dtype=lane0.stack.dtype
+                    )
+                    for j, i in enumerate(lanes):
+                        batch[j, :, :h, :w] = stacks[i].stack
+                mask_batch = None
+                if has_mask:
+                    # bucket pad masks to 0: pad pixels composite to
+                    # black, and their bytes are sliced away anyway
+                    mask_batch = np.zeros(
+                        (len(lanes), bh, bw), dtype=np.uint8
+                    )
+                    for j, i in enumerate(lanes):
+                        mask_batch[j, :h, :w] = stacks[i].mask
                 disp = self._get_dispatcher()
                 with TRACER.start_span("render_device"):
                     fut = disp.submit_render(
                         batch, tables, luts, h, 1 + w * 3, fmode,
                         "rle", lanes, [(w, h)] * len(lanes),
+                        mask=mask_batch, staged=is_dev,
                     )
                 pending.append((lanes, fut))
             except Exception:
@@ -1439,17 +1545,22 @@ class TilePipeline:
             return
         spec = ctx.render
         try:
+            stack = lane.stack
+            if not isinstance(stack, np.ndarray):
+                # a device-resident lane degrading to the host mirror
+                # pays the one pull the happy path avoided
+                stack = self._pull_crop(stack)
             tables, luts = self._render_tables_for(
                 lane.tspec, lane.tdtype
             )
             if spec.format == "png":
                 results[i] = rengine.render_png_host(
-                    lane.stack, tables, luts,
+                    stack, tables, luts,
                     self._render_filter_mode(), lane.mask,
                 )
             else:
                 rgb = rengine.render_host(
-                    lane.stack, tables, luts, lane.mask
+                    stack, tables, luts, lane.mask
                 )
                 results[i] = rengine.encode_jpeg(rgb, spec.quality)
             rengine.RENDER_TILES.inc(path="host", format=spec.format)
@@ -1457,7 +1568,293 @@ class TilePipeline:
             log.exception("host render failed for lane %d", i)
             results[i] = None
 
-    def _plane_cache_region(self, buf, level, coord):
+    @staticmethod
+    def _stage_stack(stack, spec, chans, dtype, device_project):
+        """The shared pointwise tail of render staging: quantize
+        float/int32 channels onto the u16 bin space (host float64 —
+        engine byte identity), z/t-project in integer arithmetic,
+        reinterpret signed pixels as their unsigned gather index.
+        ONE implementation serving both the per-lane path and the
+        super-tile path — fused-vs-independent byte identity depends
+        on these transforms never diverging. (C, Z, H, W) ->
+        ((C, H, W) unsigned, table spec, table dtype)."""
+        from ..render.engine import (
+            default_window,
+            quantize_to_u16,
+            renderable_dtype,
+            unsigned_view,
+        )
+        from ..render.projection import project
+
+        tspec, tdtype = spec, dtype
+        if not renderable_dtype(dtype):
+            q = np.empty(stack.shape, dtype=np.uint16)
+            for ci, ch in enumerate(chans):
+                win = (
+                    ch.window if ch.window is not None
+                    else default_window(dtype)
+                )
+                q[ci] = quantize_to_u16(stack[ci], win)
+            stack = q
+            tspec = spec.without_windows()
+            tdtype = np.dtype(np.uint16)
+        if spec.projection is not None:
+            stack = project(
+                stack, spec.projection, device=device_project
+            )
+        else:
+            stack = stack[:, 0]
+        return unsigned_view(np.ascontiguousarray(stack)), tspec, tdtype
+
+    # -- super-tile fusion (r19) ---------------------------------------
+
+    def _supertile_group(
+        self, lanes, resolved, ctxs, results, use_fused, pending,
+        stacks,
+    ) -> set:
+        """Execute one batcher-stamped super-tile: ONE plane gather
+        over the group's bounding rectangle (through the HBM plane
+        cache when resident), ONE composite, per-lane regions carved
+        out and fed to the existing per-lane encode paths. Returns
+        the lane indices this fusion HANDLED (result written or fused
+        group queued); everything else — a lane that re-validates out
+        (degraded permit, spent deadline, failed resolve) or a whole
+        group the fusion declines (over budget, unrenderable spec,
+        gather failure) — is left for the independent path, so a
+        split lane never poisons its neighbors. Registered per-lane
+        carved stacks back the host-mirror fallback of the fused
+        device group (byte-identical by the engine contract)."""
+        from ..render import engine as rengine
+        from ..render import supertile as stile
+        from ..render.engine import (
+            RENDER_SECONDS,
+            quantizable_dtype,
+            renderable_dtype,
+        )
+        from ..resilience.faultinject import INJECTOR
+
+        # re-validate against RESOLVED state: the stamp is pre-resolve
+        live = []
+        for i in lanes:
+            rt, ctx = resolved[i], ctxs[i]
+            if rt is None or results[i] is not None:
+                continue  # failed/expired resolve, or already marked
+            if rt.degrade_level is not None or ctx.degraded:
+                continue  # degraded permits never fuse with full-res
+            if ctx.deadline is not None and ctx.deadline.expired:
+                continue
+            live.append(i)
+        if len(live) < 2:
+            stile.SUPERTILE_FALLBACK.inc(len(live))
+            return set()
+        rt0, ctx0 = resolved[live[0]], ctxs[live[0]]
+        spec = ctx0.render
+        dtype = rt0.meta.dtype
+        try:
+            chans = spec.resolve_channels(rt0.meta.size_c)
+            zts = spec.plane_range(
+                ctx0.z, ctx0.t, rt0.meta.size_z, rt0.meta.size_t
+            )
+        except Exception:
+            stile.SUPERTILE_FALLBACK.inc(len(live))
+            return set()  # unrenderable spec: independent path 404s it
+        if not renderable_dtype(dtype):
+            if not quantizable_dtype(dtype):
+                stile.SUPERTILE_FALLBACK.inc(len(live))
+                return set()
+            if dtype.kind == "f" and any(
+                ch.window is None for ch in chans
+            ):
+                stile.SUPERTILE_FALLBACK.inc(len(live))
+                return set()
+        rects = [
+            (resolved[i].x, resolved[i].y, resolved[i].w, resolved[i].h)
+            for i in live
+        ]
+        bx, by, bw_, bh_ = stile.bounding_rect(rects)
+        nplanes = len(chans) * len(zts)
+        if (
+            self.max_tile_bytes
+            and bw_ * bh_ * rt0.meta.bytes_per_pixel * nplanes
+            > self.max_tile_bytes
+        ):
+            # the SUPER-rect blew the allocation guard; the individual
+            # tiles may still be fine — serve them independently
+            stile.SUPERTILE_FALLBACK.inc(len(live))
+            return set()
+        # ONE plane gather over the bounding rectangle, through the
+        # HBM plane cache when the planes are resident
+        buf = rt0.buffer
+        coords = [
+            (z, ch.index, t, bx, by, bw_, bh_)
+            for ch in chans for (z, t) in zts
+        ]
+        use_hbm = (
+            self.use_device
+            and self.use_plane_cache
+            and getattr(buf, "samples", 1) == 1
+            and dtype.itemsize <= 4
+        )
+        slots: List[Optional[np.ndarray]] = [None] * len(coords)
+        missing, owners = [], []
+        for j, coord in enumerate(coords):
+            arr = (
+                self._plane_cache_region(buf, rt0.level, coord)
+                if use_hbm else None
+            )
+            if arr is not None:
+                slots[j] = arr
+            else:
+                missing.append(coord)
+                owners.append(j)
+        try:
+            if missing:
+                fetched = buf.read_tiles(missing, level=rt0.level)
+                for j, arr in zip(owners, fetched):
+                    slots[j] = arr
+        except _UNAVAILABLE as e:
+            log.warning(
+                "store unavailable for super-tile of image %d: %s",
+                rt0.meta.image_id, e,
+            )
+            marker = _lane_unavailable(e)
+            for i in live:
+                results[i] = marker  # lanes -> 503, like a grouped read
+            return set(live)
+        except Exception:
+            log.exception(
+                "super-tile gather failed; independent fallback"
+            )
+            stile.SUPERTILE_FALLBACK.inc(len(live))
+            return set()
+        try:
+            stack, tspec, tdtype = self._stage_stack(
+                np.stack(slots).reshape(
+                    len(chans), len(zts), bh_, bw_
+                ),
+                spec, chans, dtype, device_project=use_fused,
+            )
+        except Exception:
+            log.exception(
+                "super-tile staging failed; independent fallback"
+            )
+            stile.SUPERTILE_FALLBACK.inc(len(live))
+            return set()
+        # per-lane carved stacks (views into the shared stack): the
+        # host mirror AND every fused-group failure path render from
+        # these — byte-identical to an independent lane's stack
+        rel = [
+            (resolved[i].x - bx, resolved[i].y - by) for i in live
+        ]
+        for (rx, ry), i in zip(rel, live):
+            rt = resolved[i]
+            stacks[i] = RenderLane(
+                stack[:, ry : ry + rt.h, rx : rx + rt.w],
+                tspec, tdtype, None,
+            )
+        stile.SUPERTILE_SIZE.observe(len(live))
+        fmode = self._render_filter_mode()
+        max_w = max(r[2] for r in rects)
+        max_h = max(r[3] for r in rects)
+        bucket = (
+            self._bucket(max_w, max_h)
+            if use_fused and spec.format == "png" else None
+        )
+        if bucket is not None:
+            try:
+                # the chaos seam: failing `render.supertile` proves
+                # the host carve serves byte-identical tiles
+                INJECTOR.fire("render.supertile")
+                import jax
+
+                tables, luts = self._render_tables_for(tspec, tdtype)
+                bw_b, bh_b = bucket
+                with TRACER.start_span("supertile_device"):
+                    stack_dev = jax.device_put(stack)
+                    carved = stile.composite_carve_batch(
+                        stack_dev, tables, luts,
+                        [(ry, rx) for (rx, ry) in rel], bh_b, bw_b,
+                    )
+                    disp = self._get_dispatcher()
+                    size_groups: Dict[Tuple[int, int], List[int]] = {}
+                    for j, i in enumerate(live):
+                        rt = resolved[i]
+                        size_groups.setdefault(
+                            (rt.w, rt.h), []
+                        ).append(j)
+                    for (w, h), js in size_groups.items():
+                        lane_ids = [live[j] for j in js]
+                        try:
+                            sub = (
+                                carved
+                                if len(js) == carved.shape[0]
+                                else carved[jnp.asarray(js)]
+                            )
+                            fut = disp.submit(
+                                sub, h, 1 + w * 3, 3, fmode, "rle",
+                                lane_ids, [(w, h)] * len(lane_ids),
+                                8, 2, staged=True,
+                            )
+                        except Exception as e:
+                            # a raise here must not re-render lanes of
+                            # subgroups ALREADY submitted above: this
+                            # subgroup alone degrades through the
+                            # normal drain fallback (the
+                            # _submit_bucket_groups shape)
+                            fut = concurrent.futures.Future()
+                            fut.set_exception(e)
+                        pending.append((lane_ids, fut))
+                stile.SUPERTILE_LANES.inc(len(live), path="device")
+                return set(live)
+            except Exception:
+                log.exception(
+                    "super-tile device dispatch failed; host carve"
+                )
+        # host path (host engine, jpeg, fused-dispatch failure): ONE
+        # composite, per-lane carve through the host mirror tail —
+        # timed under the same stage as render_png_host, so the
+        # render_seconds{stage="host"} attribution covers the fused
+        # burst path too
+        try:
+            with RENDER_SECONDS.time(stage="host"):
+                tables, luts = self._render_tables_for(tspec, tdtype)
+                rgb = rengine.render_host(stack, tables, luts)
+        except Exception:
+            log.exception(
+                "super-tile composite failed; independent fallback"
+            )
+            for i in live:
+                stacks.pop(i, None)
+            stile.SUPERTILE_FALLBACK.inc(len(live))
+            return set()
+        with RENDER_SECONDS.time(stage="host"):
+            for (rx, ry), i in zip(rel, live):
+                rt = resolved[i]
+                try:
+                    tile_rgb = stile.carve_host(
+                        rgb, rx, ry, rt.w, rt.h
+                    )
+                    if spec.format == "png":
+                        results[i] = rengine.png_from_rgb_host(
+                            tile_rgb, fmode
+                        )
+                    else:
+                        results[i] = rengine.encode_jpeg(
+                            np.ascontiguousarray(tile_rgb),
+                            spec.quality,
+                        )
+                    rengine.RENDER_TILES.inc(
+                        path="host", format=spec.format
+                    )
+                except Exception:
+                    log.exception(
+                        "super-tile carve encode failed for lane %d", i
+                    )
+                    results[i] = None
+        stile.SUPERTILE_LANES.inc(len(live), path="host")
+        return set(live)
+
+    def _plane_cache_region(self, buf, level, coord, device=False):
         """One (z, c, t) plane region served from (and filling) the
         HBM plane-cache namespace — the projection read path: the
         cache's admission counter sees every touch, so a repeated
@@ -1466,7 +1863,11 @@ class TilePipeline:
         tile. None on any miss/ineligibility (edge-clamped crop, cold
         plane, budget); the caller falls back to the batched host
         read. The crop's values are identical to the host read by
-        construction (the plane IS the host read, staged once)."""
+        construction (the plane IS the host read, staged once).
+
+        ``device=True`` (r19) returns the crop as a DEVICE array —
+        the projection/composite chain consumes it resident, so a
+        warm projection pan never round-trips through the host."""
         z, c, t, x, y, w, h = coord
         try:
             from .device_cache import DevicePlaneCache
@@ -1481,11 +1882,25 @@ class TilePipeline:
             if plane is None:
                 return None
             crop = cache.crop_batch(plane, [(y, x)], h, w)
+            if device:
+                return crop[0]  # stays resident; no host sync
+            self._proj_host_pulls += 1
             # ompb-lint: disable=jax-hotpath -- the ONE intended pull of this path: the cached plane region returns to host staging
             return np.asarray(crop)[0]
         except Exception:
             log.debug("plane-cache region read failed", exc_info=True)
             return None
+
+    def _pull_crop(self, arr):
+        """Host-materialize one slot that MAY be a device crop (the
+        mixed cold-pan case: some planes resident, some freshly read)
+        — counted, because it is exactly the round trip the resident
+        path exists to avoid."""
+        if isinstance(arr, np.ndarray):
+            return arr
+        self._proj_host_pulls += 1
+        # ompb-lint: disable=jax-hotpath -- mixed cold-pan fallback: a partially-resident lane degrades to host staging once
+        return np.asarray(arr)
 
     # ------------------------------------------------------------------
     # analysis lanes (render/analysis): per-channel histograms as a

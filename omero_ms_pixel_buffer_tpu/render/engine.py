@@ -382,6 +382,21 @@ def fused_render_filter_deflate_batch(
 # ---------------------------------------------------------------------------
 
 
+def png_from_rgb_host(rgb: np.ndarray, filter_mode: str = "up") -> bytes:
+    """The encode tail of the host mirror alone: composited (H, W, 3)
+    uint8 RGB -> PNG bytes through the numpy scanline filter + the
+    numpy mirror of the device RLE/fixed-Huffman stream. Split out so
+    the super-tile path (render/supertile) can composite ONCE and
+    encode each carved region through exactly this chain — carved
+    bytes stay identical to ``render_png_host`` of the same region."""
+    h, w = rgb.shape[:2]
+    filtered = filter_rows_np(
+        np.ascontiguousarray(rgb).reshape(h, w * 3), 3, filter_mode
+    )
+    stream = zlib_rle_np(filtered.tobytes())
+    return frame_png(stream, w, h, 8, 2)
+
+
 def render_png_host(
     planes: np.ndarray,
     index_tables: np.ndarray,
@@ -396,10 +411,7 @@ def render_png_host(
     (``ops.device_deflate.zlib_rle_np``)."""
     with RENDER_SECONDS.time(stage="host"):
         rgb = render_host(planes, index_tables, color_luts, mask)
-        h, w = rgb.shape[:2]
-        filtered = filter_rows_np(rgb.reshape(h, w * 3), 3, filter_mode)
-        stream = zlib_rle_np(filtered.tobytes())
-    return frame_png(stream, w, h, 8, 2)
+        return png_from_rgb_host(rgb, filter_mode)
 
 
 def encode_jpeg(rgb: np.ndarray, quality: int) -> Optional[bytes]:

@@ -47,6 +47,19 @@ def project_np(stack: np.ndarray, mode: str) -> np.ndarray:
     ).astype(stack.dtype)
 
 
+def project_jax(stack: "jax.Array", mode: str) -> "jax.Array":
+    """Device-RESIDENT projection: same jitted reduction, but the
+    result stays a device array (no host pull) — the cached-plane
+    projection path (models/tile_pipeline) chains it straight into
+    the fused render program so a plane-cache-served projection pan
+    never round-trips through the host."""
+    if mode not in MODES:
+        raise ValueError(f"Unknown projection mode: {mode}")
+    if stack.shape[-3] == 1:  # single plane: nothing to reduce
+        return stack[..., 0, :, :]
+    return _project_device(stack, mode)
+
+
 def project(stack: np.ndarray, mode: str, device: bool = False) -> np.ndarray:
     """Project a host-staged stack; ``device=True`` runs the jitted
     reduction on the accelerator (pixels identical either way — the

@@ -1,0 +1,336 @@
+"""Super-tile fusion — render the viewport, not the tile.
+
+A pan or DZI/IIIF zoom burst requests dozens of neighboring tiles that
+share planes, windows, LUTs, and halo reads; rendered independently,
+every lane pays its own plane gather and composite. This module
+applies the warp-overlapped-tiling result (PAPERS.md, Model-Based
+Warp Overlapped Tiling) at the serving layer: spatially adjacent
+lanes of one (image, RenderSpec, resolution) bucket into a
+**super-tile** — ONE plane gather over the bounding rectangle, ONE
+composite with the windows/LUTs applied once, then per-tile regions
+carved out of the shared result and fed to the existing per-lane
+deflate/encode path.
+
+The byte-identity contract holds by construction: every stage up to
+the carve is pointwise (table gathers, integer projection, int32
+composite), so a pixel's value does not depend on which rectangle it
+was rendered inside; the PNG filter only references bytes above/left
+inside the tile, and the deflate consumes exactly the tile's sliced
+scanline bytes — so a carved tile's stream, ETag, and cache entry are
+byte-identical to the independently rendered tile.
+
+Three pieces live here, used by two layers:
+
+- ``assign_supertiles`` — adjacency bucketing, called by the
+  dispatch batcher (dispatch/batcher.py) on every coalesced batch:
+  groups candidate render lanes by fuse key (same image / spec /
+  resolution / plane; degraded, masked, and expired lanes never
+  fuse), clusters each group's rectangles into spatial neighborhoods
+  (adapter ``BurstHint`` grids take an O(n) grid walk; hintless lanes
+  pay a pairwise touch sweep), splits clusters by the configured
+  pixel budget, and stamps each surviving group onto its lanes'
+  transient ``ctx.supertile`` field. Non-adjacent lanes keep today's
+  independent path unchanged.
+- ``BurstHint`` — the adapter annotation (http/protocols): a DZI
+  level row is a KNOWN rectangle on a known tile grid, so the
+  batcher doesn't have to rediscover the geometry.
+- ``composite_carve_batch`` — the fused device program (jax imported
+  lazily, like models/device_cache): one composite over the bounding
+  rectangle, zero-pad, then a vmapped ``dynamic_slice`` carve to the
+  per-lane bucket shape. The carved (B, bh, bw, 3) RGB8 batch feeds
+  the SAME streaming fused filter+deflate program raw RGB lanes use
+  (ops/device_deflate via models/device_dispatch.submit). The pad
+  region of a carved bucket contains neighbor pixels, not zeros —
+  harmless, because PNG filters never look right or down and the
+  stream is built from the sliced real-region bytes only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.metrics import REGISTRY
+
+SUPERTILE_LANES = REGISTRY.counter(
+    "supertile_lanes_total",
+    "Render lanes served through a fused super-tile, by path",
+)
+SUPERTILE_FALLBACK = REGISTRY.counter(
+    "supertile_fallback_total",
+    "Lanes returned from a super-tile to the independent path",
+)
+SUPERTILE_SIZE = REGISTRY.histogram(
+    "supertile_lanes_per_group", "Lanes fused per super-tile",
+    buckets=(2, 4, 8, 16, 32, 64, float("inf")),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class BurstHint:
+    """Adapter-known burst geometry: the tile grid the dialect serves
+    (DZI TileSize / IIIF tile width / Iris layer grid). Transient on
+    the ctx — never serialized, never part of any cache key; it only
+    lets ``assign_supertiles`` cluster by grid cell instead of a
+    pairwise rectangle sweep."""
+
+    tile_w: int
+    tile_h: int
+
+
+class SuperTileGroup:
+    """The batcher's stamp: one planned super-tile. Identity IS the
+    group (lanes sharing the same object fuse); the pipeline
+    re-validates every lane against the resolved metadata before
+    executing the fusion, so a stale stamp can only fall back, never
+    mis-render."""
+
+    __slots__ = ("key", "n")
+
+    def __init__(self, key: tuple, n: int):
+        self.key, self.n = key, n
+
+
+def _fuse_key(ctx) -> Optional[tuple]:
+    """The same-spec bucketing key, or None when the lane must never
+    fuse. Deliberately narrow (KNOWN_GAPS documents the scope):
+    render PNG/JPEG lanes only, full resolution only (a degraded
+    permit reads a coarser level — fusing it with full-res lanes
+    would gather the wrong pyramid rung), no ROI masks (per-tile
+    rasters serve through the per-lane paths), explicit regions only.
+    No session component — like ``handle_batch``'s per-image read
+    grouping, every lane still authorizes itself in ``resolve()``."""
+    spec = ctx.render
+    if spec is None or ctx.analysis is not None:
+        return None
+    if ctx.degraded:
+        return None
+    if getattr(spec, "masks", None):
+        return None
+    r = ctx.region
+    if r.width <= 0 or r.height <= 0:
+        return None
+    if ctx.deadline is not None and ctx.deadline.expired:
+        return None
+    return (
+        ctx.image_id, ctx.resolution, ctx.z, ctx.t, ctx.format,
+        spec.signature(),
+    )
+
+
+def _rect(ctx) -> Tuple[int, int, int, int]:
+    r = ctx.region
+    return (r.x, r.y, r.width, r.height)
+
+
+def _touching(a, b) -> bool:
+    """Edge- or corner-adjacent (1px-dilated intersection)."""
+    ax, ay, aw, ah = a
+    bx, by, bw, bh = b
+    return (
+        ax <= bx + bw and bx <= ax + aw
+        and ay <= by + bh and by <= ay + ah
+    )
+
+
+def _components(rects: List[tuple]) -> List[List[int]]:
+    """Connected components under ``_touching`` — union-find over the
+    (max-batch-bounded, so at most a few dozen) rectangles."""
+    n = len(rects)
+    parent = list(range(n))
+
+    def find(i):
+        while parent[i] != i:
+            parent[i] = parent[parent[i]]
+            i = parent[i]
+        return i
+
+    for i in range(n):
+        for j in range(i + 1, n):
+            if _touching(rects[i], rects[j]):
+                ri, rj = find(i), find(j)
+                if ri != rj:
+                    parent[ri] = rj
+    comps: Dict[int, List[int]] = {}
+    for i in range(n):
+        comps.setdefault(find(i), []).append(i)
+    return list(comps.values())
+
+
+def _grid_components(
+    rects: List[tuple], hint: BurstHint
+) -> Optional[List[List[int]]]:
+    """O(n) clustering for adapter bursts: lanes on the hint's tile
+    grid cluster by 8-neighborhood of their grid cell. None when any
+    lane is off-grid (caller falls back to the pairwise sweep)."""
+    tw, th = hint.tile_w, hint.tile_h
+    if tw <= 0 or th <= 0:
+        return None
+    cells: Dict[Tuple[int, int], int] = {}
+    for i, (x, y, w, h) in enumerate(rects):
+        if x % tw or y % th or w > tw or h > th:
+            return None
+        cells[(x // tw, y // th)] = i
+    seen: set = set()
+    comps: List[List[int]] = []
+    for cell in cells:
+        if cell in seen:
+            continue
+        stack, comp = [cell], []
+        seen.add(cell)
+        while stack:
+            cx, cy = stack.pop()
+            comp.append(cells[(cx, cy)])
+            for dx in (-1, 0, 1):
+                for dy in (-1, 0, 1):
+                    nb = (cx + dx, cy + dy)
+                    if nb in cells and nb not in seen:
+                        seen.add(nb)
+                        stack.append(nb)
+        comps.append(comp)
+    return comps
+
+
+def bounding_rect(
+    rects: Sequence[Tuple[int, int, int, int]]
+) -> Tuple[int, int, int, int]:
+    x0 = min(r[0] for r in rects)
+    y0 = min(r[1] for r in rects)
+    x1 = max(r[0] + r[2] for r in rects)
+    y1 = max(r[1] + r[3] for r in rects)
+    return (x0, y0, x1 - x0, y1 - y0)
+
+
+def _split_by_budget(
+    comp: List[int],
+    rects: List[tuple],
+    max_pixels: int,
+    min_coverage: float,
+) -> List[List[int]]:
+    """Greedy row-major split of one spatial component: accumulate
+    lanes while the running bounding rectangle stays inside the pixel
+    budget AND the covered fraction stays above ``min_coverage`` (a
+    sparse diagonal would otherwise gather mostly pixels nobody
+    asked for)."""
+    order = sorted(comp, key=lambda i: (rects[i][1], rects[i][0]))
+    groups: List[List[int]] = []
+    cur: List[int] = []
+    for i in order:
+        trial = cur + [i]
+        bx, by, bw, bh = bounding_rect([rects[j] for j in trial])
+        area = bw * bh
+        covered = sum(rects[j][2] * rects[j][3] for j in trial)
+        if cur and (
+            area > max_pixels or covered < min_coverage * area
+        ):
+            groups.append(cur)
+            cur = [i]
+        else:
+            cur = trial
+    if cur:
+        groups.append(cur)
+    return groups
+
+
+def assign_supertiles(
+    ctxs: Sequence,
+    max_pixels: int = 4 << 20,
+    min_lanes: int = 2,
+    min_coverage: float = 0.5,
+) -> int:
+    """Stamp ``ctx.supertile`` group tokens onto spatially adjacent
+    render lanes of one batch. Returns the number of lanes stamped.
+    Lanes that don't qualify (or whose neighborhood is too small /
+    too sparse / over budget) keep ``supertile=None`` and fall
+    through to the independent path unchanged."""
+    by_key: Dict[tuple, List[int]] = {}
+    for i, ctx in enumerate(ctxs):
+        ctx.supertile = None  # a retried ctx must not carry a stale stamp
+        key = _fuse_key(ctx)
+        if key is not None:
+            by_key.setdefault(key, []).append(i)
+    stamped = 0
+    for key, lane_ids in by_key.items():
+        if len(lane_ids) < min_lanes:
+            continue
+        rects = [_rect(ctxs[i]) for i in lane_ids]
+        # a single tile must fit the budget, or the whole neighborhood
+        # is unfusable (the budget is a bounding-RECT bound)
+        if any(w * h > max_pixels for (_, _, w, h) in rects):
+            continue
+        hints = {getattr(ctxs[i], "burst", None) for i in lane_ids}
+        comps = None
+        if len(hints) == 1:
+            hint = next(iter(hints))
+            if hint is not None:
+                comps = _grid_components(rects, hint)
+        if comps is None:
+            comps = _components(rects)
+        for comp in comps:
+            for group in _split_by_budget(
+                comp, rects, max_pixels, min_coverage
+            ):
+                if len(group) < min_lanes:
+                    continue
+                token = SuperTileGroup(key, len(group))
+                for j in group:
+                    ctxs[lane_ids[j]].supertile = token
+                stamped += len(group)
+    return stamped
+
+
+# ---------------------------------------------------------------------------
+# The fused device program: composite once, carve per-lane buckets
+# ---------------------------------------------------------------------------
+
+_composite_carve_jit = None
+
+
+def composite_carve_batch(planes, index_tables, color_luts, coords, bh, bw):
+    """One fused dispatch: (C, H, W) unsigned super-tile planes ->
+    composited RGB -> (B, bh, bw, 3) uint8 carved bucket batch at the
+    given relative (y, x) tile origins. The RGB pads (bh, bw) beyond
+    the rectangle so an edge tile's static-size carve never clamps
+    (``dynamic_slice`` would silently shift the origin); pad pixels
+    can reach only the carved BUCKET pad region, whose bytes the
+    per-lane stream build slices away. Built lazily so importing this
+    module never imports jax (the batcher imports it on every batch)."""
+    global _composite_carve_jit
+    if _composite_carve_jit is None:
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+        from jax import lax
+
+        from .engine import render_local
+
+        @partial(jax.jit, static_argnums=(4, 5))
+        def carve(planes, tables, luts, coords_yx, bh, bw):
+            rgb = render_local(planes[None], tables, luts)[0]
+            rgb = jnp.pad(rgb, ((0, bh), (0, bw), (0, 0)))
+
+            def one(y0, x0):
+                return lax.dynamic_slice(rgb, (y0, x0, 0), (bh, bw, 3))
+
+            return jax.vmap(one)(coords_yx[:, 0], coords_yx[:, 1])
+
+        _composite_carve_jit = carve
+    import jax.numpy as jnp
+
+    coords_yx = jnp.asarray(
+        [(y, x) for (y, x) in coords], dtype=jnp.int32
+    ).reshape(len(coords), 2)
+    return _composite_carve_jit(
+        planes, index_tables, color_luts, coords_yx, bh, bw
+    )
+
+
+def carve_host(
+    rgb: np.ndarray, x: int, y: int, w: int, h: int
+) -> np.ndarray:
+    """Host mirror of the carve: a plain view into the composited
+    super-tile RGB (pixels identical to the device carve's real
+    region by the engine's pointwise contract)."""
+    return rgb[y : y + h, x : x + w]

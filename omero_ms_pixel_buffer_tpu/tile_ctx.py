@@ -121,6 +121,20 @@ class TileCtx:
     obs: Optional[object] = dataclasses.field(
         default=None, compare=False, repr=False
     )
+    # Super-tile plane (render/supertile): ``burst`` is the adapter's
+    # known burst geometry (a DZI level row is a known rectangle on a
+    # known grid — BurstHint), attached at URL translation; ``supertile``
+    # is the batcher's adjacency stamp (a shared SuperTileGroup token)
+    # assigned per coalesced batch. Both TRANSIENT like ``obs``: never
+    # serialized across the dispatch boundary, never part of any
+    # cache/dedupe/lane key — fusion changes where pixels are gathered
+    # and composited, never which bytes a tile serves.
+    burst: Optional[object] = dataclasses.field(
+        default=None, compare=False, repr=False
+    )
+    supertile: Optional[object] = dataclasses.field(
+        default=None, compare=False, repr=False
+    )
 
     @classmethod
     def from_params(

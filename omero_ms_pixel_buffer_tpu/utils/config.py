@@ -240,6 +240,12 @@ class PrefetchConfig:
     headroom: float = 0.5
     budget_ms: float = 0.0
     lookahead: int = 2
+    # whole-viewport speculation (r19): perpendicular tiles predicted
+    # each side of the pan trajectory at every lookahead step, so the
+    # speculative band fuses into the super-tile path. 0 restores the
+    # r8 prediction (continuation + nearest perpendicular pair at the
+    # first step only).
+    viewport_span: int = 1
 
 
 @dataclasses.dataclass
@@ -473,6 +479,25 @@ class ProtocolsConfig:
 
 
 @dataclasses.dataclass
+class SupertileConfig:
+    """The supertile: block — super-tile fusion (render/supertile,
+    r19). The dispatch batcher buckets spatially adjacent render
+    lanes of one (image, spec, resolution) into fused super-tiles:
+    one plane gather over the bounding rectangle, one composite,
+    per-tile regions carved out byte-identically. ``max_pixels``
+    bounds the bounding-RECT area one fusion may gather (the
+    allocation ceiling); ``min_lanes`` is the smallest neighborhood
+    worth fusing; ``coverage`` is the minimum fraction of the
+    bounding rect the member tiles must cover (sparse neighborhoods
+    would gather mostly pixels nobody asked for)."""
+
+    enabled: bool = True
+    max_pixels: int = 4 << 20  # 4 Mpx ~ a 2048x2048 viewport
+    min_lanes: int = 2
+    coverage: float = 0.5
+
+
+@dataclasses.dataclass
 class MeshConfig:
     """The mesh: block — serving-mesh health. ``probe_interval_ms``
     > 0 runs MeshManager's chip probe on a background cadence so a
@@ -553,6 +578,9 @@ class Config:
     )
     protocols: ProtocolsConfig = dataclasses.field(
         default_factory=ProtocolsConfig
+    )
+    supertile: SupertileConfig = dataclasses.field(
+        default_factory=SupertileConfig
     )
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     jax: JaxConfig = dataclasses.field(default_factory=JaxConfig)
@@ -860,6 +888,7 @@ class Config:
                 headroom=headroom,
                 budget_ms=_num(pf, "budget-ms", 0.0, 0.0),
                 lookahead=_num(pf, "lookahead", 2, 1, int),
+                viewport_span=_num(pf, "viewport-span", 1, 0, int),
             ),
         )
 
@@ -1214,6 +1243,46 @@ class Config:
         )
 
     @staticmethod
+    def _parse_supertile(raw: dict) -> SupertileConfig:
+        """Validate the supertile: block — same posture as the other
+        blocks: unknown keys and nonsense fail at startup, never
+        silently default."""
+        st = raw.get("supertile") or {}
+        unknown = set(st) - {
+            "enabled", "max-pixels", "min-lanes", "coverage",
+        }
+        if unknown:
+            raise ConfigError(
+                f"Unknown keys in 'supertile' block: {sorted(unknown)}"
+            )
+
+        def _num(key: str, default, minimum, cast=float):
+            try:
+                value = cast(st.get(key, default))
+            except (TypeError, ValueError):
+                raise ConfigError(
+                    f"Invalid value for 'supertile.{key}': "
+                    f"{st.get(key)!r}"
+                ) from None
+            if value < minimum:
+                raise ConfigError(
+                    f"'supertile.{key}' must be >= {minimum}"
+                )
+            return value
+
+        coverage = _num("coverage", 0.5, 0.0)
+        if coverage > 1.0:
+            raise ConfigError("'supertile.coverage' must be in [0, 1]")
+        return SupertileConfig(
+            enabled=bool(st.get("enabled", True)),
+            # floor: one 256x256 tile — a smaller budget could never
+            # fuse anything and would silently disable the plane
+            max_pixels=_num("max-pixels", 4 << 20, 65536, int),
+            min_lanes=_num("min-lanes", 2, 2, int),
+            coverage=coverage,
+        )
+
+    @staticmethod
     def _parse_mesh(raw: dict) -> MeshConfig:
         """Validate the mesh: block."""
         ms = raw.get("mesh") or {}
@@ -1352,6 +1421,7 @@ class Config:
             render=cls._parse_render(raw),
             analysis=cls._parse_analysis(raw),
             protocols=cls._parse_protocols(raw),
+            supertile=cls._parse_supertile(raw),
             mesh=cls._parse_mesh(raw),
             jax=cls._parse_jax(raw),
             logging=LoggingConfig(

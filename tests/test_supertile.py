@@ -1,0 +1,727 @@
+"""Super-tile plane suite (render/supertile + the r19 wiring).
+
+Covers: adjacency bucketing (grid hints, pairwise sweep, pixel-budget
+splits, coverage, fuse-key isolation), fused-vs-independent byte
+identity across host/device engines over a tile grid (uniform and
+edge-tile sizes, projection specs), burst-split correctness (an
+expired / 404 / chaos-faulted lane leaves its neighbors
+byte-identical), degraded-permit isolation (never fuses with
+full-res), the r19 satellites — ROI masks through
+``submit_render``/the streaming queue (byte-identity pinned against
+the host mirror, device path proven by counter) and device-resident
+cached-plane projection crops (zero host pulls on the warm pan) —
+plus the ``supertile:`` config block, the batcher stamping seam, and
+whole-viewport prefetch speculation.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from omero_ms_pixel_buffer_tpu.auth.omero_session import AllowListValidator
+from omero_ms_pixel_buffer_tpu.auth.stores import MemorySessionStore
+from omero_ms_pixel_buffer_tpu.dispatch.batcher import BatchingTileWorker
+from omero_ms_pixel_buffer_tpu.http.server import PixelBufferApp
+from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
+from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+    ImageRegistry,
+    PixelsService,
+)
+from omero_ms_pixel_buffer_tpu.models.tile_pipeline import TilePipeline
+from omero_ms_pixel_buffer_tpu.render import supertile as stile
+from omero_ms_pixel_buffer_tpu.render.engine import RENDER_TILES
+from omero_ms_pixel_buffer_tpu.render.model import RenderSpec
+from omero_ms_pixel_buffer_tpu.render.supertile import (
+    BurstHint,
+    assign_supertiles,
+)
+from omero_ms_pixel_buffer_tpu.resilience.breaker import BOARD
+from omero_ms_pixel_buffer_tpu.resilience.deadline import Deadline
+from omero_ms_pixel_buffer_tpu.resilience.faultinject import (
+    INJECTOR,
+    always,
+)
+from omero_ms_pixel_buffer_tpu.tile_ctx import RegionDef, TileCtx
+from omero_ms_pixel_buffer_tpu.utils.config import Config, ConfigError
+
+rng = np.random.default_rng(23)
+AUTH = {"Cookie": "sessionid=ck"}
+
+# (T, C, Z, Y, X) — two channels, four z planes
+IMG = rng.integers(0, 4096, (1, 2, 4, 96, 128), dtype=np.uint16)
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    INJECTOR.clear()
+    yield
+    INJECTOR.clear()
+    BOARD.reset()
+
+
+def _write_fixture(tmp_path):
+    path = str(tmp_path / "img.ome.tiff")
+    write_ome_tiff(path, IMG, tile_size=(64, 64))
+    registry = ImageRegistry()
+    registry.add(1, path)
+    return registry
+
+
+@pytest.fixture
+def service(tmp_path):
+    svc = PixelsService(_write_fixture(tmp_path))
+    yield svc
+    svc.close()
+
+
+def _spec(**extra):
+    params = {"c": "1|0:4095$FF0000,2|0:4095$00FF00"}
+    params.update(extra)
+    return RenderSpec.from_params(params)
+
+
+def _ctx(spec, x, y, w=32, h=32, z=1, burst=None, **kw):
+    return TileCtx(
+        image_id=1, z=z, c=0, t=0, region=RegionDef(x, y, w, h),
+        format=spec.format, omero_session_key="k", render=spec,
+        burst=burst, **kw,
+    )
+
+
+def _grid(spec, tile=32, cols=3, rows=2, **kw):
+    return [
+        _ctx(spec, tile * c, tile * r, tile, tile, **kw)
+        for r in range(rows) for c in range(cols)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Adjacency bucketing (the pure planner)
+# ---------------------------------------------------------------------------
+
+
+class TestAdjacencyBucketing:
+    def test_grid_burst_forms_one_group(self):
+        ctxs = _grid(_spec())
+        assert assign_supertiles(ctxs) == 6
+        tokens = {id(c.supertile) for c in ctxs}
+        assert len(tokens) == 1 and None not in tokens
+
+    def test_non_adjacent_lane_falls_through(self):
+        spec = _spec()
+        ctxs = _grid(spec, cols=2, rows=1)
+        # far corner: not touching the 2x1 run
+        ctxs.append(_ctx(spec, 96, 64))
+        assign_supertiles(ctxs)
+        assert ctxs[0].supertile is ctxs[1].supertile is not None
+        assert ctxs[2].supertile is None
+
+    def test_pixel_budget_splits_groups(self):
+        ctxs = _grid(_spec(), cols=4, rows=1)
+        # budget fits exactly two 32x32 tiles side by side
+        assign_supertiles(ctxs, max_pixels=2 * 32 * 32)
+        tokens = [id(c.supertile) for c in ctxs]
+        assert None not in [c.supertile for c in ctxs]
+        assert len(set(tokens)) == 2
+        # every group respects the budget
+        for ctx in ctxs:
+            assert ctx.supertile.n == 2
+
+    def test_min_lanes_and_singletons(self):
+        ctxs = [_ctx(_spec(), 0, 0)]
+        assert assign_supertiles(ctxs) == 0
+        assert ctxs[0].supertile is None
+
+    def test_degraded_masked_analysis_never_stamp(self):
+        spec = _spec()
+        roi = '[{"type":"rect","x":0,"y":0,"w":30,"h":20}]'
+        masked = _spec(roi=roi)
+        ctxs = _grid(spec, cols=2, rows=1)
+        ctxs.append(_ctx(spec, 64, 0, degraded=1))
+        ctxs.append(_ctx(masked, 0, 32))
+        ctxs.append(_ctx(masked, 32, 32))
+        assign_supertiles(ctxs)
+        assert ctxs[0].supertile is not None
+        assert ctxs[2].supertile is None  # degraded
+        assert ctxs[3].supertile is None  # masked
+        assert ctxs[4].supertile is None
+
+    def test_expired_deadline_never_stamps(self):
+        ctxs = _grid(_spec(), cols=2, rows=1)
+        ctxs[1].deadline = Deadline.after(0)
+        assign_supertiles(ctxs)
+        assert ctxs[0].supertile is None  # partner expired: < min lanes
+        assert ctxs[1].supertile is None
+
+    def test_fuse_key_isolates_spec_image_plane(self):
+        a, b = _spec(), _spec(m="g")
+        ctxs = (
+            _grid(a, cols=2, rows=1)
+            + [_ctx(b, 64, 0), _ctx(b, 96, 0)]
+            + [_ctx(a, 0, 32, z=2), _ctx(a, 32, 32, z=2)]
+        )
+        assign_supertiles(ctxs)
+        groups = {id(c.supertile) for c in ctxs}
+        assert len(groups) == 3  # one per (spec, z) bucket
+
+    def test_grid_hint_matches_sweep_clusters(self):
+        hint = BurstHint(32, 32)
+        hinted = _grid(_spec(), burst=hint) + [
+            _ctx(_spec(), 96, 96, burst=hint)
+        ]
+        plain = _grid(_spec()) + [_ctx(_spec(), 96, 96)]
+        assign_supertiles(hinted)
+        assign_supertiles(plain)
+        for h, p in zip(hinted, plain):
+            assert (h.supertile is None) == (p.supertile is None)
+        assert hinted[-1].supertile is None
+
+    def test_coverage_bound_rejects_sparse_diagonal(self):
+        spec = _spec()
+        # corner-touching diagonal: bounding rect 64x64, covered 1/2
+        # at two tiles — with coverage 0.9 nothing fuses
+        ctxs = [_ctx(spec, 0, 0), _ctx(spec, 32, 32)]
+        assign_supertiles(ctxs, min_coverage=0.9)
+        assert all(c.supertile is None for c in ctxs)
+
+
+# ---------------------------------------------------------------------------
+# Fused vs independent byte identity
+# ---------------------------------------------------------------------------
+
+
+def _independent(service, ctxs_fn):
+    pipe = TilePipeline(service, engine="host")
+    try:
+        return [pipe.handle(c) for c in ctxs_fn()]
+    finally:
+        pipe.close()
+
+
+class TestFusedByteIdentity:
+    def test_host_engine_grid(self, service):
+        spec = _spec()
+        ref = _independent(service, lambda: _grid(spec))
+        assert all(b is not None for b in ref)
+        pipe = TilePipeline(service, engine="host")
+        try:
+            ctxs = _grid(spec)
+            assert assign_supertiles(ctxs) == 6
+            assert pipe.handle_batch(ctxs) == ref
+        finally:
+            pipe.close()
+
+    def test_device_engine_grid(self, service):
+        spec = _spec()
+        ref = _independent(service, lambda: _grid(spec))
+        pipe = TilePipeline(service, engine="device", device_deflate=True)
+        pipe.mesh = None
+        try:
+            before = dict(stile.SUPERTILE_LANES._values)
+            ctxs = _grid(spec)
+            assign_supertiles(ctxs)
+            assert pipe.handle_batch(ctxs) == ref
+            after = dict(stile.SUPERTILE_LANES._values)
+            key = (("path", "device"),)
+            assert after.get(key, 0) - before.get(key, 0) == 6
+        finally:
+            pipe.close()
+
+    def test_edge_tiles_mixed_sizes(self, service):
+        """A DZI-style edge row: rightmost/bottom tiles are smaller —
+        the fused carve sub-groups by real size and every lane stays
+        byte-identical."""
+        spec = _spec()
+
+        def ctxs_fn():
+            out = []
+            for y, h in ((0, 48), (48, 48)):
+                for x, w in ((0, 48), (48, 48), (96, 32)):
+                    out.append(_ctx(spec, x, y, w, h))
+            return out
+
+        ref = _independent(service, ctxs_fn)
+        assert all(b is not None for b in ref)
+        for engine, dd in (("host", False), ("device", True)):
+            pipe = TilePipeline(
+                service, engine=engine, device_deflate=dd, buckets=(64,),
+            )
+            pipe.mesh = None
+            try:
+                ctxs = ctxs_fn()
+                assert assign_supertiles(ctxs) == 6
+                assert pipe.handle_batch(ctxs) == ref, engine
+            finally:
+                pipe.close()
+
+    def test_projection_spec_fused(self, service):
+        spec = _spec(p="intmax|0:3")
+
+        def ctxs_fn():
+            return _grid(spec, cols=2, rows=2, z=0)
+
+        ref = _independent(service, ctxs_fn)
+        assert all(b is not None for b in ref)
+        pipe = TilePipeline(service, engine="device", device_deflate=True)
+        pipe.mesh = None
+        try:
+            ctxs = ctxs_fn()
+            assign_supertiles(ctxs)
+            assert pipe.handle_batch(ctxs) == ref
+        finally:
+            pipe.close()
+
+    def test_jpeg_burst_carves_host_side(self, service):
+        spec = _spec(format="jpeg", q="0.9")
+        ref = _independent(service, lambda: _grid(spec))
+        assert all(b is not None and b[:2] == b"\xff\xd8" for b in ref)
+        pipe = TilePipeline(service, engine="host")
+        try:
+            ctxs = _grid(spec)
+            assert assign_supertiles(ctxs) == 6
+            assert pipe.handle_batch(ctxs) == ref
+        finally:
+            pipe.close()
+
+
+# ---------------------------------------------------------------------------
+# Burst-split correctness: one bad lane never poisons its neighbors
+# ---------------------------------------------------------------------------
+
+
+class TestBurstSplit:
+    def test_expired_lane_splits_out(self, service):
+        spec = _spec()
+        ref = _independent(service, lambda: _grid(spec))
+        pipe = TilePipeline(service, engine="host")
+        try:
+            ctxs = _grid(spec)
+            assign_supertiles(ctxs)
+            assert all(c.supertile is not None for c in ctxs)
+            ctxs[2].deadline = Deadline.after(0)  # expires post-stamp
+            out = pipe.handle_batch(ctxs)
+            assert out[2] is None  # -> 504 at the dispatch layer
+            for i in (0, 1, 3, 4, 5):
+                assert out[i] == ref[i]
+        finally:
+            pipe.close()
+
+    def test_404_lane_splits_out(self, service):
+        spec = _spec()
+        ref = _independent(service, lambda: _grid(spec, cols=2, rows=1))
+        pipe = TilePipeline(service, engine="host")
+        try:
+            ctxs = _grid(spec, cols=2, rows=1)
+            # adjacent but off the 128px plane: resolve fails -> 404
+            ctxs.append(_ctx(spec, 64, 0, 96, 32))
+            assign_supertiles(ctxs)
+            assert all(c.supertile is not None for c in ctxs)
+            out = pipe.handle_batch(ctxs)
+            assert out[:2] == ref and out[2] is None
+        finally:
+            pipe.close()
+
+    @pytest.mark.resilience
+    def test_supertile_fault_neighbors_identical(self, service):
+        """The chaos lane: the fused super-tile dispatch down -> the
+        whole group serves through the host carve, byte-identical."""
+        spec = _spec()
+        ref = _independent(service, lambda: _grid(spec))
+        pipe = TilePipeline(service, engine="device", device_deflate=True)
+        pipe.mesh = None
+        try:
+            INJECTOR.install(
+                "render.supertile", always(RuntimeError("fused down"))
+            )
+            ctxs = _grid(spec)
+            assign_supertiles(ctxs)
+            assert pipe.handle_batch(ctxs) == ref
+            assert INJECTOR.calls("render.supertile") >= 1
+        finally:
+            pipe.close()
+
+    @pytest.mark.resilience
+    def test_stale_stamp_falls_back(self, service):
+        """A stamp whose partner lanes vanished (all but one filtered
+        out) re-validates down to the independent path."""
+        spec = _spec()
+        ref = _independent(service, lambda: _grid(spec, cols=2, rows=1))
+        pipe = TilePipeline(service, engine="host")
+        try:
+            ctxs = _grid(spec, cols=2, rows=1)
+            assign_supertiles(ctxs)
+            ctxs[1].deadline = Deadline.after(0)
+            out = pipe.handle_batch(ctxs)
+            assert out[0] == ref[0] and out[1] is None
+        finally:
+            pipe.close()
+
+
+class TestDegradedIsolation:
+    def test_degraded_lane_never_fuses_and_serves_degraded_bytes(
+        self, service
+    ):
+        spec = _spec()
+        host = TilePipeline(service, engine="host")
+        try:
+            deg_ref = host.handle(_ctx(spec, 0, 0, 64, 64, degraded=1))
+            full_ref = [
+                host.handle(_ctx(spec, x, 0, 32, 32)) for x in (0, 32)
+            ]
+            assert deg_ref is not None and deg_ref not in full_ref
+            ctxs = [
+                _ctx(spec, 0, 0, 32, 32),
+                _ctx(spec, 32, 0, 32, 32),
+                _ctx(spec, 0, 0, 64, 64, degraded=1),
+            ]
+            assign_supertiles(ctxs)
+            assert ctxs[0].supertile is not None
+            assert ctxs[2].supertile is None
+            out = host.handle_batch(ctxs)
+            assert out[0] == full_ref[0] and out[1] == full_ref[1]
+            assert out[2] == deg_ref
+        finally:
+            host.close()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: ROI masks through submit_render / the streaming queue
+# ---------------------------------------------------------------------------
+
+
+class TestMaskQueueWiring:
+    def test_masked_lane_takes_device_path_byte_identical(self, service):
+        roi = (
+            '[{"type":"rect","x":8,"y":8,"w":30,"h":20},'
+            '{"type":"ellipse","cx":40,"cy":24,"rx":12,"ry":9}]'
+        )
+        spec = _spec(roi=roi)
+        host = TilePipeline(service, engine="host")
+        dev = TilePipeline(service, engine="device", device_deflate=True)
+        dev.mesh = None
+        try:
+            ref = host.handle(_ctx(spec, 0, 0, 64, 48))
+            assert ref is not None
+            before = dict(RENDER_TILES._values)
+            out = dev.handle_batch([_ctx(spec, 0, 0, 64, 48)])[0]
+            after = dict(RENDER_TILES._values)
+            assert out == ref
+            key = (("format", "png"), ("path", "device"))
+            assert after.get(key, 0) > before.get(key, 0), (
+                "masked lane detoured to the host mirror"
+            )
+        finally:
+            host.close()
+            dev.close()
+
+    def test_masked_and_unmasked_lanes_share_a_batch(self, service):
+        roi = '[{"type":"rect","x":0,"y":0,"w":20,"h":20}]'
+        masked, plain = _spec(roi=roi), _spec()
+        host = TilePipeline(service, engine="host")
+        dev = TilePipeline(service, engine="device", device_deflate=True)
+        dev.mesh = None
+        try:
+            ref = [
+                host.handle(_ctx(masked, 0, 0, 64, 48)),
+                host.handle(_ctx(plain, 0, 0, 64, 48)),
+            ]
+            out = dev.handle_batch([
+                _ctx(masked, 0, 0, 64, 48),
+                _ctx(plain, 0, 0, 64, 48),
+            ])
+            assert out == ref
+        finally:
+            host.close()
+            dev.close()
+
+    @pytest.mark.resilience
+    def test_masked_device_fault_falls_back_identical(self, service):
+        roi = '[{"type":"rect","x":4,"y":4,"w":40,"h":30}]'
+        spec = _spec(roi=roi)
+        dev = TilePipeline(service, engine="device", device_deflate=True)
+        dev.mesh = None
+        try:
+            clean = dev.handle_batch([_ctx(spec, 0, 0, 64, 48)])[0]
+            assert clean is not None
+            INJECTOR.install(
+                "render.engine", always(RuntimeError("engine down"))
+            )
+            faulted = dev.handle_batch([_ctx(spec, 0, 0, 64, 48)])[0]
+            assert faulted == clean
+        finally:
+            dev.close()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: device-resident cached-plane projection crops
+# ---------------------------------------------------------------------------
+
+
+class TestProjectionResidency:
+    def test_warm_projection_pan_zero_host_pulls(self, service):
+        """Second pan over plane-cache-resident planes: crops stay
+        device-resident through project + composite + deflate — ZERO
+        host round trips (the r19 regression pin), bytes identical to
+        the host engine."""
+        spec = _spec(p="intmax|0:3")
+        host = TilePipeline(service, engine="host")
+        dev = TilePipeline(
+            service, engine="device", device_deflate=True, buckets=(64,),
+        )
+        dev.mesh = None
+        try:
+            ref = host.handle(_ctx(spec, 0, 0, 64, 32, z=0))
+            # warm: first touches read host-side and admit the planes
+            # (admit_after=2), third call serves from HBM
+            for _ in range(3):
+                out = dev.handle_batch([_ctx(spec, 0, 0, 64, 32, z=0)])[0]
+            assert out == ref
+            pulls = dev._proj_host_pulls
+            out2 = dev.handle_batch([_ctx(spec, 0, 0, 64, 32, z=0)])[0]
+            assert out2 == ref
+            assert dev._proj_host_pulls == pulls, (
+                "warm projection pan round-tripped through the host"
+            )
+            assert dev.render_snapshot()["projection_host_pulls"] == pulls
+        finally:
+            host.close()
+            dev.close()
+
+
+# ---------------------------------------------------------------------------
+# Config + batcher stamping seam
+# ---------------------------------------------------------------------------
+
+
+def _cfg(extra=None):
+    raw = {"session-store": {"type": "memory"}}
+    raw.update(extra or {})
+    return Config.from_dict(raw)
+
+
+class TestSupertileConfig:
+    def test_defaults(self):
+        cfg = _cfg()
+        st = cfg.supertile
+        assert st.enabled and st.max_pixels == 4 << 20
+        assert st.min_lanes == 2 and st.coverage == 0.5
+        assert cfg.cache.prefetch.viewport_span == 1
+
+    def test_unknown_key_fails_startup(self):
+        with pytest.raises(ConfigError):
+            _cfg({"supertile": {"max-pixel": 1 << 20}})
+
+    @pytest.mark.parametrize("block", [
+        {"max-pixels": "many"},
+        {"max-pixels": 1024},  # below the one-tile floor
+        {"min-lanes": 1},
+        {"coverage": 1.5},
+        {"coverage": "-"},
+    ])
+    def test_invalid_values_fail_startup(self, block):
+        with pytest.raises(ConfigError):
+            _cfg({"supertile": block})
+
+    def test_viewport_span_validated(self):
+        cfg = _cfg({"cache": {"prefetch": {"viewport-span": 3}}})
+        assert cfg.cache.prefetch.viewport_span == 3
+        with pytest.raises(ConfigError):
+            _cfg({"cache": {"prefetch": {"viewport-span": -1}}})
+
+
+class TestBatcherStamping:
+    def test_worker_stamps_adjacent_render_lanes(self, loop):
+        """The dispatch seam: a coalesced batch of adjacent render
+        lanes reaches the pipeline already stamped (adjacency
+        detection lives in the batcher, not the pipeline)."""
+        seen = {}
+
+        class Recorder:
+            def handle(self, ctx):
+                return b"x"
+
+            def handle_batch(self, ctxs):
+                seen["stamped"] = [
+                    c.supertile is not None for c in ctxs
+                ]
+                return [b"x"] * len(ctxs)
+
+        cfg = _cfg()
+        worker = BatchingTileWorker(
+            Recorder(), AllowListValidator(), max_batch=8,
+            coalesce_window_ms=20.0, workers=1,
+            supertile=cfg.supertile,
+        )
+        spec = _spec()
+
+        async def run():
+            await worker.start()
+            await asyncio.gather(*[
+                worker.handle(c) for c in _grid(spec, cols=2, rows=2)
+            ])
+            await worker.close()
+
+        loop.run_until_complete(run())
+        assert seen["stamped"] == [True] * 4
+
+    def test_disabled_config_never_stamps(self, loop):
+        seen = {}
+
+        class Recorder:
+            def handle(self, ctx):
+                return b"x"
+
+            def handle_batch(self, ctxs):
+                seen["stamped"] = [
+                    c.supertile is not None for c in ctxs
+                ]
+                return [b"x"] * len(ctxs)
+
+        cfg = _cfg({"supertile": {"enabled": False}})
+        worker = BatchingTileWorker(
+            Recorder(), AllowListValidator(), max_batch=8,
+            coalesce_window_ms=20.0, workers=1,
+            supertile=cfg.supertile,
+        )
+        spec = _spec()
+
+        async def run():
+            await worker.start()
+            await asyncio.gather(*[
+                worker.handle(c) for c in _grid(spec, cols=2, rows=1)
+            ])
+            await worker.close()
+
+        loop.run_until_complete(run())
+        assert seen["stamped"] == [False, False]
+
+
+# ---------------------------------------------------------------------------
+# Whole-viewport prefetch speculation
+# ---------------------------------------------------------------------------
+
+
+class _Admission:
+    def has_headroom(self, fraction=0.5):
+        return True
+
+
+class TestViewportSpeculation:
+    def _prefetcher(self, span):
+        from omero_ms_pixel_buffer_tpu.cache.prefetch import (
+            ViewportPrefetcher,
+        )
+
+        return ViewportPrefetcher(
+            lambda ctx, key: None, cache=None, admission=_Admission(),
+            lookahead=2, viewport_span=span,
+        )
+
+    def test_band_predicted_at_every_step(self):
+        pre = self._prefetcher(span=1)
+        spec = _spec()
+        pre.observe(_ctx(spec, 0, 32, 32, 32))
+        pre.observe(_ctx(spec, 32, 32, 32, 32))
+        regions = [
+            (c.region.x, c.region.y) for c, _ in pre._queue._queue
+        ]
+        # two lookahead steps, each a full 3-tile perpendicular band
+        expected = {
+            (64, 32), (64, 0), (64, 64),
+            (96, 32), (96, 0), (96, 64),
+        }
+        assert expected == set(regions)
+
+    def test_span_zero_restores_linear_prediction(self):
+        pre = self._prefetcher(span=0)
+        spec = _spec()
+        pre.observe(_ctx(spec, 0, 32, 32, 32))
+        pre.observe(_ctx(spec, 32, 32, 32, 32))
+        regions = {
+            (c.region.x, c.region.y) for c, _ in pre._queue._queue
+        }
+        assert regions == {(64, 32), (96, 32), (64, 0), (64, 64)}
+
+    def test_predictions_carry_burst_geometry(self):
+        pre = self._prefetcher(span=1)
+        spec = _spec()
+        hint = BurstHint(32, 32)
+        pre.observe(_ctx(spec, 0, 32, 32, 32, burst=hint))
+        pre.observe(_ctx(spec, 32, 32, 32, 32, burst=hint))
+        assert pre._queue.qsize() > 0
+        for c, _ in pre._queue._queue:
+            assert c.burst is hint
+        # hintless native pans synthesize the grid from the tile size
+        pre2 = self._prefetcher(span=1)
+        pre2.observe(_ctx(spec, 0, 32, 32, 32))
+        pre2.observe(_ctx(spec, 32, 32, 32, 32))
+        for c, _ in pre2._queue._queue:
+            assert c.burst == BurstHint(32, 32)
+
+
+# ---------------------------------------------------------------------------
+# HTTP end to end: a DZI burst shares bytes + ETags with native /render
+# ---------------------------------------------------------------------------
+
+
+async def _make_app(tmp_path, config_extra=None):
+    registry = _write_fixture(tmp_path)
+    raw = {
+        "session-store": {"type": "memory"},
+        "backend": {"batching": {"coalesce-window-ms": 5.0}},
+        "protocols": {
+            "dzi": {"tile-size": 32},
+            "iiif": {"tile-size": 32},
+            "iris": {"tile-size": 32},
+        },
+    }
+    if config_extra:
+        raw.update(config_extra)
+    config = Config.from_dict(raw)
+    app_obj = PixelBufferApp(
+        config,
+        pixels_service=PixelsService(registry),
+        session_store=MemorySessionStore({"ck": "omero-key-1"}),
+    )
+    client = TestClient(
+        TestServer(app_obj.make_app()), loop=asyncio.get_running_loop()
+    )
+    await client.start_server()
+    return app_obj, client
+
+
+class TestHttpBurst:
+    async def test_dzi_burst_matches_native_bytes_and_etags(
+        self, tmp_path
+    ):
+        """A concurrent DZI row burst (the batcher fuses what
+        coalesces) serves bytes + ETags identical to sequential
+        native /render requests for the same tiles."""
+        app_obj, client = await _make_app(tmp_path)
+        try:
+            c = "1|0:4095$FF0000,2|0:4095$00FF00"
+            native = {}
+            for col in range(4):
+                r = await client.get(
+                    f"/render/1/1/0/0?x={32*col}&y=0&w=32&h=32&c={c}",
+                    headers=AUTH,
+                )
+                assert r.status == 200
+                native[col] = (await r.read(), r.headers.get("ETag"))
+            # max level for 128x96 is 7; level 7 = resolution 0
+            burst = await asyncio.gather(*[
+                client.get(
+                    f"/dzi/1_files/7/{col}_0.png?c={c}&z=1",
+                    headers=AUTH,
+                )
+                for col in range(4)
+            ])
+            for col, resp in enumerate(burst):
+                assert resp.status == 200
+                body = await resp.read()
+                assert body == native[col][0]
+                assert resp.headers.get("ETag") == native[col][1]
+        finally:
+            await client.close()

@@ -563,8 +563,11 @@ _JAX_SYNC_SCOPE = (
     "omero_ms_pixel_buffer_tpu/models/device_dispatch.py",
     "omero_ms_pixel_buffer_tpu/ops/",
     # render/ covers the whole analysis plane too: engine.py,
-    # analysis.py (device histograms), masks.py — every device->host
-    # pull there needs the intended-sink justification
+    # analysis.py (device histograms), masks.py — and, since r19,
+    # supertile.py (the fused composite+carve program: its carved
+    # batches must stay device-resident into the encode queue) —
+    # every device->host pull there needs the intended-sink
+    # justification
     "omero_ms_pixel_buffer_tpu/render/",
 )
 _JAX_JIT_SCOPE = _JAX_SYNC_SCOPE + (
