@@ -1193,6 +1193,307 @@ def bench_lifecycle(cache_dir: str) -> dict:
     return out
 
 
+def bench_decentralized(cache_dir: str) -> dict:
+    """Decentralized control plane (r20) section — two drives, two
+    pins:
+
+    - ``redisless``: a three-replica GOSSIP cluster (Redis demoted to
+      L2 + join hint) is warmed, then the RESP stub is killed
+      mid-traffic and the same hot set is driven again. Pin
+      ``cluster_ok_redisless_convergence``: every replica's
+      membership view stays fully converged through the outage, the
+      post-outage warm-hit rate holds >= 0.8, and the whole drive
+      serves ZERO 5xx — "Redis down" degrades the shared cache,
+      never coordination.
+    - ``integrity``: one replica of a gossip+suspicion fleet serves
+      bit-flipped bodies under intact ETags (the wrong-but-200 bad-
+      RAM failure). Every transfer is discarded at the content-hash
+      gate and the strikes feed the suspicion quorum. Pin
+      ``cluster_ok_integrity_demotion``: zero wrong bytes reach any
+      client, and the corrupt replica is demoted within <= 2 brain
+      rounds of the verdict landing (one round to publish the
+      verdict over gossip, one for the peers to apply it).
+    """
+    import socket
+
+    from aiohttp import ClientSession, web
+
+    from omero_ms_pixel_buffer_tpu.auth.stores import MemorySessionStore
+    from omero_ms_pixel_buffer_tpu.cache.plane.resp_stub import (
+        InMemoryRespServer,
+    )
+    from omero_ms_pixel_buffer_tpu.cache.result_cache import CachedTile
+    from omero_ms_pixel_buffer_tpu.http.server import PixelBufferApp
+    from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
+    from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+        ImageRegistry,
+        PixelsService,
+    )
+    from omero_ms_pixel_buffer_tpu.tile_ctx import TileCtx
+    from omero_ms_pixel_buffer_tpu.utils.config import Config
+
+    out: dict = {}
+    headers = {"Cookie": "sessionid=bench-cookie"}
+    img_path = os.path.join(cache_dir, "cluster_fixture.ome.tiff")
+    if not os.path.exists(img_path):
+        rng_local = np.random.default_rng(23)
+        img = rng_local.integers(
+            0, 60000, (1, 1, 1, 512, 512), dtype=np.uint16
+        )
+        write_ome_tiff(
+            img_path, img, tile_size=(64, 64), pyramid_levels=2
+        )
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def tile_paths(n):
+        return [
+            f"/tile/1/0/0/0?x={64 * (i % 8)}&y={64 * (i // 8)}"
+            "&w=64&h=64&format=png"
+            for i in range(n)
+        ]
+
+    def key_for(app_obj, path):
+        query = dict(
+            kv.split("=") for kv in path.split("?", 1)[1].split("&")
+        )
+        _, _, image_id, z, c, t = path.split("?", 1)[0].split("/")
+        ctx = TileCtx.from_params(
+            {"imageId": image_id, "z": z, "c": c, "t": t, **query},
+            None,
+        )
+        return ctx.cache_key(app_obj.pipeline.encode_signature())
+
+    gossip_block = {
+        "gossip": {
+            "enabled": True, "interval-s": 0.15, "fail-after-s": 1.2,
+        },
+    }
+
+    async def boot(members, self_url, port, resp_uri, extra):
+        registry = ImageRegistry()
+        registry.add(1, img_path)
+        cluster_block = {
+            "members": members, "self": self_url,
+            "peer-timeout-ms": 3000, **(extra or {}),
+        }
+        if resp_uri:
+            cluster_block["l2"] = {"uri": resp_uri}
+        config = Config.from_dict({
+            "session-store": {"type": "memory"},
+            "backend": {"batching": {"coalesce-window-ms": 1.0}},
+            "cache": {"prefetch": {"enabled": False}},
+            "cluster": cluster_block,
+        })
+        app_obj = PixelBufferApp(
+            config,
+            pixels_service=PixelsService(registry),
+            session_store=MemorySessionStore(
+                {"bench-cookie": "bench-key"}
+            ),
+        )
+        runner = web.AppRunner(app_obj.make_app(), access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        await site.start()
+        return app_obj, runner
+
+    n_hot = 16
+    warm_sources = ("hit", "l2-hit", "peer-hit")
+
+    async def redisless_drive() -> dict:
+        resp = InMemoryRespServer()
+        await resp.start()
+        ports = [free_port() for _ in range(3)]
+        members = [f"http://127.0.0.1:{p}" for p in ports]
+        nodes = []
+        for i, port in enumerate(ports):
+            nodes.append(await boot(
+                members, members[i], port, resp.uri, gossip_block,
+            ))
+        statuses: list = []
+        post_sources: list = []
+        try:
+            await asyncio.sleep(0.6)  # gossip rounds seed the view
+            paths = tile_paths(n_hot)
+            async with ClientSession() as http:
+                for path in paths:  # warm every replica
+                    for app_obj, _r in nodes:
+                        async with http.get(
+                            app_obj.cache_plane.self_url + path,
+                            headers=headers,
+                        ) as r:
+                            await r.read()
+                            statuses.append(r.status)
+                # the coordinator dies mid-traffic
+                await resp.close()
+                await asyncio.sleep(0.6)  # gossip keeps ticking
+                for path in paths:
+                    for app_obj, _r in nodes:
+                        async with http.get(
+                            app_obj.cache_plane.self_url + path,
+                            headers=headers,
+                        ) as r:
+                            await r.read()
+                            statuses.append(r.status)
+                            post_sources.append(
+                                r.headers.get("X-Cache")
+                            )
+            converged = all(
+                len(a.cache_plane.membership.members) == 3
+                for a, _r in nodes
+            )
+            errors = sum(1 for s in statuses if s >= 500)
+            warm = sum(1 for s in post_sources if s in warm_sources)
+            return {
+                "requests": len(statuses),
+                "serving_errors": errors,
+                "ring_converged_after_outage": converged,
+                "post_outage_warm_hit_rate": round(
+                    warm / max(1, len(post_sources)), 3
+                ),
+            }
+        finally:
+            for _a, runner in nodes:
+                try:
+                    await runner.cleanup()
+                except Exception:
+                    pass
+            await resp.close()
+
+    out["redisless"] = asyncio.run(redisless_drive())
+
+    async def integrity_drive() -> dict:
+        ports = [free_port() for _ in range(3)]
+        members = [f"http://127.0.0.1:{p}" for p in ports]
+        nodes = []
+        for i, port in enumerate(ports):
+            nodes.append(await boot(
+                members, members[i], port, None,
+                {**gossip_block, "suspect": {"enabled": True}},
+            ))
+        victim_app = nodes[2][0]
+        victim_url = victim_app.cache_plane.self_url
+        healthy = [a for a, _r in nodes[:2]]
+        try:
+            await asyncio.sleep(0.6)
+            paths = tile_paths(n_hot)
+            baseline = {}
+            wrong_bytes = 0
+            async with ClientSession() as http:
+                # baseline through the honest victim: it caches its
+                # owned keys, the healthy replicas only their own
+                for path in paths:
+                    async with http.get(
+                        victim_url + path, headers=headers
+                    ) as r:
+                        baseline[path] = await r.read()
+                # bad-RAM lever: victim serves flipped bytes under
+                # the ORIGINAL ETag from here on
+                cache = victim_app.result_cache
+                inner = cache.get
+
+                async def bad_get(key):
+                    entry = await inner(key)
+                    if entry is None:
+                        return None
+                    flipped = (
+                        bytes([entry.body[0] ^ 0xFF]) + entry.body[1:]
+                    )
+                    return CachedTile(
+                        flipped, etag=entry.etag,
+                        filename=entry.filename,
+                        stored_at=entry.stored_at,
+                    )
+
+                cache.get = bad_get
+                for a in healthy:
+                    for path in paths:
+                        async with http.get(
+                            a.cache_plane.self_url + path,
+                            headers=headers,
+                        ) as r:
+                            if await r.read() != baseline[path]:
+                                wrong_bytes += 1
+
+                async def _verdicts():
+                    while not all(
+                        victim_url in a.cache_plane.brains.my_verdicts
+                        for a in healthy
+                    ):
+                        await asyncio.sleep(0.02)
+
+                await asyncio.wait_for(_verdicts(), 10.0)
+                base_rounds = {
+                    a: a.cache_plane.membership.refreshes
+                    for a in healthy
+                }
+                demote_rounds: dict = {}
+
+                async def _demoted():
+                    while len(demote_rounds) < len(healthy):
+                        for a in healthy:
+                            if a in demote_rounds:
+                                continue
+                            if victim_url in a.cache_plane.brains.demoted:
+                                demote_rounds[a] = (
+                                    a.cache_plane.membership.refreshes
+                                    - base_rounds[a]
+                                )
+                        await asyncio.sleep(0.02)
+
+                await asyncio.wait_for(_demoted(), 10.0)
+                # the re-homed keys still serve correct bytes
+                for a in healthy:
+                    for path in paths[:4]:
+                        async with http.get(
+                            a.cache_plane.self_url + path,
+                            headers=headers,
+                        ) as r:
+                            if await r.read() != baseline[path]:
+                                wrong_bytes += 1
+            strikes = {
+                a.cache_plane.self_url:
+                    a.cache_plane.corruption.counts().get(victim_url, 0)
+                for a in healthy
+            }
+            return {
+                "wrong_bytes_served": wrong_bytes,
+                "demoted": True,
+                "rounds_to_demote": max(demote_rounds.values()),
+                "round_bound": 2,
+                "integrity_strikes": strikes,
+            }
+        finally:
+            for _a, runner in nodes:
+                try:
+                    await runner.cleanup()
+                except Exception:
+                    pass
+
+    out["integrity"] = asyncio.run(integrity_drive())
+
+    rl = out["redisless"]
+    out["cluster_ok_redisless_convergence"] = (
+        rl["serving_errors"] == 0
+        and rl["ring_converged_after_outage"]
+        and rl["post_outage_warm_hit_rate"] >= 0.8
+        and rl["requests"] > 0
+    )
+    it = out["integrity"]
+    out["cluster_ok_integrity_demotion"] = (
+        it["wrong_bytes_served"] == 0
+        and it["demoted"]
+        and it["rounds_to_demote"] <= it["round_bound"]
+    )
+    return out
+
+
 def bench_overload(
     cache_dir: str,
     duration_s: float = 4.0,
@@ -2386,6 +2687,19 @@ def main():
             lifecycle_stats = {"error": f"{type(e).__name__}: {e}"}
             log(f"lifecycle bench failed: {e!r}")
 
+    # --- decentralized control plane (r20): gossip membership through
+    # a Redis outage + corrupt-replica demotion via integrity verdicts
+    # (cluster_ok_redisless_convergence /
+    # cluster_ok_integrity_demotion pins)
+    decentralized_stats: dict = {}
+    if os.environ.get("BENCH_DECENTRALIZED", "1") != "0":
+        try:
+            decentralized_stats = bench_decentralized(cache_dir)
+            log(f"decentralized: {decentralized_stats}")
+        except Exception as e:
+            decentralized_stats = {"error": f"{type(e).__name__}: {e}"}
+            log(f"decentralized bench failed: {e!r}")
+
     # --- batched read plane (r14): cold remote reads over a loopback
     # HTTP object store — sequential vs parallel+coalesced, sharded
     # byte identity, requests-per-tile (io_ok_* pins)
@@ -2475,6 +2789,8 @@ def main():
         record["cluster"] = cluster_stats
     if lifecycle_stats:
         record["lifecycle"] = lifecycle_stats
+    if decentralized_stats:
+        record["decentralized"] = decentralized_stats
     if overload_stats:
         record["overload"] = overload_stats
     if io_stats:
@@ -2579,6 +2895,15 @@ def main():
         )
         comparison["cluster_repair_rounds_to_converge"] = (
             lifecycle_stats["repair"]["rounds_to_converge"]
+        )
+    if decentralized_stats and "redisless" in decentralized_stats:
+        comparison["cluster_redisless_warm_hit_rate"] = (
+            decentralized_stats["redisless"][
+                "post_outage_warm_hit_rate"
+            ]
+        )
+        comparison["cluster_integrity_rounds_to_demote"] = (
+            decentralized_stats["integrity"]["rounds_to_demote"]
         )
     record["engine_comparison"] = comparison
     print(json.dumps(record))

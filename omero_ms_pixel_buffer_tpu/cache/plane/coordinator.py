@@ -40,18 +40,22 @@ from typing import Optional, Tuple
 
 from ...cluster import (
     AntiEntropyRepairer,
+    CorruptionLedger,
     EpochRegistry,
     FleetBrains,
+    GossipManager,
     HedgePolicy,
     HotSetReplicator,
     MembershipManager,
     RedisLink,
+    body_matches,
     build_digest,
     decode_transfer,
     encode_transfer,
     image_id_of,
     parse_digest,
 )
+from ...cluster.integrity import INTEGRITY_FAILS
 from ...cluster.repair import REPAIR_PULLED, REPAIR_ROUNDS
 from ...cluster.replicate import REPLICATION
 from ...obs.recorder import ambient_stage, current_record
@@ -94,10 +98,22 @@ class CachePlane:
         repair_max_keys: int = 64,
         quality=None,
         suspicion=None,
+        gossip_interval_s: float = 0.0,
+        gossip_fanout: int = 2,
+        gossip_fail_after_s: float = 5.0,
+        integrity_verify: bool = True,
     ):
         self.self_url = self_url
         self.secret = secret
         self.result_cache = result_cache
+        # r20 byte integrity: every ingress of remote bytes (peer
+        # fetch, replica push, handoff/warm-up/repair transfer, L2
+        # read) re-hashes the body against the entry's strong ETag;
+        # the ledger turns repeated mismatches into suspicion
+        # verdicts (cluster/integrity.py)
+        self.integrity_verify = bool(integrity_verify)
+        self.corruption = CorruptionLedger()
+        self.gossip_enabled = gossip_interval_s > 0 and bool(self_url)
         # the coordination link: the cluster's OWN connection to the
         # shared Redis (lease scans must not head-of-line-block a
         # serving-path L2 get) — built whenever the shared Redis
@@ -108,8 +124,16 @@ class CachePlane:
         if l2_uri:
             self.link = RedisLink(l2_uri)
             self.epochs = EpochRegistry(self.link)
+        elif self.gossip_enabled:
+            # no Redis at all: epochs still exist — bumps advance the
+            # local high-water mark and gossip disseminates it, so
+            # invalidation keeps converging with no coordinator
+            self.epochs = EpochRegistry(None)
         self.l2 = (
-            RedisL2Tier(l2_uri, ttl_s=l2_ttl_s, epochs=self.epochs)
+            RedisL2Tier(
+                l2_uri, ttl_s=l2_ttl_s, epochs=self.epochs,
+                verify_bodies=self.integrity_verify,
+            )
             if l2_uri else None
         )
         self.ring: Optional[HashRing] = None
@@ -134,13 +158,28 @@ class CachePlane:
         self.draining = False
         self.quality = quality
         self.suspicion = suspicion
-        self.membership: Optional[MembershipManager] = None
+        self.membership = None
         self.brains: Optional[FleetBrains] = None
-        if lease_ttl_s > 0 and self.link is not None and self_url:
+        if self.gossip_enabled and self.peers is not None:
+            # r20 decentralized mode: gossip IS membership; Redis
+            # (when configured) is only the L2 cache and the join-
+            # bootstrap hint the GossipManager consults best-effort
+            self.membership = GossipManager(
+                self.peers, self_url, members or (self_url,),
+                interval_s=gossip_interval_s,
+                fanout=gossip_fanout,
+                fail_after_s=gossip_fail_after_s,
+                on_change=self._on_membership_change,
+                link=self.link, secret=secret or "",
+                epochs=self.epochs,
+            )
+        elif lease_ttl_s > 0 and self.link is not None and self_url:
             self.membership = MembershipManager(
                 self.link, self_url, members or (self_url,),
                 lease_ttl_s, on_change=self._on_membership_change,
+                secret=secret or "",
             )
+        if self.membership is not None:
             self.brains = FleetBrains(
                 self.link, self_url,
                 scheduler=scheduler, admission=admission,
@@ -150,6 +189,8 @@ class CachePlane:
                     if self.peers is not None else None
                 ),
                 on_demote=self._on_demote,
+                secret=secret or "",
+                corruption_source=self.corruption.counts,
             )
         self.replicator: Optional[HotSetReplicator] = None
         if replication_factor > 1 and self.peers is not None:
@@ -234,17 +275,38 @@ class CachePlane:
     # -- cluster coordination loop -------------------------------------
 
     async def _coord_loop(self) -> None:
-        """The heartbeat: lease refresh + membership scan, brain
-        publish/collect, and — once, after the first successful
-        refresh — the join-time warm-up pull. One loop, one cadence;
-        each round degrades independently."""
+        """The heartbeat: membership round (lease refresh + scan, or
+        a gossip push-pull fanout), brain exchange, and — once, after
+        the first successful refresh — the join-time warm-up pull.
+        One loop, one cadence; each round degrades independently.
+
+        In gossip mode the brain payload is computed BEFORE the round
+        (it piggybacks on the outbound digest) and the collected
+        fleet map comes from the gossip state instead of a Redis
+        MGET — so pressure, dead-dependency suspicion, and quality
+        demotion all keep converging with Redis gone entirely."""
         membership = self.membership
+        gossip_mode = isinstance(membership, GossipManager)
         first = True
         while not self._closed:
+            if gossip_mode and self.brains is not None:
+                membership.set_local_brain(
+                    self.brains.local_payload()
+                )
             ok = await membership.refresh_once()
             if self.brains is not None and not self._closed:
-                await self.brains.publish_once(membership.interval_s)
-                await self.brains.collect_once(membership.members)
+                if gossip_mode:
+                    self.brains.apply_fleet(
+                        membership.fleet_brains(),
+                        membership.members,
+                    )
+                else:
+                    await self.brains.publish_once(
+                        membership.interval_s
+                    )
+                    await self.brains.collect_once(
+                        membership.members
+                    )
             if first and ok:
                 first = False
                 # spawned, not awaited: warm-up pulls each peer under
@@ -341,12 +403,39 @@ class CachePlane:
             )
             if body is None:
                 continue
-            pulled += await self._absorb_transfer(body)
+            pulled += await self._absorb_transfer(
+                body, source="transfer", member=member
+            )
         if pulled:
             self.replicator.transfers_pulled += 1
             log.info("join warm-up: absorbed %d hot entries", pulled)
 
-    async def _absorb_transfer(self, body: bytes) -> int:
+    def verify_entry_bytes(
+        self, entry: CachedTile, source: str,
+        member: Optional[str] = None,
+    ) -> bool:
+        """The single integrity gate every ingress of remote bytes
+        passes: True when the body hashes to the entry's strong ETag
+        (or verification is disabled). A failure counts by source,
+        strikes the sending member in the corruption ledger (feeding
+        the suspicion quorum), and the caller MUST discard the
+        bytes."""
+        if not self.integrity_verify:
+            return True
+        if body_matches(entry.etag, entry.body):
+            return True
+        INTEGRITY_FAILS.inc(source=source)
+        self.corruption.note(member)
+        log.warning(
+            "integrity check failed on %s bytes from %s — discarded",
+            source, member or "<unknown>",
+        )
+        return False
+
+    async def _absorb_transfer(
+        self, body: bytes, source: str = "transfer",
+        member: Optional[str] = None,
+    ) -> int:
         from .l2 import decode_entry_epoch
 
         cache = self.result_cache
@@ -354,6 +443,10 @@ class CachePlane:
         for key, frame in decode_transfer(body):
             entry, epoch = decode_entry_epoch(frame)
             if entry is None:
+                continue
+            if not self.verify_entry_bytes(
+                entry, source, member=member
+            ):
                 continue
             if self.epochs is not None and self.epochs.is_stale(
                 key, epoch
@@ -444,12 +537,16 @@ class CachePlane:
             return await self.membership.release_lease()
         return True
 
-    async def absorb_handoff(self, body: bytes) -> int:
+    async def absorb_handoff(
+        self, body: bytes, member: Optional[str] = None,
+    ) -> int:
         """Inbound half of the drain handoff: transfer-framed entries
         from a draining peer, admitted through the same epoch-checked
-        path as a join warm-up (a handoff can never resurrect purged
-        bytes)."""
-        stored = await self._absorb_transfer(body)
+        AND hash-checked path as a join warm-up (a handoff can never
+        resurrect purged bytes — or inject corrupt ones)."""
+        stored = await self._absorb_transfer(
+            body, source="handoff", member=member
+        )
         if self.replicator is not None:
             self.replicator.received += stored
         REPLICATION.inc(op="handoff_recv", outcome="ok")
@@ -467,13 +564,17 @@ class CachePlane:
 
     def digest_payload(self, limit: int) -> bytes:
         """The /internal/digest response: a compact (key, epoch)
-        summary of this replica's hottest RAM entries — what the
-        replication contract says its successors should hold."""
+        summary of this replica's WARM SET — the hottest RAM entries
+        first, then the disk tier's manifest keys (r20) — what the
+        replication contract says its successors should hold. Before
+        the disk keys joined, anti-entropy only converged the RAM
+        slice: an entry that spilled to disk was invisible to repair
+        and its replica copies silently rotted away across churn."""
         cache = self.result_cache
         if cache is None or limit <= 0:
             return build_digest([])
         items = []
-        for key, _entry in cache.hot_entries(limit):
+        for key in cache.warm_keys(limit):
             epoch = None
             if self.epochs is not None:
                 image_id = image_id_of(key)
@@ -575,7 +676,9 @@ class CachePlane:
             rep.pull_errors += 1
             REPAIR_ROUNDS.inc(outcome="pull_error")
             return 0
-        stored = await self._absorb_transfer(frames)
+        stored = await self._absorb_transfer(
+            frames, source="repair", member=peer
+        )
         rep.pulled += stored
         rep.last_round_pulled = stored
         if stored:
@@ -661,6 +764,10 @@ class CachePlane:
                             trace_context, epoch,
                         )
                     )
+                    # the owner rides along so a late consumer (the
+                    # hedge race in http/server) can attribute an
+                    # integrity failure to it
+                    task.ompb_owner = owner
                     done, pending = await asyncio.wait(
                         {task}, timeout=delay
                     )
@@ -673,7 +780,7 @@ class CachePlane:
                             rec.tag("hedge", "fired")
                         return None, None, epoch, task
                     result = task.result()  # ompb-lint: disable=loop-block -- asyncio.Task already in asyncio.wait's done set: result() returns immediately, never blocks
-                entry = self.entry_from_peer_result(result)
+                entry = self.entry_from_peer(result, owner)
                 if entry is not None:
                     return entry, "peer-hit", epoch, None
         return None, None, epoch, None
@@ -701,17 +808,39 @@ class CachePlane:
     @staticmethod
     def entry_from_peer_result(result) -> Optional[CachedTile]:
         """A ``CachedTile`` from a completed peer exchange, or None
-        for any failure/non-200 (the caller renders locally)."""
+        for any failure/non-200 (the caller renders locally). The
+        declared ETag is carried verbatim — ``entry_from_peer`` is
+        the integrity-checked wrapper serving paths must use."""
         if result is None or result[0] != 200:
             return None
         _status, headers, body = result
+        etag = headers.get("etag")
+        if etag is None:
+            # never auto-compute a validator for remote bytes: a
+            # CachedTile minted without one would hash ITSELF into
+            # a matching ETag and sail through the integrity gate
+            return None
         return CachedTile(
             body,
-            etag=headers.get("etag"),
+            etag=etag,
             filename=filename_from_disposition(
                 headers.get("content-disposition", "")
             ),
         )
+
+    def entry_from_peer(
+        self, result, owner: Optional[str] = None
+    ) -> Optional[CachedTile]:
+        """The serving-path version: parse AND verify. A body that
+        does not hash to the owner's declared ETag is discarded (the
+        caller renders locally — wrong bytes are never served) and
+        strikes the owner in the corruption ledger."""
+        entry = self.entry_from_peer_result(result)
+        if entry is None:
+            return None
+        if not self.verify_entry_bytes(entry, "peer", member=owner):
+            return None
+        return entry
 
     def publish(
         self, key: str, entry: CachedTile,
@@ -854,9 +983,21 @@ class CachePlane:
                 target=label, outcome="error" if failed else "ok"
             )
 
+    def gossip_receive(self, remote: dict) -> Optional[dict]:
+        """Inbound half of a push-pull gossip exchange (the
+        ``/internal/gossip`` handler): merge the sender's digest,
+        reply with ours. None when this replica does not run gossip
+        membership (the handler answers 503 — a mixed-mode fleet
+        mid-migration degrades to the Redis plane)."""
+        membership = self.membership
+        if not isinstance(membership, GossipManager):
+            return None
+        return membership.receive(remote)
+
     def members_view(self) -> tuple:
-        """The live member list: the lease view when membership is
-        dynamic, the ring's (bootstrap) list otherwise."""
+        """The live member list: the lease/gossip view when
+        membership is dynamic, the ring's (bootstrap) list
+        otherwise."""
         if self.membership is not None:
             return tuple(self.membership.members)
         if self.ring is not None:
@@ -886,6 +1027,11 @@ class CachePlane:
             "authenticated": bool(self.secret),
             "draining": self.draining,
             "demoted": sorted(self.demoted),
+            "gossip": self.gossip_enabled,
+            "integrity": {
+                "verify": self.integrity_verify,
+                "ledger": self.corruption.snapshot(),
+            },
         }
         if self.repairer is not None:
             out["repair"] = self.repairer.snapshot()

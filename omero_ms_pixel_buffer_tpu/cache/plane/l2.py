@@ -32,6 +32,7 @@ import time
 from typing import List, Optional, Tuple
 from urllib.parse import urlparse
 
+from ...cluster.integrity import INTEGRITY_FAILS, body_matches
 from ...resilience.breaker import BreakerOpenError, for_dependency
 from ...resilience.faultinject import INJECTOR
 from ...resilience.timeouts import io_timeout_s
@@ -108,6 +109,7 @@ class RedisL2Tier:
         ttl_s: float = 3600.0,
         key_prefix: str = KEY_PREFIX,
         epochs=None,
+        verify_bodies: bool = True,
     ):
         parsed = urlparse(uri)
         self.host = parsed.hostname or "localhost"
@@ -122,6 +124,13 @@ class RedisL2Tier:
         # writer's observed epoch — cluster invalidation stops being
         # TTL-backstopped
         self.epochs = epochs
+        # r20 integrity: every served body is re-hashed against the
+        # frame's strong ETag — a bit-flipped Redis value (failing
+        # RAM on the Redis host, a tampering writer) reads as a miss
+        # and the entry is deleted, instead of flowing to a client
+        # as a wrong-but-200
+        self.verify_bodies = verify_bodies
+        self.integrity_fails = 0
         # transport state in the one holder (utils/connstate):
         # exchanges run under the op lock, teardown runs lock-free
         # off the terminal `closed` flag
@@ -289,8 +298,34 @@ class RedisL2Tier:
                 self.epochs.count_stale()
             L2_REQUESTS.inc(op="get", outcome="stale_epoch")
             return None, current_epoch
+        if self.verify_bodies and not body_matches(
+            entry.etag, entry.body
+        ):
+            # the framing decoded but the bytes do not hash to the
+            # ETag the writer stamped: corruption between the
+            # writer's put and this read. Discard, delete, count —
+            # the caller re-renders; wrong bytes are never served.
+            self.integrity_fails += 1
+            INTEGRITY_FAILS.inc(source="l2")
+            L2_REQUESTS.inc(op="get", outcome="integrity_fail")
+            await self.delete(key)
+            return None, current_epoch
         L2_REQUESTS.inc(op="get", outcome="hit")
         return entry, current_epoch
+
+    async def delete(self, key: str) -> bool:
+        """Best-effort DEL of one entry (the integrity path's
+        quarantine). False on any failure — the TTL remains the
+        backstop."""
+        try:
+            await self._guarded(b"DEL", self._key(key))
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            L2_REQUESTS.inc(op="delete", outcome="error")
+            return False
+        L2_REQUESTS.inc(op="delete", outcome="done")
+        return True
 
     async def put(
         self, key: str, entry: CachedTile,

@@ -272,6 +272,57 @@ class PeerClient:
         PEER_REQUESTS.inc(outcome="digest_ok")
         return result[2]
 
+    async def gossip(
+        self, member: str, payload: bytes
+    ) -> Optional[dict]:
+        """One push-pull gossip exchange (cluster/gossip.py): POST
+        our digest, return the peer's parsed digest reply. None on
+        any transport failure, non-200, or an unparseable reply —
+        the round simply skips that target. Rides the shared
+        breaker/fault/timeout wrapper like every other peer op."""
+        import json as _json
+
+        result = await self._bounded(
+            member, "POST", "/internal/gossip",
+            body=payload, extra_headers={
+                "Content-Type": "application/json"
+            },
+            outcome_prefix="gossip_",
+        )
+        if result is None or result[0] != 200:
+            if result is not None:
+                PEER_REQUESTS.inc(outcome="gossip_rejected")
+            return None
+        try:
+            reply = _json.loads(result[2])
+        except Exception:
+            PEER_REQUESTS.inc(outcome="gossip_rejected")
+            return None
+        if not isinstance(reply, dict):
+            PEER_REQUESTS.inc(outcome="gossip_rejected")
+            return None
+        PEER_REQUESTS.inc(outcome="gossip_ok")
+        return reply
+
+    async def get_json(
+        self, member: str, path_qs: str
+    ) -> Optional[dict]:
+        """One signed GET expecting a JSON object — the fleet-wide
+        debug scatter-gather (``/debug/requests?fleet=1``). None on
+        any failure; the member's column simply reads absent."""
+        import json as _json
+
+        result = await self._bounded(
+            member, "GET", path_qs, outcome_prefix="json_",
+        )
+        if result is None or result[0] != 200:
+            return None
+        try:
+            reply = _json.loads(result[2])
+        except Exception:
+            return None
+        return reply if isinstance(reply, dict) else None
+
     async def pull_keys(
         self, member: str, keys: list
     ) -> Optional[bytes]:

@@ -410,6 +410,16 @@ class DiskTier:
             meta = self._index.get(key)
             return None if meta is None else meta[4]
 
+    def keys_snapshot(self, limit: int = 0) -> List[str]:
+        """Up to ``limit`` keys from the MRU end of the index (all
+        when 0) — the disk half of the anti-entropy digest (r20).
+        Index-only like ``peek_stored_at``: no file I/O, no LRU
+        promotion, loop-safe."""
+        with self._lock:
+            keys = list(self._index)
+        keys.reverse()  # MRU first: the warmest slice wins the bound
+        return keys[:limit] if limit else keys
+
     def put(self, key: str, entry: CachedTile) -> None:
         if entry.nbytes > self.max_bytes:
             return
@@ -713,6 +723,32 @@ class TileResultCache:
             return out
         except Exception:
             log.exception("hot-set enumeration failed; empty transfer")
+            return []
+
+    def warm_keys(self, limit: int = 128) -> List[str]:
+        """Up to ``limit`` keys spanning this replica's FULL warm set
+        — the hottest RAM entries first (admission-sketch order, the
+        ``hot_entries`` ranking), then the disk tier's index keys,
+        deduplicated. The r20 anti-entropy digest enumerates these so
+        a replica's disk-resident warm set survives fleet churn too,
+        not just its RAM slice. Index-only on the disk side (no file
+        I/O); empty on any failure (pass-through)."""
+        try:
+            out: List[str] = []
+            seen = set()
+            for key, _entry in self.hot_entries(limit):
+                out.append(key)
+                seen.add(key)
+            if self.disk is not None and len(out) < limit:
+                for key in self.disk.keys_snapshot(limit):
+                    if key in seen:
+                        continue
+                    out.append(key)
+                    if len(out) >= limit:
+                        break
+            return out
+        except Exception:
+            log.exception("warm-set enumeration failed; empty digest")
             return []
 
     def generation(self) -> int:

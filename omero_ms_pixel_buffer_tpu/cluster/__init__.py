@@ -52,6 +52,23 @@ The r18 lifecycle + repair plane makes the fleet self-*healing*:
   demote a sick-but-heartbeating replica to non-owner until its
   signals recover.
 
+The r20 decentralized control plane removes the last single point of
+trust and failure:
+
+- **gossip** — SWIM-style push-pull dissemination of membership,
+  epochs, and brains over the signed ``/internal/gossip`` endpoint,
+  so rings keep rebuilding, invalidations keep fanning out, and
+  suspicion keeps demoting through a TOTAL Redis outage; Redis, when
+  configured, is demoted to L2 cache + join-bootstrap hint.
+- **integrity** — end-to-end byte verification: every transfer path
+  (peer fetch, replication push, handoff, repair pull, L2 read)
+  cross-checks the body against the entry's strong content hash;
+  a mismatch discards the bytes AND feeds the suspicion quorum as a
+  corruption verdict via the ``CorruptionLedger``.
+- **sealed values** — lease/brain payloads written to Redis are
+  HMAC-sealed under ``cluster.secret`` (``seal``/``unseal``), so
+  reaching Redis no longer grants membership or brain influence.
+
 Everything here inherits the cache plane's contract: no operation may
 fail a request; every network edge carries a breaker, a fault point,
 and a per-call timeout; every failure degrades to single-process
@@ -60,20 +77,25 @@ behavior.
 
 from .brains import FleetBrains
 from .epochs import EpochRegistry, image_id_of
+from .gossip import GossipManager
 from .hedge import HedgePolicy
+from .integrity import CorruptionLedger, body_matches
 from .lifecycle import DrainCoordinator
 from .link import RedisLink
 from .membership import MembershipManager
 from .repair import AntiEntropyRepairer, build_digest, parse_digest
 from .replicate import HotSetReplicator, decode_transfer, encode_transfer
-from .security import NonceCache, SIG_HEADER, sign, verify
+from .security import NonceCache, SIG_HEADER, seal, sign, unseal, verify
 from .suspect import QualityTracker, SuspicionPolicy
 
 __all__ = [
     "FleetBrains",
     "EpochRegistry",
     "image_id_of",
+    "GossipManager",
     "HedgePolicy",
+    "CorruptionLedger",
+    "body_matches",
     "DrainCoordinator",
     "RedisLink",
     "MembershipManager",
@@ -85,7 +107,9 @@ __all__ = [
     "decode_transfer",
     "NonceCache",
     "SIG_HEADER",
+    "seal",
     "sign",
+    "unseal",
     "verify",
     "QualityTracker",
     "SuspicionPolicy",

@@ -47,6 +47,8 @@ from typing import Dict, List, Optional, Sequence
 
 from ..resilience.breaker import BOARD
 from ..utils.metrics import REGISTRY
+from .integrity import UNSIGNED_PAYLOADS
+from .security import seal, unseal
 
 log = logging.getLogger("omero_ms_pixel_buffer_tpu.cluster")
 
@@ -78,6 +80,8 @@ class FleetBrains:
         suspicion=None,
         peer_failures_source=None,
         on_demote=None,
+        secret: str = "",
+        corruption_source=None,
     ):
         self.link = link
         self.self_url = self_url
@@ -92,6 +96,12 @@ class FleetBrains:
         self.suspicion = suspicion
         self.peer_failures_source = peer_failures_source
         self.on_demote = on_demote
+        # r20: brain values in Redis are sealed under the cluster
+        # secret — reaching Redis must not be enough to steer
+        # suspicion — and integrity strikes (corruption_source, the
+        # CorruptionLedger's counts) join the verdict inputs
+        self.secret = secret
+        self.corruption_source = corruption_source
         self.fleet: Dict[str, dict] = {}
         self.fleet_pressure = 0.0
         self.suspected: List[str] = []
@@ -147,14 +157,18 @@ class FleetBrains:
 
     # -- the exchange ---------------------------------------------------
 
-    async def publish_once(self, interval_s: float) -> bool:
-        payload = json.dumps(
-            self.local_payload(), separators=(",", ":")
-        ).encode()
+    async def publish_once(
+        self, interval_s: float, payload: Optional[dict] = None,
+    ) -> bool:
+        if payload is None:
+            payload = self.local_payload()
+        raw = seal(self.secret, json.dumps(
+            payload, separators=(",", ":")
+        ).encode())
         ttl_ms = str(int(max(interval_s * 3.0, 1.0) * 1000)).encode()
         try:
             await self.link.command(
-                b"SET", brain_key(self.self_url), payload,
+                b"SET", brain_key(self.self_url), raw,
                 b"PX", ttl_ms,
             )
         except Exception:
@@ -190,10 +204,28 @@ class FleetBrains:
         for member, value in zip(peers, raw):
             if value is None:
                 continue
+            payload = unseal(self.secret, value)
+            if payload is None:
+                # an unsigned/tampered brain is a poisoning attempt,
+                # not a peer — it steers nothing
+                UNSIGNED_PAYLOADS.inc(kind="brain")
+                continue
             try:
-                fleet[member] = json.loads(value)
+                fleet[member] = json.loads(payload)
             except Exception:
                 continue  # a corrupt brain is an absent brain
+        self.apply_fleet(fleet, members)
+        BRAIN_ROUNDS.inc(op="collect", outcome="ok")
+        return True
+
+    def apply_fleet(
+        self, fleet: Dict[str, dict], members: Sequence[str],
+    ) -> None:
+        """Derive and apply the fleet facts from a collected brain
+        map — the shared back half of ``collect_once``, also fed
+        directly by the gossip layer (cluster/gossip.py) so pressure,
+        dead-dependency suspicion, and quality demotion keep working
+        with Redis gone entirely."""
         self.fleet = fleet
         pressures = [
             float(b.get("pressure") or 0.0) for b in fleet.values()
@@ -224,13 +256,19 @@ class FleetBrains:
                     failures = self.peer_failures_source() or {}
                 except Exception:
                     failures = {}
-            verdicts = self.suspicion.verdicts(fleet, failures)
+            corruptions = {}
+            if self.corruption_source is not None:
+                try:
+                    corruptions = self.corruption_source() or {}
+                except Exception:
+                    corruptions = {}
+            verdicts = self.suspicion.verdicts(
+                fleet, failures, corruptions
+            )
             demoted = self.suspicion.demoted(
                 fleet, verdicts, tuple(members)
             )
         self._apply(mean_pressure, suspects, verdicts, demoted)
-        BRAIN_ROUNDS.inc(op="collect", outcome="ok")
-        return True
 
     def _apply(
         self,

@@ -96,6 +96,16 @@ class EpochRegistry:
         with self._lock:
             return self._known.get(int(image_id), 0)
 
+    def known_map(self, limit: int = 512) -> dict:
+        """The most recent ``limit`` entries of the local high-water
+        map — the gossip digest's epoch payload (cluster/gossip.py).
+        Insertion order is first-sight order, so the tail holds the
+        images most recently active on this replica — the epochs most
+        worth disseminating."""
+        with self._lock:
+            items = list(self._known.items())
+        return dict(items[-limit:]) if limit else {}
+
     def is_stale(
         self, cache_key: str, entry_epoch: Optional[int]
     ) -> bool:

@@ -47,6 +47,8 @@ from collections import deque
 from typing import Callable, FrozenSet, Optional, Sequence, Tuple
 
 from ..utils.metrics import REGISTRY
+from .integrity import UNSIGNED_PAYLOADS
+from .security import seal, unseal
 
 log = logging.getLogger("omero_ms_pixel_buffer_tpu.cluster")
 
@@ -71,9 +73,14 @@ class MembershipManager:
         lease_ttl_s: float,
         on_change: Optional[Callable] = None,
         clock=time.monotonic,
+        secret: str = "",
     ):
         self.link = link
         self.self_url = self_url
+        # r20: leases are sealed under the cluster secret — a
+        # Redis-reachable attacker SETting a member key no longer
+        # joins the ring (unsigned leases are skipped, counted)
+        self.secret = secret
         self.lease_ttl_s = float(lease_ttl_s)
         self.interval_s = max(self.lease_ttl_s / 3.0, 0.05)
         self.on_change = on_change
@@ -99,7 +106,8 @@ class MembershipManager:
         fields = {"url": self.self_url, "wall": time.time()}
         if self.self_draining:
             fields["draining"] = True
-        return json.dumps(fields, separators=(",", ":")).encode()
+        raw = json.dumps(fields, separators=(",", ":")).encode()
+        return seal(self.secret, raw)
 
     async def refresh_once(self) -> bool:
         """One heartbeat round: refresh this replica's lease, scan the
@@ -132,6 +140,18 @@ class MembershipManager:
         draining = set()
         for key, value in zip(keys, values):
             url = key.decode("utf-8", "replace")[len(MEMBER_PREFIX):]
+            if self.secret:
+                # sealed-lease posture: a key whose value is missing
+                # (expiry racing the MGET — it will reappear or stay
+                # gone next scan) or unsealed/tampered (an attacker
+                # who can merely reach Redis) grants NO membership
+                if value is None:
+                    continue
+                payload = unseal(self.secret, value)
+                if payload is None:
+                    UNSIGNED_PAYLOADS.inc(kind="lease")
+                    continue
+                value = payload
             live.add(url)
             if value is not None:
                 try:

@@ -190,3 +190,52 @@ def verify(
     ):
         return False  # verbatim replay inside the window
     return True
+
+
+# --------------------------------------------------------------- values
+#
+# Coordination VALUES stored in Redis (membership leases, fleet
+# brains) are a second trust surface: anyone who can reach Redis can
+# SET a lease key and join the ring, or plant a brain payload and
+# steer suspicion. Sealing binds each stored value to the cluster
+# secret so reaching Redis no longer grants membership — a reader
+# that verifies discards anything unsealed or tampered. Epoch
+# counters cannot be sealed (they are bare INCR integers); poisoning
+# one forces re-renders but never wrong bytes, which is the accepted
+# residual (see KNOWN_GAPS).
+
+_SEAL_VERSION = b"s1"
+
+
+def seal(secret: str, payload: bytes) -> bytes:
+    """Wrap ``payload`` as ``s1:<hex hmac-sha256>:<payload>`` under
+    ``secret``. With no secret configured the payload passes through
+    unchanged (back-compat with unsigned fleets)."""
+    if not secret:
+        return payload
+    mac = hmac.new(secret.encode(), payload, hashlib.sha256).hexdigest()
+    return _SEAL_VERSION + b":" + mac.encode() + b":" + payload
+
+
+def unseal(secret: str, raw: Optional[bytes]) -> Optional[bytes]:
+    """The payload inside a sealed value, or ``None`` when the seal
+    is missing, malformed, or fails the constant-time MAC check.
+    With no secret configured the raw value passes through (the
+    unsigned posture). Never raises."""
+    if raw is None:
+        return None
+    if not secret:
+        return raw
+    if not raw.startswith(_SEAL_VERSION + b":"):
+        return None
+    rest = raw[len(_SEAL_VERSION) + 1:]
+    sep = rest.find(b":")
+    if sep != 64:  # hex sha256 is exactly 64 bytes
+        return None
+    mac, payload = rest[:sep], rest[sep + 1:]
+    expected = hmac.new(
+        secret.encode(), payload, hashlib.sha256
+    ).hexdigest().encode()
+    if not hmac.compare_digest(expected, mac):
+        return None
+    return payload

@@ -130,12 +130,17 @@ class SuspicionPolicy:
         p99_factor: float = 3.0,
         min_requests: int = 8,
         peer_failures: int = 3,
+        corruption_after: int = 1,
     ):
         self.enabled = enabled
         self.error_rate = error_rate
         self.p99_factor = p99_factor
         self.min_requests = max(1, int(min_requests))
         self.peer_failures = max(1, int(peer_failures))
+        # integrity strikes (cluster/integrity.py) needed before a
+        # corruption verdict — wrong bytes are deliberate harm, so
+        # the default is a single strike
+        self.corruption_after = max(1, int(corruption_after))
 
     @staticmethod
     def _quality(brain: dict) -> Optional[dict]:
@@ -162,13 +167,19 @@ class SuspicionPolicy:
         self,
         fleet: Dict[str, dict],
         peer_failures: Dict[str, int],
+        corruptions: Optional[Dict[str, int]] = None,
     ) -> List[str]:
         """This collector's BAD list: peers whose self-reported
-        quality breaches the thresholds, or against whom this
-        replica's own peer client failed ``peer_failures``+ times
-        this window. Sorted for stable payloads."""
+        quality breaches the thresholds, against whom this replica's
+        own peer client failed ``peer_failures``+ times this window,
+        or whose transferred bodies failed their content-hash check
+        ``corruption_after``+ times inside the integrity ledger's
+        freshness window (cluster/integrity.py — the "wrong-but-200"
+        clause status codes cannot see). Sorted for stable
+        payloads."""
         if not self.enabled:
             return []
+        corruptions = corruptions or {}
         bad = set()
         median = self._fleet_median_p99(fleet)
         # union, not fleet alone: the replica too sick to even
@@ -176,7 +187,7 @@ class SuspicionPolicy:
         # process) is precisely the one the peer-failure clause
         # exists for — judging only reporting peers would give the
         # silent ones a pass
-        for url in set(fleet) | set(peer_failures):
+        for url in set(fleet) | set(peer_failures) | set(corruptions):
             brain = fleet.get(url)
             q = self._quality(brain) if brain is not None else None
             if q is not None and q.get("n", 0) >= self.min_requests:
@@ -192,6 +203,8 @@ class SuspicionPolicy:
                 ):
                     bad.add(url)
             if peer_failures.get(url, 0) >= self.peer_failures:
+                bad.add(url)
+            if corruptions.get(url, 0) >= self.corruption_after:
                 bad.add(url)
         return sorted(bad)
 
@@ -231,4 +244,5 @@ class SuspicionPolicy:
             "p99_factor": self.p99_factor,
             "min_requests": self.min_requests,
             "peer_failures": self.peer_failures,
+            "corruption_after": self.corruption_after,
         }

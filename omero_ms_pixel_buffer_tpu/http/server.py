@@ -810,6 +810,7 @@ class PixelBufferApp:
                     p99_factor=cl.suspect.p99_factor,
                     min_requests=cl.suspect.min_requests,
                     peer_failures=cl.suspect.peer_failures,
+                    corruption_after=cl.integrity.verdict_after,
                 )
                 self.cache_plane = CachePlane(
                     members=cl.members,
@@ -830,6 +831,12 @@ class PixelBufferApp:
                     repair_max_keys=cl.repair.max_keys,
                     quality=self.quality,
                     suspicion=suspicion,
+                    gossip_interval_s=(
+                        cl.gossip.interval_s if cl.gossip.enabled else 0.0
+                    ),
+                    gossip_fanout=cl.gossip.fanout,
+                    gossip_fail_after_s=cl.gossip.fail_after_s,
+                    integrity_verify=cl.integrity.verify_bodies,
                 )
                 # the planned-leave protocol (cluster/lifecycle.py):
                 # SIGTERM or POST /internal/drain runs it; the
@@ -992,6 +999,9 @@ class PixelBufferApp:
             )
             app.router.add_post(
                 "/internal/drain", self.handle_internal_drain
+            )
+            app.router.add_post(
+                "/internal/gossip", self.handle_internal_gossip
             )
         if self.config.render.enabled:
             app.router.add_get(
@@ -1603,7 +1613,9 @@ class PixelBufferApp:
                     # belong under a |deg key we can't reconstruct
                     # here — discard and let the local render decide
                     result = None
-                entry = plane.entry_from_peer_result(result)
+                entry = plane.entry_from_peer(
+                    result, getattr(pending, "ompb_owner", None)
+                )
                 if entry is not None and (
                     await self._authorize_cached(ctx)
                 ):
@@ -1684,12 +1696,40 @@ class PixelBufferApp:
             except (TypeError, ValueError):
                 return web.Response(status=400, text="bad limit")
         events = self.recorder.events(limit=limit)
-        return web.json_response({
+        local = {
             "kept": self.recorder.kept_count(),
             "ring_size": self.recorder.ring_size,
             "count": len(events),
             "events": events,
-        })
+        }
+        fleet = request.query.get("fleet", "").strip().lower() in (
+            "1", "true", "yes"
+        )
+        plane = self.cache_plane
+        if (
+            fleet
+            and plane is not None
+            and plane.self_url
+            # a peer-originated scatter is terminal here — the fleet
+            # fan-out must never recurse peer-to-peer
+            and PEER_HEADER not in request.headers
+        ):
+            others = [
+                m for m in plane.members_view() if m != plane.self_url
+            ]
+            path = "/debug/requests" + (
+                f"?limit={limit}" if limit is not None else ""
+            )
+            replies = await asyncio.gather(
+                *(plane.peers.get_json(m, path) for m in others)
+            )
+            members = {plane.self_url: local}
+            for member, reply in zip(others, replies):
+                members[member] = reply  # None = unreachable, kept honest
+            return web.json_response({
+                "fleet": True, "members": members,
+            })
+        return web.json_response(local)
 
     async def handle_debug_request_detail(
         self, request: web.Request
@@ -1705,6 +1745,29 @@ class PixelBufferApp:
         return web.json_response({
             "trace_id": trace_id, "events": events,
         })
+
+    async def handle_internal_gossip(self, request: web.Request) -> web.Response:
+        """One push-pull gossip exchange (cluster/gossip.py): the
+        sender's full-state digest (membership + epochs + brains)
+        arrives as JSON; this replica merges it, marks the sender
+        alive (a POST that reached us IS liveness evidence), and
+        answers with its own digest — one round trip disseminates in
+        both directions. Peer-marked and HMAC-guarded like the rest
+        of /internal/*."""
+        if PEER_HEADER not in request.headers:
+            return web.Response(status=403, text="peer requests only")
+        import json as _json
+
+        try:
+            remote = _json.loads(await request.read())
+        except Exception:
+            return web.Response(status=400, text="bad digest")
+        if not isinstance(remote, dict):
+            return web.Response(status=400, text="bad digest")
+        reply = self.cache_plane.gossip_receive(remote)
+        if reply is None:
+            return web.Response(status=503, text="gossip disabled")
+        return web.json_response(reply)
 
     async def handle_internal_purge(self, request: web.Request) -> web.Response:
         """Inbound half of the purge fan-out. Requires the peer
@@ -1750,6 +1813,13 @@ class PixelBufferApp:
         if entry is None:
             return web.Response(status=400, text="malformed frame")
         plane = self.cache_plane
+        if not plane.verify_entry_bytes(
+            entry, "replica", member=request.headers.get(PEER_HEADER)
+        ):
+            # corrupt push: refuse the bytes AND let the ledger feed
+            # the suspicion quorum — replication must never implant
+            # wrong-but-200 bytes into this replica's caches
+            return web.Response(status=400, text="integrity check failed")
         if plane.replica_push_stale(key, epoch):
             if plane.replicator is not None:
                 plane.replicator.rejected_stale += 1
@@ -1791,7 +1861,9 @@ class PixelBufferApp:
         if self.cache_plane is None or self.result_cache is None:
             return web.Response(status=503, text="cache disabled")
         body = await request.read()
-        stored = await self.cache_plane.absorb_handoff(body)
+        stored = await self.cache_plane.absorb_handoff(
+            body, member=request.headers.get(PEER_HEADER)
+        )
         return web.json_response({"stored": stored})
 
     async def handle_internal_digest(self, request: web.Request) -> web.Response:

@@ -133,6 +133,7 @@ def configure(io_config) -> None:
     """Apply the validated ``io:`` config block (utils/config.IoConfig)
     process-wide; the server calls this at startup, tests directly."""
     from .pixel_buffer import set_negative_ttl
+    from .zarr import set_shard_index_ttl
 
     with CONFIG._lock:
         CONFIG.parallel = bool(io_config.parallel_fetch)
@@ -142,6 +143,7 @@ def configure(io_config) -> None:
         CONFIG.decode_workers = int(io_config.decode_workers)
         CONFIG.negative_ttl_s = float(io_config.negative_ttl_s)
     set_negative_ttl(CONFIG.negative_ttl_s)
+    set_shard_index_ttl(float(io_config.shard_index_ttl_s))
     POOL.set_max_per_host(CONFIG.max_conns_per_host)
 
 

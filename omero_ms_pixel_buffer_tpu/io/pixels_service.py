@@ -286,7 +286,17 @@ class PixelsService:
         open."""
         with self._lock:
             buf = self._cache.pop(int(image_id), None)
-        return getattr(buf, "cache_ns", None) if buf is not None else None
+        if buf is None:
+            return None
+        # concurrent requests may still hold this buffer: drop its
+        # memoized shard indexes so any late reads refetch footers
+        purge = getattr(buf, "purge_shard_indexes", None)
+        if purge is not None:
+            try:
+                purge()
+            except Exception:
+                pass  # invalidation must never fail the caller
+        return getattr(buf, "cache_ns", None)
 
     def close(self) -> None:
         with self._lock:

@@ -26,6 +26,7 @@ import json
 import os
 import struct
 import threading
+import time
 import zlib
 from collections import OrderedDict
 from typing import Iterable, List, Optional, Sequence, Tuple
@@ -55,6 +56,27 @@ except ImportError:  # pragma: no cover - baked into the image
 _SUPPORTED_COMPRESSORS = ("zlib", "gzip", "blosc", "zstd", "lz4")
 
 _MISSING = object()
+
+# Process-wide TTL for memoized shard indexes (zarr v3 sharding). A
+# shard rewritten in place gets a NEW index footer; without expiry the
+# memo serves the old (offset, nbytes) table until restart, which on a
+# rewritten object means corrupt reads. 0 disables expiry.
+
+_shard_ttl_lock = threading.Lock()
+_shard_index_ttl_s = 300.0
+
+
+def set_shard_index_ttl(seconds: float) -> None:
+    """Process-wide TTL for memoized shard indexes; 0 disables expiry
+    (config ``io.shard-index-ttl-s``)."""
+    global _shard_index_ttl_s
+    with _shard_ttl_lock:
+        _shard_index_ttl_s = float(seconds)
+
+
+def shard_index_ttl_s() -> float:
+    with _shard_ttl_lock:
+        return _shard_index_ttl_s
 
 
 class _PrefixedCache:
@@ -233,10 +255,13 @@ class ZarrArray:
         self.prefix = prefix.strip("/")
         self.codecs: Optional[list] = None  # v3 pipeline when set
         self.sharding: Optional[_ShardInfo] = None
-        # shard key -> parsed index array | None (absent shard);
-        # bounded LRU, lock-shared by the batch planner's threads
-        self._shard_indexes: "OrderedDict[str, object]" = OrderedDict()
+        # shard key -> (parsed index array | None for absent shard,
+        # stamp); bounded LRU with a process-wide TTL so a rewritten
+        # shard's new footer is observed without a restart;
+        # lock-shared by the batch planner's threads
+        self._shard_indexes: "OrderedDict[str, tuple]" = OrderedDict()
         self._shard_lock = threading.Lock()
+        self._shard_clock = time.monotonic  # test injection point
         raw_meta = store.get(self._key(".zarray"))
         if raw_meta is not None:
             self._init_v2(json.loads(raw_meta))
@@ -434,18 +459,32 @@ class ZarrArray:
         )
 
     def _cached_shard_index(self, key: str):
+        ttl = shard_index_ttl_s()
         with self._shard_lock:
             hit = self._shard_indexes.get(key, _MISSING)
-            if hit is not _MISSING:
-                self._shard_indexes.move_to_end(key)
-            return hit
+            if hit is _MISSING:
+                return _MISSING
+            index, stamp = hit
+            if ttl > 0 and self._shard_clock() - stamp > ttl:
+                del self._shard_indexes[key]
+                return _MISSING
+            self._shard_indexes.move_to_end(key)
+            return index
 
     def _store_shard_index(self, key: str, index) -> None:
         with self._shard_lock:
-            self._shard_indexes[key] = index
+            self._shard_indexes[key] = (index, self._shard_clock())
             self._shard_indexes.move_to_end(key)
             while len(self._shard_indexes) > 512:
                 self._shard_indexes.popitem(last=False)
+
+    def purge_shard_indexes(self) -> int:
+        """Drop every memoized shard index (image invalidation);
+        returns the number of entries dropped."""
+        with self._shard_lock:
+            n = len(self._shard_indexes)
+            self._shard_indexes.clear()
+            return n
 
     def _load_shard_index(
         self, shard_idx: Tuple[int, ...]
@@ -853,6 +892,12 @@ class ZarrPixelBuffer(PixelBuffer):
     @property
     def resolution_levels(self) -> int:
         return len(self.levels)
+
+    def purge_shard_indexes(self) -> int:
+        """Drop memoized shard indexes across every level (called on
+        image invalidation so a rewritten shard is observed without
+        waiting out the TTL)."""
+        return sum(a.purge_shard_indexes() for a in self.levels)
 
     def level_size(self, level: Optional[int] = None) -> Tuple[int, int]:
         lv = self._resolution_level if level is None else level

@@ -340,6 +340,37 @@ class ClusterRepairConfig:
 
 
 @dataclasses.dataclass
+class ClusterGossipConfig:
+    """Decentralized coordination (cluster/gossip.py): SWIM-style
+    push-pull gossip over the signed /internal/gossip endpoint.
+    Enabled, membership + epochs + fleet brains disseminate peer-to-
+    peer — the ring keeps rebuilding, invalidations keep fanning out,
+    and suspicion keeps demoting through a total Redis outage (Redis,
+    when configured, demotes to L2 cache + join-bootstrap hint).
+    ``interval_s`` paces the rounds; ``fanout`` is the targets per
+    round; a member whose heartbeat stalls past ``fail_after_s``
+    leaves the live view."""
+
+    enabled: bool = False
+    interval_s: float = 1.0
+    fanout: int = 2
+    fail_after_s: float = 5.0
+
+
+@dataclasses.dataclass
+class ClusterIntegrityConfig:
+    """End-to-end byte integrity (cluster/integrity.py): every
+    transfer path (peer fetch, replication push, handoff, repair
+    pull, L2 read) cross-checks the body against the entry's strong
+    content hash when ``verify_bodies`` is on; a mismatch discards
+    the bytes and, after ``verdict_after`` fresh strikes, feeds the
+    suspicion quorum as a corruption verdict."""
+
+    verify_bodies: bool = True
+    verdict_after: int = 1
+
+
+@dataclasses.dataclass
 class ClusterSuspectConfig:
     """Quality-based suspicion (cluster/suspect.py): a replica whose
     self-reported error rate crosses ``error_rate``, whose p99
@@ -396,6 +427,12 @@ class ClusterConfig:
     suspect: ClusterSuspectConfig = dataclasses.field(
         default_factory=ClusterSuspectConfig
     )
+    gossip: ClusterGossipConfig = dataclasses.field(
+        default_factory=ClusterGossipConfig
+    )
+    integrity: ClusterIntegrityConfig = dataclasses.field(
+        default_factory=ClusterIntegrityConfig
+    )
 
     @property
     def plane_enabled(self) -> bool:
@@ -415,7 +452,10 @@ class IoConfig:
     into one request; ``decode_workers`` bounds the parallel chunk
     decode pool (0 = decode serially); ``negative_ttl_s`` bounds how
     long an absent chunk (fill_value) is remembered by the block
-    cache (0 = never expires)."""
+    cache (0 = never expires); ``shard_index_ttl_s`` bounds how long
+    a zarr v3 shard's parsed index footer is memoized, so a shard
+    rewritten in place is observed without a restart (0 = never
+    expires)."""
 
     parallel_fetch: bool = True
     fetch_workers: int = 16
@@ -423,6 +463,7 @@ class IoConfig:
     coalesce_gap_kb: float = 64.0
     decode_workers: int = 4
     negative_ttl_s: float = 300.0
+    shard_index_ttl_s: float = 300.0
 
 
 @dataclasses.dataclass
@@ -905,6 +946,7 @@ class Config:
             "members", "self", "virtual-nodes", "peer-timeout-ms", "l2",
             "lease-ttl-s", "replication-factor", "transfer-max-entries",
             "secret", "hedge", "drain", "repair", "suspect",
+            "gossip", "integrity",
         }
         if unknown:
             raise ConfigError(
@@ -1037,6 +1079,46 @@ class Config:
                 "repairs the replication contract; without one there "
                 "is nothing to repair"
             )
+        gossip_raw = cl.get("gossip") or {}
+        unknown = set(gossip_raw) - {
+            "enabled", "interval-s", "fanout", "fail-after-s",
+        }
+        if unknown:
+            raise ConfigError(
+                f"Unknown keys in 'cluster.gossip' block: "
+                f"{sorted(unknown)}"
+            )
+        gossip_enabled = gossip_raw.get("enabled", False)
+        if not isinstance(gossip_enabled, bool):
+            raise ConfigError(
+                "'cluster.gossip.enabled' must be a boolean"
+            )
+        if gossip_enabled and (not members or self_url is None):
+            raise ConfigError(
+                "'cluster.gossip.enabled' needs 'cluster.members' "
+                "and 'cluster.self' — gossip seeds from the "
+                "configured peer list"
+            )
+        gossip_interval_s = _num(gossip_raw, "interval-s", 1.0, 0.05)
+        gossip_fail_after_s = _num(gossip_raw, "fail-after-s", 5.0, 0.1)
+        if gossip_fail_after_s <= gossip_interval_s:
+            raise ConfigError(
+                "'cluster.gossip.fail-after-s' must exceed "
+                "'cluster.gossip.interval-s' — a member must survive "
+                "at least one missed round"
+            )
+        integrity_raw = cl.get("integrity") or {}
+        unknown = set(integrity_raw) - {"verify-bodies", "verdict-after"}
+        if unknown:
+            raise ConfigError(
+                f"Unknown keys in 'cluster.integrity' block: "
+                f"{sorted(unknown)}"
+            )
+        integrity_verify = integrity_raw.get("verify-bodies", True)
+        if not isinstance(integrity_verify, bool):
+            raise ConfigError(
+                "'cluster.integrity.verify-bodies' must be a boolean"
+            )
         suspect_raw = cl.get("suspect") or {}
         unknown = set(suspect_raw) - {
             "enabled", "error-rate", "p99-factor", "min-requests",
@@ -1052,11 +1134,12 @@ class Config:
             raise ConfigError(
                 "'cluster.suspect.enabled' must be a boolean"
             )
-        if suspect_enabled and lease_ttl_s <= 0:
+        if suspect_enabled and lease_ttl_s <= 0 and not gossip_enabled:
             raise ConfigError(
                 "'cluster.suspect.enabled' needs "
-                "'cluster.lease-ttl-s' — suspicion rides the fleet-"
-                "brain exchange, which rides the lease heartbeat"
+                "'cluster.lease-ttl-s' or 'cluster.gossip.enabled' — "
+                "suspicion rides the fleet-brain exchange, which "
+                "rides the lease heartbeat or the gossip rounds"
             )
         suspect_error_rate = _num(suspect_raw, "error-rate", 0.5, 0.0)
         if not 0.0 < suspect_error_rate <= 1.0:
@@ -1104,6 +1187,18 @@ class Config:
                     suspect_raw, "peer-failures", 3, 1, int
                 ),
             ),
+            gossip=ClusterGossipConfig(
+                enabled=gossip_enabled,
+                interval_s=gossip_interval_s,
+                fanout=_num(gossip_raw, "fanout", 2, 1, int),
+                fail_after_s=gossip_fail_after_s,
+            ),
+            integrity=ClusterIntegrityConfig(
+                verify_bodies=integrity_verify,
+                verdict_after=_num(
+                    integrity_raw, "verdict-after", 1, 1, int
+                ),
+            ),
         )
 
     @staticmethod
@@ -1114,6 +1209,7 @@ class Config:
         unknown = set(io) - {
             "parallel-fetch", "fetch-workers", "max-conns-per-host",
             "coalesce-gap-kb", "decode-workers", "negative-ttl-s",
+            "shard-index-ttl-s",
         }
         if unknown:
             raise ConfigError(
@@ -1138,6 +1234,7 @@ class Config:
             coalesce_gap_kb=_num("coalesce-gap-kb", 64.0, 0.0),
             decode_workers=_num("decode-workers", 4, 0, int),
             negative_ttl_s=_num("negative-ttl-s", 300.0, 0.0),
+            shard_index_ttl_s=_num("shard-index-ttl-s", 300.0, 0.0),
         )
 
     @staticmethod

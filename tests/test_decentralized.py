@@ -1,0 +1,882 @@
+"""Decentralized control plane (r20): gossip membership, sealed
+coordination writes, end-to-end byte integrity, disk-tier
+anti-entropy, and the shard-index TTL.
+
+Unit lanes: seal/unseal (HMAC-sealed Redis values), GossipManager
+merge semantics (heartbeat precedence, flag OR, tombstones, SWIM
+self-refutation, direct-contact refutation, stall expiry, bounded
+state, epoch + brain piggyback, rotation coverage, the Redis
+join-bootstrap hint), body_matches + CorruptionLedger + the suspicion
+corruption clause, L2 integrity verification against the RESP stub,
+warm-set digests over the disk manifest, the Zarr v3 shard-index TTL
++ purge, and config validation for the new blocks.
+
+Chaos lanes (``-m resilience``): a three-replica gossip fleet whose
+Redis dies mid-traffic (ring stays converged, epoch bumps still
+disseminate, zero 5xx) and a corrupt-peer drive (one replica serves
+bit-flipped bodies with intact ETags; integrity verdicts feed the
+suspicion quorum until it is demoted, and every client request still
+receives correct bytes).
+"""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+from aiohttp import ClientSession
+
+from omero_ms_pixel_buffer_tpu.cache.plane.l2 import RedisL2Tier
+from omero_ms_pixel_buffer_tpu.cache.plane.resp_stub import (
+    InMemoryRespServer,
+)
+from omero_ms_pixel_buffer_tpu.cache.result_cache import (
+    CachedTile,
+    TileResultCache,
+    make_etag,
+)
+from omero_ms_pixel_buffer_tpu.cluster import (
+    CorruptionLedger,
+    EpochRegistry,
+    GossipManager,
+    SuspicionPolicy,
+    body_matches,
+    seal,
+    unseal,
+)
+from omero_ms_pixel_buffer_tpu.cluster.gossip import _MAX_ENTRIES
+from omero_ms_pixel_buffer_tpu.cluster.membership import MEMBER_PREFIX
+from omero_ms_pixel_buffer_tpu.io import zarr as zarr_mod
+from omero_ms_pixel_buffer_tpu.io.pixels_service import PixelsService
+from omero_ms_pixel_buffer_tpu.utils.config import Config, ConfigError
+
+from test_cluster import (
+    _get,
+    _key_for,
+    _make_cluster,
+    _tile_paths,
+)
+
+A, B, C = "http://a:1", "http://b:2", "http://c:3"
+
+
+# ---------------------------------------------------------------------------
+# sealed coordination values
+# ---------------------------------------------------------------------------
+
+class TestSealUnseal:
+    def test_round_trip(self):
+        raw = seal("s3cret", b'{"url":"http://a:1"}')
+        assert unseal("s3cret", raw) == b'{"url":"http://a:1"}'
+
+    def test_no_secret_passthrough(self):
+        assert seal("", b"payload") == b"payload"
+        assert unseal("", b"payload") == b"payload"
+
+    def test_tampered_payload_rejected(self):
+        raw = bytearray(seal("s3cret", b"payload"))
+        raw[-1] ^= 0x01
+        assert unseal("s3cret", bytes(raw)) is None
+
+    def test_wrong_secret_rejected(self):
+        assert unseal("other", seal("s3cret", b"p")) is None
+
+    def test_unsealed_value_rejected_when_secret_set(self):
+        # a bare (attacker-written) value never passes a sealed read
+        assert unseal("s3cret", b'{"url":"http://evil:1"}') is None
+        assert unseal("s3cret", b"") is None
+        assert unseal("s3cret", None) is None
+
+    def test_malformed_frames_rejected(self):
+        assert unseal("s", b"s1:short:payload") is None
+        assert unseal("s", b"s1:" + b"a" * 64) is None
+        assert unseal("s", b"v9:" + b"a" * 64 + b":x") is None
+
+
+# ---------------------------------------------------------------------------
+# gossip membership units
+# ---------------------------------------------------------------------------
+
+class _Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class _StubPeers:
+    """PeerClient.gossip stand-in: canned replies per target."""
+
+    def __init__(self, replies=None):
+        self.replies = replies or {}
+        self.sent = []
+
+    async def gossip(self, target, payload):
+        self.sent.append((target, json.loads(payload)))
+        reply = self.replies.get(target)
+        return reply() if callable(reply) else reply
+
+
+def _gm(self_url=A, seed=(A, B, C), clock=None, **kw):
+    return GossipManager(
+        _StubPeers(), self_url, seed,
+        interval_s=0.1, fanout=2, fail_after_s=1.0,
+        clock=clock or _Clock(), **kw,
+    )
+
+
+def _member(hb, draining=False, left=False):
+    return {"hb": hb, "draining": draining, "left": left}
+
+
+class TestGossipMerge:
+    def test_seed_view_is_live(self):
+        gm = _gm()
+        assert gm.members == (A, B, C)
+        assert gm.draining == frozenset()
+
+    def test_higher_heartbeat_wins(self):
+        gm = _gm()
+        gm.merge({"members": {B: _member(5, draining=True)}})
+        assert gm._entries[B]["hb"] == 5
+        assert gm._entries[B]["draining"]
+        # an older rumor never rolls state back
+        gm.merge({"members": {B: _member(3)}})
+        assert gm._entries[B]["hb"] == 5
+        assert gm._entries[B]["draining"]
+
+    def test_equal_heartbeat_ors_flags(self):
+        gm = _gm()
+        gm.merge({"members": {B: _member(2)}})
+        gm.merge({"members": {B: _member(2, draining=True)}})
+        assert gm._entries[B]["draining"]
+
+    def test_stalled_member_expires(self):
+        clock = _Clock()
+        gm = _gm(clock=clock)
+        clock.t += 2.0  # past fail_after_s
+        gm._apply_view()
+        assert gm.members == (A,)
+
+    def test_advancing_heartbeat_is_liveness(self):
+        clock = _Clock()
+        gm = _gm(clock=clock)
+        clock.t += 0.9
+        gm.merge({"members": {B: _member(7)}})
+        clock.t += 0.5  # B heard 0.5s ago, C stalled 1.4s
+        gm._apply_view()
+        assert gm.members == (A, B)
+
+    def test_tombstone_removes_member(self):
+        gm = _gm()
+        gm.merge({"members": {B: _member(9, left=True)}})
+        gm._apply_view()
+        assert B not in gm.members
+
+    def test_direct_contact_refutes_tombstone(self):
+        gm = _gm()
+        gm.merge({"members": {B: _member(9, left=True)}})
+        gm._apply_view()
+        assert B not in gm.members
+        # B POSTs to us: direct evidence beats any rumor counter
+        gm.receive({"from": B, "members": {B: _member(0)}})
+        assert B in gm.members
+
+    def test_self_refutation_outpaces_rumor(self):
+        gm = _gm()
+        gm.merge({"members": {A: _member(40, left=True)}})
+        assert gm._entries[A]["hb"] == 41
+        assert not gm._entries[A]["left"]
+        assert A in gm.members
+
+    def test_released_self_does_not_refute(self):
+        gm = _gm()
+        gm.released = True
+        gm._entries[A]["left"] = True
+        gm.merge({"members": {A: _member(40)}})
+        assert gm._entries[A]["left"]
+
+    def test_unknown_member_adopted_bounded(self):
+        gm = _gm()
+        gm.merge({"members": {
+            f"http://m{i}:1": _member(1) for i in range(_MAX_ENTRIES * 2)
+        }})
+        assert len(gm._entries) <= _MAX_ENTRIES
+
+    def test_malformed_digest_never_raises(self):
+        gm = _gm()
+        gm.merge(None)
+        gm.merge([])
+        gm.merge({"members": "nope", "epochs": 3, "brains": []})
+        gm.merge({"members": {B: "nope", "": _member(1), C: {"hb": "x"}}})
+        gm.merge({"brains": {B: "nope", C: [1, "not-a-dict"]}})
+        assert gm.members == (A, B, C)
+
+    def test_rotation_covers_all_candidates(self):
+        gm = _gm()
+        gm.fanout = 1
+        seen = set()
+        for _ in range(3):
+            gm._round += 1
+            seen.update(gm._pick_targets())
+        assert seen == {B, C}
+
+
+class TestGossipPiggyback:
+    def test_epochs_disseminate(self):
+        ea, eb = EpochRegistry(None), EpochRegistry(None)
+        ga = _gm(epochs=ea)
+        gb = _gm(self_url=B, epochs=eb)
+        ea.note(7, 3)
+        gb.merge(ga.digest())
+        assert eb.known(7) == 3
+        # high-water only: an older epoch never rolls back
+        gb.epochs.note(7, 5)
+        gb.merge(ga.digest())
+        assert eb.known(7) == 5
+
+    def test_brains_ride_the_digest(self):
+        ga, gb = _gm(), _gm(self_url=B)
+        ga.set_local_brain({"url": A, "pressure": 0.5})
+        digest = ga.digest()
+        assert digest["brains"][A][1]["pressure"] == 0.5
+        gb.merge(digest)
+        assert gb.fleet_brains()[A]["pressure"] == 0.5
+
+    def test_stale_brain_never_overwrites(self):
+        gb = _gm(self_url=B)
+        gb.merge({"members": {A: _member(5)},
+                  "brains": {A: [5, {"pressure": 0.9}]}})
+        gb.merge({"brains": {A: [3, {"pressure": 0.1}]}})
+        assert gb.fleet_brains()[A]["pressure"] == 0.9
+
+    def test_left_member_brain_excluded(self):
+        gb = _gm(self_url=B)
+        gb.merge({"members": {A: _member(5)},
+                  "brains": {A: [5, {"pressure": 0.9}]}})
+        gb.merge({"members": {A: _member(9, left=True)}})
+        gb._apply_view()
+        assert A not in gb.fleet_brains()
+        assert A not in gb.digest().get("brains", {})
+
+    async def test_release_pushes_tombstone(self):
+        gm = _gm()
+        assert await gm.release_lease()
+        digest = gm.digest()
+        assert digest["members"][A]["left"]
+        # terminal: no further rounds
+        assert not await gm.refresh_once()
+
+    async def test_refresh_exchange_merges_reply(self):
+        gm = _gm()
+        gm.peers = _StubPeers(replies={
+            B: {"from": B, "members": {B: _member(11)}},
+            C: None,  # unreachable
+        })
+        ok = await gm.refresh_once()
+        assert ok
+        assert gm._entries[B]["hb"] == 11
+        assert gm.exchanges == 1 and gm.exchange_failures == 1
+
+
+class _FakeLink:
+    """RedisLink stand-in for the join-bootstrap hint."""
+
+    def __init__(self):
+        self.store = {}
+
+    async def command(self, *parts):
+        if parts[0] == b"SET":
+            self.store[parts[1]] = parts[2]
+            return b"OK"
+        if parts[0] == b"MGET":
+            return [self.store.get(k) for k in parts[1:]]
+        if parts[0] == b"DEL":
+            return int(self.store.pop(parts[1], None) is not None)
+        raise AssertionError(parts)
+
+    async def scan_keys(self, pattern):
+        return list(self.store)
+
+
+class TestGossipHint:
+    async def test_hint_adopts_unknown_member(self):
+        link = _FakeLink()
+        ga = _gm(self_url=A, seed=(A,), link=link, secret="s")
+        # D published its sealed lease; A has never heard of it
+        link.store[(MEMBER_PREFIX + "http://d:4").encode()] = seal(
+            "s", b'{"url":"http://d:4"}'
+        )
+        await ga._hint_round()
+        assert "http://d:4" in ga._entries
+        assert (MEMBER_PREFIX + A).encode() in link.store
+
+    async def test_hint_rejects_unsealed_lease(self):
+        link = _FakeLink()
+        ga = _gm(self_url=A, seed=(A,), link=link, secret="s")
+        link.store[(MEMBER_PREFIX + "http://evil:1").encode()] = (
+            b'{"url":"http://evil:1"}'
+        )
+        await ga._hint_round()
+        assert "http://evil:1" not in ga._entries
+
+    async def test_hint_failure_is_silent(self):
+        class _DeadLink:
+            async def command(self, *parts):
+                raise ConnectionError("down")
+
+            async def scan_keys(self, pattern):
+                raise ConnectionError("down")
+
+        ga = _gm(self_url=A, seed=(A, B), link=_DeadLink())
+        await ga._hint_round()  # must not raise
+        assert ga.hint_failures == 1
+
+
+# ---------------------------------------------------------------------------
+# byte integrity: the hash gate, the ledger, the suspicion clause
+# ---------------------------------------------------------------------------
+
+class TestBodyIntegrity:
+    def test_body_matches(self):
+        body = b"tile-bytes"
+        assert body_matches(make_etag(body), body)
+        assert not body_matches(make_etag(body), body + b"x")
+
+    def test_missing_etag_fails(self):
+        # a stripped validator must not bypass the gate
+        assert not body_matches(None, b"tile-bytes")
+        assert not body_matches("", b"tile-bytes")
+
+    def test_ledger_counts_and_expiry(self):
+        clock = _Clock()
+        ledger = CorruptionLedger(ttl_s=10.0, clock=clock)
+        ledger.note(B)
+        ledger.note(B)
+        ledger.note(C)
+        assert ledger.counts() == {B: 2, C: 1}
+        # counts are NOT consumed by reading (suspicion re-derives
+        # verdicts every round)
+        assert ledger.counts() == {B: 2, C: 1}
+        clock.t += 11.0
+        assert ledger.counts() == {}
+
+    def test_ledger_bounded(self):
+        ledger = CorruptionLedger(max_members=4)
+        for i in range(10):
+            ledger.note(f"http://m{i}:1")
+        assert len(ledger.counts()) <= 4
+        assert ledger.snapshot()["total"] == 10
+
+    def test_ledger_ignores_anonymous(self):
+        ledger = CorruptionLedger()
+        ledger.note(None)
+        ledger.note("")
+        assert ledger.counts() == {}
+
+    def test_corruption_verdict(self):
+        policy = SuspicionPolicy(enabled=True, corruption_after=2)
+        assert policy.verdicts({}, {}, {B: 1}) == []
+        assert policy.verdicts({}, {}, {B: 2}) == [B]
+
+    def test_corruption_feeds_quorum(self):
+        policy = SuspicionPolicy(enabled=True)
+        my = policy.verdicts({}, {}, {C: 1})
+        assert my == [C]
+        # two of three reporters (peer brain + local verdict) demote
+        fleet = {B: {"bad": [C]}, C: {"bad": []}}
+        assert policy.demoted(fleet, my, (A, B, C)) == [C]
+
+    def test_disabled_policy_judges_nothing(self):
+        policy = SuspicionPolicy(enabled=False)
+        assert policy.verdicts({}, {}, {B: 99}) == []
+
+
+class TestL2Integrity:
+    async def test_corrupt_l2_value_is_miss_and_deleted(self):
+        resp = InMemoryRespServer()
+        await resp.start()
+        tier = RedisL2Tier(resp.uri, ttl_s=60.0)
+        try:
+            entry = CachedTile(b"png-bytes", filename="t.png")
+            assert await tier.put("img=1|k", entry)
+            got = await tier.get("img=1|k")
+            assert got is not None and got.body == b"png-bytes"
+            # flip one body byte inside the stored frame (the ETag in
+            # the header stays intact — silent Redis-side corruption)
+            key = tier._key("img=1|k")
+            raw, expires = resp.data[key]
+            resp.data[key] = (raw[:-1] + bytes([raw[-1] ^ 0xFF]),
+                              expires)
+            fails_before = tier.integrity_fails
+            got = await tier.get("img=1|k")
+            assert got is None
+            assert tier.integrity_fails == fails_before + 1
+            # quarantined: the corrupt value is gone from Redis
+            assert key not in resp.data
+        finally:
+            await tier.close()
+            await resp.close()
+
+    async def test_verification_can_be_disabled(self):
+        resp = InMemoryRespServer()
+        await resp.start()
+        tier = RedisL2Tier(resp.uri, ttl_s=60.0, verify_bodies=False)
+        try:
+            entry = CachedTile(b"png-bytes", filename="t.png")
+            await tier.put("img=1|k", entry)
+            key = tier._key("img=1|k")
+            raw, expires = resp.data[key]
+            resp.data[key] = (raw[:-1] + bytes([raw[-1] ^ 0xFF]),
+                              expires)
+            got = await tier.get("img=1|k")  # escape hatch honored
+            assert got is not None
+        finally:
+            await tier.close()
+            await resp.close()
+
+
+# ---------------------------------------------------------------------------
+# warm-set digests over the disk manifest
+# ---------------------------------------------------------------------------
+
+class TestWarmKeys:
+    async def test_warm_keys_spans_both_tiers(self, tmp_path):
+        cache = TileResultCache(
+            memory_bytes=1 << 20, disk_dir=str(tmp_path / "spill"),
+            manifest=False,
+        )
+        await cache.put("img=1|ram", CachedTile(b"r" * 64),
+                        generation=cache.generation())
+        # a disk-only entry (spilled and evicted from RAM long ago)
+        cache.disk.put("img=1|disk", CachedTile(b"d" * 64))
+        keys = cache.warm_keys(limit=16)
+        assert "img=1|ram" in keys
+        assert "img=1|disk" in keys
+        # RAM slice leads: the hottest entries head the digest
+        assert keys.index("img=1|ram") < keys.index("img=1|disk")
+
+    async def test_warm_keys_dedups_and_bounds(self, tmp_path):
+        cache = TileResultCache(
+            memory_bytes=1 << 20, disk_dir=str(tmp_path / "spill"),
+            manifest=False,
+        )
+        for i in range(6):
+            await cache.put(f"img=1|k{i}", CachedTile(b"x" * 32),
+                            generation=cache.generation())
+            cache.disk.put(f"img=1|k{i}", CachedTile(b"x" * 32))
+        keys = cache.warm_keys(limit=4)
+        assert len(keys) == 4
+        assert len(set(keys)) == 4
+
+    def test_disk_keys_snapshot_mru_first(self, tmp_path):
+        cache = TileResultCache(
+            memory_bytes=1 << 20, disk_dir=str(tmp_path / "spill"),
+            manifest=False,
+        )
+        for i in range(3):
+            cache.disk.put(f"img=1|k{i}", CachedTile(b"x" * 32))
+        snap = cache.disk.keys_snapshot()
+        assert snap[0] == "img=1|k2"
+        assert cache.disk.keys_snapshot(limit=2) == snap[:2]
+
+
+# ---------------------------------------------------------------------------
+# the Zarr v3 shard-index TTL + purge
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _restore_shard_ttl():
+    before = zarr_mod.shard_index_ttl_s()
+    yield
+    zarr_mod.set_shard_index_ttl(before)
+
+
+class TestShardIndexTtl:
+    def _arr(self, tmp_path):
+        img = np.arange(64 * 64, dtype=np.uint16).reshape(
+            1, 1, 1, 64, 64
+        )
+        root = str(tmp_path / "sharded.zarr")
+        zarr_mod.write_ngff(
+            root, img, chunks=(32, 32), levels=1, zarr_format=3,
+            compressor=None, shards=(64, 64),
+        )
+        return zarr_mod.ZarrArray(os.path.join(root, "0"))
+
+    def test_memo_expires_after_ttl(self, tmp_path):
+        arr = self._arr(tmp_path)
+        clock = _Clock()
+        arr._shard_clock = clock
+        zarr_mod.set_shard_index_ttl(300.0)
+        arr.read_region((0, 0, 0, 0, 0), (1, 1, 1, 64, 64))
+        assert len(arr._shard_indexes) == 1
+        key = next(iter(arr._shard_indexes))
+        assert arr._cached_shard_index(key) is not zarr_mod._MISSING
+        clock.t += 301.0
+        # expired: the memo is dropped and the next read refetches
+        assert arr._cached_shard_index(key) is zarr_mod._MISSING
+        assert key not in arr._shard_indexes
+
+    def test_zero_ttl_never_expires(self, tmp_path):
+        arr = self._arr(tmp_path)
+        clock = _Clock()
+        arr._shard_clock = clock
+        zarr_mod.set_shard_index_ttl(0.0)
+        arr.read_region((0, 0, 0, 0, 0), (1, 1, 1, 64, 64))
+        key = next(iter(arr._shard_indexes))
+        clock.t += 1e9
+        assert arr._cached_shard_index(key) is not zarr_mod._MISSING
+
+    def test_rewritten_shard_observed_after_ttl(self, tmp_path):
+        img = np.full((1, 1, 1, 64, 64), 7, dtype=np.uint16)
+        root = str(tmp_path / "rw.zarr")
+        zarr_mod.write_ngff(
+            root, img, chunks=(32, 32), levels=1, zarr_format=3,
+            compressor=None, shards=(64, 64),
+        )
+        arr = zarr_mod.ZarrArray(os.path.join(root, "0"))
+        clock = _Clock()
+        arr._shard_clock = clock
+        zarr_mod.set_shard_index_ttl(300.0)
+        first = arr.read_region((0, 0, 0, 0, 0), (1, 1, 1, 64, 64))
+        assert int(first[0, 0, 0, 0, 0]) == 7
+        # rewrite the shard in place with different pixels
+        zarr_mod.write_ngff(
+            root, np.full_like(img, 9), chunks=(32, 32), levels=1,
+            zarr_format=3, compressor=None, shards=(64, 64),
+        )
+        clock.t += 301.0
+        second = arr.read_region((0, 0, 0, 0, 0), (1, 1, 1, 64, 64))
+        assert int(second[0, 0, 0, 0, 0]) == 9
+
+    def test_purge_drops_all_levels(self, tmp_path):
+        arr = self._arr(tmp_path)
+        arr.read_region((0, 0, 0, 0, 0), (1, 1, 1, 64, 64))
+        assert arr.purge_shard_indexes() == 1
+        assert len(arr._shard_indexes) == 0
+
+    def test_pixels_service_invalidate_purges(self):
+        class _Buf:
+            cache_ns = 42
+            purged = 0
+
+            def purge_shard_indexes(self):
+                _Buf.purged += 1
+                return 3
+
+            def close(self):
+                pass
+
+        service = PixelsService.__new__(PixelsService)
+        import threading
+
+        service._lock = threading.Lock()
+        service._cache = {1: _Buf()}
+        assert service.invalidate(1) == 42
+        assert _Buf.purged == 1
+        assert service.invalidate(1) is None  # already gone
+
+
+# ---------------------------------------------------------------------------
+# config validation for the r20 blocks
+# ---------------------------------------------------------------------------
+
+def _cfg(raw):
+    return Config.from_dict({
+        "session-store": {"type": "memory"}, **raw,
+    })
+
+
+class TestDecentralizedConfig:
+    def test_gossip_and_integrity_parse(self):
+        cfg = _cfg({"cluster": {
+            "members": [A, B], "self": A,
+            "gossip": {"enabled": True, "interval-s": 0.5,
+                       "fanout": 3, "fail-after-s": 4.0},
+            "integrity": {"verify-bodies": False, "verdict-after": 2},
+        }})
+        g, i = cfg.cluster.gossip, cfg.cluster.integrity
+        assert g.enabled and g.interval_s == 0.5 and g.fanout == 3
+        assert g.fail_after_s == 4.0
+        assert not i.verify_bodies and i.verdict_after == 2
+
+    def test_defaults(self):
+        cfg = _cfg({})
+        assert not cfg.cluster.gossip.enabled
+        assert cfg.cluster.integrity.verify_bodies
+        assert cfg.cluster.integrity.verdict_after == 1
+        assert cfg.io.shard_index_ttl_s == 300.0
+
+    def test_gossip_requires_members_and_self(self):
+        with pytest.raises(ConfigError, match="gossip"):
+            _cfg({"cluster": {"gossip": {"enabled": True}}})
+
+    def test_fail_after_must_exceed_interval(self):
+        with pytest.raises(ConfigError, match="fail-after-s"):
+            _cfg({"cluster": {
+                "members": [A], "self": A,
+                "gossip": {"enabled": True, "interval-s": 5,
+                           "fail-after-s": 2},
+            }})
+
+    def test_unknown_keys_fail(self):
+        with pytest.raises(ConfigError, match="gossip"):
+            _cfg({"cluster": {"gossip": {"typo": 1}}})
+        with pytest.raises(ConfigError, match="integrity"):
+            _cfg({"cluster": {"integrity": {"typo": 1}}})
+        with pytest.raises(ConfigError, match="io"):
+            _cfg({"io": {"shard-index-ttls": 1}})
+
+    def test_suspect_rides_gossip_without_lease(self):
+        cfg = _cfg({"cluster": {
+            "members": [A, B], "self": A,
+            "gossip": {"enabled": True},
+            "suspect": {"enabled": True},
+        }})
+        assert cfg.cluster.suspect.enabled
+
+    def test_suspect_still_needs_a_heartbeat(self):
+        with pytest.raises(ConfigError, match="suspect"):
+            _cfg({"cluster": {
+                "members": [A], "self": A,
+                "suspect": {"enabled": True},
+            }})
+
+    def test_shard_index_ttl_parses_and_applies(self):
+        cfg = _cfg({"io": {"shard-index-ttl-s": 120}})
+        assert cfg.io.shard_index_ttl_s == 120.0
+        from omero_ms_pixel_buffer_tpu.io.fetch import configure
+
+        before = zarr_mod.shard_index_ttl_s()
+        try:
+            configure(cfg.io)
+            assert zarr_mod.shard_index_ttl_s() == 120.0
+        finally:
+            zarr_mod.set_shard_index_ttl(before)
+
+
+# ---------------------------------------------------------------------------
+# chaos: the gossip fleet vs a dead Redis
+# ---------------------------------------------------------------------------
+
+GOSSIP_EXTRA = {
+    "gossip": {
+        "enabled": True, "interval-s": 0.15, "fail-after-s": 1.2,
+    },
+}
+
+
+def _converged(replicas, expected):
+    return all(
+        set(r.app.cache_plane.membership.members) == set(expected)
+        for r in replicas if not r.dead
+    )
+
+
+class TestRedislessFleet:
+    @pytest.mark.resilience
+    async def test_redis_death_is_a_non_event(self, tmp_path):
+        """The tentpole drive: Redis dies mid-traffic and the control
+        plane shrugs — membership stays converged over gossip, epoch
+        bumps still disseminate, and the fleet serves zero 5xx."""
+        replicas, resp, cleanup = await _make_cluster(
+            tmp_path, n=3, cluster_extra=GOSSIP_EXTRA,
+        )
+        members = [r.url for r in replicas]
+        try:
+            await asyncio.sleep(0.6)  # a few gossip rounds
+            assert _converged(replicas, members)
+            statuses = []
+            async with ClientSession() as http:
+                for path in _tile_paths(8):
+                    for r in replicas:
+                        s, _, _ = await _get(http, r.url + path)
+                        statuses.append(s)
+                # the coordinator dies mid-traffic
+                await resp.close()
+                await asyncio.sleep(0.8)
+                for path in _tile_paths(8):
+                    for r in replicas:
+                        s, _, _ = await _get(http, r.url + path)
+                        statuses.append(s)
+            assert all(s == 200 for s in statuses), statuses
+            # membership kept converging with no Redis at all
+            assert _converged(replicas, members)
+            # epochs: a bump on one replica reaches the others over
+            # the gossip digest (Redis INCR is impossible now)
+            plane0 = replicas[0].app.cache_plane
+            await plane0.epochs.bump(1)
+            bumped = plane0.epochs.known(1)
+            assert bumped >= 1
+
+            async def _epochs_spread():
+                while not all(
+                    r.app.cache_plane.epochs.known(1) >= bumped
+                    for r in replicas
+                ):
+                    await asyncio.sleep(0.05)
+
+            await asyncio.wait_for(_epochs_spread(), 5.0)
+        finally:
+            await cleanup()
+
+    @pytest.mark.resilience
+    async def test_member_death_detected_without_redis(self, tmp_path):
+        """With Redis already dead, a crashed replica still leaves the
+        live view within the gossip failure window."""
+        replicas, resp, cleanup = await _make_cluster(
+            tmp_path, n=3, cluster_extra=GOSSIP_EXTRA,
+        )
+        members = [r.url for r in replicas]
+        try:
+            await asyncio.sleep(0.6)
+            await resp.close()
+            await replicas[2].kill()
+            survivors = replicas[:2]
+
+            async def _shrunk():
+                while not _converged(survivors, members[:2]):
+                    await asyncio.sleep(0.05)
+
+            await asyncio.wait_for(_shrunk(), 10.0)
+            # survivors keep serving
+            async with ClientSession() as http:
+                for path in _tile_paths(4):
+                    for r in survivors:
+                        s, _, _ = await _get(http, r.url + path)
+                        assert s == 200
+        finally:
+            await cleanup()
+
+
+# ---------------------------------------------------------------------------
+# chaos: the corrupt peer
+# ---------------------------------------------------------------------------
+
+def _corrupt_serving(replica):
+    """Bad-RAM lever: every cache read on this replica returns
+    bit-flipped bytes under the ORIGINAL ETag — wrong-but-200 output
+    that status codes cannot see."""
+    cache = replica.app.result_cache
+    inner = cache.get
+
+    async def bad_get(key):
+        entry = await inner(key)
+        if entry is None:
+            return None
+        flipped = bytes([entry.body[0] ^ 0xFF]) + entry.body[1:]
+        return CachedTile(
+            flipped, etag=entry.etag, filename=entry.filename,
+            stored_at=entry.stored_at,
+        )
+
+    cache.get = bad_get
+
+
+class TestCorruptPeer:
+    @pytest.mark.resilience
+    async def test_corrupt_replica_demoted_clients_unharmed(
+        self, tmp_path
+    ):
+        """One replica serves bit-flipped bodies: every transfer is
+        discarded at the hash gate (clients always receive correct
+        bytes), the strikes feed the suspicion quorum on every healthy
+        replica, and the corrupt replica is demoted off the ring."""
+        replicas, _resp, cleanup = await _make_cluster(
+            tmp_path, n=3, l2=False, cluster_extra={
+                **GOSSIP_EXTRA,
+                "suspect": {"enabled": True},
+            },
+        )
+        victim, healthy = replicas[2], replicas[:2]
+        paths = _tile_paths(16)
+        try:
+            await asyncio.sleep(0.6)
+            plane0 = healthy[0].app.cache_plane
+            victim_owned = [
+                p for p in paths
+                if plane0.ring.owner(
+                    _key_for(healthy[0].app, p)
+                ) == victim.url
+            ]
+            assert victim_owned  # 16 keys over 3 members: some here
+            baseline = {}
+            async with ClientSession() as http:
+                # baseline through the HONEST victim: it caches its
+                # owned keys (the poisoned RAM of the next phase)
+                # while the healthy replicas cache only their own
+                for path in paths:
+                    s, body, _ = await _get(http, victim.url + path)
+                    assert s == 200
+                    baseline[path] = body
+                _corrupt_serving(victim)
+                # every victim-owned key now peer-fetches flipped
+                # bytes under the original ETag; the gate discards
+                # them, strikes the ledger, and renders locally
+                for r in healthy:
+                    for path in paths:
+                        s, body, _ = await _get(http, r.url + path)
+                        assert s == 200
+                        assert body == baseline[path]
+                for r in healthy:
+                    ledger = r.app.cache_plane.corruption.counts()
+                    assert ledger.get(victim.url, 0) >= 1
+
+                async def _demoted():
+                    while not all(
+                        victim.url in r.app.cache_plane.brains.demoted
+                        for r in healthy
+                    ):
+                        await asyncio.sleep(0.05)
+
+                await asyncio.wait_for(_demoted(), 10.0)
+                # the demoted ring re-homes the victim's keys; the
+                # fleet keeps serving correct bytes
+                for path in victim_owned:
+                    for r in healthy:
+                        s, body, _ = await _get(http, r.url + path)
+                        assert s == 200
+                        assert body == baseline[path]
+        finally:
+            await cleanup()
+
+    @pytest.mark.resilience
+    async def test_corrupt_replica_push_rejected(self, tmp_path):
+        """The replication ingress: a push whose body fails the hash
+        gate is refused with a 400 and never lands in the cache."""
+        from omero_ms_pixel_buffer_tpu.cache.plane.l2 import (
+            encode_entry,
+        )
+        from omero_ms_pixel_buffer_tpu.cache.plane.peer import (
+            KEY_HEADER,
+            PEER_HEADER,
+        )
+
+        replicas, _resp, cleanup = await _make_cluster(
+            tmp_path, n=2, l2=False, cluster_extra=GOSSIP_EXTRA,
+        )
+        try:
+            good = CachedTile(b"correct-bytes", filename="t.png")
+            evil = CachedTile(
+                b"corrupt-bytes!", etag=good.etag, filename="t.png",
+            )
+            async with ClientSession() as http:
+                async with http.post(
+                    replicas[0].url + "/internal/replica",
+                    data=encode_entry(evil),
+                    headers={
+                        PEER_HEADER: replicas[1].url,
+                        KEY_HEADER: "img=1|evil",
+                    },
+                ) as r:
+                    assert r.status == 400
+            assert await replicas[0].app.result_cache.get(
+                "img=1|evil"
+            ) is None
+            ledger = replicas[0].app.cache_plane.corruption.counts()
+            assert ledger.get(replicas[1].url, 0) >= 1
+        finally:
+            await cleanup()

@@ -360,8 +360,12 @@ _RESILIENCE_SCOPE = (
     # the cluster coordination plane (r17): the coordination RESP
     # link is the one raw network primitive here (membership leases,
     # epoch bumps, and brain exchanges all ride it); every future
-    # remote call added to this package must arrive wrapped too
+    # remote call added to this package must arrive wrapped too.
+    # r20 explicitly includes cluster/gossip.py — its exchanges must
+    # keep riding PeerClient's breaker/fault-point/timeout wrapper
+    # rather than growing a raw network path of their own
     "omero_ms_pixel_buffer_tpu/cluster/",
+    "omero_ms_pixel_buffer_tpu/cluster/gossip.py",
 )
 
 _NET_PRIMITIVES: List[Tuple[Optional[str], str, str]] = [
