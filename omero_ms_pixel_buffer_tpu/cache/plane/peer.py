@@ -96,11 +96,21 @@ class PeerClient:
         # failing against is observably sick whatever its lease says
         self._failures: Dict[str, int] = {}
 
+    #: per-member breaker map cap: member URLs churn with the fleet,
+    #: and an unbounded map would hold a breaker for every ex-member a
+    #: long-lived replica has ever seen. Far above any real ring size.
+    _MAX_BREAKERS = 256
+
     def _breaker(self, member: str):
         b = self._breakers.get(member)
         if b is None:
             netloc = urlparse(member).netloc or member
             b = for_dependency(f"cache:peer:{netloc}")
+            # oldest-inserted evicted first; a re-appearing member
+            # simply re-registers (for_dependency returns the same
+            # shared breaker for the same dependency name)
+            while len(self._breakers) >= self._MAX_BREAKERS:
+                self._breakers.pop(next(iter(self._breakers)))
             self._breakers[member] = b
         return b
 

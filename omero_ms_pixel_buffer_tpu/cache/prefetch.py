@@ -101,6 +101,13 @@ class ViewportPrefetcher:
         self._streams: "OrderedDict[tuple, _Stream]" = OrderedDict()
         self._max_streams = max_streams
         self._worker: Optional[asyncio.Task] = None
+        # close-in-progress latch, checked by _run between items: the
+        # fetch path bounds its wait with wait_for(shield(...)), and a
+        # cancel that lands in the same tick the flight completes is
+        # swallowed by wait_for's completion race (bpo-42130) — the
+        # worker would sail back into queue.get() and close() would
+        # await it forever
+        self._closing = False
         # extent_fn(image_id, resolution) -> (size_x, size_y) | None:
         # a NON-BLOCKING cache peek (PixelsService.peek_extent) that
         # lets predictions prune against the plane bounds at
@@ -132,6 +139,7 @@ class ViewportPrefetcher:
 
     async def close(self) -> None:
         if self._worker is not None:
+            self._closing = True
             self._worker.cancel()
             try:
                 await self._worker
@@ -307,7 +315,10 @@ class ViewportPrefetcher:
     # -- the low-priority worker ---------------------------------------
 
     async def _run(self) -> None:
-        while True:
+        # the latch (not while True) so a cancel swallowed inside
+        # _fetch's bounded wait still terminates the worker at the
+        # top of the loop instead of re-entering queue.get()
+        while not self._closing:
             ctx, key = await self._queue.get()
             if not self._admission.has_headroom(self.headroom_fraction):
                 # the service is busy with real traffic: speculative

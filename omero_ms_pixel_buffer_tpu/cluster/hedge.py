@@ -55,7 +55,11 @@ class HedgePolicy:
         self.min_s = min_s
         self.max_s = max_s
         self.fallback_s = fallback_s
-        self.outcomes = {"fired": 0, "peer_win": 0, "local_win": 0}
+        # fixed-slot outcome record: every label note() ever receives
+        # is declared here (callers pass literals only)
+        self.outcomes = {
+            "fired": 0, "peer_win": 0, "peer_failed": 0, "local_win": 0,
+        }
 
     def delay_s(self):
         """How long to give the peer fetch before starting the local

@@ -754,6 +754,7 @@ class PixelBufferApp:
         self.quality = None
         self.drainer = None
         self._sigterm_installed = False
+        self._drain_task: Optional[asyncio.Task] = None
         # replay guard for the HMAC peer surface (cluster/security):
         # nonces accepted inside the skew window, bounded per peer
         self.cluster_nonces = NonceCache()
@@ -1149,7 +1150,24 @@ class PixelBufferApp:
                 pass  # non-unix / nested loop: endpoint-only drains
 
     def _on_sigterm(self) -> None:
-        asyncio.ensure_future(self._drain_then_exit())
+        # keep a reference and consume the outcome: an untracked
+        # ensure_future can be GC'd mid-drain and silently loses its
+        # exception (the PR-14 hang class). A repeat SIGTERM while the
+        # drain is in flight reuses it instead of racing a second one.
+        if self._drain_task is not None and not self._drain_task.done():
+            return
+        task = asyncio.ensure_future(self._drain_then_exit())
+        task.add_done_callback(self._drain_task_done)
+        self._drain_task = task
+
+    @staticmethod
+    def _drain_task_done(task: "asyncio.Task") -> None:
+        if task.cancelled():
+            log.warning("SIGTERM drain task cancelled before completion")
+            return
+        exc = task.exception()
+        if exc is not None:
+            log.error("SIGTERM drain task died: %r", exc)
 
     async def _drain_then_exit(self) -> None:
         try:

@@ -197,6 +197,7 @@ class FlightRecord:
         return _StageTimer(self, stage)
 
     def tag(self, key: str, value) -> "FlightRecord":
+        # ompb-lint: disable=bounded-growth -- per-request record: tags live exactly as long as the request's ring slot (the ring is maxlen-bounded), and callers pass a fixed tag vocabulary
         self.tags[key] = value
         return self
 

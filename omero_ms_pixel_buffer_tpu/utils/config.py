@@ -91,7 +91,6 @@ class PngConfig:
 @dataclasses.dataclass
 class BackendConfig:
     engine: str = "jax"  # "jax"/"auto" | "device" | "host"
-    mesh_axes: tuple = ("data",)
     batching: BatchingConfig = dataclasses.field(default_factory=BatchingConfig)
     png: PngConfig = dataclasses.field(default_factory=PngConfig)
     # Per-request allocation guard (MiB); 0 disables. The reference
@@ -1444,9 +1443,6 @@ class Config:
         jmx = raw.get("jmx-metrics") or {}
         be_raw = raw.get("backend") or {}
         batching_raw = be_raw.get("batching") or {}
-        mesh_axes = be_raw.get("mesh-axes", ("data",))
-        if isinstance(mesh_axes, str):  # scalar YAML spelling of one axis
-            mesh_axes = (mesh_axes,)
         png_raw = be_raw.get("png") or {}
         engine = be_raw.get("engine", "jax")
         if engine not in ("jax", "auto", "device", "tpu", "host"):
@@ -1458,7 +1454,6 @@ class Config:
             )
         backend = BackendConfig(
             engine=engine,
-            mesh_axes=tuple(mesh_axes),
             batching=BatchingConfig(
                 buckets=tuple(batching_raw.get("buckets", (256, 512, 1024))),
                 max_batch=int(batching_raw.get("max-batch", 32)),

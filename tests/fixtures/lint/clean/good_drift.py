@@ -1,0 +1,13 @@
+# ompb-lint: scope=config-drift
+"""Clean corpus (doc pair: good_drift.yaml): every key is validated,
+documented, and consumed — ompb-lint must report nothing here."""
+
+
+def load(raw):
+    unknown = set(raw) - {"port", "depth"}
+    if unknown:
+        raise ValueError(f"unknown keys: {unknown}")
+    return {
+        "port": raw.get("port", 8082),
+        "depth": raw.get("depth", 2),
+    }

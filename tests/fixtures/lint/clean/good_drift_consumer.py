@@ -1,0 +1,5 @@
+"""Consumer side of the clean drift corpus: both keys are used."""
+
+
+def apply(cfg):
+    return cfg.port, cfg.depth

@@ -1,0 +1,32 @@
+# ompb-lint: scope=bounded-growth
+"""Clean corpus: every growing collection carries eviction evidence —
+maxlen by construction, pop/len cap, rebuild, or a fixed-slot
+record — ompb-lint must report nothing here."""
+
+from collections import deque
+
+
+class BoundedIndex:
+    def __init__(self):
+        self.recent = deque(maxlen=64)
+        self.by_key = {}
+        self.outcomes = {"hit": 0, "miss": 0}
+
+    def record(self, key, value):
+        while len(self.by_key) >= 64:
+            self.by_key.pop(next(iter(self.by_key)))
+        self.by_key[key] = value
+        self.recent.append(key)
+        self.outcomes["hit"] = self.outcomes["hit"] + 1
+
+
+_EVENTS = []
+
+
+def note(event):
+    _EVENTS.append(event)
+
+
+def reset():
+    global _EVENTS
+    _EVENTS = []

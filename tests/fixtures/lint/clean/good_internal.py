@@ -1,0 +1,28 @@
+# ompb-lint: scope=trust-surface
+"""Clean corpus: the /internal/* handler verifies the cluster HMAC
+and the remote-byte ingress crosses the integrity check — ompb-lint
+must report nothing here."""
+
+
+def verify_cluster_request(request):
+    return True
+
+
+def body_matches(entry, body):
+    return True
+
+
+async def state_handler(request):
+    verify_cluster_request(request)
+    return {"ok": True}
+
+
+def setup(router):
+    router.add_get("/internal/state", state_handler)
+
+
+def ingest(payload):
+    entry = decode_transfer(payload)
+    if not body_matches(entry, payload):
+        raise ValueError("corrupt transfer")
+    return entry

@@ -1,0 +1,36 @@
+# ompb-lint: scope=task-hygiene
+"""Clean corpus: every spawned task is awaited, tracked-and-drained,
+or handed to a consumer — ompb-lint must report nothing here."""
+
+import asyncio
+
+
+class Worker:
+    def __init__(self):
+        self._task = None
+        self._jobs = set()
+
+    async def start(self):
+        self._task = asyncio.create_task(self._run())
+
+    async def close(self):
+        if self._task is not None:
+            self._task.cancel()
+
+    def spawn(self, coro):
+        t = asyncio.create_task(coro)
+        self._jobs.add(t)
+        t.add_done_callback(self._jobs.discard)
+        return t
+
+    async def _run(self):
+        await asyncio.sleep(0.1)
+
+
+async def awaited_directly():
+    await asyncio.create_task(asyncio.sleep(0.01))
+
+
+async def gathered(coros):
+    tasks = [asyncio.ensure_future(c) for c in coros]
+    return await asyncio.gather(*tasks)

@@ -1,0 +1,8 @@
+"""Seeded cross-module loop-block: the helper lives in a sibling
+module, so only the interprocedural call graph can see the chain."""
+
+from crossmod_block_b import busy_wait
+
+
+async def tick():
+    busy_wait()  # SEEDED: loop-block via the cross-module call graph

@@ -1,0 +1,8 @@
+"""Sync helper for crossmod_block_a — not a violation by itself; the
+rule fires where an ASYNC caller in another module reaches this."""
+
+import time
+
+
+def busy_wait():
+    time.sleep(0.2)

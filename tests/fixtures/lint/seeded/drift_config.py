@@ -1,0 +1,15 @@
+# ompb-lint: scope=config-drift
+"""Seeded config-drift violations (doc pair: drift_config.yaml).
+One finding of each type: an undocumented key, a documented-but-
+never-parsed key, and a parsed-but-never-consumed (dead) key."""
+
+
+def load(raw):
+    unknown = set(raw) - {"port", "dead-timeout-ms", "mystery-knob"}
+    if unknown:
+        raise ValueError(f"unknown keys: {unknown}")
+    return {
+        "port": raw.get("port", 8082),
+        "dead": raw.get("dead-timeout-ms", 100),  # SEEDED: dead key
+        "knob": raw.get("mystery-knob", 1),  # SEEDED: undocumented
+    }

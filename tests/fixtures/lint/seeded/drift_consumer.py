@@ -1,0 +1,6 @@
+"""Consumer side of the seeded drift corpus: uses port and
+mystery-knob so only dead-timeout-ms reads as dead config."""
+
+
+def apply(cfg):
+    return cfg.port, cfg.mystery_knob

@@ -1,0 +1,16 @@
+# ompb-lint: scope=jax-hotpath
+"""Seeded jax-hotpath violation the module-local analyzer provably
+missed: the device value escapes through a PARAMETER — the caller
+produces it, the callee host-syncs it."""
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def _finish_lanes(filtered):
+    return np.asarray(filtered)  # SEEDED: device value via parameter
+
+
+def render(tiles):
+    filtered = jnp.square(jnp.asarray(tiles))
+    return _finish_lanes(filtered)

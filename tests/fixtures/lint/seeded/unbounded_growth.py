@@ -1,0 +1,19 @@
+# ompb-lint: scope=bounded-growth
+"""Seeded bounded-growth violations: collections that only ever grow
+(the PR-9 immortal-negative-cache shape)."""
+
+_SEEN = []
+
+
+def note(event):
+    _SEEN.append(event)  # SEEDED: module-level growth, no eviction
+
+
+class SessionIndex:
+    def __init__(self):
+        self.by_key = {}
+        self.order = []
+
+    def record(self, key, value):
+        self.by_key[key] = value  # SEEDED: dynamic-key store, no eviction
+        self.order.append(key)  # SEEDED: append, no eviction

@@ -1,0 +1,18 @@
+# ompb-lint: scope=trust-surface
+"""Seeded trust-surface violations: an /internal/* route with no
+cluster-HMAC verification anywhere on its path, and a remote-byte
+ingress that never crosses the integrity check."""
+
+
+async def state_handler(request):
+    return {"ok": True}
+
+
+def setup(router):
+    # SEEDED: handler never verifies, no guard middleware here
+    router.add_get("/internal/state", state_handler)
+
+
+def ingest(payload):
+    entry = decode_transfer(payload)  # SEEDED: unverified remote bytes
+    return entry

@@ -1,0 +1,30 @@
+# ompb-lint: scope=task-hygiene
+"""Seeded task-hygiene violations (never imported — parsed by
+ompb-lint in tests/test_lint.py). Each spawn below drops its task on
+the floor in a different way: the PR-14 hang class."""
+
+import asyncio
+
+
+class Poller:
+    def __init__(self):
+        self._task = None
+
+    async def start(self):
+        asyncio.create_task(self._run())  # SEEDED: bare fire-and-forget
+
+    async def start_untracked(self):
+        # SEEDED: stored on self but nothing ever awaits/cancels it
+        self._task = asyncio.ensure_future(self._run())
+
+    async def _run(self):
+        await asyncio.sleep(0.1)
+
+
+async def spawn_and_drop():
+    t = asyncio.create_task(asyncio.sleep(0.1))  # SEEDED: never used again
+    return None
+
+
+async def offload_and_forget(loop, work):
+    loop.run_in_executor(None, work)  # SEEDED: bare fire-and-forget

@@ -116,11 +116,79 @@ class TestSeededViolations:
         assert "CancelledError" in messages
         assert "'KeyError'" in messages
 
+    def test_crossmod_loop_block(self, seeded):
+        """r21 regression: the blocking helper lives in a SIBLING
+        module — only the interprocedural call graph sees the chain."""
+        found = seeded["crossmod_block_a.py"]
+        assert [f.rule for f in found] == ["loop-block"]
+        assert "busy_wait() -> time.sleep" in found[0].message
+        assert "via tests/fixtures/lint/seeded/crossmod_block_b.py" in (
+            found[0].message
+        )
+        # the sync helper module itself is not a violation
+        assert "crossmod_block_b.py" not in seeded
+
+    def test_passed_device_param(self, seeded):
+        """r21 regression: the device value escapes through a
+        PARAMETER — the caller produces it, the callee host-syncs it
+        (the _finish_png_lanes shape the module-local analyzer
+        missed)."""
+        found = seeded["passed_device_param.py"]
+        assert [f.rule for f in found] == ["jax-hotpath"]
+        assert "'_finish_lanes'" in found[0].message
+        assert "np.asarray(...)" in found[0].message
+        assert (
+            "device value arrives via parameter filtered"
+            in found[0].message
+        )
+
+    def test_task_hygiene(self, seeded):
+        found = seeded["untracked_task.py"]
+        assert all(f.rule == "task-hygiene" for f in found)
+        assert len(found) == 4
+        messages = " | ".join(f.message for f in found)
+        # each escape shape distinctly diagnosed
+        assert messages.count("bare fire-and-forget statement") == 2
+        assert "assigned to 't' which is never used again" in messages
+        assert (
+            "stored on 'self._task' but nothing in the class" in messages
+        )
+        assert "run_in_executor" in messages and "create_task" in messages
+
+    def test_bounded_growth(self, seeded):
+        found = seeded["unbounded_growth.py"]
+        assert all(f.rule == "bounded-growth" for f in found)
+        messages = " | ".join(f.message for f in found)
+        assert "module-level '_SEEN'" in messages
+        assert "'SessionIndex.by_key' grows (subscript store)" in messages
+        assert "'SessionIndex.order' grows (append)" in messages
+
+    def test_trust_surface(self, seeded):
+        found = seeded["unguarded_internal.py"]
+        assert all(f.rule == "trust-surface" for f in found)
+        assert len(found) == 2
+        messages = " | ".join(f.message for f in found)
+        assert "route '/internal/state'" in messages
+        assert "verify_cluster_request" in messages
+        assert "decode_transfer(...) in 'ingest'" in messages
+        assert "body_matches / verify_entry_bytes" in messages
+
+    def test_config_drift(self, seeded):
+        found = seeded["drift_config.py"]
+        assert all(f.rule == "config-drift" for f in found)
+        assert len(found) == 3
+        messages = " | ".join(f.message for f in found)
+        # one of each drift type
+        assert "'mystery-knob' is validated/read here but never documented" in messages
+        assert "'ghost-flag' is documented in drift_config.yaml" in messages
+        assert "'dead-timeout-ms' is parsed but its value is never consumed" in messages
+
     def test_every_rule_fired(self, seeded):
         fired = {f.rule for fs in seeded.values() for f in fs}
         assert fired == {
             "loop-block", "lock-discipline", "resilience-coverage",
-            "jax-hotpath", "error-taxonomy",
+            "jax-hotpath", "error-taxonomy", "task-hygiene",
+            "bounded-growth", "trust-surface", "config-drift",
         }
 
 
@@ -220,6 +288,71 @@ class TestCli:
             {"rule", "path", "line", "message"} <= set(f)
             for f in data["findings"]
         )
+
+    def test_json_format_fingerprints_and_summary(self):
+        proc = self._run(SEEDED, "--format=json")
+        data = json.loads(proc.stdout)
+        assert data["summary"]["findings"] == len(data["findings"])
+        assert data["summary"]["clean"] is False
+        fps = [f["fingerprint"] for f in data["findings"]]
+        assert all(fps) and len(fps) == len(set(fps))
+        # stable across runs: same tree -> same fingerprints
+        again = json.loads(self._run(SEEDED, "--format=json").stdout)
+        assert fps == [f["fingerprint"] for f in again["findings"]]
+
+    def test_fingerprint_survives_unrelated_edits(self, tmp_path):
+        """The fingerprint keys on (rule, path, normalized line) like
+        the baseline does, NOT on line numbers — edits above a finding
+        must not re-identify it."""
+        from tools.analyze.output import fingerprints
+
+        mod = tmp_path / "mod.py"
+        mod.write_text(
+            "import time\n\nasync def f():\n    time.sleep(1)\n"
+        )
+        before = run_paths([str(mod)], baseline_path=None)
+        (_, _, fp_before), = fingerprints(
+            before.findings, before.project
+        )
+        mod.write_text(
+            "import time\n\n# an unrelated comment\n\n\n"
+            "async def f():\n    time.sleep(1)\n"
+        )
+        after = run_paths([str(mod)], baseline_path=None)
+        (f_after, _, fp_after), = fingerprints(
+            after.findings, after.project
+        )
+        assert f_after.line != before.findings[0].line
+        assert fp_after == fp_before
+
+    def test_sarif_output(self):
+        proc = self._run(SEEDED, "--format=sarif")
+        doc = json.loads(proc.stdout)
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        assert run["tool"]["driver"]["name"] == "ompb-lint"
+        rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert {
+            "loop-block", "task-hygiene", "bounded-growth",
+            "trust-surface", "config-drift", "jax-hotpath",
+        } <= rule_ids
+        assert run["results"]
+        for res in run["results"]:
+            assert res["ruleId"] in rule_ids
+            loc = res["locations"][0]["physicalLocation"]
+            assert loc["artifactLocation"]["uri"].startswith(
+                "tests/fixtures/lint/seeded/"
+            )
+            assert loc["region"]["startLine"] >= 1
+            assert res["partialFingerprints"]["ompbLintContext/v1"]
+
+    def test_sarif_clean_run_still_documents_rules(self, tmp_path):
+        out = tmp_path / "lint.sarif"
+        proc = self._run(CLEAN, "--format=sarif", f"--output={out}")
+        assert proc.returncode == 0
+        doc = json.loads(out.read_text())
+        assert doc["runs"][0]["results"] == []
+        assert doc["runs"][0]["tool"]["driver"]["rules"]
 
     def test_repo_gate(self):
         """Exactly what CI runs."""

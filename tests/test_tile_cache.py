@@ -718,6 +718,28 @@ class TestPrefetcher:
         finally:
             await pre.close()
 
+    async def test_close_survives_swallowed_cancel(self):
+        """close() must terminate even when its cancel is eaten by the
+        fetch path's bounded wait (wait_for's completion race,
+        bpo-42130): the worker checks the closing latch instead of
+        sailing back into queue.get() forever."""
+        entered = asyncio.Event()
+
+        async def fetch(ctx, key):
+            entered.set()
+            try:
+                await asyncio.Event().wait()  # park until cancelled
+            except asyncio.CancelledError:
+                return  # the swallowed-cancel shape
+
+        pre = ViewportPrefetcher(fetch, None, _FakeAdmission())
+        pre.start()
+        pre.observe(_ctx(x=0))
+        pre.observe(_ctx(x=64))  # predictions put the worker in fetch
+        await asyncio.wait_for(entered.wait(), 5)
+        await asyncio.wait_for(pre.close(), 5)
+        assert pre._worker is None
+
     async def test_http_pan_warms_neighbor(self, tmp_path):
         app_obj, client = await _make_app(tmp_path)
         try:

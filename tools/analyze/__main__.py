@@ -7,11 +7,11 @@ Exit codes: 0 clean (no unsuppressed, non-baselined findings);
 from __future__ import annotations
 
 import argparse
-import json
 import sys
 
 from . import DEFAULT_PATHS, run_paths, write_baseline
 from .core import BASELINE_PATH
+from .output import render_json, render_sarif
 
 
 def main(argv=None) -> int:
@@ -38,7 +38,16 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--json", action="store_true", dest="as_json",
-        help="machine-readable output",
+        help="machine-readable output (alias for --format=json)",
+    )
+    parser.add_argument(
+        "--format", default=None, choices=("text", "json", "sarif"),
+        dest="fmt",
+        help="output format; json and sarif carry stable fingerprints",
+    )
+    parser.add_argument(
+        "--output", default=None,
+        help="write the report to this file instead of stdout",
     )
     args = parser.parse_args(argv)
     paths = args.paths or None
@@ -64,21 +73,29 @@ def main(argv=None) -> int:
         paths, rules=rules,
         baseline_path=None if args.no_baseline else BASELINE_PATH,
     )
-    if args.as_json:
-        print(json.dumps({
-            "findings": [vars(f) for f in report.findings],
-            "suppressed": [vars(f) for f in report.suppressed],
-            "baselined": [vars(f) for f in report.baselined],
-        }, indent=2))
+    fmt = args.fmt or ("json" if args.as_json else "text")
+    if fmt == "json":
+        out = render_json(report)
+    elif fmt == "sarif":
+        out = render_sarif(report)
     else:
-        for f in report.findings:
-            print(f.format())
-        print(
+        lines = [f.format() for f in report.findings]
+        lines.append(
             f"ompb-lint: {len(report.findings)} finding(s), "
             f"{len(report.suppressed)} suppressed, "
             f"{len(report.baselined)} baselined, "
             f"{len(report.project.files)} file(s)"
         )
+        out = "\n".join(lines)
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as fh:
+            fh.write(out + "\n")
+        if fmt == "text":
+            print(out)
+        else:
+            print(f"ompb-lint: report written to {args.output}")
+    else:
+        print(out)
     return 0 if report.clean else 1
 
 

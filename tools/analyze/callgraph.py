@@ -1,35 +1,62 @@
-"""Conservative intra-module/intra-package call graph.
+"""Conservative call graph: module-local resolution plus a
+project-wide interprocedural layer (r21).
 
-Resolution is deliberately name-based and local — the goal is a
-linter that never hallucinates edges across unrelated objects, not a
-whole-program points-to analysis:
+Resolution is deliberately name-based and conservative — the goal is
+a linter that never hallucinates edges across unrelated objects, not
+a whole-program points-to analysis:
 
-- **strict** edges (loop-block): a bare name resolves to a function
-  defined at module level in the same module; ``self.m`` resolves to a
-  method of the enclosing class; ``OBJ.m`` resolves through
-  module-level ``OBJ = ClassName()`` singletons (the REGISTRY/INJECTOR
-  pattern this codebase uses everywhere).
-- **loose** edges (resilience-coverage): any function or method in the
-  same module whose bare name matches the call's attribute tail. That
-  over-connects (``.get`` matches every ``get``), which is safe for a
-  reachability argument that only *admits* guard markers.
+- **strict** edges (loop-block, the fact lattices): a bare name
+  resolves to a function defined at module level in the same module
+  OR imported by name from another analyzed module; ``self.m``
+  resolves to a method of the enclosing class; ``self.attr.m``
+  resolves through ``self.attr = ClassName(...)`` attribute typing;
+  ``OBJ.m`` resolves through module-level ``OBJ = ClassName()``
+  singletons (the REGISTRY/INJECTOR pattern this codebase uses
+  everywhere) and through ``import mod`` + ``mod.func(...)``;
+  ``var = ClassName(...)`` types locals for ``var.m(...)``.
+- **loose** edges (resilience-coverage): any function or method in
+  the same module whose bare name matches the call's attribute tail.
+  That over-connects (``.get`` matches every ``get``), which is safe
+  for a reachability argument that only *admits* guard markers.
+
+Imports resolve only to files in the analyzed set (stdlib and
+third-party calls stay unresolved), so cross-module edges exist only
+between modules the run can actually see. ``from``-imports follow
+relative levels; absolute imports try the repo root first and the
+importer's own directory second (the flat fixture corpora import each
+other by bare module name, exactly like scripts on ``sys.path``).
+
+Handler tables registered via ``router.add_get/add_post/add_route``
+are extracted per module (``RouteReg``) so the trust-surface checker
+can walk from a route path literal to its handler function.
 
 Calls that appear inside arguments to ``run_in_executor`` /
 ``asyncio.to_thread`` / executor ``submit`` — including lambdas and
 local functions passed by name — are tagged ``in_executor``: they run
 on a pool thread, so blocking there is the *correct* pattern, not a
 loop hazard.
+
+Known remaining blind spots (documented in KNOWN_GAPS): dynamic
+``getattr``/string dispatch, values smuggled through containers, and
+facts that cross process boundaries (executors, subprocesses).
 """
 
 from __future__ import annotations
 
 import ast
 import dataclasses
+import posixpath
 from typing import Dict, List, Optional, Set, Tuple
 
 from .core import Project, SourceFile
 
 EXECUTOR_ENTRYPOINTS = {"run_in_executor", "to_thread", "submit"}
+
+#: aiohttp-style route registration methods the route scan recognizes.
+ROUTE_ADDERS = {
+    "add_get", "add_post", "add_put", "add_delete", "add_patch",
+    "add_head", "add_route",
+}
 
 
 @dataclasses.dataclass
@@ -54,6 +81,27 @@ class FunctionInfo:
     is_async: bool
     lineno: int
     calls: List[CallSite] = dataclasses.field(default_factory=list)
+    # local variable -> constructor type expression ("ClassName" or
+    # "mod.ClassName") for strict method resolution on locals
+    local_types: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class ImportTarget:
+    kind: str                    # "module" | "symbol"
+    module: str                  # repo-relative path of the target file
+    symbol: Optional[str] = None  # original name for "symbol" imports
+
+
+@dataclasses.dataclass
+class RouteReg:
+    """One ``router.add_*("/path", handler)`` registration."""
+    module: str
+    line: int
+    method: str                  # the add_* name
+    path: str                    # route path literal ("" if dynamic)
+    handler_name: Optional[str]
+    handler: Optional[FunctionInfo]
 
 
 def _base_of(func: ast.expr) -> Tuple[Optional[str], Optional[str]]:
@@ -152,6 +200,21 @@ class _FunctionScanner:
             self._visit(child, in_exec)
 
 
+def _ctor_type_expr(value: ast.expr) -> Optional[str]:
+    """"ClassName" / "mod.ClassName" if ``value`` is a constructor-
+    looking call (uppercase-initial callee), else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    base, name = _base_of(value.func)
+    if not name or not name[:1].isupper():
+        return None
+    if base and base != "<expr>" and base != "self":
+        return f"{base}.{name}"
+    if base is None:
+        return name
+    return None
+
+
 class ModuleIndex:
     """Functions/methods of one module plus local resolution tables."""
 
@@ -162,22 +225,30 @@ class ModuleIndex:
         self.methods: Dict[Tuple[str, str], FunctionInfo] = {}
         self.module_level: Dict[str, FunctionInfo] = {}
         self.instances: Dict[str, str] = {}  # var -> ClassName
+        self.classes: Set[str] = set()
+        # (class, attr) -> "ClassName" / "mod.ClassName" from
+        # ``self.attr = ClassName(...)`` assignments anywhere in the
+        # class (not just __init__ — lazily-built collaborators count)
+        self.attr_types: Dict[Tuple[str, str], str] = {}
         if sf.tree is None:
             return
         self._index(sf.tree)
         for fn in self.functions:
             _FunctionScanner(fn).scan()
+            self._collect_local_types(fn)
 
     def _index(self, tree: ast.AST) -> None:
         for node in tree.body:  # type: ignore[attr-defined]
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self._add(node, class_name=None)
             elif isinstance(node, ast.ClassDef):
+                self.classes.add(node.name)
                 for item in node.body:
                     if isinstance(
                         item, (ast.FunctionDef, ast.AsyncFunctionDef)
                     ):
                         self._add(item, class_name=node.name)
+                self._collect_attr_types(node)
             elif isinstance(node, ast.Assign):
                 # module-level singletons: INJECTOR = FaultInjector()
                 if (
@@ -188,6 +259,34 @@ class ModuleIndex:
                     for t in node.targets:
                         if isinstance(t, ast.Name):
                             self.instances[t.id] = node.value.func.id
+
+    def _collect_attr_types(self, cls: ast.ClassDef) -> None:
+        for sub in ast.walk(cls):
+            if not isinstance(sub, ast.Assign):
+                continue
+            texpr = _ctor_type_expr(sub.value)
+            if texpr is None:
+                continue
+            for t in sub.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    self.attr_types.setdefault(
+                        (cls.name, t.attr), texpr
+                    )
+
+    def _collect_local_types(self, fn: FunctionInfo) -> None:
+        for sub in ast.walk(fn.node):
+            if not isinstance(sub, ast.Assign):
+                continue
+            texpr = _ctor_type_expr(sub.value)
+            if texpr is None:
+                continue
+            for t in sub.targets:
+                if isinstance(t, ast.Name):
+                    fn.local_types.setdefault(t.id, texpr)
 
     def _add(self, node, class_name: Optional[str]) -> None:
         qual = (
@@ -231,3 +330,333 @@ class ModuleIndex:
 
 def build_indexes(project: Project) -> Dict[str, ModuleIndex]:
     return {sf.path: ModuleIndex(sf) for sf in project.files}
+
+
+# ---------------------------------------------------------------------------
+# project-wide layer (r21)
+# ---------------------------------------------------------------------------
+
+
+def _module_file_candidates(
+    importer: str, dotted: str, level: int
+) -> List[str]:
+    """Repo-relative file paths a dotted import could denote."""
+    parts = [p for p in dotted.split(".") if p] if dotted else []
+    bases: List[str] = []
+    if level == 0:
+        if parts:
+            bases.append("/".join(parts))
+            # same-directory fallback: flat corpora (test fixtures)
+            # import siblings by bare name, script-style
+            d = posixpath.dirname(importer)
+            if d:
+                bases.append(posixpath.join(d, "/".join(parts)))
+    else:
+        d = posixpath.dirname(importer)
+        for _ in range(level - 1):
+            d = posixpath.dirname(d)
+        bases.append(posixpath.join(d, "/".join(parts)) if parts else d)
+    out: List[str] = []
+    for b in bases:
+        if not b:
+            continue
+        out.append(b + ".py")
+        out.append(b + "/__init__.py")
+    return out
+
+
+def _find_module(
+    importer: str, dotted: str, level: int, by_path: Dict[str, SourceFile]
+) -> Optional[str]:
+    for cand in _module_file_candidates(importer, dotted, level):
+        if cand in by_path:
+            return cand
+    return None
+
+
+def _scan_imports(
+    sf: SourceFile, by_path: Dict[str, SourceFile]
+) -> Dict[str, ImportTarget]:
+    """Local name -> what it denotes, for names that resolve to files
+    in the analyzed set. Walks the whole tree so lazy function-level
+    imports bind too (module-granularity; last writer wins)."""
+    table: Dict[str, ImportTarget] = {}
+    if sf.tree is None:
+        return table
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    local, dotted = alias.asname, alias.name
+                else:
+                    # `import a.b` binds `a`; only the top package is
+                    # addressable through the local name
+                    local = dotted = alias.name.split(".")[0]
+                mod = _find_module(sf.path, dotted, 0, by_path)
+                if mod is not None:
+                    table[local] = ImportTarget("module", mod)
+        elif isinstance(node, ast.ImportFrom):
+            base = _find_module(
+                sf.path, node.module or "", node.level, by_path
+            )
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                local = alias.asname or alias.name
+                sub_dotted = (
+                    f"{node.module}.{alias.name}"
+                    if node.module else alias.name
+                )
+                sub = _find_module(
+                    sf.path, sub_dotted, node.level, by_path
+                )
+                if sub is not None:
+                    table[local] = ImportTarget("module", sub)
+                elif base is not None:
+                    table[local] = ImportTarget(
+                        "symbol", base, alias.name
+                    )
+    return table
+
+
+class ProjectGraph:
+    """Interprocedural strict resolution over every analyzed module.
+
+    ``resolve(caller, call)`` returns the unique strict callee (or
+    None): module-local first, then through the import table,
+    attribute/local constructor typing, and module-level singletons of
+    imported classes. ``callers_of`` is the reverse strict graph, and
+    ``routes`` the extracted handler tables.
+    """
+
+    def __init__(self, project: Project, indexes: Dict[str, ModuleIndex]):
+        self.project = project
+        self.indexes = indexes
+        self.imports: Dict[str, Dict[str, ImportTarget]] = {
+            path: _scan_imports(idx.sf, project.by_path)
+            for path, idx in indexes.items()
+        }
+        self.routes: List[RouteReg] = []
+        for idx in indexes.values():
+            self._scan_routes(idx)
+        self._callers: Optional[Dict[str, Set[str]]] = None
+        self._by_qual: Dict[str, FunctionInfo] = {
+            fn.qualname: fn
+            for idx in indexes.values() for fn in idx.functions
+        }
+
+    # -- class / function resolution -----------------------------------
+
+    def function(self, qualname: str) -> Optional[FunctionInfo]:
+        return self._by_qual.get(qualname)
+
+    def functions(self) -> List[FunctionInfo]:
+        return list(self._by_qual.values())
+
+    def resolve_class(
+        self, module: str, type_expr: str
+    ) -> Optional[Tuple[str, str]]:
+        """("mod.Class" | "Class") in ``module`` -> (defining module
+        path, class name), or None."""
+        idx = self.indexes.get(module)
+        imports = self.imports.get(module, {})
+        if "." in type_expr:
+            base, cname = type_expr.split(".", 1)
+            tgt = imports.get(base)
+            if tgt is not None and tgt.kind == "module":
+                tidx = self.indexes.get(tgt.module)
+                if tidx is not None and cname in tidx.classes:
+                    return tgt.module, cname
+            return None
+        if idx is not None and type_expr in idx.classes:
+            return module, type_expr
+        tgt = imports.get(type_expr)
+        if tgt is not None and tgt.kind == "symbol":
+            tidx = self.indexes.get(tgt.module)
+            if tidx is not None and tgt.symbol in tidx.classes:
+                return tgt.module, tgt.symbol
+        return None
+
+    def _method(
+        self, cls: Optional[Tuple[str, str]], name: str
+    ) -> Optional[FunctionInfo]:
+        if cls is None:
+            return None
+        tidx = self.indexes.get(cls[0])
+        if tidx is None:
+            return None
+        return tidx.methods.get((cls[1], name))
+
+    def resolve(
+        self, caller: FunctionInfo, call: CallSite
+    ) -> Optional[FunctionInfo]:
+        idx = self.indexes.get(caller.module)
+        if idx is None:
+            return None
+        local = idx.resolve_strict(caller, call)
+        if local is not None:
+            return local
+        imports = self.imports.get(caller.module, {})
+
+        if call.base is None:
+            tgt = imports.get(call.name)
+            if tgt is None:
+                return None
+            if tgt.kind == "symbol":
+                tidx = self.indexes.get(tgt.module)
+                if tidx is None:
+                    return None
+                fn = tidx.module_level.get(tgt.symbol)
+                if fn is not None:
+                    return fn
+                # imported class constructed: ClassName(...) runs
+                # ClassName.__init__
+                if tgt.symbol in tidx.classes:
+                    return tidx.methods.get((tgt.symbol, "__init__"))
+            return None
+
+        base = call.base
+        if base == "<expr>":
+            return None
+
+        if base.startswith("self.") and caller.class_name is not None:
+            attr = base[len("self."):]
+            texpr = idx.attr_types.get((caller.class_name, attr))
+            if texpr is not None:
+                return self._method(
+                    self.resolve_class(caller.module, texpr), call.name
+                )
+            return None
+
+        if "." in base:
+            # mod.OBJ.m / mod.Class(...) with a two-part base
+            head, tail = base.split(".", 1)
+            tgt = imports.get(head)
+            if tgt is not None and tgt.kind == "module":
+                tidx = self.indexes.get(tgt.module)
+                if tidx is not None:
+                    cls = tidx.instances.get(tail)
+                    if cls is not None:
+                        return self._method(
+                            self.resolve_class(tgt.module, cls),
+                            call.name,
+                        ) or tidx.methods.get((cls, call.name))
+            return None
+
+        # single-identifier base
+        texpr = caller.local_types.get(base)
+        if texpr is not None:
+            m = self._method(
+                self.resolve_class(caller.module, texpr), call.name
+            )
+            if m is not None:
+                return m
+        tgt = imports.get(base)
+        if tgt is not None:
+            tidx = self.indexes.get(tgt.module)
+            if tidx is None:
+                return None
+            if tgt.kind == "module":
+                fn = tidx.module_level.get(call.name)
+                if fn is not None:
+                    return fn
+                if call.name in tidx.classes:
+                    return tidx.methods.get((call.name, "__init__"))
+                cls = tidx.instances.get(call.name)
+                # `mod.OBJ(...)` — calling an instance: skip
+                return None
+            # imported class as namespace (classmethod/staticmethod)
+            return tidx.methods.get((tgt.symbol, call.name))
+        # module-level singleton of an imported class
+        cls = idx.instances.get(base)
+        if cls is not None:
+            return self._method(
+                self.resolve_class(caller.module, cls), call.name
+            )
+        return None
+
+    # -- reverse edges --------------------------------------------------
+
+    @property
+    def callers_of(self) -> Dict[str, Set[str]]:
+        if self._callers is None:
+            rev: Dict[str, Set[str]] = {}
+            for fn in self._by_qual.values():
+                for call in fn.calls:
+                    callee = self.resolve(fn, call)
+                    if callee is not None:
+                        rev.setdefault(callee.qualname, set()).add(
+                            fn.qualname
+                        )
+            self._callers = rev
+        return self._callers
+
+    # -- route tables ---------------------------------------------------
+
+    def _scan_routes(self, idx: ModuleIndex) -> None:
+        sf = idx.sf
+        if sf.tree is None:
+            return
+
+        def handler_of(
+            expr: ast.expr, class_name: Optional[str]
+        ) -> Tuple[Optional[str], Optional[FunctionInfo]]:
+            if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name
+            ) and expr.value.id == "self" and class_name:
+                return expr.attr, idx.methods.get(
+                    (class_name, expr.attr)
+                )
+            if isinstance(expr, ast.Name):
+                return expr.id, idx.module_level.get(expr.id)
+            return None, None
+
+        def scan_fn(node: ast.AST, class_name: Optional[str]) -> None:
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                if not isinstance(sub.func, ast.Attribute):
+                    continue
+                method = sub.func.attr
+                if method not in ROUTE_ADDERS:
+                    continue
+                args = list(sub.args)
+                # add_route(method, path, handler); add_get(path, handler)
+                if method == "add_route" and len(args) >= 3:
+                    path_arg, handler_arg = args[1], args[2]
+                elif method != "add_route" and len(args) >= 2:
+                    path_arg, handler_arg = args[0], args[1]
+                else:
+                    continue
+                route_path = (
+                    path_arg.value
+                    if isinstance(path_arg, ast.Constant)
+                    and isinstance(path_arg.value, str) else ""
+                )
+                hname, hfn = handler_of(handler_arg, class_name)
+                self.routes.append(RouteReg(
+                    module=sf.path, line=sub.lineno, method=method,
+                    path=route_path, handler_name=hname, handler=hfn,
+                ))
+
+        for node in sf.tree.body:  # type: ignore[attr-defined]
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan_fn(node, None)
+            elif isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(
+                        item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        scan_fn(item, node.name)
+
+
+def project_graph(
+    project: Project, indexes: Dict[str, ModuleIndex]
+) -> ProjectGraph:
+    """Build (and cache on the project) the interprocedural layer —
+    every checker in one run shares the same graph."""
+    graph = getattr(project, "_ompb_graph", None)
+    if graph is None:
+        graph = ProjectGraph(project, indexes)
+        project._ompb_graph = graph  # type: ignore[attr-defined]
+    return graph

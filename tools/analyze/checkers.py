@@ -1,4 +1,5 @@
-"""The five ompb-lint checkers.
+"""The core ompb-lint checkers (plus the r21 fleet rules registered
+from ``checkers_fleet``).
 
 Each checker is a function ``(project, indexes) -> [Finding]``; the
 driver (``tools.analyze.run``) applies suppressions and the baseline
@@ -7,20 +8,36 @@ afterwards, so checkers just report what they see.
 Rule ids:
 
 - ``loop-block``           blocking call reachable from an async def
+                           (strict INTERPROCEDURAL edges since r21 —
+                           a sync helper imported from another module
+                           propagates its may-block fact)
 - ``lock-discipline``      lock-guarded attribute accessed without it
 - ``resilience-coverage``  naked remote-I/O (no breaker/fault-point/
                            per-call timeout)
 - ``jax-hotpath``          host sync / per-call jit in device modules
+                           (device values now propagate through call
+                           parameters and returns — the
+                           ``_finish_png_lanes`` escape)
 - ``error-taxonomy``       bare except, swallowed CancelledError,
                            unmapped exception on the request path
+- ``task-hygiene`` / ``bounded-growth`` / ``trust-surface`` /
+  ``config-drift``         see ``checkers_fleet.py``
 """
 
 from __future__ import annotations
 
 import ast
+import dataclasses
 from typing import Dict, List, Optional, Set, Tuple
 
-from .callgraph import CallSite, FunctionInfo, ModuleIndex, _base_of
+from .callgraph import (
+    CallSite,
+    FunctionInfo,
+    ModuleIndex,
+    ProjectGraph,
+    _base_of,
+    project_graph,
+)
 from .core import Finding, Project, SourceFile
 
 # ---------------------------------------------------------------------------
@@ -66,24 +83,21 @@ def _match_blocking(
     return None
 
 
-def check_loop_block(
-    project: Project, indexes: Dict[str, ModuleIndex]
-) -> List[Finding]:
-    findings: List[Finding] = []
-
-    # 1) per-function direct STRONG blocking reasons
+def may_block_lattice(graph: ProjectGraph) -> Dict[str, str]:
+    """"May block the event loop" fact per function qualname: a
+    human-readable reason chain, propagated over STRICT interprocedural
+    edges (cross-module included) through SYNC callees — an async
+    callee suspends instead of blocking its caller. Executor-tagged
+    calls are exempt by construction."""
     direct_strong: Dict[str, str] = {}
-    for idx in indexes.values():
-        for fn in idx.functions:
-            for call in fn.calls:
-                if call.in_executor:
-                    continue
-                desc = _match_blocking(call, _STRONG_BLOCKING)
-                if desc is not None:
-                    direct_strong.setdefault(fn.qualname, desc)
+    for fn in graph.functions():
+        for call in fn.calls:
+            if call.in_executor:
+                continue
+            desc = _match_blocking(call, _STRONG_BLOCKING)
+            if desc is not None:
+                direct_strong.setdefault(fn.qualname, desc)
 
-    # 2) transitive reachability over strict same-module edges for
-    #    SYNC functions (async callees don't block their caller)
     reaches: Dict[str, Optional[str]] = {}
 
     def blocking_reason(fn: FunctionInfo, stack: Set[str]) -> Optional[str]:
@@ -94,11 +108,10 @@ def check_loop_block(
         stack.add(fn.qualname)
         reason = direct_strong.get(fn.qualname)
         if reason is None:
-            idx = indexes[fn.module]
             for call in fn.calls:
                 if call.in_executor:
                     continue
-                callee = idx.resolve_strict(fn, call)
+                callee = graph.resolve(fn, call)
                 if callee is None or callee.is_async:
                     continue
                 sub = blocking_reason(callee, stack)
@@ -109,35 +122,52 @@ def check_loop_block(
         reaches[fn.qualname] = reason
         return reason
 
-    # 3) flag async functions
-    for idx in indexes.values():
-        for fn in idx.functions:
-            if not fn.is_async:
+    for fn in graph.functions():
+        blocking_reason(fn, set())
+    return {q: r for q, r in reaches.items() if r is not None}
+
+
+def check_loop_block(
+    project: Project, indexes: Dict[str, ModuleIndex]
+) -> List[Finding]:
+    findings: List[Finding] = []
+    graph = project_graph(project, indexes)
+    reaches = may_block_lattice(graph)
+
+    # flag async functions: direct blocking primitives, then strict
+    # (interprocedural — a sync helper imported from another module
+    # counts) reachability into the may-block set
+    for fn in graph.functions():
+        if not fn.is_async:
+            continue
+        for call in fn.calls:
+            if call.in_executor:
                 continue
-            for call in fn.calls:
-                if call.in_executor:
-                    continue
-                desc = _match_blocking(
-                    call, _STRONG_BLOCKING
-                ) or _match_blocking(call, _DIRECT_ONLY)
-                if desc is not None:
-                    findings.append(Finding(
-                        "loop-block", fn.module, call.line,
-                        f"blocking call in async '{fn.name}': {desc} "
-                        "— hop through run_in_executor (or use the "
-                        "async variant)",
-                    ))
-                    continue
-                callee = idx.resolve_strict(fn, call)
-                if callee is None or callee.is_async:
-                    continue
-                reason = blocking_reason(callee, set())
-                if reason is not None:
-                    findings.append(Finding(
-                        "loop-block", fn.module, call.line,
-                        f"async '{fn.name}' reaches blocking code: "
-                        f"{callee.name}() -> {reason}",
-                    ))
+            desc = _match_blocking(
+                call, _STRONG_BLOCKING
+            ) or _match_blocking(call, _DIRECT_ONLY)
+            if desc is not None:
+                findings.append(Finding(
+                    "loop-block", fn.module, call.line,
+                    f"blocking call in async '{fn.name}': {desc} "
+                    "— hop through run_in_executor (or use the "
+                    "async variant)",
+                ))
+                continue
+            callee = graph.resolve(fn, call)
+            if callee is None or callee.is_async:
+                continue
+            reason = reaches.get(callee.qualname)
+            if reason is not None:
+                via = (
+                    "" if callee.module == fn.module
+                    else f" (via {callee.module})"
+                )
+                findings.append(Finding(
+                    "loop-block", fn.module, call.line,
+                    f"async '{fn.name}' reaches blocking code: "
+                    f"{callee.name}() -> {reason}{via}",
+                ))
     return findings
 
 
@@ -601,7 +631,24 @@ _SYNC_SINKS = {
 }
 
 
-def _device_names_flow(fn: FunctionInfo) -> Dict[int, Set[str]]:
+@dataclasses.dataclass
+class _DeviceFlowResult:
+    #: line -> sink descriptions (the findings feed)
+    sinks: Dict[int, Set[str]]
+    #: calls that received >= 1 device-valued argument:
+    #: (base, name, line, positional device flags, keyword device flags)
+    device_calls: List[
+        Tuple[Optional[str], str, int, List[bool], Dict[str, bool]]
+    ]
+    #: whether some ``return`` expression carries a device value
+    returns_device: bool
+
+
+def _device_names_flow(
+    fn: FunctionInfo,
+    seed_params: frozenset = frozenset(),
+    extra_producer=None,
+) -> _DeviceFlowResult:
     """One forward pass over statements in source order — an SSA
     approximation good enough for a linter: names assigned from device
     producers join the device set, names reassigned from anything else
@@ -609,13 +656,26 @@ def _device_names_flow(fn: FunctionInfo) -> Dict[int, Set[str]]:
     the device set AS OF their statement, so a post-``device_get``
     ``int(lengths.max())`` is correctly host-side.
 
+    The r21 interprocedural layer threads through three extensions:
+    ``seed_params`` are parameter names device-valued at entry (the
+    passed-device-param escape — a callee receiving ``filtered`` from
+    a device producer at some call site); ``extra_producer`` lets the
+    driver mark calls to functions whose RETURN carries a device value;
+    the result records every call that received a device argument and
+    whether the function returns one, which is what the fixpoint in
+    ``check_jax_hotpath`` feeds back in.
+
     Sinks reached INSIDE a loop body (``for``/``while``) are tagged
     distinctly: a per-iteration ``np.asarray``/``.item()``/``float()``
     on a device value pays one full device round trip per lane, the
     exact pattern the double-buffered dispatcher exists to avoid —
     batch the pull through one ``jax.device_get`` outside the loop."""
-    device: Set[str] = set()
+    device: Set[str] = set(seed_params)
     sinks: Dict[int, Set[str]] = {}
+    device_calls: List[
+        Tuple[Optional[str], str, int, List[bool], Dict[str, bool]]
+    ] = []
+    returns_device = False
     loop_depth = 0
 
     def call_is_producer(call: ast.Call) -> Optional[bool]:
@@ -627,6 +687,8 @@ def _device_names_flow(fn: FunctionInfo) -> Dict[int, Set[str]]:
             return True
         if name in _DEVICE_PRODUCER_NAMES:
             return True
+        if extra_producer is not None:
+            return extra_producer(call)
         return None
 
     def expr_device(expr: ast.expr) -> bool:
@@ -665,6 +727,16 @@ def _device_names_flow(fn: FunctionInfo) -> Dict[int, Set[str]]:
             if not isinstance(node, ast.Call):
                 continue
             base, name = _base_of(node.func)
+            if name is not None:
+                pos_flags = [expr_device(a) for a in node.args]
+                kw_flags = {
+                    kw.arg: expr_device(kw.value)
+                    for kw in node.keywords if kw.arg is not None
+                }
+                if any(pos_flags) or any(kw_flags.values()):
+                    device_calls.append(
+                        (base, name, node.lineno, pos_flags, kw_flags)
+                    )
             if name not in _SYNC_SINKS:
                 continue
             if name in ("asarray", "array") and base not in ("np", "numpy"):
@@ -683,9 +755,14 @@ def _device_names_flow(fn: FunctionInfo) -> Dict[int, Set[str]]:
                 )
 
     def process(node: ast.AST) -> None:
-        nonlocal loop_depth
+        nonlocal loop_depth, returns_device
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             return  # nested defs analyzed as their own scope? no — skip
+        if isinstance(node, ast.Return):
+            scan_sinks(node.value)
+            if node.value is not None and expr_device(node.value):
+                returns_device = True
+            return
         if isinstance(node, ast.Assign):
             scan_sinks(node.value)
             is_dev = expr_device(node.value)
@@ -734,13 +811,91 @@ def _device_names_flow(fn: FunctionInfo) -> Dict[int, Set[str]]:
 
     for stmt in getattr(fn.node, "body", []):
         process(stmt)
-    return sinks
+    return _DeviceFlowResult(sinks, device_calls, returns_device)
+
+
+def _device_param_lattice(
+    graph: ProjectGraph,
+    sync_fns: List[FunctionInfo],
+) -> Tuple[Dict[str, frozenset], Set[str]]:
+    """"Carries a device value" fact, propagated interprocedurally:
+    a parameter is device-valued if ANY strict call site passes a
+    device expression in its position (the ``_finish_png_lanes``
+    ``filtered`` escape the module-local analyzer provably missed),
+    and a function is device-returning if some ``return`` carries one.
+    Fixpoint over the sync-scope functions — each round can only add
+    facts, and call chains here are shallow, so it converges fast."""
+    in_scope = {fn.qualname for fn in sync_fns}
+    seeds: Dict[str, frozenset] = {}
+    device_returns: Set[str] = set()
+
+    def param_names(fn: FunctionInfo) -> List[str]:
+        a = fn.node.args  # type: ignore[union-attr]
+        return [p.arg for p in list(a.posonlyargs) + list(a.args)]
+
+    for _ in range(len(sync_fns) + 1):
+        changed = False
+        for fn in sync_fns:
+
+            def extra_producer(call_node, _fn=fn):
+                base, name = _base_of(call_node.func)
+                if name is None:
+                    return None
+                callee = graph.resolve(
+                    _fn, CallSite(base, name, call_node.lineno, False)
+                )
+                if callee is not None and callee.qualname in device_returns:
+                    return True
+                return None
+
+            res = _device_names_flow(
+                fn, seeds.get(fn.qualname, frozenset()), extra_producer
+            )
+            if res.returns_device and fn.qualname not in device_returns:
+                device_returns.add(fn.qualname)
+                changed = True
+            for base, name, line, pos_flags, kw_flags in res.device_calls:
+                callee = graph.resolve(
+                    fn, CallSite(base, name, line, False)
+                )
+                if callee is None or callee.qualname not in in_scope:
+                    continue
+                params = param_names(callee)
+                offset = 1 if (
+                    callee.class_name is not None
+                    and params and params[0] == "self"
+                ) else 0
+                hit: Set[str] = set(seeds.get(callee.qualname, frozenset()))
+                before = len(hit)
+                for i, flag in enumerate(pos_flags):
+                    j = i + offset
+                    if flag and j < len(params):
+                        hit.add(params[j])
+                for kw, flag in kw_flags.items():
+                    if flag and kw in params:
+                        hit.add(kw)
+                if len(hit) != before:
+                    seeds[callee.qualname] = frozenset(hit)
+                    changed = True
+        if not changed:
+            break
+    return seeds, device_returns
 
 
 def check_jax_hotpath(
     project: Project, indexes: Dict[str, ModuleIndex]
 ) -> List[Finding]:
     findings: List[Finding] = []
+    graph = project_graph(project, indexes)
+
+    sync_fns: List[FunctionInfo] = []
+    for sf in project.files:
+        if sf.tree is None or sf.path in _JAX_ALLOWLIST:
+            continue
+        if project.in_scope(sf, "jax-hotpath", _JAX_SYNC_SCOPE):
+            sync_fns.extend(indexes[sf.path].functions)
+    seeds, device_returns = _device_param_lattice(graph, sync_fns)
+
     for sf in project.files:
         if sf.tree is None or sf.path in _JAX_ALLOWLIST:
             continue
@@ -762,16 +917,36 @@ def check_jax_hotpath(
                             "device (benchmarks belong in "
                             "runtime/microbench.py)",
                         ))
-                for line, descs in sorted(
-                    _device_names_flow(fn).items()
-                ):
+
+                def extra_producer(call_node, _fn=fn):
+                    base, name = _base_of(call_node.func)
+                    if name is None:
+                        return None
+                    callee = graph.resolve(
+                        _fn,
+                        CallSite(base, name, call_node.lineno, False),
+                    )
+                    if (
+                        callee is not None
+                        and callee.qualname in device_returns
+                    ):
+                        return True
+                    return None
+
+                seed = seeds.get(fn.qualname, frozenset())
+                res = _device_names_flow(fn, seed, extra_producer)
+                via = (
+                    " (device value arrives via parameter "
+                    + "/".join(sorted(seed)) + ")"
+                ) if seed else ""
+                for line, descs in sorted(res.sinks.items()):
                     for desc in sorted(descs):
                         findings.append(Finding(
                             "jax-hotpath", sf.path, line,
                             f"host sync in '{fn.name}': {desc} forces "
                             "a device->host transfer — batch pulls "
                             "through one jax.device_get, or justify "
-                            "with a suppression",
+                            f"with a suppression{via}",
                         ))
         if in_jit_scope:
             findings.extend(_check_jit_in_function(sf))
@@ -1015,10 +1190,13 @@ def check_error_taxonomy(
     return findings
 
 
+from .checkers_fleet import FLEET_CHECKERS  # noqa: E402
+
 ALL_CHECKERS = {
     "loop-block": check_loop_block,
     "lock-discipline": check_lock_discipline,
     "resilience-coverage": check_resilience_coverage,
     "jax-hotpath": check_jax_hotpath,
     "error-taxonomy": check_error_taxonomy,
+    **FLEET_CHECKERS,
 }

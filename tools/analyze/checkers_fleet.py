@@ -1,0 +1,799 @@
+"""The r21 fleet-invariant checkers (whole-program rules).
+
+These four rules ride the interprocedural layer in ``callgraph.py``
+and encode the invariants the r17–r20 planes introduced — the bug
+classes that shipped (the PR-14 suite-wide hang from an untracked
+fire-and-forget task, the PR-9 immortal negative-cache entries) and
+the trust properties the cluster depends on:
+
+- ``task-hygiene``     every ``create_task``/``ensure_future``/
+                       ``run_in_executor`` result is awaited, tracked
+                       (and the tracking attr is consumed somewhere in
+                       the class — a drain/cancel/callback), or handed
+                       to a consumer call. A bare fire-and-forget
+                       expression statement is exactly the PR-14 hang
+                       shape.
+- ``bounded-growth``   an instance/module collection that grows on a
+                       request/gossip/heartbeat path (scope: cluster/,
+                       cache/plane/, obs/) needs eviction evidence in
+                       its class: pop/clear/del, a rebuild
+                       reassignment, a ``len(...)`` cap check, or a
+                       ``deque(maxlen=...)`` by construction.
+- ``trust-surface``    every ``/internal/*`` route must sit behind
+                       ``verify_cluster_request`` (in-handler or via a
+                       guard middleware in the registering module),
+                       and every remote-byte ingress (``decode_*``
+                       frame parsers) must reach cluster/integrity
+                       verification on its own path or a caller path.
+- ``config-drift``     three-way diff of the validated schema in
+                       utils/config.py, the conf/config.yaml
+                       documentation, and actual consumer read sites:
+                       undocumented, unvalidated, and dead keys are
+                       all finding-worthy.
+
+Decision tables for each rule live in ARCHITECTURE.md ("Invariant
+analysis (r21)").
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .callgraph import (
+    CallSite,
+    FunctionInfo,
+    ModuleIndex,
+    ProjectGraph,
+    _base_of,
+    project_graph,
+)
+from .core import REPO_ROOT, Finding, Project, SourceFile
+
+# ---------------------------------------------------------------------------
+# task-hygiene
+# ---------------------------------------------------------------------------
+
+_TASK_SCOPE = ("omero_ms_pixel_buffer_tpu/",)
+_SPAWN_NAMES = {"create_task", "ensure_future", "run_in_executor"}
+
+
+def _parent_map(root: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(root):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _attr_loads_in_class(
+    idx: ModuleIndex, class_name: str, attr: str
+) -> bool:
+    """True if ``self.<attr>`` is LOADED anywhere in the class — the
+    tracked task is cancelled, awaited, drained, iterated, or given a
+    callback somewhere (``self.X.cancel()`` parses as a Load of the
+    attribute)."""
+    for fn in idx.functions:
+        if fn.class_name != class_name:
+            continue
+        for node in ast.walk(fn.node):
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr == attr
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and isinstance(node.ctx, ast.Load)
+            ):
+                return True
+    return False
+
+
+def _name_loaded_later(
+    fn_node: ast.AST, name: str, exclude: ast.stmt
+) -> bool:
+    """True if ``name`` is loaded anywhere in the function outside the
+    assigning statement — awaited, cancelled, passed along, stored."""
+    excluded = set(map(id, ast.walk(exclude)))
+    for node in ast.walk(fn_node):
+        if id(node) in excluded:
+            continue
+        if (
+            isinstance(node, ast.Name)
+            and node.id == name
+            and isinstance(node.ctx, ast.Load)
+        ):
+            return True
+    return False
+
+
+def check_task_hygiene(
+    project: Project, indexes: Dict[str, ModuleIndex]
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in project.files:
+        if sf.tree is None or not project.in_scope(
+            sf, "task-hygiene", _TASK_SCOPE
+        ):
+            continue
+        idx = indexes[sf.path]
+        for fn in idx.functions:
+            parents = _parent_map(fn.node)
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                _, name = _base_of(node.func)
+                if name not in _SPAWN_NAMES:
+                    continue
+                verdict = _classify_spawn(
+                    node, parents, fn, idx
+                )
+                if verdict is not None:
+                    findings.append(Finding(
+                        "task-hygiene", sf.path, node.lineno,
+                        f"{name}(...) in '{fn.name}' {verdict} — "
+                        "await it, track it on the owner (and drain/"
+                        "cancel in close()), or attach a done "
+                        "callback that consumes the result "
+                        "(untracked fire-and-forget tasks are the "
+                        "PR-14 hang shape: their cancellation and "
+                        "exceptions vanish)",
+                    ))
+    return findings
+
+
+def _classify_spawn(
+    spawn: ast.Call,
+    parents: Dict[ast.AST, ast.AST],
+    fn: FunctionInfo,
+    idx: ModuleIndex,
+) -> Optional[str]:
+    """None if the spawned task is consumed; else a reason string."""
+    node: ast.AST = spawn
+    while True:
+        parent = parents.get(node)
+        if parent is None:
+            return None  # the function node itself — defensive
+        if isinstance(parent, (ast.Await, ast.Return, ast.Lambda)):
+            return None
+        if isinstance(parent, ast.Call) :
+            # the task is an argument to (or receiver of) another call:
+            # asyncio.wait({t}), tasks.add(t), t.add_done_callback(cb)
+            return None
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return None
+        if isinstance(parent, ast.Expr):
+            return (
+                "is a bare fire-and-forget statement: the task "
+                "reference is dropped on the floor"
+            )
+        if isinstance(parent, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                parent.targets
+                if isinstance(parent, ast.Assign) else [parent.target]
+            )
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    if not _name_loaded_later(fn.node, t.id, parent):
+                        return (
+                            f"is assigned to '{t.id}' which is never "
+                            "used again"
+                        )
+                elif isinstance(t, ast.Attribute) and isinstance(
+                    t.value, ast.Name
+                ) and t.value.id == "self":
+                    if fn.class_name is None or not _attr_loads_in_class(
+                        idx, fn.class_name, t.attr
+                    ):
+                        return (
+                            f"is stored on 'self.{t.attr}' but nothing "
+                            "in the class ever awaits, cancels, or "
+                            "drains it"
+                        )
+                # Subscript / Tuple targets: stored into a collection
+                # or unpacked — consumed
+            return None
+        node = parent
+
+
+# ---------------------------------------------------------------------------
+# bounded-growth
+# ---------------------------------------------------------------------------
+
+_GROWTH_SCOPE = (
+    "omero_ms_pixel_buffer_tpu/cluster/",
+    "omero_ms_pixel_buffer_tpu/cache/plane/",
+    "omero_ms_pixel_buffer_tpu/obs/",
+)
+_COLLECTION_CTORS = {
+    "dict", "list", "set", "OrderedDict", "defaultdict", "Counter",
+    "deque",
+}
+_GROWTH_METHODS = {
+    "append", "appendleft", "add", "extend", "insert", "setdefault",
+    "update",
+}
+_SHRINK_METHODS = {
+    "pop", "popitem", "clear", "discard", "remove", "popleft",
+}
+
+
+def _collection_init(value: ast.expr) -> Optional[bool]:
+    """None if not a collection initializer; True if bounded by
+    construction; False if unbounded. ``deque(maxlen=...)`` is bounded
+    by construction; so is a NON-EMPTY dict literal whose keys are all
+    string constants — that's a fixed-slot record declaring its key
+    space (``{"fired": 0, "peer_win": 0}``), not an open map."""
+    if isinstance(value, ast.Dict):
+        if value.keys and all(
+            isinstance(k, ast.Constant) and isinstance(k.value, str)
+            for k in value.keys
+        ):
+            return True
+        return False
+    if isinstance(value, (ast.List, ast.Set)):
+        return False
+    if isinstance(value, ast.Call):
+        _, name = _base_of(value.func)
+        if name in _COLLECTION_CTORS:
+            if name == "deque" and any(
+                kw.arg == "maxlen" for kw in value.keywords
+            ):
+                return True
+            return False
+    return None
+
+
+def _flat_targets(targets: List[ast.expr]) -> List[ast.expr]:
+    """Assign targets with tuple/list unpacking flattened — the
+    ``taken, self._failures = self._failures, {}`` rebuild idiom must
+    count as a rebuild of ``self._failures``."""
+    out: List[ast.expr] = []
+    for t in targets:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            out.extend(t.elts)
+        else:
+            out.append(t)
+    return out
+
+
+def _self_attr_of(expr: ast.expr) -> Optional[str]:
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        return expr.attr
+    return None
+
+
+def check_bounded_growth(
+    project: Project, indexes: Dict[str, ModuleIndex]
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in project.files:
+        if sf.tree is None or not project.in_scope(
+            sf, "bounded-growth", _GROWTH_SCOPE
+        ):
+            continue
+        for node in sf.tree.body:  # type: ignore[attr-defined]
+            if isinstance(node, ast.ClassDef):
+                findings.extend(_check_class_growth(sf, node))
+        findings.extend(_check_module_growth(sf))
+    return findings
+
+
+def _check_class_growth(
+    sf: SourceFile, cls: ast.ClassDef
+) -> List[Finding]:
+    methods = [
+        m for m in cls.body
+        if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    tracked: Set[str] = set()   # unbounded collection attrs from __init__
+    for m in methods:
+        if m.name != "__init__":
+            continue
+        for sub in ast.walk(m):
+            if isinstance(sub, ast.Assign):
+                kind = _collection_init(sub.value)
+                if kind is False:
+                    for t in _flat_targets(sub.targets):
+                        attr = _self_attr_of(t)
+                        if attr is not None:
+                            tracked.add(attr)
+            elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                if _collection_init(sub.value) is False:
+                    attr = _self_attr_of(sub.target)
+                    if attr is not None:
+                        tracked.add(attr)
+    if not tracked:
+        return []
+
+    grows: Dict[str, Tuple[int, str]] = {}   # attr -> (line, how)
+    shrinks: Set[str] = set()
+    for m in methods:
+        in_init = m.name == "__init__"
+        for sub in ast.walk(m):
+            # self.X.<method>(...)
+            if isinstance(sub, ast.Call) and isinstance(
+                sub.func, ast.Attribute
+            ):
+                attr = _self_attr_of(sub.func.value)
+                if attr in tracked:
+                    if sub.func.attr in _SHRINK_METHODS:
+                        shrinks.add(attr)
+                    elif sub.func.attr in _GROWTH_METHODS and not in_init:
+                        grows.setdefault(
+                            attr, (sub.lineno, sub.func.attr)
+                        )
+            # len(self.X) anywhere = cap-check evidence
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Name)
+                and sub.func.id == "len"
+                and sub.args
+                and _self_attr_of(sub.args[0]) in tracked
+            ):
+                shrinks.add(_self_attr_of(sub.args[0]))
+            # del self.X[k]
+            if isinstance(sub, ast.Delete):
+                for t in sub.targets:
+                    if isinstance(t, ast.Subscript):
+                        attr = _self_attr_of(t.value)
+                        if attr in tracked:
+                            shrinks.add(attr)
+            if isinstance(sub, ast.Assign):
+                for t in _flat_targets(sub.targets):
+                    # self.X[k] = v with a DYNAMIC key grows; a string
+                    # literal key is a fixed slot, not growth
+                    if isinstance(t, ast.Subscript):
+                        attr = _self_attr_of(t.value)
+                        if attr in tracked and not in_init:
+                            key = t.slice
+                            if not (
+                                isinstance(key, ast.Constant)
+                                and isinstance(key.value, str)
+                            ):
+                                grows.setdefault(
+                                    attr, (t.lineno, "subscript store")
+                                )
+                    # self.X = <anything> outside __init__ = rebuild
+                    attr = _self_attr_of(t)
+                    if attr in tracked and not in_init:
+                        shrinks.add(attr)
+    out = []
+    for attr in sorted(grows):
+        if attr in shrinks:
+            continue
+        line, how = grows[attr]
+        out.append(Finding(
+            "bounded-growth", sf.path, line,
+            f"'{cls.name}.{attr}' grows ({how}) with no eviction "
+            "evidence anywhere in the class (no pop/clear/del, no "
+            "rebuild, no len() cap check, no maxlen) — on a request/"
+            "gossip/heartbeat path this is an unbounded leak (the "
+            "PR-9 immortal-negative-cache shape); cap or prune it",
+        ))
+    return out
+
+
+def _check_module_growth(sf: SourceFile) -> List[Finding]:
+    """Module-level collections mutated inside functions."""
+    tracked: Dict[str, int] = {}
+    for node in sf.tree.body:  # type: ignore[attr-defined]
+        if isinstance(node, ast.Assign):
+            if _collection_init(node.value) is False:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        tracked[t.id] = node.lineno
+    if not tracked:
+        return []
+    grows: Dict[str, Tuple[int, str]] = {}
+    shrinks: Set[str] = set()
+    for node in ast.walk(sf.tree):
+        if not isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and isinstance(
+                sub.func, ast.Attribute
+            ) and isinstance(sub.func.value, ast.Name):
+                name = sub.func.value.id
+                if name in tracked:
+                    if sub.func.attr in _SHRINK_METHODS:
+                        shrinks.add(name)
+                    elif sub.func.attr in _GROWTH_METHODS:
+                        grows.setdefault(
+                            name, (sub.lineno, sub.func.attr)
+                        )
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Name)
+                and sub.func.id == "len"
+                and sub.args
+                and isinstance(sub.args[0], ast.Name)
+                and sub.args[0].id in tracked
+            ):
+                shrinks.add(sub.args[0].id)
+            if isinstance(sub, ast.Delete):
+                for t in sub.targets:
+                    if isinstance(t, ast.Subscript) and isinstance(
+                        t.value, ast.Name
+                    ) and t.value.id in tracked:
+                        shrinks.add(t.value.id)
+            if isinstance(sub, ast.Assign):
+                for t in _flat_targets(sub.targets):
+                    if isinstance(t, ast.Subscript) and isinstance(
+                        t.value, ast.Name
+                    ) and t.value.id in tracked:
+                        key = t.slice
+                        if not (
+                            isinstance(key, ast.Constant)
+                            and isinstance(key.value, str)
+                        ):
+                            grows.setdefault(
+                                t.value.id,
+                                (t.lineno, "subscript store"),
+                            )
+            if isinstance(sub, ast.Global):
+                # `global X; X = ...` rebuild counts as shrink
+                for name in sub.names:
+                    if name in tracked:
+                        shrinks.add(name)
+    out = []
+    for name in sorted(grows):
+        if name in shrinks:
+            continue
+        line, how = grows[name]
+        out.append(Finding(
+            "bounded-growth", sf.path, line,
+            f"module-level '{name}' grows ({how}) with no eviction "
+            "evidence in this module — cap or prune it",
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# trust-surface
+# ---------------------------------------------------------------------------
+
+_INGRESS_SCOPE = (
+    "omero_ms_pixel_buffer_tpu/cluster/",
+    "omero_ms_pixel_buffer_tpu/cache/plane/",
+    "omero_ms_pixel_buffer_tpu/http/",
+)
+_INGRESS_NAMES = {"decode_transfer", "decode_entry_epoch", "decode_entry"}
+_VERIFY_NAMES = {"body_matches", "verify_entry_bytes"}
+_GUARD_NAME = "verify_cluster_request"
+
+
+def _forward_reaches(
+    graph: ProjectGraph,
+    fn: FunctionInfo,
+    names: Set[str],
+    memo: Dict[str, bool],
+) -> bool:
+    """fn (or a strict transitive callee) makes a call named in
+    ``names``. Name matching is admit-only, so it's safe to accept a
+    match without resolving it."""
+    if fn.qualname in memo:
+        return memo[fn.qualname]
+    memo[fn.qualname] = False  # cycle guard
+    hit = any(c.name in names for c in fn.calls)
+    if not hit:
+        for call in fn.calls:
+            callee = graph.resolve(fn, call)
+            if callee is not None and _forward_reaches(
+                graph, callee, names, memo
+            ):
+                hit = True
+                break
+    memo[fn.qualname] = hit
+    return hit
+
+
+def _has_internal_string(fn: FunctionInfo) -> bool:
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Constant) and isinstance(
+            node.value, str
+        ) and "/internal/" in node.value:
+            return True
+    return False
+
+
+def check_trust_surface(
+    project: Project, indexes: Dict[str, ModuleIndex]
+) -> List[Finding]:
+    findings: List[Finding] = []
+    graph = project_graph(project, indexes)
+    guard_memo: Dict[str, bool] = {}
+    verify_memo: Dict[str, bool] = {}
+
+    # (a) every /internal/* route behind verify_cluster_request:
+    # in-handler (transitively) or via a guard middleware in the
+    # registering module — a function that both names the "/internal/"
+    # path prefix and reaches the verifier (the aiohttp middleware
+    # shape http/server.py uses)
+    guarded_modules: Set[str] = set()
+    for idx in indexes.values():
+        for fn in idx.functions:
+            if _has_internal_string(fn) and _forward_reaches(
+                graph, fn, {_GUARD_NAME}, guard_memo
+            ):
+                guarded_modules.add(fn.module)
+                break
+    for route in graph.routes:
+        if not route.path.startswith("/internal/"):
+            continue
+        if route.module in guarded_modules:
+            continue
+        if route.handler is not None and _forward_reaches(
+            graph, route.handler, {_GUARD_NAME}, guard_memo
+        ):
+            continue
+        findings.append(Finding(
+            "trust-surface", route.module, route.line,
+            f"route '{route.path}' is registered without "
+            f"{_GUARD_NAME} on its path: the handler never verifies "
+            "the cluster HMAC and no guard middleware in this module "
+            "covers /internal/* — an unauthenticated caller reaches "
+            "a cluster-internal surface",
+        ))
+
+    # (b) every remote-byte ingress reaches integrity verification on
+    # its own path or some caller path (admit-only, like
+    # resilience-coverage)
+    callers = graph.callers_of
+    for sf in project.files:
+        if sf.tree is None or not project.in_scope(
+            sf, "trust-surface", _INGRESS_SCOPE
+        ):
+            continue
+        idx = indexes[sf.path]
+        for fn in idx.functions:
+            if fn.name in _INGRESS_NAMES:
+                continue  # the frame parser itself, not an ingress
+            ingress = [
+                c for c in fn.calls if c.name in _INGRESS_NAMES
+            ]
+            if not ingress:
+                continue
+            covered = False
+            seen: Set[str] = set()
+            frontier = [fn.qualname]
+            while frontier and not covered:
+                q = frontier.pop()
+                if q in seen:
+                    continue
+                seen.add(q)
+                qfn = graph.function(q)
+                if qfn is not None and _forward_reaches(
+                    graph, qfn, _VERIFY_NAMES, verify_memo
+                ):
+                    covered = True
+                    break
+                frontier.extend(callers.get(q, ()))
+            if covered:
+                continue
+            for call in ingress:
+                findings.append(Finding(
+                    "trust-surface", sf.path, call.line,
+                    f"remote-byte ingress {call.name}(...) in "
+                    f"'{fn.name}' never reaches cluster/integrity "
+                    "verification (body_matches / verify_entry_bytes) "
+                    "on its path or any caller path — transferred "
+                    "bytes must cross the content-hash check before "
+                    "they are served or cached",
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# config-drift
+# ---------------------------------------------------------------------------
+
+_CONFIG_MODULE = "omero_ms_pixel_buffer_tpu/utils/config.py"
+_CONFIG_DOC = os.path.join(REPO_ROOT, "conf", "config.yaml")
+#: dotted doc-key prefixes passed through verbatim (never read
+#: key-by-key by the parser) — the OMERO server passthrough block
+_DOC_PASSTHROUGH_PREFIXES = ("omero.",)
+_PARSE_FN_RE = re.compile(r"^(_parse|from_dict$|from_yaml$|load)")
+_DOC_KEY_RE = re.compile(r"^(\s*#?\s*)([A-Za-z0-9_.-]+):(\s|$)")
+
+
+def _schema_of(sf: SourceFile) -> Tuple[Dict[str, int], Dict[str, int]]:
+    """(validated keys, read keys) -> first line seen. Validated =
+    literals in ``set(block) - {...}`` unknown-key rejections; read =
+    literal keys pulled out of block dicts inside parse functions
+    (``.get("k")``, ``block["k"]``, ``_num(block, "k", ...)``)."""
+    validated: Dict[str, int] = {}
+    reads: Dict[str, int] = {}
+    if sf.tree is None:
+        return validated, reads
+
+    parse_fns = [
+        node for node in ast.walk(sf.tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and _PARSE_FN_RE.match(node.name)
+    ]
+    for fn_node in parse_fns:
+        for sub in ast.walk(fn_node):
+            if isinstance(sub, ast.BinOp) and isinstance(
+                sub.op, ast.Sub
+            ):
+                left, right = sub.left, sub.right
+                if not (
+                    isinstance(left, ast.Call)
+                    and isinstance(left.func, ast.Name)
+                    and left.func.id == "set"
+                ):
+                    continue
+                consts: List[ast.expr] = []
+                if isinstance(right, ast.Set):
+                    consts = right.elts
+                elif isinstance(right, ast.Call) and isinstance(
+                    right.func, ast.Name
+                ) and right.func.id == "set" and right.args and isinstance(
+                    right.args[0], (ast.Set, ast.List, ast.Tuple)
+                ):
+                    consts = right.args[0].elts
+                for e in consts:
+                    if isinstance(e, ast.Constant) and isinstance(
+                        e.value, str
+                    ):
+                        validated.setdefault(e.value, e.lineno)
+            elif isinstance(sub, ast.Call):
+                key: Optional[ast.expr] = None
+                if (
+                    isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "get"
+                    and isinstance(sub.func.value, ast.Name)
+                    and sub.args
+                ):
+                    key = sub.args[0]
+                elif (
+                    isinstance(sub.func, ast.Name)
+                    and sub.func.id.startswith("_")
+                    and len(sub.args) >= 2
+                    and isinstance(sub.args[0], ast.Name)
+                ):
+                    # helper reads: _num(block, "key", default, ...)
+                    key = sub.args[1]
+                if isinstance(key, ast.Constant) and isinstance(
+                    key.value, str
+                ):
+                    reads.setdefault(key.value, sub.lineno)
+            elif isinstance(sub, ast.Subscript) and isinstance(
+                sub.value, ast.Name
+            ):
+                key = sub.slice
+                if isinstance(key, ast.Constant) and isinstance(
+                    key.value, str
+                ):
+                    reads.setdefault(key.value, sub.lineno)
+    return validated, reads
+
+
+def _doc_keys(doc_path: str) -> Tuple[Set[str], Set[str], Set[str]]:
+    """(all documented bare keys, uncommented bare keys, uncommented
+    dotted paths). Commented-out keys count as documentation only —
+    the cluster block is documented entirely in comments; prose like
+    "# auto: probe ..." can false-match the key shape, so commented
+    keys never become validation claims."""
+    documented: Set[str] = set()
+    claims: Set[str] = set()
+    claim_paths: Set[str] = set()
+    if not os.path.exists(doc_path):
+        return documented, claims, claim_paths
+    stack: List[Tuple[int, str]] = []   # (indent, key)
+    with open(doc_path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            m = _DOC_KEY_RE.match(line.rstrip("\n"))
+            if m is None:
+                continue
+            prefix, key = m.group(1), m.group(2)
+            commented = "#" in prefix
+            indent = len(prefix.replace("#", "").expandtabs())
+            indent = (indent // 2) * 2
+            while stack and stack[-1][0] >= indent:
+                stack.pop()
+            dotted = ".".join([k for _, k in stack] + [key])
+            stack.append((indent, key))
+            documented.add(key)
+            if not commented:
+                claims.add(key)
+                claim_paths.add(dotted)
+    return documented, claims, claim_paths
+
+
+def _used_names(project: Project, config_paths: Set[str]) -> Set[str]:
+    names: Set[str] = set()
+    for sf in project.files:
+        if sf.tree is None or sf.path in config_paths:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Attribute):
+                names.add(node.attr)
+            elif isinstance(node, ast.Name):
+                names.add(node.id)
+            elif isinstance(node, ast.keyword) and node.arg:
+                names.add(node.arg)
+    return names
+
+
+def check_config_drift(
+    project: Project, indexes: Dict[str, ModuleIndex]
+) -> List[Finding]:
+    findings: List[Finding] = []
+    config_files = [
+        sf for sf in project.files
+        if sf.path == _CONFIG_MODULE or "config-drift" in sf.scopes
+    ]
+    if not config_files:
+        return findings
+    used = _used_names(
+        project, {sf.path for sf in config_files}
+    )
+    for sf in config_files:
+        doc_path = (
+            _CONFIG_DOC if sf.path == _CONFIG_MODULE
+            else sf.abs_path[:-3] + ".yaml"
+        )
+        validated, reads = _schema_of(sf)
+        documented, claims, claim_paths = _doc_keys(doc_path)
+        doc_name = os.path.basename(doc_path)
+
+        # (a) undocumented: schema keys the doc never mentions
+        for key in sorted(set(validated) | set(reads)):
+            if key in documented:
+                continue
+            line = validated.get(key) or reads.get(key) or 1
+            findings.append(Finding(
+                "config-drift", sf.path, line,
+                f"config key '{key}' is validated/read here but "
+                f"never documented in {doc_name} — document it (or "
+                "drop it)",
+            ))
+        # (b) unvalidated: uncommented doc keys the parser neither
+        # validates nor reads (stale docs are operational lies)
+        schema_keys = set(validated) | set(reads)
+        for dotted in sorted(claim_paths):
+            if any(
+                dotted.startswith(p) for p in _DOC_PASSTHROUGH_PREFIXES
+            ):
+                continue
+            key = dotted.rsplit(".", 1)[-1]
+            if key in schema_keys:
+                continue
+            findings.append(Finding(
+                "config-drift", sf.path, 1,
+                f"'{dotted}' is documented in {doc_name} but the "
+                "parser neither validates nor reads it — stale "
+                "documentation (remove it or wire it up)",
+            ))
+        # (c) dead: keys the parser reads but nothing consumes (loose
+        # substring match over every attribute/name in the project, so
+        # renamed fields like *_ms suffixes still count as used)
+        for key in sorted(reads):
+            field = key.replace("-", "_").replace(".", "_")
+            if any(field in n for n in used):
+                continue
+            findings.append(Finding(
+                "config-drift", sf.path, reads[key],
+                f"config key '{key}' is parsed but its value is "
+                "never consumed anywhere outside the parser — dead "
+                "config (remove the key from the schema and "
+                f"{doc_name})",
+            ))
+    return findings
+
+
+FLEET_CHECKERS = {
+    "task-hygiene": check_task_hygiene,
+    "bounded-growth": check_bounded_growth,
+    "trust-surface": check_trust_surface,
+    "config-drift": check_config_drift,
+}

@@ -1,0 +1,180 @@
+"""Machine-readable report rendering: ``--format=json|sarif``.
+
+Both formats attach a **stable fingerprint** to every finding so
+downstream tooling (CI annotations, review bots, dashboards) can track
+a finding across commits. The fingerprint reuses the baseline's
+matching key — (rule, path, normalized source line) — so it survives
+unrelated edits above the finding exactly the way baseline entries do.
+Two identical offending lines in one file get an ``/2``-style ordinal
+suffix, mirroring the baseline's multiset semantics.
+
+SARIF output is the 2.1.0 subset GitHub code scanning ingests: one
+run, one driver, ``rules`` metadata derived from the live checker
+table, one result per finding with ``partialFingerprints`` carrying
+the baseline-compatible key under ``ompbLintContext/v1``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Dict, List, Tuple
+
+from .core import Finding, Project
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+#: One-line rule descriptions surfaced in SARIF ``rules`` metadata and
+#: ``--format=json`` output. Kept here (not in checkers) so rendering
+#: has no import cycle with the checker tables.
+RULE_DESCRIPTIONS = {
+    "parse": "file failed to parse; nothing else was checked",
+    "loop-block": (
+        "async def reaches blocking/synchronous code (directly or "
+        "through the interprocedural call graph)"
+    ),
+    "lock-discipline": (
+        "executor-shared structure touched outside its lock"
+    ),
+    "resilience-coverage": (
+        "remote I/O edge bypasses the resilience wrappers"
+    ),
+    "jax-hotpath": (
+        "device value host-synced or jit recompiled on the serving "
+        "path (including device values arriving via parameters)"
+    ),
+    "error-taxonomy": (
+        "raw exception escapes a boundary that promised the error "
+        "taxonomy"
+    ),
+    "task-hygiene": (
+        "fire-and-forget asyncio task: result never awaited, tracked, "
+        "or consumed by a done-callback"
+    ),
+    "bounded-growth": (
+        "collection grows on a request/gossip/heartbeat path with no "
+        "eviction evidence"
+    ),
+    "trust-surface": (
+        "/internal/* route or remote-byte ingress misses its "
+        "verification funnel"
+    ),
+    "config-drift": (
+        "validated schema, conf/config.yaml docs, and read sites "
+        "disagree"
+    ),
+}
+
+
+def fingerprints(
+    findings: List[Finding], project: Project
+) -> List[Tuple[Finding, str, str]]:
+    """Return ``(finding, context, fingerprint)`` triples.
+
+    The fingerprint hashes (rule, path, normalized line, ordinal) —
+    the ordinal disambiguates repeated identical lines so the
+    multiset property of the baseline carries over.
+    """
+    counts: Dict[Tuple[str, str, str], int] = {}
+    out: List[Tuple[Finding, str, str]] = []
+    for f in findings:
+        sf = project.by_path.get(f.path)
+        ctx = sf.context(f.line) if sf else ""
+        key = (f.rule, f.path, ctx)
+        counts[key] = counts.get(key, 0) + 1
+        raw = f"{f.rule}\x00{f.path}\x00{ctx}\x00{counts[key]}"
+        digest = hashlib.sha256(raw.encode("utf-8")).hexdigest()[:16]
+        out.append((f, ctx, digest))
+    return out
+
+
+def _finding_dicts(findings: List[Finding], project: Project) -> List[dict]:
+    return [
+        {
+            "rule": f.rule,
+            "path": f.path,
+            "line": f.line,
+            "message": f.message,
+            "context": ctx,
+            "fingerprint": fp,
+        }
+        for f, ctx, fp in fingerprints(findings, project)
+    ]
+
+
+def render_json(report) -> str:
+    """The ``--format=json`` document (superset of the old ``--json``:
+    same keys plus context/fingerprint per finding and a summary)."""
+    doc = {
+        "findings": _finding_dicts(report.findings, report.project),
+        "suppressed": _finding_dicts(report.suppressed, report.project),
+        "baselined": _finding_dicts(report.baselined, report.project),
+        "summary": {
+            "findings": len(report.findings),
+            "suppressed": len(report.suppressed),
+            "baselined": len(report.baselined),
+            "files": len(report.project.files),
+            "clean": report.clean,
+        },
+    }
+    return json.dumps(doc, indent=2)
+
+
+def render_sarif(report) -> str:
+    """SARIF 2.1.0 for the live (unsuppressed, non-baselined) findings."""
+    rules_seen: List[str] = []
+    results: List[dict] = []
+    for f, ctx, fp in fingerprints(report.findings, report.project):
+        if f.rule not in rules_seen:
+            rules_seen.append(f.rule)
+        results.append({
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path,
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {"startLine": f.line},
+                },
+            }],
+            "partialFingerprints": {
+                "ompbLintContext/v1": fp,
+            },
+        })
+    # emit metadata for every known rule, not just fired ones, so a
+    # clean run still documents what was checked
+    rule_meta = [
+        {
+            "id": rule,
+            "shortDescription": {"text": desc},
+        }
+        for rule, desc in sorted(RULE_DESCRIPTIONS.items())
+    ]
+    doc = {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "ompb-lint",
+                    "informationUri": (
+                        "https://github.com/glencoesoftware/"
+                        "omero-ms-pixel-buffer"
+                    ),
+                    "rules": rule_meta,
+                },
+            },
+            "originalUriBaseIds": {
+                "SRCROOT": {"uri": "file:///"},
+            },
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2)
