@@ -1494,6 +1494,259 @@ def bench_decentralized(cache_dir: str) -> dict:
     return out
 
 
+def bench_session(cache_dir: str) -> dict:
+    """Interactive session plane (r22) section — two drives, two pins:
+
+    - ``push``: a two-replica pair; a WebSocket channel subscribed on
+      replica B while annotation writes land on replica A. Each
+      write's invalidation rides the purge fan-out to B and is pushed
+      down the channel — the measured write->frame latency is the
+      delta path end to end, cross-replica. Pin
+      ``session_ok_push_latency``: every delta arrives, p99 under
+      1000 ms (a TTL-polling viewer would wait a cache TTL — tens of
+      seconds — to learn the same fact).
+    - ``drain``: replica A drains while holding 10 live channels and
+      serving tile traffic. Every channel must receive an explicit
+      ``{"reconnect": successor}`` frame before its close, the
+      successor must absorb the subscription summary, and the tile
+      traffic must see zero 5xx. Pin ``session_ok_drain_zero_drops``:
+      reconnect frames == channels, absorbed == channels, zero 5xx.
+    """
+    import socket
+
+    from aiohttp import ClientSession, WSMsgType, web
+
+    from omero_ms_pixel_buffer_tpu.auth.stores import MemorySessionStore
+    from omero_ms_pixel_buffer_tpu.cache.plane.resp_stub import (
+        InMemoryRespServer,
+    )
+    from omero_ms_pixel_buffer_tpu.http.server import PixelBufferApp
+    from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
+    from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+        ImageRegistry,
+        PixelsService,
+    )
+    from omero_ms_pixel_buffer_tpu.utils.config import Config
+
+    out: dict = {}
+    headers = {"Cookie": "sessionid=bench-cookie"}
+    peer_headers = {**headers, "X-OMPB-Peer": "bench-ops"}
+    img_path = os.path.join(cache_dir, "session_fixture.ome.tiff")
+    if not os.path.exists(img_path):
+        rng_local = np.random.default_rng(29)
+        img = rng_local.integers(
+            0, 60000, (1, 1, 1, 256, 256), dtype=np.uint16
+        )
+        write_ome_tiff(img_path, img, tile_size=(64, 64))
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    async def boot(members, self_url, port, resp_uri=None, extra=None):
+        registry = ImageRegistry()
+        registry.add(1, img_path)
+        cluster_block = {
+            "members": members, "self": self_url,
+            "peer-timeout-ms": 3000, **(extra or {}),
+        }
+        if resp_uri:
+            cluster_block["l2"] = {"uri": resp_uri}
+        config = Config.from_dict({
+            "session-store": {"type": "memory"},
+            "backend": {"batching": {"coalesce-window-ms": 1.0}},
+            "cache": {"prefetch": {"enabled": False}},
+            "cluster": cluster_block,
+        })
+        app_obj = PixelBufferApp(
+            config,
+            pixels_service=PixelsService(registry),
+            session_store=MemorySessionStore(
+                {"bench-cookie": "bench-key"}
+            ),
+        )
+        runner = web.AppRunner(app_obj.make_app(), access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        await site.start()
+        return app_obj, runner
+
+    async def recv_frame(ws, timeout=10.0):
+        msg = await asyncio.wait_for(ws.receive(), timeout)
+        if msg.type != WSMsgType.TEXT:
+            return None
+        return json.loads(msg.data)
+
+    n_writes = 20
+
+    async def push_drive() -> dict:
+        ports = [free_port() for _ in range(2)]
+        members = [f"http://127.0.0.1:{p}" for p in ports]
+        nodes = []
+        for i, port in enumerate(ports):
+            nodes.append(await boot(members, members[i], port))
+        url_a, url_b = members
+        latencies: list = []
+        delivered = 0
+        try:
+            async with ClientSession() as http:
+                ws = await asyncio.wait_for(
+                    http.ws_connect(
+                        url_b + "/session/1/live", headers=headers
+                    ), 10.0,
+                )
+                await recv_frame(ws)  # hello
+                shape = {"type": "rect", "x": 4, "y": 4,
+                         "w": 16, "h": 16}
+                for i in range(n_writes):
+                    t0 = time.perf_counter()
+                    async with http.post(
+                        url_a + "/annotations/1", headers=headers,
+                        json={"shape": shape, "label": f"w{i}"},
+                    ) as r:
+                        assert r.status == 201, await r.text()
+                    frame = await recv_frame(ws, timeout=5.0)
+                    if frame is not None and frame.get("type") in (
+                        "invalidate", "annotations"
+                    ):
+                        latencies.append(
+                            (time.perf_counter() - t0) * 1000.0
+                        )
+                        delivered += 1
+                    # drain any second frame from the same write (the
+                    # local fan-out can produce both kinds) so the
+                    # next measurement starts on an empty queue
+                    while True:
+                        try:
+                            msg = await asyncio.wait_for(
+                                ws.receive(), 0.05
+                            )
+                        except asyncio.TimeoutError:
+                            break
+                        if msg.type != WSMsgType.TEXT:
+                            break
+                await ws.close()
+        finally:
+            for _a, runner in nodes:
+                await runner.cleanup()
+        latencies.sort()
+        return {
+            "writes": n_writes,
+            "delivered": delivered,
+            "p50_ms": round(
+                latencies[len(latencies) // 2], 2
+            ) if latencies else None,
+            "p99_ms": round(
+                latencies[min(len(latencies) - 1,
+                              int(len(latencies) * 0.99))], 2
+            ) if latencies else None,
+        }
+
+    out["push"] = asyncio.run(push_drive())
+
+    n_channels = 10
+
+    async def drain_drive() -> dict:
+        resp = InMemoryRespServer()
+        await resp.start()
+        ports = [free_port() for _ in range(2)]
+        members = [f"http://127.0.0.1:{p}" for p in ports]
+        extra = {
+            "lease-ttl-s": 0.5,
+            "drain": {"deadline-s": 5, "signal": False},
+        }
+        nodes = []
+        for i, port in enumerate(ports):
+            nodes.append(await boot(
+                members, members[i], port, resp.uri, extra,
+            ))
+        url_a, url_b = members
+        statuses: list = []
+        reconnects = 0
+        try:
+            await asyncio.sleep(0.4)  # leases discovered
+            async with ClientSession() as http:
+                sockets = []
+                for _ in range(n_channels):
+                    ws = await asyncio.wait_for(
+                        http.ws_connect(
+                            url_a + "/session/1/live", headers=headers
+                        ), 10.0,
+                    )
+                    await recv_frame(ws)  # hello
+                    sockets.append(ws)
+
+                async def tile_round():
+                    for url in (url_a, url_b):
+                        async with http.get(
+                            url + "/tile/1/0/0/0?w=64&h=64&format=png",
+                            headers=headers,
+                        ) as r:
+                            await r.read()
+                            statuses.append(r.status)
+
+                async def _drain():
+                    async with http.post(
+                        url_a + "/internal/drain?wait=1",
+                        headers=peer_headers,
+                    ) as r:
+                        return r.status, await r.json()
+
+                drain_task = asyncio.ensure_future(_drain())
+                while not drain_task.done():
+                    await tile_round()
+                    await asyncio.sleep(0.02)
+                status, drained = await drain_task
+                assert status == 200, drained
+                for ws in sockets:
+                    frame = await recv_frame(ws, timeout=10.0)
+                    if frame is not None and \
+                            frame.get("type") == "reconnect" and \
+                            frame.get("reconnect") == url_b:
+                        reconnects += 1
+                    await ws.close()
+                absorbed = nodes[1][0].session_channels.snapshot()[
+                    "handoff_in"
+                ]
+            return {
+                "channels": n_channels,
+                "reconnect_frames": reconnects,
+                "absorbed_by_successor": absorbed,
+                "requests": len(statuses),
+                "serving_errors": sum(
+                    1 for s in statuses if s >= 500
+                ),
+                "drain_sessions": drained["stats"]["sessions"],
+            }
+        finally:
+            for _a, runner in nodes:
+                try:
+                    await runner.cleanup()
+                except Exception:
+                    pass
+            await resp.close()
+
+    out["drain"] = asyncio.run(drain_drive())
+
+    push = out["push"]
+    out["session_ok_push_latency"] = (
+        push["delivered"] == push["writes"]
+        and push["p99_ms"] is not None
+        and push["p99_ms"] < 1000.0
+    )
+    dr = out["drain"]
+    out["session_ok_drain_zero_drops"] = (
+        dr["reconnect_frames"] == dr["channels"]
+        and dr["absorbed_by_successor"] == dr["channels"]
+        and dr["serving_errors"] == 0
+        and dr["requests"] > 0
+    )
+    return out
+
+
 def bench_overload(
     cache_dir: str,
     duration_s: float = 4.0,
@@ -2700,6 +2953,18 @@ def main():
             decentralized_stats = {"error": f"{type(e).__name__}: {e}"}
             log(f"decentralized bench failed: {e!r}")
 
+    # --- interactive session plane (r22): cross-replica delta push
+    # latency over a live channel + rolling drain with channel handoff
+    # (session_ok_push_latency / session_ok_drain_zero_drops pins)
+    session_stats: dict = {}
+    if os.environ.get("BENCH_SESSION", "1") != "0":
+        try:
+            session_stats = bench_session(cache_dir)
+            log(f"session: {session_stats}")
+        except Exception as e:
+            session_stats = {"error": f"{type(e).__name__}: {e}"}
+            log(f"session bench failed: {e!r}")
+
     # --- batched read plane (r14): cold remote reads over a loopback
     # HTTP object store — sequential vs parallel+coalesced, sharded
     # byte identity, requests-per-tile (io_ok_* pins)
@@ -2791,6 +3056,8 @@ def main():
         record["lifecycle"] = lifecycle_stats
     if decentralized_stats:
         record["decentralized"] = decentralized_stats
+    if session_stats:
+        record["session"] = session_stats
     if overload_stats:
         record["overload"] = overload_stats
     if io_stats:
@@ -2904,6 +3171,16 @@ def main():
         )
         comparison["cluster_integrity_rounds_to_demote"] = (
             decentralized_stats["integrity"]["rounds_to_demote"]
+        )
+    if session_stats and "push" in session_stats:
+        comparison["session_push_p99_ms"] = (
+            session_stats["push"]["p99_ms"]
+        )
+        comparison["session_drain_reconnects"] = (
+            session_stats["drain"]["reconnect_frames"]
+        )
+        comparison["session_drain_serving_errors"] = (
+            session_stats["drain"]["serving_errors"]
         )
     record["engine_comparison"] = comparison
     print(json.dumps(record))

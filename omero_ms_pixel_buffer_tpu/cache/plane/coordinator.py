@@ -34,6 +34,7 @@ fail a request. ``fetch`` returns misses on every failure path;
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import time
 from typing import Optional, Tuple
@@ -531,6 +532,43 @@ class CachePlane:
                 REPLICATION.inc(op="handoff", outcome="error")
         return stats
 
+    async def handoff_sessions(
+        self, registry, deadline: float, clock=time.monotonic,
+    ) -> dict:
+        """Drain step 2b (session plane, r22): hand the live-channel
+        subscription summary to ONE post-drain successor and tell
+        every connected client where to reconnect. Identities never
+        ride the wire — the summary is per-image channel counts; the
+        client re-authenticates at the successor, which is what keeps
+        the handoff a capacity hint rather than a credential move.
+        Best-effort like the cache handoff: a dead successor just
+        means clients reconnect through the balancer instead."""
+        stats = {"channels": 0, "successor": "", "pushed": False}
+        if registry is None:
+            return stats
+        successor = ""
+        eligible = [
+            m for m in self._ring_eligible() if m != self.self_url
+        ]
+        if eligible and self.peers is not None \
+                and clock() < deadline:
+            successor = eligible[0]
+            summary = registry.begin_handoff(successor)
+            stats["channels"] = summary.get("channels", 0)
+            stats["successor"] = successor
+            if stats["channels"]:
+                stats["pushed"] = await self.peers.push_session_handoff(
+                    successor,
+                    json.dumps(summary).encode("utf-8"),
+                )
+        else:
+            # no successor (last replica) or out of time: close the
+            # channels with a bare reconnect frame — the balancer
+            # decides where those clients land
+            summary = registry.begin_handoff("")
+            stats["channels"] = summary.get("channels", 0)
+        return stats
+
     async def release_lease(self) -> bool:
         """Drain step 4: leave the fleet for good."""
         if self.membership is not None:
@@ -993,6 +1031,25 @@ class CachePlane:
         if not isinstance(membership, GossipManager):
             return None
         return membership.receive(remote)
+
+    def note_peer_contact(self, url: str) -> None:
+        """Gossip-native join hint (r22): every authenticated peer
+        request carries the sender's serving URL in the signed
+        ``X-OMPB-Peer`` header, so ANY verified internal contact — in
+        either direction — teaches this replica a member address
+        without touching Redis. Only URL-shaped values from verified
+        requests are adopted (the HTTP layer gates on signature);
+        everything else is silently ignored — this is a hint, never
+        an authority."""
+        if not isinstance(url, str) or len(url) > 512:
+            return
+        if not (url.startswith("http://") or url.startswith("https://")):
+            return
+        if url == self.self_url:
+            return
+        membership = self.membership
+        if membership is not None and hasattr(membership, "note_contact"):
+            membership.note_contact(url)
 
     def members_view(self) -> tuple:
         """The live member list: the lease/gossip view when

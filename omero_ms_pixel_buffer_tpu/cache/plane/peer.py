@@ -265,6 +265,30 @@ class PeerClient:
         )
         return ok
 
+    async def push_session_handoff(
+        self, member: str, payload: bytes
+    ) -> bool:
+        """Session-plane drain handoff (session/channels.py): POST the
+        draining replica's live-channel subscription summary to its
+        successor as JSON on the same authenticated ``/internal/handoff``
+        surface cache batches ride — the receiver routes on content
+        type. Best-effort: a dead successor costs nothing durable
+        (clients reconnect and re-subscribe), never the drain."""
+        result = await self._bounded(
+            member, "POST", "/internal/handoff",
+            body=payload,
+            extra_headers={"Content-Type": "application/json"},
+            outcome_prefix="session_handoff_",
+        )
+        if result is None:
+            return False
+        ok = result[0] == 200
+        PEER_REQUESTS.inc(
+            outcome="session_handoff_ok" if ok
+            else "session_handoff_rejected"
+        )
+        return ok
+
     async def get_digest(
         self, member: str, limit: int
     ) -> Optional[bytes]:

@@ -100,6 +100,14 @@ class ViewportPrefetcher:
         )
         self._streams: "OrderedDict[tuple, _Stream]" = OrderedDict()
         self._max_streams = max_streams
+        # viewport-true speculation (r22): the session plane reports
+        # the REAL viewport rectangle for (session, image) over the
+        # live channel; when present it supersedes the fixed-width
+        # span band (the rect says exactly which tiles the pan is
+        # about to expose — no diagonal-pan/zoom-out misprediction).
+        # Written on the serving loop, dropped from the resolver's
+        # refresh thread on invalidation -> shares _extents_lock.
+        self._viewports: "OrderedDict[tuple, dict]" = OrderedDict()
         self._worker: Optional[asyncio.Task] = None
         # close-in-progress latch, checked by _run between items: the
         # fetch path bounds its wait with wait_for(shield(...)), and a
@@ -127,6 +135,7 @@ class ViewportPrefetcher:
             "observed": 0, "enqueued": 0, "warmed": 0, "shed": 0,
             "already_cached": 0, "dropped_queue_full": 0, "failed": 0,
             "pruned_off_image": 0, "suppressed_sweep": 0,
+            "viewport_true": 0,
         }
 
     # -- lifecycle -----------------------------------------------------
@@ -184,6 +193,55 @@ class ViewportPrefetcher:
         for region, resolution in self._predict(ctx, dx, dy):
             self._enqueue(ctx, region, resolution)
 
+    # -- viewport-true geometry (r22) ----------------------------------
+
+    def note_viewport(
+        self, session_key: str, image_id: int, rect: dict
+    ) -> bool:
+        """Record a session's reported viewport rectangle
+        (``{"x","y","w","h"}`` in level pixels, optional ``"zoom"`` =
+        resolution level). Subsequent predictions for that (session,
+        image) cover the rect's trajectory instead of the fixed span
+        band. Bounded like the stream table; False on a nonsense
+        rect (the session plane turns that into a client error)."""
+        try:
+            x = int(rect["x"])
+            y = int(rect["y"])
+            w = int(rect["w"])
+            h = int(rect["h"])
+        except (KeyError, TypeError, ValueError):
+            return False
+        if w <= 0 or h <= 0 or x < 0 or y < 0:
+            return False
+        zoom = rect.get("zoom")
+        if zoom is not None:
+            try:
+                zoom = int(zoom)
+            except (TypeError, ValueError):
+                return False
+        entry = {"x": x, "y": y, "w": w, "h": h, "zoom": zoom}
+        key = (session_key, image_id)
+        with self._extents_lock:
+            self._viewports[key] = entry
+            self._viewports.move_to_end(key)
+            while len(self._viewports) > self._max_streams:
+                self._viewports.popitem(last=False)
+        return True
+
+    def _viewport_for(self, ctx: TileCtx) -> Optional[dict]:
+        key = (ctx.omero_session_key, ctx.image_id)
+        with self._extents_lock:
+            rect = self._viewports.get(key)
+        if rect is None:
+            return None
+        # a rect reported at another zoom level describes a different
+        # pixel space — only supersede the band when levels agree (or
+        # the client didn't say)
+        if rect["zoom"] is not None and ctx.resolution is not None \
+                and rect["zoom"] != ctx.resolution:
+            return None
+        return rect
+
     def _extent(self, image_id: int, resolution) -> Optional[tuple]:
         """Memoized plane extent per (image, level); None = unknown
         (no pruning — the pipeline stays the backstop)."""
@@ -231,27 +289,57 @@ class ViewportPrefetcher:
             out.append((RegionDef(x, y, w, h), res))
 
         if dx or dy:
-            span = self.viewport_span
-            for i in range(1, self.lookahead + 1):
-                nx, ny = r.x + dx * i, r.y + dy * i
-                add(nx, ny, r.width, r.height, ctx.resolution)
-                # the perpendicular band at this step: the viewport
-                # is taller/wider than one tile, so the pan exposes a
-                # whole row/column, not a line of single tiles
-                offs = (
-                    range(1, span + 1) if span else ((1,) if i == 1 else ())
-                )
-                for k in offs:
-                    if dx == 0:
-                        add(nx - k * r.width, ny, r.width, r.height,
-                            ctx.resolution)
-                        add(nx + k * r.width, ny, r.width, r.height,
-                            ctx.resolution)
-                    else:
-                        add(nx, ny - k * r.height, r.width, r.height,
-                            ctx.resolution)
-                        add(nx, ny + k * r.height, r.width, r.height,
-                            ctx.resolution)
+            rect = self._viewport_for(ctx)
+            if rect is not None:
+                # viewport-true speculation (r22): the session plane
+                # told us the REAL rectangle this viewer shows, so
+                # predict the tiles the rect exposes as it slides
+                # along the motion vector — grid-aligned to the tile
+                # pitch, every step of the lookahead. Diagonal pans
+                # and wide/zoomed-out viewports are covered exactly,
+                # where the span band could only guess a fixed width.
+                self._stats["viewport_true"] += 1
+                for i in range(1, self.lookahead + 1):
+                    vx, vy = rect["x"] + dx * i, rect["y"] + dy * i
+                    col0 = max(0, vx) // r.width
+                    col1 = max(0, vx + rect["w"] - 1) // r.width
+                    row0 = max(0, vy) // r.height
+                    row1 = max(0, vy + rect["h"] - 1) // r.height
+                    budget = 64  # cap: a pathological rect can't
+                    # flood the queue with a whole-plane sweep
+                    for row in range(row0, row1 + 1):
+                        for col in range(col0, col1 + 1):
+                            if budget <= 0:
+                                break
+                            budget -= 1
+                            add(col * r.width, row * r.height,
+                                r.width, r.height, ctx.resolution)
+                        if budget <= 0:
+                            break
+            else:
+                span = self.viewport_span
+                for i in range(1, self.lookahead + 1):
+                    nx, ny = r.x + dx * i, r.y + dy * i
+                    add(nx, ny, r.width, r.height, ctx.resolution)
+                    # the perpendicular band at this step: the
+                    # viewport is taller/wider than one tile, so the
+                    # pan exposes a whole row/column, not a line of
+                    # single tiles
+                    offs = (
+                        range(1, span + 1) if span
+                        else ((1,) if i == 1 else ())
+                    )
+                    for k in offs:
+                        if dx == 0:
+                            add(nx - k * r.width, ny, r.width, r.height,
+                                ctx.resolution)
+                            add(nx + k * r.width, ny, r.width, r.height,
+                                ctx.resolution)
+                        else:
+                            add(nx, ny - k * r.height, r.width, r.height,
+                                ctx.resolution)
+                            add(nx, ny + k * r.height, r.width, r.height,
+                                ctx.resolution)
         if ctx.resolution is not None and ctx.resolution > 0:
             # zoom-in prediction: the finer level's tile under this
             # tile's center (OMERO levels halve per step), aligned to
@@ -311,6 +399,8 @@ class ViewportPrefetcher:
         with self._extents_lock:
             for key in [k for k in self._extents if k[0] == image_id]:
                 del self._extents[key]
+            for key in [k for k in self._viewports if k[1] == image_id]:
+                del self._viewports[key]
 
     # -- the low-priority worker ---------------------------------------
 

@@ -42,9 +42,12 @@ from __future__ import annotations
 
 import json
 import logging
+import threading
 import time
+import weakref
 from typing import Dict, List, Optional, Sequence
 
+from ..obs.sli import active_burn_rates
 from ..resilience.breaker import BOARD
 from ..utils.metrics import REGISTRY
 from .integrity import UNSIGNED_PAYLOADS
@@ -66,6 +69,39 @@ BRAIN_ROUNDS = REGISTRY.counter(
 
 def brain_key(member: str) -> bytes:
     return (BRAIN_PREFIX + member).encode()
+
+
+# latest-instance registry for the fleet burn-rate gauge — the same
+# weak-ref idiom the per-process slo_burn_rate gauge uses (tests boot
+# several apps per process; the gauge follows the live instance)
+_ACTIVE_BRAINS: Optional["weakref.ref"] = None
+_fleet_gauge_registered = False
+_fleet_gauge_lock = threading.Lock()
+
+
+def _fleet_burn_gauge_values():
+    ref = _ACTIVE_BRAINS
+    brains = ref() if ref is not None else None
+    if brains is None:
+        return {}
+    values = {}
+    for window, rates in brains.fleet_sli.items():
+        for cls, rate in rates.items():
+            values[(("priority", cls), ("window", window))] = rate
+    return values
+
+
+def _register_fleet_gauge() -> None:
+    global _fleet_gauge_registered
+    with _fleet_gauge_lock:
+        if not _fleet_gauge_registered:
+            REGISTRY.gauge_fn(
+                "slo_burn_rate_fleet",
+                "Fleet-wide worst-replica error-budget burn rate by "
+                "class and window (brain exchange)",
+                _fleet_burn_gauge_values,
+            )
+            _fleet_gauge_registered = True
 
 
 class FleetBrains:
@@ -110,6 +146,15 @@ class FleetBrains:
         self.publish_errors = 0
         self.collect_errors = 0
         self._last_shed_total = 0
+        # fleet-wide SLI burn rates (PR-16 residual, closed r22):
+        # {window: {class: burn}} — the WORST reporting replica per
+        # (window, class), self included. Max, not mean: a burn rate
+        # is a page signal, and averaging a 14x burn against nine
+        # idle replicas is how a page gets lost.
+        self.fleet_sli: Dict[str, Dict[str, float]] = {}
+        global _ACTIVE_BRAINS
+        _ACTIVE_BRAINS = weakref.ref(self)
+        _register_fleet_gauge()
 
     # -- local view ----------------------------------------------------
 
@@ -153,6 +198,11 @@ class FleetBrains:
             # precedes collect in the heartbeat — one round of lag,
             # which the quorum absorbs)
             payload["bad"] = list(self.my_verdicts)
+        burn = active_burn_rates()
+        if burn is not None:
+            # per-class burn rates by window — the fleet aggregation
+            # (apply_fleet) takes the max across reporting replicas
+            payload["sli"] = burn
         return payload
 
     # -- the exchange ---------------------------------------------------
@@ -247,6 +297,29 @@ class FleetBrains:
         suspects = sorted(
             dep for dep, n in counts.items() if n >= need
         ) if fleet else []
+        # fleet SLI aggregation: worst burn per (window, class)
+        # across every reporting replica, self included — bounded by
+        # the fixed window/class vocabulary so a malformed brain
+        # cannot grow the map
+        fleet_sli: Dict[str, Dict[str, float]] = {}
+        sources = [b.get("sli") for b in fleet.values()]
+        sources.append(active_burn_rates())
+        for sli in sources:
+            if not isinstance(sli, dict):
+                continue
+            for window in ("5m", "30m", "1h"):
+                rates = sli.get(window)
+                if not isinstance(rates, dict):
+                    continue
+                slot = fleet_sli.setdefault(window, {})
+                for cls in ("interactive", "prefetch", "bulk"):
+                    try:
+                        rate = float(rates.get(cls, 0.0))
+                    except (TypeError, ValueError):
+                        continue
+                    if rate > slot.get(cls, -1.0):
+                        slot[cls] = rate
+        self.fleet_sli = fleet_sli
         verdicts: List[str] = []
         demoted: List[str] = []
         if self.suspicion is not None and self.suspicion.enabled:
@@ -322,6 +395,9 @@ class FleetBrains:
     def snapshot(self) -> dict:
         return {
             "fleet_pressure": round(self.fleet_pressure, 4),
+            "fleet_sli": {
+                w: dict(r) for w, r in self.fleet_sli.items()
+            },
             "suspected_deps": list(self.suspected),
             "my_verdicts": list(self.my_verdicts),
             "demoted": list(self.demoted),

@@ -145,6 +145,7 @@ class GossipManager:
         self.receives = 0
         self.hint_rounds = 0
         self.hint_failures = 0
+        self.contacts_adopted = 0
 
     # -- digest build / merge -------------------------------------------
 
@@ -276,6 +277,26 @@ class GossipManager:
             }
         e["left"] = False
         self._heard[url] = self._clock()
+
+    def note_contact(self, url: str) -> None:
+        """Gossip-native join hint (r22): adopt a member address
+        learned from a verified internal contact's ``X-OMPB-Peer``
+        header. One authenticated request in EITHER direction between
+        a joiner and any live member now bootstraps membership — the
+        joiner's first digest push teaches the receiver, and the
+        receiver's reply digest teaches the joiner the rest of the
+        fleet — so Redis is no longer on the join path at all. Same
+        bounds as every other rumor source: capped table, capped URL
+        length, self ignored."""
+        if not isinstance(url, str) or not url or \
+                url == self.self_url or len(url) > _MAX_URL_LEN:
+            return
+        known = url in self._entries
+        self._alive(url)
+        if not known and url in self._entries:
+            self.contacts_adopted += 1
+            GOSSIP_ROUNDS.inc(kind="contact_adopted")
+        self._apply_view()
 
     # -- the inbound half (the /internal/gossip handler) ----------------
 
@@ -567,6 +588,7 @@ class GossipManager:
             "receives": self.receives,
             "hint_rounds": self.hint_rounds,
             "hint_failures": self.hint_failures,
+            "contacts_adopted": self.contacts_adopted,
             "last_refresh_age_s": age,
             "events": list(self.events),
         }

@@ -67,12 +67,17 @@ class DrainCoordinator:
         deadline_s: float = 10.0,
         admission=None,
         scheduler=None,
+        session_registry=None,
         clock=time.monotonic,
     ):
         self.plane = plane
         self.deadline_s = float(deadline_s)
         self.admission = admission
         self.scheduler = scheduler
+        # the session plane's ChannelRegistry (r22): live channels get
+        # a reconnect frame and their subscription summary rides to a
+        # successor before the lease drops
+        self.session_registry = session_registry
         self._clock = clock
         self.state = "serving"
         self.stats: dict = {}
@@ -119,6 +124,23 @@ class DrainCoordinator:
         handoff = await self.plane.handoff_hot_set(
             deadline, clock=self._clock
         )
+        # session-plane handoff (r22) rides the same deadline: every
+        # live channel gets a {"reconnect": url} frame pointing at the
+        # chosen successor (or the balancer when we're the last
+        # replica), and the subscription summary POSTs over the same
+        # authenticated /internal/handoff surface the cache uses.
+        # Zero dropped sessions means zero frames lost BEFORE the
+        # reconnect frame — the channel closes only after it lands.
+        sessions = {"channels": 0, "successor": "", "pushed": False}
+        if self.session_registry is not None:
+            try:
+                sessions = await self.plane.handoff_sessions(
+                    self.session_registry, deadline, clock=self._clock
+                )
+                DRAIN_EVENTS.inc(event="sessions_handed_off")
+            except Exception:
+                log.warning("drain: session handoff failed",
+                            exc_info=True)
         quiesced = await self._await_quiescence(deadline)
         released = await self.plane.release_lease()
         self.state = "drained"
@@ -126,6 +148,7 @@ class DrainCoordinator:
         self.stats = {
             "announced": announced,
             "handoff": handoff,
+            "sessions": sessions,
             "quiesced": quiesced,
             "lease_released": released,
             "took_s": round(self._clock() - t0, 3),

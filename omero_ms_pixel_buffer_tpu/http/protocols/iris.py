@@ -47,7 +47,8 @@ def layer_grid(
 
 
 def metadata_document(
-    level_sizes: List[Tuple[int, int]], tile_size: int
+    level_sizes: List[Tuple[int, int]], tile_size: int,
+    image_id: int = 0, session_plane: bool = False,
 ) -> dict:
     w0, h0 = level_sizes[0]
     layers = []
@@ -60,7 +61,7 @@ def metadata_document(
             "y_tiles": y_tiles,
             "scale": max(1, round(w0 / lw)),
         })
-    return {
+    doc = {
         "type": "iris_slide_metadata",
         "format": "png",
         "encoding": "image",
@@ -71,6 +72,16 @@ def metadata_document(
             "layers": layers,
         },
     }
+    if session_plane:
+        # the Iris paper's server-push + annotation surfaces (the two
+        # gaps KNOWN_GAPS listed against this adapter): advertise the
+        # session plane's endpoints so an Iris-speaking viewer can
+        # subscribe to invalidation deltas and read/write overlays
+        doc["capabilities"] = {
+            "push": f"/session/{image_id}/live",
+            "annotations": f"/annotations/{image_id}",
+        }
+    return doc
 
 
 def register_iris(router, app_obj, cfg) -> None:
@@ -86,7 +97,13 @@ def register_iris(router, app_obj, cfg) -> None:
             return err
         return web.Response(
             body=json.dumps(
-                metadata_document(sizes, tile_size),
+                metadata_document(
+                    sizes, tile_size, image_id=image_id,
+                    session_plane=(
+                        getattr(app_obj, "session_channels", None)
+                        is not None
+                    ),
+                ),
                 separators=(",", ":"),
             ).encode(),
             content_type="application/json",

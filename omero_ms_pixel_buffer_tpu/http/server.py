@@ -481,6 +481,17 @@ def cluster_guard_middleware(app_obj: "PixelBufferApp"):
                 return web.Response(
                     status=403, text="invalid cluster signature"
                 )
+            if claims_peer and app_obj.cache_plane is not None:
+                # gossip-native join hint (r22): the peer marker
+                # carries the sender's serving URL INSIDE the HMAC,
+                # so a verified contact in either direction teaches
+                # this replica a member address — an out-of-seed
+                # joiner bootstraps from its first signed exchange,
+                # no Redis required. Unverified requests never reach
+                # here; non-URL markers are ignored downstream.
+                app_obj.cache_plane.note_peer_contact(
+                    request.headers.get(PEER_HEADER, "")
+                )
         elif is_internal and not claims_peer:
             return web.Response(status=403, text="peer requests only")
         return await handler(request)
@@ -758,6 +769,27 @@ class PixelBufferApp:
         # replay guard for the HMAC peer surface (cluster/security):
         # nonces accepted inside the skew window, bounded per peer
         self.cluster_nonces = NonceCache()
+        # interactive session plane (session/, r22): the live-channel
+        # registry and the annotation store. Built BEFORE the cluster
+        # plane so the drain coordinator can hand channels off, and
+        # independent of it — single-node deployments get local delta
+        # push and annotations too.
+        self.session_channels = None
+        self.annotations = None
+        sp = config.session
+        if sp.enabled:
+            from ..session import AnnotationStore, ChannelRegistry
+
+            self.session_channels = ChannelRegistry(
+                max_channels=sp.max_channels,
+                max_per_image=sp.max_per_image,
+                queue_size=sp.queue_size,
+                recorder=self.recorder,
+            )
+            self.annotations = AnnotationStore(
+                max_images=sp.max_annotation_images,
+                max_per_image=sp.max_annotations_per_image,
+            )
         if cc.enabled:
             admission = None
             if cc.tinylfu.enabled:
@@ -848,6 +880,9 @@ class PixelBufferApp:
                     deadline_s=cl.drain.deadline_s,
                     admission=self.admission,
                     scheduler=self.scheduler,
+                    # live channels ride the drain: reconnect frames
+                    # out, subscription summary to the successor
+                    session_registry=self.session_channels,
                 )
             if cc.prefetch.enabled:
                 self.prefetcher = ViewportPrefetcher(
@@ -1008,6 +1043,37 @@ class PixelBufferApp:
             app.router.add_get(
                 "/render/{imageId}/{z}/{c}/{t}", self.handle_get_render
             )
+        if self.session_channels is not None:
+            # the interactive session plane (session/, r22): the live
+            # channel, its SSE-side viewport report, and annotation
+            # CRUD. All behind the session middleware (cookie auth) —
+            # none are SERVING_PREFIXES lanes (a held-open channel
+            # must not occupy an admission slot or door budget)
+            app.router.add_get(
+                "/session/{imageId}/live", self.handle_session_live
+            )
+            app.router.add_post(
+                "/session/{imageId}/viewport",
+                self.handle_session_viewport,
+            )
+            app.router.add_post(
+                "/annotations/{imageId}", self.handle_annotations_create
+            )
+            app.router.add_get(
+                "/annotations/{imageId}", self.handle_annotations_list
+            )
+            app.router.add_get(
+                "/annotations/{imageId}/{annId}",
+                self.handle_annotation_get,
+            )
+            app.router.add_put(
+                "/annotations/{imageId}/{annId}",
+                self.handle_annotation_update,
+            )
+            app.router.add_delete(
+                "/annotations/{imageId}/{annId}",
+                self.handle_annotation_delete,
+            )
         self._protocols_enabled: dict = {}
         if self.config.analysis.enabled:
             app.router.add_get(
@@ -1128,6 +1194,10 @@ class PixelBufferApp:
             self.prefetcher.start()
         if self.mesh_prober is not None:
             self.mesh_prober.start()
+        if self.session_channels is not None:
+            # like the cache plane: delta pushes originate on resolver
+            # threads and must marshal onto the serving loop
+            self.session_channels.start(asyncio.get_running_loop())
         if self.cache_plane is not None:
             # the plane needs the serving loop: invalidation listeners
             # fire from resolver threads and schedule their fan-out here
@@ -1204,6 +1274,10 @@ class PixelBufferApp:
             self.mesh_prober.stop()
         if self.prefetcher is not None:
             await self.prefetcher.close()
+        if self.session_channels is not None:
+            # close every live channel (sentinel frames) so their
+            # writer tasks unwind before the loop does
+            await self.session_channels.close()
         if self.cache_plane is not None:
             await self.cache_plane.close()
         if self.result_cache is not None:
@@ -1297,6 +1371,7 @@ class PixelBufferApp:
             "render": render_health,
             "analysis": analysis_health,
             "protocols": getattr(self, "_protocols_enabled", {}),
+            "session": self._session_snapshot(),
             "device_queue": device_queue,
             "io": io_snapshot(),
             "request_budget_ms": self.request_budget_s * 1000.0,
@@ -1689,6 +1764,18 @@ class PixelBufferApp:
             self.prefetcher.invalidate_image(image_id)
         self._authz_purge(image_id)
         self.pipeline.invalidate_image(image_id)
+        if self.session_channels is not None:
+            # session plane (r22): every local purge — originated here
+            # OR inbound from a peer's fan-out — becomes a delta frame
+            # to the image's subscribed channels. That inbound leg is
+            # what makes a purge on replica A reach a viewer whose
+            # channel lives on replica B without any new fan-out
+            # machinery. Thread-safe (resolver refresh thread included).
+            epoch = None
+            plane = self.cache_plane
+            if plane is not None and plane.epochs is not None:
+                epoch = plane.epochs.known(image_id)
+            self.session_channels.push_delta(image_id, epoch=epoch)
 
     def _invalidate_image(self, image_id: int) -> None:
         """Metadata-change listener (the resolver's refresh thread):
@@ -1787,6 +1874,321 @@ class PixelBufferApp:
             return web.Response(status=503, text="gossip disabled")
         return web.json_response(reply)
 
+    # -- interactive session plane (session/, r22) ---------------------
+
+    def _session_snapshot(self) -> dict:
+        if self.session_channels is None:
+            return {"enabled": False}
+        out = self.session_channels.snapshot()
+        if self.annotations is not None:
+            out["annotations"] = self.annotations.snapshot()
+        return out
+
+    def _session_epoch(self, image_id: int) -> Optional[int]:
+        plane = self.cache_plane
+        if plane is not None and plane.epochs is not None:
+            return plane.epochs.known(image_id)
+        return None
+
+    def _note_viewport(
+        self, session_key: str, image_id: int, rect
+    ) -> bool:
+        if self.prefetcher is None or not isinstance(rect, dict):
+            return False
+        return self.prefetcher.note_viewport(
+            session_key, image_id, rect
+        )
+
+    async def _session_still_valid(self, session_id: str) -> bool:
+        """Ping-interval revalidation: a browser session revoked in
+        the session store loses its live channel within one interval.
+        Store UNAVAILABLE reads as still-valid — the same 'auth
+        unavailable must never read as auth denied' posture the
+        session middleware takes."""
+        try:
+            key = await self.session_store.get_omero_session_key(
+                session_id
+            )
+        except Exception:
+            return True
+        return bool(key)
+
+    def _session_hello(self, channel) -> dict:
+        return {
+            "type": "hello",
+            "image": channel.image_id,
+            "transport": channel.transport,
+            "epoch": self._session_epoch(channel.image_id),
+            "annotations": (
+                self.annotations.sub_epoch(channel.image_id)
+                if self.annotations is not None else 0
+            ),
+        }
+
+    def _session_inbound(self, channel, frame) -> None:
+        """One client->server frame off the live channel. Only the
+        viewport report is meaningful today; unknown types are
+        ignored (forward compatibility, never an error loop)."""
+        if not isinstance(frame, dict):
+            return
+        if frame.get("type") == "viewport":
+            self._note_viewport(
+                channel.omero_session_key, channel.image_id, frame
+            )
+
+    async def _session_pump(self, channel, send) -> None:
+        """Drain the channel's frame queue into one transport until
+        the close sentinel. Quiet intervals ping (liveness for
+        proxies) and REVALIDATE the session — revocation closes the
+        channel from inside the pump via the registry's revoke
+        frames."""
+        interval = self.config.session.ping_interval_s
+        while True:
+            try:
+                frame = await asyncio.wait_for(
+                    channel.queue.get(), interval
+                )
+            except asyncio.TimeoutError:
+                if not await self._session_still_valid(
+                    channel.session_id
+                ):
+                    self.session_channels.revoke(channel)
+                    continue  # the revoke frames drain next loop
+                await send({
+                    "type": "ping",
+                    "epoch": self._session_epoch(channel.image_id),
+                })
+                continue
+            if frame is None:
+                return
+            await send(frame)
+
+    async def handle_session_live(self, request: web.Request) -> web.StreamResponse:
+        """The live channel: WebSocket when the client asks to
+        upgrade, SSE (text/event-stream) otherwise. Authenticated by
+        the session middleware like every serving route; registration
+        beyond the channel bounds answers 503 + Retry-After (explicit
+        backpressure, never an eviction of someone else's channel).
+        Deliberately NOT a SERVING_PREFIXES lane: a held-open channel
+        must not occupy an admission slot or door budget for hours."""
+        try:
+            image_id = int(request.match_info["imageId"])
+        except (TypeError, ValueError):
+            return web.Response(status=400, text="bad image id")
+        session_id = request.cookies.get("sessionid", "")
+        omero_key = request.get("omero.session_key", "")
+        want_ws = (
+            request.headers.get("Upgrade", "").strip().lower()
+            == "websocket"
+        )
+        channel = self.session_channels.register(
+            image_id, session_id, omero_key,
+            "ws" if want_ws else "sse",
+        )
+        if channel is None:
+            return web.Response(
+                status=503, text="Session plane at capacity",
+                headers={"Retry-After": "1"},
+            )
+        try:
+            if want_ws:
+                return await self._session_ws(request, channel)
+            return await self._session_sse(request, channel)
+        finally:
+            self.session_channels.unregister(channel)
+
+    async def _session_ws(self, request: web.Request, channel) -> web.StreamResponse:
+        import json as _json
+
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        await ws.send_json(self._session_hello(channel))
+
+        async def _pump_then_close() -> None:
+            # when the pump sees the close sentinel (drain handoff,
+            # revocation, shutdown) it returns — closing the socket
+            # here unblocks the reader loop below, so the handler
+            # unwinds without waiting on a silent client
+            try:
+                await self._session_pump(channel, ws.send_json)
+            finally:
+                if not ws.closed:
+                    await ws.close()
+
+        # the pump is a TRACKED per-channel task: cancelled (and
+        # awaited) in the finally below, so a dropped socket can
+        # never leak a pump into the loop
+        pump = asyncio.get_running_loop().create_task(
+            _pump_then_close()
+        )
+        try:
+            async for msg in ws:
+                if msg.type == web.WSMsgType.TEXT:
+                    try:
+                        frame = _json.loads(msg.data)
+                    except ValueError:
+                        continue  # a garbled frame is a no-op
+                    self._session_inbound(channel, frame)
+                elif msg.type in (
+                    web.WSMsgType.ERROR, web.WSMsgType.CLOSE,
+                ):
+                    break
+        finally:
+            pump.cancel()
+            try:
+                await pump
+            except asyncio.CancelledError:
+                if not pump.cancelled():
+                    raise  # the HANDLER was cancelled: propagate
+            except (ConnectionResetError, ConnectionError, OSError):
+                pass  # a send racing a gone socket IS the close
+        return ws
+
+    async def _session_sse(self, request: web.Request, channel) -> web.StreamResponse:
+        """The SSE fallback: same frames, one per ``data:`` event.
+        Inbound geometry rides POST /session/{imageId}/viewport
+        instead (SSE is one-directional)."""
+        import json as _json
+
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "X-Accel-Buffering": "no",
+            }
+        )
+        await resp.prepare(request)
+
+        async def send(frame: dict) -> None:
+            data = _json.dumps(frame, separators=(",", ":"))
+            await resp.write(b"data: " + data.encode() + b"\n\n")
+
+        try:
+            await send(self._session_hello(channel))
+            await self._session_pump(channel, send)
+            await resp.write_eof()
+        except (ConnectionResetError, ConnectionError, OSError):
+            pass  # the viewer went away: close is the outcome
+        return resp
+
+    async def handle_session_viewport(self, request: web.Request) -> web.Response:
+        """Viewport-geometry report for SSE clients (WS clients send
+        the same frame inline). The rect supersedes the prefetcher's
+        fixed span band for this (session, image) stream."""
+        import json as _json
+
+        try:
+            image_id = int(request.match_info["imageId"])
+        except (TypeError, ValueError):
+            return web.Response(status=400, text="bad image id")
+        try:
+            body = _json.loads(await request.read())
+        except Exception:
+            return web.Response(status=400, text="bad viewport body")
+        if not isinstance(body, dict):
+            return web.Response(status=400, text="bad viewport body")
+        noted = self._note_viewport(
+            request.get("omero.session_key", ""), image_id, body
+        )
+        if not noted and self.prefetcher is not None:
+            return web.Response(status=400, text="bad viewport rect")
+        return web.json_response({"noted": noted})
+
+    def _annotation_changed(self, image_id: int, sub_epoch: int) -> None:
+        """Every annotation write: bump-and-tell. The image purge
+        fans out cluster-wide through the existing epoch machinery
+        (remote replicas' inbound purge becomes THEIR channels' delta
+        push), and local subscribers additionally get the annotation
+        sub-epoch frame."""
+        self._invalidate_image(image_id)
+        if self.session_channels is not None:
+            self.session_channels.push_delta(
+                image_id,
+                epoch=self._session_epoch(image_id),
+                kind="annotations",
+                annotation_epoch=sub_epoch,
+            )
+
+    async def _annotation_body(self, request: web.Request):
+        import json as _json
+
+        try:
+            body = _json.loads(await request.read())
+        except Exception:
+            return None
+        return body if isinstance(body, dict) else None
+
+    async def handle_annotations_create(self, request: web.Request) -> web.Response:
+        try:
+            image_id = int(request.match_info["imageId"])
+        except (TypeError, ValueError):
+            return web.Response(status=400, text="bad image id")
+        body = await self._annotation_body(request)
+        if body is None:
+            return web.Response(status=400, text="bad annotation body")
+        try:
+            record, sub_epoch = self.annotations.create(image_id, body)
+        except TileError as e:
+            return web.Response(status=e.code, text=e.message)
+        self._annotation_changed(image_id, sub_epoch)
+        return web.json_response(
+            {"annotation": record, "epoch": sub_epoch}, status=201
+        )
+
+    async def handle_annotations_list(self, request: web.Request) -> web.Response:
+        try:
+            image_id = int(request.match_info["imageId"])
+        except (TypeError, ValueError):
+            return web.Response(status=400, text="bad image id")
+        return web.json_response(self.annotations.list(image_id))
+
+    async def handle_annotation_get(self, request: web.Request) -> web.Response:
+        try:
+            image_id = int(request.match_info["imageId"])
+        except (TypeError, ValueError):
+            return web.Response(status=400, text="bad image id")
+        record = self.annotations.get(
+            image_id, request.match_info["annId"]
+        )
+        if record is None:
+            return web.Response(status=404, text="no such annotation")
+        return web.json_response({"annotation": record})
+
+    async def handle_annotation_update(self, request: web.Request) -> web.Response:
+        try:
+            image_id = int(request.match_info["imageId"])
+        except (TypeError, ValueError):
+            return web.Response(status=400, text="bad image id")
+        body = await self._annotation_body(request)
+        if body is None:
+            return web.Response(status=400, text="bad annotation body")
+        try:
+            result = self.annotations.update(
+                image_id, request.match_info["annId"], body
+            )
+        except TileError as e:
+            return web.Response(status=e.code, text=e.message)
+        if result is None:
+            return web.Response(status=404, text="no such annotation")
+        record, sub_epoch = result
+        self._annotation_changed(image_id, sub_epoch)
+        return web.json_response(
+            {"annotation": record, "epoch": sub_epoch}
+        )
+
+    async def handle_annotation_delete(self, request: web.Request) -> web.Response:
+        try:
+            image_id = int(request.match_info["imageId"])
+        except (TypeError, ValueError):
+            return web.Response(status=400, text="bad image id")
+        sub_epoch = self.annotations.delete(
+            image_id, request.match_info["annId"]
+        )
+        if sub_epoch is None:
+            return web.Response(status=404, text="no such annotation")
+        self._annotation_changed(image_id, sub_epoch)
+        return web.json_response({"deleted": True, "epoch": sub_epoch})
+
     async def handle_internal_purge(self, request: web.Request) -> web.Response:
         """Inbound half of the purge fan-out. Requires the peer
         header (the same loop guard as tile forwarding: a peer-
@@ -1876,9 +2278,31 @@ class PixelBufferApp:
         re-render per key."""
         if PEER_HEADER not in request.headers:
             return web.Response(status=403, text="peer requests only")
+        body = await request.read()
+        if request.content_type == "application/json":
+            # session-plane handoff (r22): the draining peer's live-
+            # channel subscription summary rides the same route as
+            # JSON; cache batches stay octet-stream. Routed on
+            # content type so the two handoffs share one signed
+            # surface without ambiguity.
+            import json as _json
+
+            if self.session_channels is None:
+                return web.Response(
+                    status=503, text="session plane disabled"
+                )
+            try:
+                payload = _json.loads(body)
+            except Exception:
+                return web.Response(status=400, text="bad handoff body")
+            if not isinstance(payload, dict) or (
+                payload.get("kind") != "session_handoff"
+            ):
+                return web.Response(status=400, text="bad handoff kind")
+            absorbed = self.session_channels.absorb_handoff(payload)
+            return web.json_response({"absorbed": absorbed})
         if self.cache_plane is None or self.result_cache is None:
             return web.Response(status=503, text="cache disabled")
-        body = await request.read()
         stored = await self.cache_plane.absorb_handoff(
             body, member=request.headers.get(PEER_HEADER)
         )
@@ -2032,6 +2456,27 @@ class PixelBufferApp:
         spec, err = self.build_render_spec(request.query, ctx.c)
         if err is not None:
             return err
+        if self.annotations is not None and request.query.get(
+            "annotations", ""
+        ).strip().lower() in ("1", "true", "yes"):
+            # annotation overlays (session/, r22): stored shapes ARE
+            # ShapeSpecs from the roi= grammar, so compositing is just
+            # appending them to the mask tuple — the joined spec's
+            # signature (hence cache key and ETag) is identical to an
+            # explicit roi= request carrying the same shapes, and the
+            # raster path is the engine-independent masks.py math, so
+            # overlays are byte-identical host vs device by the same
+            # argument roi= already is
+            stored = self.annotations.shapes(ctx.image_id)
+            if stored:
+                import dataclasses as _dc
+
+                from ..render.masks import MAX_SHAPES
+
+                # same bound the roi= grammar enforces, applied to
+                # the JOINED set — explicit roi shapes win the budget
+                merged = (spec.masks + stored)[:MAX_SHAPES]
+                spec = _dc.replace(spec, masks=merged)
         ctx.render = spec
         ctx.format = spec.format  # drives Content-Type + filename
         # query x/y/w/h/resolution ride along exactly like /tile's

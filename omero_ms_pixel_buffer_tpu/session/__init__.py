@@ -1,0 +1,35 @@
+"""Interactive session plane (r22) — the live edge the pull surfaces
+terminate on.
+
+Every adapter this service grew (native, DZI, IIIF, Iris) is
+pull-only: a viewer watching a mutating image rides TTLs, and the
+prefetcher guesses the viewport from a fixed-width band. This package
+gives the machinery that already exists a push-capable endpoint:
+
+- ``channels`` — the bounded registry of live viewer channels
+  (WebSocket with SSE fallback, ``GET /session/{imageId}/live``).
+  Per-image epoch bumps the cluster already fans out become
+  ``{"tiles": [...], "epoch": N}`` delta frames to every subscribed
+  channel, so open viewports re-fetch only changed tiles instead of
+  waiting out TTLs. Channels report their REAL viewport geometry,
+  which supersedes the prefetcher's fixed ``viewport-span`` band.
+- ``annotations`` — the bounded per-image annotation store whose
+  shapes ARE the render plane's ROI grammar (render/masks.ShapeSpec):
+  overlays composite through the existing mask raster path, byte-
+  identical across host/device engines, sharing cache entries and
+  ETags with explicit ``roi=`` requests. Writes bump a sub-epoch and
+  push deltas to subscribers.
+
+Fleet citizenship is the design constraint, not an afterthought: a
+draining replica hands its subscription state to a successor over the
+authenticated ``/internal/handoff`` surface and tells every client
+where to reconnect; registries are bounded and their background tasks
+tracked (ompb-lint's bounded-growth and task-hygiene rules cover this
+package); pushes stamp the obs flight recorder so a slow channel is a
+kept trace.
+"""
+
+from .annotations import AnnotationStore
+from .channels import ChannelRegistry, SessionChannel
+
+__all__ = ["AnnotationStore", "ChannelRegistry", "SessionChannel"]

@@ -223,6 +223,31 @@ class ObsConfig:
 
 
 @dataclasses.dataclass
+class SessionPlaneConfig:
+    """The session: block — the interactive session plane (session/
+    package, r22): live push channels (WebSocket + SSE fallback) at
+    ``GET /session/{imageId}/live`` and annotation CRUD at
+    ``/annotations/{imageId}``. ``max_channels``/``max_per_image``
+    bound the channel registry (registrations beyond them answer 503
+    — explicit backpressure, never eviction of someone else's live
+    channel); ``queue_size`` bounds each channel's outbound frame
+    queue (a slow viewer drops frames, counted, never blocks the
+    purge path); ``ping_interval_s`` is the idle keepalive cadence
+    AND the session re-validation period (a revoked browser session
+    is disconnected within one interval); the annotation bounds cap
+    the in-memory store (per-image never exceeds the render path's
+    MAX_SHAPES)."""
+
+    enabled: bool = True
+    max_channels: int = 256
+    max_per_image: int = 64
+    queue_size: int = 64
+    ping_interval_s: float = 15.0
+    max_annotations_per_image: int = 64
+    max_annotation_images: int = 1024
+
+
+@dataclasses.dataclass
 class PrefetchConfig:
     """Viewport prefetch (cache.prefetch): speculative warming of the
     result cache from per-session access streams, shed first under
@@ -607,6 +632,9 @@ class Config:
     )
     slo: SloConfig = dataclasses.field(default_factory=SloConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
+    session: SessionPlaneConfig = dataclasses.field(
+        default_factory=SessionPlaneConfig
+    )
     cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
     cluster: ClusterConfig = dataclasses.field(
         default_factory=ClusterConfig
@@ -868,6 +896,47 @@ class Config:
             slow_threshold_ms=_num("slow-threshold-ms", 300.0, 0.0),
             head_sample_rate=rate,
             ring_size=_num("ring-size", 512, 1, int),
+        )
+
+    @staticmethod
+    def _parse_session(raw: dict) -> SessionPlaneConfig:
+        """Validate the session: block (session/ package, r22) — the
+        same posture as every other block: unknown keys and nonsense
+        values fail at startup, never silently default."""
+        sp = raw.get("session") or {}
+        unknown = set(sp) - {
+            "enabled", "max-channels", "max-per-image", "queue-size",
+            "ping-interval-s", "max-annotations-per-image",
+            "max-annotation-images",
+        }
+        if unknown:
+            raise ConfigError(
+                f"Unknown keys in 'session' block: {sorted(unknown)}"
+            )
+
+        def _num(key: str, default, minimum, cast=float):
+            try:
+                value = cast(sp.get(key, default))
+            except (TypeError, ValueError):
+                raise ConfigError(
+                    f"Invalid value for 'session.{key}': {sp.get(key)!r}"
+                ) from None
+            if value < minimum:
+                raise ConfigError(f"'session.{key}' must be >= {minimum}")
+            return value
+
+        return SessionPlaneConfig(
+            enabled=bool(sp.get("enabled", True)),
+            max_channels=_num("max-channels", 256, 1, int),
+            max_per_image=_num("max-per-image", 64, 1, int),
+            queue_size=_num("queue-size", 64, 1, int),
+            ping_interval_s=_num("ping-interval-s", 15.0, 0.05),
+            max_annotations_per_image=_num(
+                "max-annotations-per-image", 64, 1, int
+            ),
+            max_annotation_images=_num(
+                "max-annotation-images", 1024, 1, int
+            ),
         )
 
     @staticmethod
@@ -1507,6 +1576,7 @@ class Config:
             resilience=cls._parse_resilience(raw),
             slo=cls._parse_slo(raw),
             obs=cls._parse_obs(raw),
+            session=cls._parse_session(raw),
             cache=cls._parse_cache(raw),
             cluster=cls._parse_cluster(raw),
             io=cls._parse_io(raw),

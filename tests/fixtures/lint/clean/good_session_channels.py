@@ -1,0 +1,42 @@
+# ompb-lint: scope=task-hygiene,bounded-growth
+"""Clean corpus: the session-channel shapes done RIGHT — capped
+registry with eviction, tracked-and-drained fan-out tasks, a pump
+cancelled on close — ompb-lint must report nothing here."""
+
+import asyncio
+from collections import deque
+
+
+class BoundedChannelRegistry:
+    def __init__(self):
+        self.channels = {}
+        self.pushes = deque(maxlen=256)
+        self._jobs = set()
+        self._pump = None
+
+    def register(self, channel_id, channel):
+        while len(self.channels) >= 256:
+            self.channels.pop(next(iter(self.channels)))
+        self.channels[channel_id] = channel
+
+    def push_delta(self, image_id, epoch):
+        self.pushes.append((image_id, epoch))
+        t = asyncio.create_task(self._fan_out(image_id, epoch))
+        self._jobs.add(t)
+        t.add_done_callback(self._jobs.discard)
+
+    async def start(self):
+        self._pump = asyncio.create_task(self._run())
+
+    async def close(self):
+        if self._pump is not None:
+            self._pump.cancel()
+        for t in list(self._jobs):
+            t.cancel()
+        self._jobs.clear()
+
+    async def _fan_out(self, image_id, epoch):
+        await asyncio.sleep(0)
+
+    async def _run(self):
+        await asyncio.sleep(0.1)

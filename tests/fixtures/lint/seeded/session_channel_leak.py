@@ -1,0 +1,38 @@
+# ompb-lint: scope=task-hygiene,bounded-growth
+"""Seeded fleet-invariant violations in SESSION-CHANNEL shapes: the
+exact leaks the interactive session plane (session/channels.py) must
+never grow — a channel registry without caps, a push fan-out task
+dropped on the floor, and a per-channel pump stored but never
+cancelled. r22's "every channel bounded, every pump drained" contract,
+inverted."""
+
+import asyncio
+
+
+class LeakyChannelRegistry:
+    def __init__(self):
+        self.channels = {}
+        self.pushes = []
+        self._pump = None
+
+    def register(self, channel_id, channel):
+        # SEEDED: dynamic-key channel store, no cap, no eviction — a
+        # reconnect storm grows this forever
+        self.channels[channel_id] = channel
+
+    def push_delta(self, image_id, epoch):
+        self.pushes.append((image_id, epoch))  # SEEDED: append, no bound
+        # SEEDED: fan-out task dropped on the floor — a failed push
+        # dies silently and the delta never reaches the viewer
+        asyncio.create_task(self._fan_out(image_id, epoch))
+
+    async def start(self):
+        # SEEDED: pump stored on self but nothing awaits or cancels
+        # it — drain leaves it running against a dead loop
+        self._pump = asyncio.ensure_future(self._run())
+
+    async def _fan_out(self, image_id, epoch):
+        await asyncio.sleep(0)
+
+    async def _run(self):
+        await asyncio.sleep(0.1)

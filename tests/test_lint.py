@@ -173,6 +173,21 @@ class TestSeededViolations:
         assert "decode_transfer(...) in 'ingest'" in messages
         assert "body_matches / verify_entry_bytes" in messages
 
+    def test_session_channel_fleet_invariants(self, seeded):
+        """r22: the fleet-invariant rules fire on SESSION-CHANNEL
+        shapes — an uncapped channel registry, a fan-out task dropped
+        on the floor, a pump stored but never drained. The leaks the
+        interactive session plane must never grow, seeded."""
+        found = seeded["session_channel_leak.py"]
+        assert {f.rule for f in found} == {
+            "task-hygiene", "bounded-growth"
+        }
+        messages = " | ".join(f.message for f in found)
+        assert "'LeakyChannelRegistry.channels' grows" in messages
+        assert "'LeakyChannelRegistry.pushes' grows" in messages
+        assert "bare fire-and-forget statement" in messages
+        assert "stored on 'self._pump' but nothing" in messages
+
     def test_config_drift(self, seeded):
         found = seeded["drift_config.py"]
         assert all(f.rule == "config-drift" for f in found)

@@ -1,0 +1,1025 @@
+"""Interactive session plane (session/, r22).
+
+The contracts the new subsystem must hold:
+
+- **Auth matrix**: every session/annotation route sits behind the
+  session middleware (unauthenticated -> 403), and a browser session
+  revoked mid-channel loses its live channel within one ping
+  interval — with an explicit close frame, never a silent stall.
+- **Delta beats TTL**: an invalidation reaches a subscribed channel
+  as a push within seconds (the ping interval and cache TTL are both
+  far longer — the frame can only have been pushed).
+- **Cross-replica**: an annotation write on replica A reaches a
+  channel held open on replica B, riding the existing purge fan-out
+  (the acceptance criterion of the r22 issue).
+- **Viewport-true speculation**: a reported viewport rect supersedes
+  the prefetcher's fixed span band; nonsense rects are client errors.
+- **Annotation overlays**: stored shapes composite through the roi=
+  mask path — same cache key, same ETag, byte-identical host vs
+  device engines.
+- **Fleet citizenship** (``-m resilience``): a rolling drain with 10
+  live channels drops zero sessions (every client gets a reconnect
+  frame) and serves zero 5xx; the successor absorbs the handoff.
+"""
+
+import asyncio
+import dataclasses
+import json
+import socket
+import time
+
+import numpy as np
+import pytest
+from aiohttp import ClientSession, WSMsgType, web
+from aiohttp.test_utils import TestClient, TestServer
+
+from omero_ms_pixel_buffer_tpu.auth.stores import MemorySessionStore
+from omero_ms_pixel_buffer_tpu.cache.prefetch import ViewportPrefetcher
+from omero_ms_pixel_buffer_tpu.cluster import FleetBrains
+from omero_ms_pixel_buffer_tpu.errors import BadRequestError
+from omero_ms_pixel_buffer_tpu.http.server import PixelBufferApp
+from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
+from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+    ImageRegistry,
+    PixelsService,
+)
+from omero_ms_pixel_buffer_tpu.models.tile_pipeline import TilePipeline
+from omero_ms_pixel_buffer_tpu.render.model import RenderSpec
+from omero_ms_pixel_buffer_tpu.session import (
+    AnnotationStore,
+    ChannelRegistry,
+)
+from omero_ms_pixel_buffer_tpu.tile_ctx import RegionDef, TileCtx
+from omero_ms_pixel_buffer_tpu.utils.config import Config, ConfigError
+
+rng = np.random.default_rng(17)
+IMG = rng.integers(0, 4096, (1, 2, 2, 96, 128), dtype=np.uint16)
+AUTH = {"Cookie": "sessionid=ck"}
+RECT = {"type": "rect", "x": 8, "y": 8, "w": 24, "h": 16}
+
+
+def _write_fixture(tmp_path):
+    path = str(tmp_path / "img.ome.tiff")
+    write_ome_tiff(path, IMG, tile_size=(64, 64))
+    registry = ImageRegistry()
+    registry.add(1, path)
+    return registry
+
+
+async def _make_app(tmp_path, config_extra=None, sessions=None):
+    registry = _write_fixture(tmp_path)
+    raw = {
+        "session-store": {"type": "memory"},
+        "backend": {"batching": {"coalesce-window-ms": 1.0}},
+    }
+    if config_extra:
+        raw.update(config_extra)
+    config = Config.from_dict(raw)
+    store = MemorySessionStore(
+        dict(sessions) if sessions else {"ck": "omero-key-1"}
+    )
+    app_obj = PixelBufferApp(
+        config,
+        pixels_service=PixelsService(registry),
+        session_store=store,
+    )
+    client = TestClient(
+        TestServer(app_obj.make_app()), loop=asyncio.get_running_loop()
+    )
+    await client.start_server()
+    return app_obj, client, store
+
+
+async def _recv_json(ws, timeout=10.0):
+    msg = await asyncio.wait_for(ws.receive(), timeout)
+    assert msg.type == WSMsgType.TEXT, msg
+    return json.loads(msg.data)
+
+
+# ---------------------------------------------------------------------------
+# config: the session: block
+# ---------------------------------------------------------------------------
+
+class TestSessionConfig:
+    BASE = {"session-store": {"type": "memory"}}
+
+    def test_defaults(self):
+        cfg = Config.from_dict(dict(self.BASE))
+        sp = cfg.session
+        assert sp.enabled is True
+        assert sp.max_channels == 256
+        assert sp.max_per_image == 64
+        assert sp.queue_size == 64
+        assert sp.ping_interval_s == 15.0
+        assert sp.max_annotations_per_image == 64
+        assert sp.max_annotation_images == 1024
+
+    def test_unknown_key_fails_startup(self):
+        with pytest.raises(ConfigError, match="session"):
+            Config.from_dict({
+                **self.BASE,
+                "session": {"enabled": True, "max-chanels": 9},
+            })
+
+    def test_bad_values_fail(self):
+        with pytest.raises(ConfigError):
+            Config.from_dict({
+                **self.BASE, "session": {"max-channels": "lots"},
+            })
+        with pytest.raises(ConfigError):
+            Config.from_dict({
+                **self.BASE, "session": {"ping-interval-s": 0},
+            })
+
+    def test_disabled_removes_routes(self):
+        cfg = Config.from_dict({
+            **self.BASE, "session": {"enabled": False},
+        })
+        assert cfg.session.enabled is False
+
+
+# ---------------------------------------------------------------------------
+# auth matrix
+# ---------------------------------------------------------------------------
+
+class TestSessionAuth:
+    async def test_unauthenticated_403(self, tmp_path):
+        app_obj, client, _store = await _make_app(tmp_path)
+        try:
+            for method, path in (
+                ("GET", "/session/1/live"),
+                ("POST", "/session/1/viewport"),
+                ("GET", "/annotations/1"),
+                ("POST", "/annotations/1"),
+                ("GET", "/annotations/1/a1"),
+                ("PUT", "/annotations/1/a1"),
+                ("DELETE", "/annotations/1/a1"),
+            ):
+                r = await client.request(method, path)
+                assert r.status == 403, (method, path, r.status)
+        finally:
+            await client.close()
+
+    async def test_unknown_cookie_403(self, tmp_path):
+        app_obj, client, _store = await _make_app(tmp_path)
+        try:
+            r = await client.get(
+                "/annotations/1",
+                headers={"Cookie": "sessionid=who-is-this"},
+            )
+            assert r.status == 403
+        finally:
+            await client.close()
+
+    async def test_revoked_session_disconnects_channel(self, tmp_path):
+        """A browser session revoked in the store loses its live
+        channel within ~one ping interval, with an explicit close
+        frame — the pump's revalidation lane."""
+        app_obj, client, store = await _make_app(
+            tmp_path,
+            config_extra={"session": {"ping-interval-s": 0.1}},
+        )
+        try:
+            ws = await client.ws_connect(
+                "/session/1/live", headers=AUTH
+            )
+            hello = await _recv_json(ws)
+            assert hello["type"] == "hello"
+            del store.sessions["ck"]  # revocation
+            closed = None
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                msg = await asyncio.wait_for(ws.receive(), 10.0)
+                if msg.type != WSMsgType.TEXT:
+                    break  # server closed us
+                frame = json.loads(msg.data)
+                if frame["type"] == "close":
+                    closed = frame
+            assert closed == {"type": "close", "reason": "revoked"}
+            assert app_obj.session_channels.snapshot()["revoked"] == 1
+            await ws.close()
+        finally:
+            await client.close()
+
+
+# ---------------------------------------------------------------------------
+# delta push (single replica)
+# ---------------------------------------------------------------------------
+
+class TestDeltaPush:
+    async def test_ws_hello_carries_epochs(self, tmp_path):
+        app_obj, client, _store = await _make_app(tmp_path)
+        try:
+            ws = await client.ws_connect(
+                "/session/1/live", headers=AUTH
+            )
+            hello = await _recv_json(ws)
+            assert hello["type"] == "hello"
+            assert hello["image"] == 1
+            assert hello["transport"] == "ws"
+            assert "epoch" in hello and "annotations" in hello
+            await ws.close()
+        finally:
+            await client.close()
+
+    async def test_invalidation_pushed_not_polled(self, tmp_path):
+        """The delta frame lands in seconds while the ping interval
+        (15s default) and cache TTL are far longer — only a push
+        explains the arrival time."""
+        app_obj, client, _store = await _make_app(tmp_path)
+        try:
+            ws = await client.ws_connect(
+                "/session/1/live", headers=AUTH
+            )
+            await _recv_json(ws)  # hello
+            t0 = time.monotonic()
+            r = await client.post(
+                "/annotations/1", headers=AUTH,
+                json={"shape": RECT, "label": "tumor"},
+            )
+            assert r.status == 201
+            kinds = set()
+            while len(kinds) < 2:
+                frame = await _recv_json(ws, timeout=5.0)
+                kinds.add(frame["type"])
+                assert frame["image"] == 1
+                assert "tiles" in frame and "epoch" in frame
+                if frame["type"] == "annotations":
+                    assert frame["annotations"] == 1
+            elapsed = time.monotonic() - t0
+            # both the purge delta and the annotation sub-epoch frame,
+            # well inside one ping interval
+            assert kinds == {"invalidate", "annotations"}
+            assert elapsed < 5.0
+            await ws.close()
+        finally:
+            await client.close()
+
+    async def test_sse_fallback_same_frames(self, tmp_path):
+        app_obj, client, _store = await _make_app(tmp_path)
+        try:
+            resp = await client.get(
+                "/session/1/live", headers=AUTH
+            )
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith(
+                "text/event-stream"
+            )
+
+            async def next_frame():
+                while True:
+                    line = await asyncio.wait_for(
+                        resp.content.readline(), 10.0
+                    )
+                    if line.startswith(b"data: "):
+                        return json.loads(line[6:])
+
+            hello = await next_frame()
+            assert hello["type"] == "hello"
+            assert hello["transport"] == "sse"
+            app_obj.session_channels.push_delta(1, epoch=7)
+            frame = await next_frame()
+            assert frame == {
+                "type": "invalidate", "image": 1,
+                "tiles": [], "epoch": 7,
+            }
+            resp.close()
+        finally:
+            await client.close()
+
+    async def test_capacity_503_with_retry_after(self, tmp_path):
+        app_obj, client, _store = await _make_app(
+            tmp_path,
+            config_extra={"session": {"max-channels": 1}},
+        )
+        try:
+            held = await client.get("/session/1/live", headers=AUTH)
+            assert held.status == 200
+            await asyncio.wait_for(held.content.readline(), 10.0)
+            second = await client.get("/session/1/live", headers=AUTH)
+            assert second.status == 503
+            assert second.headers["Retry-After"] == "1"
+            snap = app_obj.session_channels.snapshot()
+            assert snap["rejected_full"] == 1
+            held.close()
+        finally:
+            await client.close()
+
+    async def test_slow_consumer_drops_frames_never_blocks(self):
+        """A full channel queue drops the frame (counted) instead of
+        blocking the purge path — and the close sentinel still lands
+        by displacing a queued frame."""
+        reg = ChannelRegistry(
+            max_channels=4, max_per_image=4, queue_size=2,
+        )
+        loop = asyncio.get_running_loop()
+        reg.start(loop)
+        ch = reg.register(1, "sid", "key", "ws")
+        assert ch is not None
+        for epoch in range(5):
+            reg.push_delta(1, epoch=epoch)
+        await asyncio.sleep(0)  # let call_soon_threadsafe drain
+        assert ch.queue.qsize() == 2
+        assert ch.dropped == 3
+        await reg.close()
+        # the sentinel displaced a queued frame rather than vanishing
+        drained = []
+        while not ch.queue.empty():
+            drained.append(ch.queue.get_nowait())
+        assert drained[-1] is None
+
+
+# ---------------------------------------------------------------------------
+# viewport-true speculation
+# ---------------------------------------------------------------------------
+
+class _FakeAdmission:
+    def has_headroom(self, fraction=0.5):
+        return True
+
+
+def _ctx(x=0, y=0, w=64, h=64, resolution=None, session="sk"):
+    return TileCtx(
+        image_id=1, z=0, c=0, t=0,
+        region=RegionDef(x, y, w, h), resolution=resolution,
+        format="png", omero_session_key=session,
+    )
+
+
+class TestViewportTrue:
+    def test_note_viewport_validation(self):
+        pre = ViewportPrefetcher(None, None, _FakeAdmission())
+        assert pre.note_viewport(
+            "sk", 1, {"x": 0, "y": 0, "w": 256, "h": 128}
+        )
+        for bad in (
+            {}, {"x": 0, "y": 0, "w": 0, "h": 64},
+            {"x": -1, "y": 0, "w": 64, "h": 64},
+            {"x": 0, "y": 0, "w": 64, "h": "tall"},
+            {"x": 0, "y": 0, "w": 64, "h": 64, "zoom": "in"},
+        ):
+            assert not pre.note_viewport("sk", 1, bad), bad
+
+    async def test_rect_supersedes_span_band(self):
+        """With a reported rect, predictions cover the rect's tile
+        footprint along the motion vector — including rows the fixed
+        span band (span=0 continuation) would never reach."""
+        fetched = []
+
+        async def fetch(ctx, key):
+            fetched.append((ctx.region.x, ctx.region.y))
+
+        pre = ViewportPrefetcher(
+            fetch, None, _FakeAdmission(),
+            lookahead=1, viewport_span=0,
+        )
+        pre.start()
+        try:
+            # a 3x2-tile viewport, reported over the live channel
+            assert pre.note_viewport(
+                "sk", 1, {"x": 0, "y": 64, "w": 192, "h": 128}
+            )
+            pre.observe(_ctx(x=0, y=64))
+            pre.observe(_ctx(x=64, y=64))  # panning right
+            for _ in range(100):
+                if len(fetched) >= 6:
+                    break
+                await asyncio.sleep(0.01)
+            assert pre.snapshot()["viewport_true"] >= 1
+            # the rect shifted one step right: columns 64..255,
+            # rows 64..191 — BOTH rows, where the span-0 band only
+            # predicts the continuation line at y=64
+            for want in (
+                (64, 64), (128, 64), (192, 64),
+                (64, 128), (128, 128), (192, 128),
+            ):
+                assert want in fetched, (want, fetched)
+        finally:
+            await pre.close()
+
+    async def test_zoom_mismatch_falls_back_to_band(self):
+        fetched = []
+
+        async def fetch(ctx, key):
+            fetched.append((ctx.region.x, ctx.region.y))
+
+        pre = ViewportPrefetcher(
+            fetch, None, _FakeAdmission(),
+            lookahead=1, viewport_span=0,
+        )
+        pre.start()
+        try:
+            pre.note_viewport(
+                "sk", 1,
+                {"x": 0, "y": 0, "w": 192, "h": 128, "zoom": 3},
+            )
+            pre.observe(_ctx(x=0, y=0, resolution=0))
+            pre.observe(_ctx(x=64, y=0, resolution=0))
+            for _ in range(100):
+                if fetched:
+                    break
+                await asyncio.sleep(0.01)
+            assert pre.snapshot()["viewport_true"] == 0
+            assert (128, 0) in fetched  # the old continuation line
+        finally:
+            await pre.close()
+
+    def test_invalidate_image_drops_viewports(self):
+        pre = ViewportPrefetcher(None, None, _FakeAdmission())
+        pre.note_viewport("sk", 1, {"x": 0, "y": 0, "w": 64, "h": 64})
+        pre.note_viewport("sk", 2, {"x": 0, "y": 0, "w": 64, "h": 64})
+        pre.invalidate_image(1)
+        assert ("sk", 1) not in pre._viewports
+        assert ("sk", 2) in pre._viewports
+
+    async def test_viewport_post_endpoint(self, tmp_path):
+        app_obj, client, _store = await _make_app(
+            tmp_path,
+            config_extra={"cache": {"prefetch": {"enabled": True}}},
+        )
+        try:
+            r = await client.post(
+                "/session/1/viewport", headers=AUTH,
+                json={"x": 0, "y": 0, "w": 256, "h": 128},
+            )
+            assert r.status == 200
+            assert (await r.json()) == {"noted": True}
+            r = await client.post(
+                "/session/1/viewport", headers=AUTH,
+                json={"x": 0, "y": 0, "w": 0, "h": 128},
+            )
+            assert r.status == 400
+            r = await client.post(
+                "/session/1/viewport", headers=AUTH, data=b"not json",
+            )
+            assert r.status == 400
+        finally:
+            await client.close()
+
+    async def test_ws_viewport_frame_feeds_prefetcher(self, tmp_path):
+        app_obj, client, _store = await _make_app(
+            tmp_path,
+            config_extra={"cache": {"prefetch": {"enabled": True}}},
+        )
+        try:
+            ws = await client.ws_connect(
+                "/session/1/live", headers=AUTH
+            )
+            await _recv_json(ws)  # hello
+            await ws.send_json({
+                "type": "viewport",
+                "x": 64, "y": 0, "w": 256, "h": 128,
+            })
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if ("omero-key-1", 1) in app_obj.prefetcher._viewports:
+                    break
+                await asyncio.sleep(0.02)
+            rect = app_obj.prefetcher._viewports[("omero-key-1", 1)]
+            assert rect["w"] == 256 and rect["x"] == 64
+            # garbled and unknown frames are no-ops, not disconnects
+            await ws.send_str("not json{")
+            await ws.send_json({"type": "mystery"})
+            await ws.send_json({
+                "type": "viewport", "x": 1, "y": 1, "w": 64, "h": 64,
+            })
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                rect = app_obj.prefetcher._viewports[
+                    ("omero-key-1", 1)
+                ]
+                if rect["x"] == 1:
+                    break
+                await asyncio.sleep(0.02)
+            assert rect["x"] == 1
+            await ws.close()
+        finally:
+            await client.close()
+
+
+# ---------------------------------------------------------------------------
+# annotations: CRUD + the render-plane join
+# ---------------------------------------------------------------------------
+
+class TestAnnotationCrud:
+    async def test_crud_lifecycle(self, tmp_path):
+        app_obj, client, _store = await _make_app(tmp_path)
+        try:
+            r = await client.post(
+                "/annotations/1", headers=AUTH,
+                json={"shape": RECT, "label": "tumor"},
+            )
+            assert r.status == 201
+            created = await r.json()
+            ann_id = created["annotation"]["id"]
+            assert created["epoch"] == 1
+            assert created["annotation"]["label"] == "tumor"
+
+            r = await client.get("/annotations/1", headers=AUTH)
+            listing = await r.json()
+            assert listing["epoch"] == 1
+            assert [a["id"] for a in listing["annotations"]] == [ann_id]
+
+            r = await client.get(
+                f"/annotations/1/{ann_id}", headers=AUTH
+            )
+            assert r.status == 200
+
+            r = await client.put(
+                f"/annotations/1/{ann_id}", headers=AUTH,
+                json={"shape": {**RECT, "w": 40}, "label": "bigger"},
+            )
+            updated = await r.json()
+            assert updated["epoch"] == 2
+            assert updated["annotation"]["shape"]["w"] == 40
+
+            r = await client.delete(
+                f"/annotations/1/{ann_id}", headers=AUTH
+            )
+            assert (await r.json()) == {"deleted": True, "epoch": 3}
+
+            for method, path in (
+                ("GET", f"/annotations/1/{ann_id}"),
+                ("PUT", f"/annotations/1/{ann_id}"),
+                ("DELETE", f"/annotations/1/{ann_id}"),
+            ):
+                r = await client.request(
+                    method, path, headers=AUTH,
+                    json={"shape": RECT},
+                )
+                assert r.status == 404, (method, r.status)
+        finally:
+            await client.close()
+
+    async def test_grammar_rejections(self, tmp_path):
+        app_obj, client, _store = await _make_app(tmp_path)
+        try:
+            for body in (
+                b"not json",
+                json.dumps(["a", "list"]).encode(),
+                json.dumps({"shape": {"type": "blob"}}).encode(),
+                json.dumps(
+                    {"shape": {**RECT, "mystery": 1}}
+                ).encode(),
+            ):
+                r = await client.post(
+                    "/annotations/1", headers=AUTH, data=body,
+                )
+                assert r.status == 400, body
+        finally:
+            await client.close()
+
+    def test_store_bounds(self):
+        store = AnnotationStore(max_images=2, max_per_image=2)
+        store.create(1, {"shape": RECT})
+        store.create(1, {"shape": RECT})
+        with pytest.raises(BadRequestError):
+            store.create(1, {"shape": RECT})
+        # LRU image eviction
+        store.create(2, {"shape": RECT})
+        store.create(3, {"shape": RECT})
+        assert store.sub_epoch(1) == 0  # evicted
+        assert store.snapshot()["evicted_images"] == 1
+
+
+class TestAnnotationOverlays:
+    async def test_overlay_shares_cache_entry_with_roi(self, tmp_path):
+        """annotations=1 with stored shapes == an explicit roi= of
+        the same shapes: one RenderSpec signature, one cache entry,
+        one ETag. The second spelling must HIT the first's entry."""
+        app_obj, client, _store = await _make_app(tmp_path)
+        try:
+            r = await client.post(
+                "/annotations/1", headers=AUTH, json={"shape": RECT},
+            )
+            assert r.status == 201
+            base = "/render/1/0/0/0?c=1|0:4095$FF0000&w=64&h=64"
+            ra = await client.get(
+                base + "&annotations=1", headers=AUTH
+            )
+            assert ra.status == 200
+            assert ra.headers["X-Cache"] == "miss"
+            roi = json.dumps([RECT], separators=(",", ":"))
+            rb = await client.get(
+                base + f"&roi={roi}", headers=AUTH
+            )
+            assert rb.status == 200
+            assert rb.headers["X-Cache"] == "hit"  # SAME entry
+            assert rb.headers["ETag"] == ra.headers["ETag"]
+            assert (await rb.read()) == (await ra.read())
+            # and the overlay actually changed the bytes
+            plain = await client.get(base, headers=AUTH)
+            assert (await plain.read()) != (await ra.read())
+        finally:
+            await client.close()
+
+    async def test_annotation_write_invalidates_overlay(self, tmp_path):
+        app_obj, client, _store = await _make_app(tmp_path)
+        try:
+            r = await client.post(
+                "/annotations/1", headers=AUTH, json={"shape": RECT},
+            )
+            ann_id = (await r.json())["annotation"]["id"]
+            base = (
+                "/render/1/0/0/0?c=1|0:4095$FF0000&w=64&h=64"
+                "&annotations=1"
+            )
+            first = await client.get(base, headers=AUTH)
+            body_one = await first.read()
+            r = await client.put(
+                f"/annotations/1/{ann_id}", headers=AUTH,
+                json={"shape": {**RECT, "w": 48}},
+            )
+            assert r.status == 200
+            second = await client.get(base, headers=AUTH)
+            # the shape set keys the cache: a changed overlay is a
+            # changed key, never a stale hit
+            assert second.headers["X-Cache"] == "miss"
+            assert (await second.read()) != body_one
+        finally:
+            await client.close()
+
+    def test_overlay_bytes_identical_host_vs_device(self, tmp_path):
+        """The engine-identity contract extends to annotation
+        overlays: the merged mask tuple renders byte-identical on the
+        host and device engines (masks are engine-independent host
+        math, composited before encode)."""
+        registry = _write_fixture(tmp_path)
+        service = PixelsService(registry)
+        store = AnnotationStore()
+        store.create(1, {"shape": RECT})
+        store.create(
+            1,
+            {"shape": {"type": "ellipse", "cx": 40, "cy": 30,
+                       "rx": 12, "ry": 8}},
+        )
+        spec = RenderSpec.from_params({"c": "1|0:4095$FF0000"})
+        spec = dataclasses.replace(
+            spec, masks=spec.masks + store.shapes(1)
+        )
+
+        def ctx():
+            return TileCtx(
+                image_id=1, z=0, c=0, t=0,
+                region=RegionDef(0, 0, 64, 64), format="png",
+                omero_session_key="k", render=spec,
+            )
+
+        host_pipe = TilePipeline(service, engine="host")
+        dev_pipe = TilePipeline(
+            service, engine="device", device_deflate=True
+        )
+        dev_pipe.mesh = None
+        try:
+            host_png = host_pipe.handle(ctx())
+            dev_png = dev_pipe.handle_batch([ctx()])[0]
+            assert host_png is not None
+            assert host_png == dev_png
+        finally:
+            host_pipe.close()
+            dev_pipe.close()
+            service.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet SLI aggregation (satellite: brain exchange)
+# ---------------------------------------------------------------------------
+
+class TestFleetSli:
+    def test_apply_fleet_takes_worst_burn(self):
+        brains = FleetBrains(None, "http://self:1")
+        fleet = {
+            "http://a:1": {"sli": {
+                "5m": {"interactive": 14.2, "bulk": 0.1},
+            }},
+            "http://b:2": {"sli": {
+                "5m": {"interactive": 0.3},
+                "1h": {"prefetch": 2.5},
+            }},
+            "http://c:3": {"sli": "garbage"},  # malformed: ignored
+        }
+        brains.apply_fleet(fleet, list(fleet))
+        sli = brains.fleet_sli
+        # max, not mean: the 14.2x burn is the page signal
+        assert sli["5m"]["interactive"] == 14.2
+        assert sli["5m"]["bulk"] == 0.1
+        assert sli["1h"]["prefetch"] == 2.5
+        assert brains.snapshot()["fleet_sli"] == sli
+
+    def test_malformed_cannot_grow_vocabulary(self):
+        brains = FleetBrains(None, "http://self:1")
+        brains.apply_fleet({
+            "http://a:1": {"sli": {
+                "5m": {"interactive": 1.0, "made-up-class": 9.0},
+                "made-up-window": {"interactive": 9.0},
+            }},
+        }, ["http://a:1"])
+        assert set(brains.fleet_sli) <= {"5m", "30m", "1h"}
+        assert set(brains.fleet_sli.get("5m", {})) <= {
+            "interactive", "prefetch", "bulk",
+        }
+
+
+# ---------------------------------------------------------------------------
+# gossip join hint (satellite: contact adoption)
+# ---------------------------------------------------------------------------
+
+class _HintMembership:
+    def __init__(self):
+        self.noted = []
+
+    def note_contact(self, url):
+        self.noted.append(url)
+
+
+class TestJoinHint:
+    def _coordinator(self):
+        from omero_ms_pixel_buffer_tpu.cache.plane.coordinator import (
+            CachePlane,
+        )
+
+        coord = CachePlane.__new__(CachePlane)
+        coord.self_url = "http://self:1"
+        coord.membership = _HintMembership()
+        return coord
+
+    def test_url_shaped_contacts_adopted(self):
+        coord = self._coordinator()
+        coord.note_peer_contact("http://peer:9")
+        assert coord.membership.noted == ["http://peer:9"]
+
+    def test_garbage_rejected(self):
+        coord = self._coordinator()
+        for bad in (
+            None, "", "bench-ops", "redis://x", 7,
+            "http://self:1", "http://" + "x" * 600,
+        ):
+            coord.note_peer_contact(bad)
+        assert coord.membership.noted == []
+
+    def test_membership_without_hint_support_is_noop(self):
+        coord = self._coordinator()
+        coord.membership = object()  # lease-mode MembershipManager
+        coord.note_peer_contact("http://peer:9")  # must not raise
+
+
+# ---------------------------------------------------------------------------
+# the two-replica lanes: cross-replica delta + drain handoff
+# ---------------------------------------------------------------------------
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def _boot_replica(img_path, members, self_url, port,
+                        cluster_extra=None):
+    registry = ImageRegistry()
+    registry.add(1, img_path)
+    config = Config.from_dict({
+        "session-store": {"type": "memory"},
+        "backend": {"batching": {"coalesce-window-ms": 1.0}},
+        "cache": {"prefetch": {"enabled": False}},
+        "cluster": {
+            "members": members,
+            "self": self_url,
+            "peer-timeout-ms": 3000,
+            **(cluster_extra or {}),
+        },
+    })
+    app_obj = PixelBufferApp(
+        config,
+        pixels_service=PixelsService(registry),
+        session_store=MemorySessionStore({"ck": "omero-key-1"}),
+    )
+    runner = web.AppRunner(app_obj.make_app())
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", port)
+    await site.start()
+    return app_obj, runner
+
+
+async def _make_pair(tmp_path, cluster_extra=None, l2=False):
+    img_path = str(tmp_path / "img.ome.tiff")
+    write_ome_tiff(img_path, IMG, tile_size=(64, 64))
+    resp = None
+    extra = dict(cluster_extra or {})
+    if l2:
+        from omero_ms_pixel_buffer_tpu.cache.plane.resp_stub import (
+            InMemoryRespServer,
+        )
+
+        resp = InMemoryRespServer()
+        await resp.start()
+        extra["l2"] = {"uri": resp.uri}
+    ports = [_free_port() for _ in range(2)]
+    members = [f"http://127.0.0.1:{p}" for p in ports]
+    nodes = []
+    for i, port in enumerate(ports):
+        nodes.append(await _boot_replica(
+            img_path, members, members[i], port,
+            cluster_extra=extra,
+        ))
+
+    async def cleanup():
+        for _app, runner in nodes:
+            await runner.cleanup()
+        if resp is not None:
+            await resp.close()
+
+    return nodes, members, cleanup
+
+
+PEER_OPS = {**AUTH, "X-OMPB-Peer": "ops"}
+
+
+class TestCrossReplica:
+    @pytest.mark.resilience
+    async def test_annotation_write_reaches_remote_channel(
+        self, tmp_path
+    ):
+        """THE acceptance criterion: a write on replica A arrives at
+        a channel held open on replica B, as a delta push riding the
+        purge fan-out — no polling, no TTL expiry involved."""
+        nodes, members, cleanup = await _make_pair(tmp_path)
+        try:
+            (app_a, _), (app_b, _) = nodes
+            url_a, url_b = members
+            async with ClientSession() as http:
+                ws = await asyncio.wait_for(
+                    http.ws_connect(
+                        url_b + "/session/1/live", headers=AUTH
+                    ), 10.0,
+                )
+                hello = await _recv_json(ws)
+                assert hello["type"] == "hello"
+                async with http.post(
+                    url_a + "/annotations/1", headers=AUTH,
+                    json={"shape": RECT, "label": "from-A"},
+                ) as r:
+                    assert r.status == 201
+                frame = await _recv_json(ws, timeout=10.0)
+                assert frame["type"] == "invalidate"
+                assert frame["image"] == 1
+                await ws.close()
+                # the obs plumbing saw the push on B
+                snap = app_b.session_channels.snapshot()
+                assert snap["delta_pushes"] >= 1
+                # and /healthz reports the session plane fleet-wide
+                async with http.get(url_b + "/healthz") as r:
+                    health = await r.json()
+                assert health["session"]["delta_pushes"] >= 1
+        finally:
+            await cleanup()
+
+    @pytest.mark.resilience
+    async def test_drain_hands_off_live_channels(self, tmp_path):
+        """Rolling drain with 10 live channels: every client gets an
+        explicit reconnect frame naming the successor (zero silent
+        drops), tile traffic sees zero 5xx throughout, the successor
+        absorbs the subscription summary, and reconnecting to the
+        named successor works immediately."""
+        nodes, members, cleanup = await _make_pair(
+            tmp_path, l2=True,
+            cluster_extra={
+                "lease-ttl-s": 0.6,
+                "drain": {"deadline-s": 5, "signal": False},
+            },
+        )
+        try:
+            (app_a, _), (app_b, _) = nodes
+            url_a, url_b = members
+            await asyncio.sleep(0.5)  # leases discovered
+            statuses = []
+            async with ClientSession() as http:
+                sockets = []
+                for _ in range(10):
+                    ws = await asyncio.wait_for(
+                        http.ws_connect(
+                            url_a + "/session/1/live", headers=AUTH
+                        ), 10.0,
+                    )
+                    hello = await _recv_json(ws)
+                    assert hello["type"] == "hello"
+                    sockets.append(ws)
+
+                async def tile_round():
+                    for url in (url_a, url_b):
+                        async with http.get(
+                            url + "/tile/1/0/0/0?w=64&h=64&format=png",
+                            headers=AUTH,
+                        ) as r:
+                            statuses.append(r.status)
+                            await r.read()
+
+                await tile_round()
+
+                async def drain():
+                    async with http.post(
+                        url_a + "/internal/drain?wait=1",
+                        headers=PEER_OPS,
+                    ) as r:
+                        return r.status, await r.json()
+
+                drain_task = asyncio.ensure_future(drain())
+                while not drain_task.done():
+                    await tile_round()
+                    await asyncio.sleep(0.05)
+                status, drained = await drain_task
+                assert status == 200
+                assert drained["state"] == "drained"
+                sessions = drained["stats"]["sessions"]
+                assert sessions["channels"] == 10
+                assert sessions["successor"] == url_b
+                assert sessions["pushed"] is True
+
+                # zero dropped sessions: every channel got the
+                # explicit reconnect frame before its close
+                reconnects = 0
+                for ws in sockets:
+                    frame = await _recv_json(ws, timeout=10.0)
+                    assert frame["type"] == "reconnect"
+                    assert frame["reconnect"] == url_b
+                    reconnects += 1
+                    msg = await asyncio.wait_for(ws.receive(), 10.0)
+                    assert msg.type in (
+                        WSMsgType.CLOSE, WSMsgType.CLOSED,
+                        WSMsgType.CLOSING,
+                    )
+                    await ws.close()
+                assert reconnects == 10
+
+                # the successor absorbed the handoff summary...
+                snap_b = app_b.session_channels.snapshot()
+                assert snap_b["handoff_in"] == 10
+                snap_a = app_a.session_channels.snapshot()
+                assert snap_a["handoff_out"] == 10
+
+                # ...and accepts the reconnect wave right now
+                ws = await asyncio.wait_for(
+                    http.ws_connect(
+                        url_b + "/session/1/live", headers=AUTH
+                    ), 10.0,
+                )
+                hello = await _recv_json(ws)
+                assert hello["type"] == "hello"
+                await ws.close()
+
+                # the fleet SLI aggregate rides the brain exchange
+                # and lands in /healthz (satellite: SLI burn rates)
+                async with http.get(url_b + "/healthz") as r:
+                    health = await r.json()
+                assert "fleet_sli" in health["cluster"]["brains"]
+
+            # a planned leave is not a crash
+            assert statuses and all(s < 500 for s in statuses), (
+                [s for s in statuses if s >= 500]
+            )
+        finally:
+            await cleanup()
+
+    @pytest.mark.resilience
+    async def test_session_handoff_endpoint_validation(self, tmp_path):
+        nodes, members, cleanup = await _make_pair(tmp_path)
+        try:
+            url_a = members[0]
+            async with ClientSession() as http:
+                # JSON content-type routes to the session branch;
+                # a malformed payload is a 400, not an absorb
+                async with http.post(
+                    url_a + "/internal/handoff",
+                    headers={
+                        **PEER_OPS,
+                        "Content-Type": "application/json",
+                    },
+                    data=b'{"kind": "mystery"}',
+                ) as r:
+                    assert r.status == 400
+                async with http.post(
+                    url_a + "/internal/handoff",
+                    headers={
+                        **PEER_OPS,
+                        "Content-Type": "application/json",
+                    },
+                    data=json.dumps({
+                        "kind": "session_handoff",
+                        "subscriptions": [
+                            {"image": 1, "channels": 3},
+                        ],
+                        "channels": 3,
+                    }).encode(),
+                ) as r:
+                    assert r.status == 200
+                    assert (await r.json()) == {"absorbed": 3}
+                # no peer marker: refused like the rest of /internal/*
+                async with http.post(
+                    url_a + "/internal/handoff",
+                    headers={"Content-Type": "application/json"},
+                    data=b"{}",
+                ) as r:
+                    assert r.status == 403
+        finally:
+            await cleanup()

@@ -396,6 +396,12 @@ _RESILIENCE_SCOPE = (
     # rather than growing a raw network path of their own
     "omero_ms_pixel_buffer_tpu/cluster/",
     "omero_ms_pixel_buffer_tpu/cluster/gossip.py",
+    # the interactive session plane (r22): channels and annotations
+    # are loop-side fan-out today (their one network hop — the drain
+    # handoff POST — rides PeerClient's wrapper), but a push plane is
+    # exactly where someone adds a webhook or an upstream subscribe
+    # next; the scope pin means it arrives wrapped
+    "omero_ms_pixel_buffer_tpu/session/",
 )
 
 _NET_PRIMITIVES: List[Tuple[Optional[str], str, str]] = [

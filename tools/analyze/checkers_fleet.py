@@ -205,6 +205,11 @@ _GROWTH_SCOPE = (
     "omero_ms_pixel_buffer_tpu/cluster/",
     "omero_ms_pixel_buffer_tpu/cache/plane/",
     "omero_ms_pixel_buffer_tpu/obs/",
+    # the session plane (r22): per-channel queues, the channel table,
+    # and the annotation tables are exactly the registries that leak
+    # when a disconnect path misses an unregister — every collection
+    # here must carry an explicit bound
+    "omero_ms_pixel_buffer_tpu/session/",
 )
 _COLLECTION_CTORS = {
     "dict", "list", "set", "OrderedDict", "defaultdict", "Counter",
