@@ -2516,6 +2516,310 @@ def bench_supertile(
     return out
 
 
+def _mesh_fusion_child():
+    """Subprocess body for the mesh half of ``bench_mesh_fusion`` —
+    runs on a virtual n-device CPU platform (the parent pins XLA_FLAGS
+    before jax init, same self-provisioning dance as
+    ``__graft_entry__.dryrun_multichip``). Prints ONE marker line
+    ``MESH_FUSION_CHILD {json}`` on stdout for the parent to parse."""
+    import time as _t
+
+    args = json.loads(os.environ["_OMPB_MESH_FUSION_ARGS"])
+    cache_dir = args["cache_dir"]
+    size, tile, grid = args["size"], args["tile"], args["grid"]
+    rounds, depth, n_devices = args["rounds"], args["depth"], args["n"]
+
+    import jax
+
+    from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+        ImageRegistry,
+        PixelsService,
+    )
+    from omero_ms_pixel_buffer_tpu.models.tile_pipeline import TilePipeline
+    from omero_ms_pixel_buffer_tpu.parallel.mesh import make_mesh
+    from omero_ms_pixel_buffer_tpu.render.model import RenderSpec
+    from omero_ms_pixel_buffer_tpu.render.supertile import (
+        BurstHint,
+        assign_supertiles,
+    )
+    from omero_ms_pixel_buffer_tpu.tile_ctx import RegionDef, TileCtx
+
+    assert len(jax.devices()) >= n_devices, (
+        f"child got {len(jax.devices())} devices, wanted {n_devices}"
+    )
+    path = build_render_fixture(cache_dir, size, depth=depth)
+    registry = ImageRegistry()
+    registry.add(1, path)
+    params = {
+        "c": "1|0:4095$FF0000,2|0:4095$00FF00,3|0:4095$0000FF",
+    }
+    if depth > 1:
+        params["p"] = f"intmax|0:{depth - 1}"
+    spec = RenderSpec.from_params(params)
+    hint = BurstHint(tile, tile)
+    max_pixels = (grid * tile) ** 2
+
+    def burst_ctxs():
+        return [
+            TileCtx(
+                image_id=1, z=0, c=0, t=0,
+                region=RegionDef(col * tile, row * tile, tile, tile),
+                format="png", omero_session_key="bench", render=spec,
+                burst=hint,
+            )
+            for row in range(grid) for col in range(grid)
+        ]
+
+    def stamped():
+        ctxs = burst_ctxs()
+        assign_supertiles(ctxs, max_pixels=max_pixels)
+        return ctxs
+
+    service = PixelsService(registry)
+    result = {"n_devices": n_devices}
+    try:
+        def make_pipe(supertile_mesh, width):
+            pipe = TilePipeline(
+                service, engine="device", device_deflate=True,
+                buckets=(tile,), supertile_mesh=supertile_mesh,
+            )
+            if width is None:
+                pipe.mesh = None
+            else:
+                pipe.mesh = make_mesh(
+                    ("data",), devices=jax.devices()[:width]
+                )
+            return pipe
+
+        # single-device reference: independent tiles AND the fused
+        # single-device program — the two identity anchors
+        p_single = make_pipe(True, None)
+        ref_ind = [p_single.handle(c) for c in burst_ctxs()]
+        ref_fused = p_single.handle_batch(stamped())
+
+        # fused over the mesh: ONE sharded gather+project+composite+
+        # carve+deflate program per super-tile group
+        p_fused = make_pipe(True, n_devices)
+        fused_out = p_fused.handle_batch(stamped())
+        st_dispatch = p_fused.last_mesh_dispatch or {}
+        result["identical"] = bool(
+            fused_out == ref_fused == ref_ind
+            and st_dispatch.get("tag") == "supertile"
+            and st_dispatch.get("executed")
+        )
+
+        # comparator: same mesh, fusion off — each tile rides the
+        # per-lane sharded render path (the pre-fusion decision-table
+        # row this PR deletes: "serving mesh active -> no fusion")
+        p_lane = make_pipe(False, n_devices)
+        lane_out = p_lane.handle_batch(stamped())
+        if lane_out != ref_ind:
+            result["identical"] = False
+
+        n_tiles = grid * grid
+        t0 = _t.perf_counter()
+        for _ in range(rounds):
+            assert all(
+                b is not None for b in p_lane.handle_batch(stamped())
+            )
+        lane_tps = rounds * n_tiles / (_t.perf_counter() - t0)
+        t0 = _t.perf_counter()
+        for _ in range(rounds):
+            assert all(
+                b is not None for b in p_fused.handle_batch(stamped())
+            )
+        fused_tps = rounds * n_tiles / (_t.perf_counter() - t0)
+        result.update({
+            "fused_mesh_tiles_per_sec": round(fused_tps, 2),
+            "per_lane_sharded_tiles_per_sec": round(lane_tps, 2),
+            "speedup": round(fused_tps / max(lane_tps, 1e-9), 3),
+        })
+        for p in (p_single, p_fused, p_lane):
+            p.close()
+    finally:
+        service.close()
+    print("MESH_FUSION_CHILD " + json.dumps(result), flush=True)
+
+
+def _bench_burst_programs(
+    n_tiles: int = 100, stagger_ms: float = 3.0
+) -> dict:
+    """100-tile zoom burst through the REAL batcher (no jax): lanes
+    arrive staggered past the 2ms coalesce window, so without
+    continuation nearly every lane is its own device program; with the
+    burst-continuation key the windows chain. handle_batch call count
+    is the device-program proxy."""
+    from omero_ms_pixel_buffer_tpu.auth.omero_session import (
+        AllowListValidator,
+    )
+    from omero_ms_pixel_buffer_tpu.dispatch.batcher import (
+        BatchingTileWorker,
+    )
+    from omero_ms_pixel_buffer_tpu.render.model import RenderSpec
+    from omero_ms_pixel_buffer_tpu.render.supertile import BurstHint
+    from omero_ms_pixel_buffer_tpu.tile_ctx import RegionDef, TileCtx
+    from omero_ms_pixel_buffer_tpu.utils.config import (
+        BurstContinuationConfig,
+    )
+
+    spec = RenderSpec.from_params({"c": "1|0:4095$FF0000"})
+    hint = BurstHint(64, 64)
+
+    class _Counting:
+        def __init__(self):
+            self.programs = 0
+
+        def handle(self, ctx):
+            return b"x"
+
+        def handle_batch(self, ctxs):
+            self.programs += 1
+            return [b"x"] * len(ctxs)
+
+    def run(bc) -> int:
+        counting = _Counting()
+        worker = BatchingTileWorker(
+            counting, AllowListValidator(), max_batch=32,
+            coalesce_window_ms=2.0, workers=1, burst_continuation=bc,
+        )
+
+        async def go():
+            await worker.start()
+            sends = []
+            for i in range(n_tiles):
+                sends.append(asyncio.ensure_future(worker.handle(
+                    TileCtx(
+                        image_id=1, z=0, c=0, t=0,
+                        region=RegionDef(
+                            64 * (i % 10), 64 * (i // 10), 64, 64
+                        ),
+                        format="png", omero_session_key="bench",
+                        render=spec, burst=hint,
+                    )
+                )))
+                await asyncio.sleep(stagger_ms / 1000.0)
+            out = await asyncio.gather(*sends)
+            await worker.close()
+            assert all(t == b"x" for t, _ in out)
+
+        loop = asyncio.new_event_loop()
+        try:
+            loop.run_until_complete(go())
+        finally:
+            loop.close()
+        return counting.programs
+
+    on = run(BurstContinuationConfig(enabled=True, window_ms=50.0))
+    off = run(None)
+    return {
+        "tiles": n_tiles,
+        "continuation_on_programs": on,
+        "continuation_off_programs": off,
+    }
+
+
+def bench_mesh_fusion(
+    cache_dir: str, engine: str, size: int = 1024, tile: int = 64,
+    grid: int = 4, rounds: int = 3, depth: int = 4, n_devices: int = 8,
+) -> dict:
+    """Mesh-fusion plane (r23) section, two halves:
+
+    - **mesh**: the bench_supertile burst (4x4 adjacent 64px tiles,
+      3-channel intmax z-projection) over an 8-chip mesh, fused
+      (``supertile_mesh=True`` — one sharded
+      gather+project+composite+carve+deflate program) vs the per-lane
+      sharded path the mesh used before this PR
+      (``supertile_mesh=False`` — every tile its own gather/projection,
+      only the encode sharded). The driver env pins exactly one real
+      chip and tests alone force virtual devices, so this half re-execs
+      a subprocess on a virtual 8-device CPU platform (the
+      ``dryrun_multichip`` self-provisioning pattern) — ratios on
+      virtual chips are work-count ratios, which is what the pin
+      guards.
+    - **burst**: programs-per-100-tile-zoom through the real batcher
+      with burst continuation on vs off (in-process, no jax).
+
+    Pins (CI smoke fails on any):
+    ``mesh_ok_fusion_identity`` — fused-mesh bytes == single-device
+    fused == independent tiles, with the dispatch tagged "supertile";
+    ``mesh_ok_fusion_speedup`` — fused >= 2x per-lane-sharded tiles/s;
+    ``mesh_ok_burst_programs`` — continuation serves the zoom in
+    <= 1/4 the programs."""
+    import re
+    import subprocess
+
+    out: dict = {}
+    try:
+        out["burst"] = _bench_burst_programs()
+        on = out["burst"]["continuation_on_programs"]
+        off = out["burst"]["continuation_off_programs"]
+        out["mesh_ok_burst_programs"] = bool(on * 4 <= off)
+        log(f"[mesh_fusion] burst: {out['burst']}")
+    except Exception as e:
+        out["burst"] = {"error": f"{type(e).__name__}: {e}"}
+        out["mesh_ok_burst_programs"] = False
+        log(f"[mesh_fusion] burst failed: {e!r}")
+
+    try:
+        env = dict(os.environ)
+        env["_OMPB_MESH_FUSION_ARGS"] = json.dumps({
+            "cache_dir": cache_dir, "size": size, "tile": tile,
+            "grid": grid, "rounds": rounds, "depth": depth,
+            "n": n_devices,
+        })
+        # replace (not merely add) any ambient device-count flag, and
+        # pin the cpu platform BEFORE jax init — the axon TPU plugin
+        # ignores a bare JAX_PLATFORMS (dryrun_multichip's dance)
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+",
+            "",
+            env.get("XLA_FLAGS", ""),
+        )
+        env["XLA_FLAGS"] = (
+            flags
+            + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+        env["JAX_PLATFORMS"] = "cpu"
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        code = (
+            "import jax; jax.config.update('jax_platforms', 'cpu'); "
+            "import bench; bench._mesh_fusion_child()"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=env, cwd=repo,
+            capture_output=True, text=True, timeout=1200,
+        )
+        if proc.stderr:
+            log(proc.stderr.rstrip())
+        marker = next(
+            (
+                line[len("MESH_FUSION_CHILD "):]
+                for line in proc.stdout.splitlines()
+                if line.startswith("MESH_FUSION_CHILD ")
+            ),
+            None,
+        )
+        if proc.returncode != 0 or marker is None:
+            raise RuntimeError(
+                f"mesh child rc={proc.returncode}, no result marker"
+            )
+        out["mesh"] = json.loads(marker)
+        out["mesh_ok_fusion_identity"] = bool(
+            out["mesh"].get("identical")
+        )
+        out["mesh_ok_fusion_speedup"] = bool(
+            (out["mesh"].get("speedup") or 0) >= 2.0
+        )
+        log(f"[mesh_fusion] mesh: {out['mesh']}")
+    except Exception as e:
+        out["mesh"] = {"error": f"{type(e).__name__}: {e}"}
+        out["mesh_ok_fusion_identity"] = False
+        out["mesh_ok_fusion_speedup"] = False
+        log(f"[mesh_fusion] mesh failed: {e!r}")
+    return out
+
+
 def bench_analysis(
     cache_dir: str, engine: str, size: int = 2048, n: int = 64
 ) -> dict:
@@ -3019,6 +3323,18 @@ def main():
             supertile_stats = {"error": f"{type(e).__name__}: {e}"}
             log(f"supertile bench failed: {e!r}")
 
+    # --- mesh-fusion plane (r23): fused-mesh vs per-lane-sharded
+    # super-tile burst + programs-per-zoom with burst continuation
+    # (mesh_ok_* pins) -------------------------------------------------
+    mesh_fusion_stats: dict = {}
+    if os.environ.get("BENCH_MESH_FUSION", "1") != "0":
+        try:
+            mesh_fusion_stats = bench_mesh_fusion(cache_dir, pipe.engine)
+            log(f"mesh_fusion: {mesh_fusion_stats}")
+        except Exception as e:
+            mesh_fusion_stats = {"error": f"{type(e).__name__}: {e}"}
+            log(f"mesh_fusion bench failed: {e!r}")
+
     if os.environ.get("BENCH_SUBS", "1") != "0":
         try:
             sub_benches(pipe, service, size, cache_dir)
@@ -3070,6 +3386,8 @@ def main():
         record["analysis"] = analysis_stats
     if supertile_stats:
         record["supertile"] = supertile_stats
+    if mesh_fusion_stats:
+        record["mesh_fusion"] = mesh_fusion_stats
     if device_stats:
         record["device"] = device_stats
     # explicit host-vs-device table so the next round can read WHICH
@@ -3099,6 +3417,22 @@ def main():
             comparison[f"supertile_independent_{label}"] = (
                 stats["independent_tiles_per_sec"]
             )
+    mesh_half = mesh_fusion_stats.get("mesh") or {}
+    if "fused_mesh_tiles_per_sec" in mesh_half:
+        comparison["mesh_fused_tiles_per_sec"] = (
+            mesh_half["fused_mesh_tiles_per_sec"]
+        )
+        comparison["mesh_per_lane_sharded_tiles_per_sec"] = (
+            mesh_half["per_lane_sharded_tiles_per_sec"]
+        )
+    burst_half = mesh_fusion_stats.get("burst") or {}
+    if "continuation_on_programs" in burst_half:
+        comparison["burst_programs_continuation_on"] = (
+            burst_half["continuation_on_programs"]
+        )
+        comparison["burst_programs_continuation_off"] = (
+            burst_half["continuation_off_programs"]
+        )
     micro = device_stats.get("micro") or {}
     for k in (
         "deflate_gbps", "pack_gbps", "pack_speedup_vs_gather",

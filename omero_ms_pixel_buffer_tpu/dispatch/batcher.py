@@ -61,6 +61,14 @@ LANES_DEDUPED = REGISTRY.counter(
     "tile_batch_deduped_lanes_total",
     "Batch lanes that shared another identical lane's execution",
 )
+BATCHES_DISPATCHED = REGISTRY.counter(
+    "tile_batches_dispatched_total",
+    "Coalesced batches handed to the executor (device programs proxy)",
+)
+BURST_CONTINUATIONS = REGISTRY.counter(
+    "tile_batch_burst_continuations_total",
+    "Coalesce windows extended by burst-continuation affinity",
+)
 
 
 class BatchingTileWorker:
@@ -76,6 +84,7 @@ class BatchingTileWorker:
         max_queue: int = 4096,
         workers: Optional[int] = None,
         supertile=None,
+        burst_continuation=None,
     ):
         self.pipeline = pipeline
         self.session_validator = session_validator
@@ -88,6 +97,21 @@ class BatchingTileWorker:
         # pipeline turns into ONE plane gather + ONE composite. None
         # disables (every lane keeps the independent path).
         self.supertile = supertile
+        # Burst-continuation batching (config
+        # ``backend.batching.burst-continuation``, r19): a straggling
+        # OpenSeadragon zoom arrives as many small coalesce windows —
+        # one device program each. When the lanes that DID arrive share
+        # a burst identity (same image/spec/resolution/session/burst
+        # grid), the window earns a bounded extension so the rest of
+        # the burst lands in the SAME batch, and the identity carries
+        # across dispatches (``_last_burst``) so window N+1 keeps
+        # waiting for the burst window N dispatched. Deadline-bounded:
+        # the extension never spends more than half the tightest lane
+        # budget. None/disabled keeps the base window exactly as-is.
+        self.burst_continuation = burst_continuation
+        # (key, loop.time()) of the last dispatched batch's dominant
+        # burst key — the cross-window carry
+        self._last_burst: Optional[Tuple[tuple, float]] = None
         # worker_pool_size analog: how many coalesced batches may be in
         # flight on the executor at once (2 x CPUs default, matching
         # the reference's worker-verticle instance count)
@@ -274,6 +298,29 @@ class BatchingTileWorker:
             while len(batch) < self.max_batch and not self._queue.empty():
                 batch.append(self._queue.get_nowait())
 
+        # burst continuation: the base window closed short of max_batch
+        # but the lanes it caught look like a tile burst (≥2 share a
+        # burst key, or the key matches the batch we JUST dispatched).
+        # Spend a bounded second window so the burst's stragglers join
+        # THIS batch instead of seeding one device program each.
+        ext = self._burst_extension(batch, loop)
+        if ext is not None:
+            BURST_CONTINUATIONS.inc()
+            stop = loop.time() + ext
+            while len(batch) < self.max_batch:
+                remaining = stop - loop.time()
+                if remaining <= 0:
+                    break
+                try:
+                    item = await asyncio.wait_for(
+                        self._queue.get(), timeout=remaining
+                    )
+                except asyncio.TimeoutError:
+                    break
+                # non-matching lanes ride along — they'd only seed a
+                # separate program otherwise
+                batch.append(item)
+
         # drop lanes whose client already gave up (bus timeout
         # cancelled the future) or whose budget is spent — no dead
         # work under overload, and an expired lane answers 504 at
@@ -300,6 +347,76 @@ class BatchingTileWorker:
         task.add_done_callback(
             lambda t: (self._inflight.discard(t), sem.release())
         )
+        BATCHES_DISPATCHED.inc()
+        bc = self.burst_continuation
+        if bc is not None and getattr(bc, "enabled", False):
+            counts: dict = {}
+            for c, _ in live:
+                k = self._burst_key(c)
+                if k is not None:
+                    counts[k] = counts.get(k, 0) + 1
+            if counts:
+                key = max(counts, key=lambda k: counts[k])
+                self._last_burst = (key, loop.time())
+
+    @staticmethod
+    def _burst_key(ctx) -> Optional[tuple]:
+        """Burst identity: the lanes of one client's zoom/pan burst on
+        one image. None for non-render lanes and lanes without a burst
+        hint — they never extend a window."""
+        burst = getattr(ctx, "burst", None)
+        if burst is None or ctx.render is None:
+            return None
+        return (
+            ctx.image_id,
+            ctx.resolution,
+            ctx.z,
+            ctx.t,
+            ctx.format,
+            ctx.render.signature(),
+            (getattr(burst, "tile_w", 0), getattr(burst, "tile_h", 0)),
+            ctx.omero_session_key,
+        )
+
+    def _burst_extension(self, batch, loop) -> Optional[float]:
+        """Seconds of extra coalesce the burst affinity earns — None
+        when continuation is off, the batch is full, no burst
+        dominates, or the deadline bound eats the whole window.
+
+        The extension is capped at the configured window AND at half
+        the tightest remaining lane budget: a continuation may trade
+        latency for fewer device programs, but never more than half of
+        what the most urgent lane has left."""
+        bc = self.burst_continuation
+        if bc is None or not getattr(bc, "enabled", False):
+            return None
+        if len(batch) >= self.max_batch:
+            return None
+        window = getattr(bc, "window_ms", 25.0) / 1000.0
+        if window <= 0:
+            return None
+        counts: dict = {}
+        for c, _ in batch:
+            k = self._burst_key(c)
+            if k is not None:
+                counts[k] = counts.get(k, 0) + 1
+        if not counts:
+            return None
+        key = max(counts, key=lambda k: counts[k])
+        carried = (
+            self._last_burst is not None
+            and self._last_burst[0] == key
+            and loop.time() - self._last_burst[1] <= window
+        )
+        if counts[key] < 2 and not carried:
+            return None
+        extra = window
+        remains = [
+            c.deadline.remaining() for c, _ in batch if c.deadline is not None
+        ]
+        if remains:
+            extra = min(extra, max(0.0, min(remains)) * 0.5)
+        return extra if extra > 0 else None
 
     async def _execute(
         self, batch: List[Tuple[TileCtx, asyncio.Future]], loop

@@ -725,6 +725,11 @@ class PixelBufferApp:
             queue_depth=config.backend.png.queue_depth,
             compilation_cache_dir=config.jax.compilation_cache_dir,
             lut_dir=config.render.lut_dir,
+            # mesh-fused super-tiles (r19 fusion plane): shard the
+            # fused gather+composite+carve+deflate across the serving
+            # mesh; `supertile.mesh: false` is the escape hatch back
+            # to the per-lane sharded preference
+            supertile_mesh=config.supertile.mesh,
         )
         if config.render.enabled:
             # build the LUT registry NOW (directory scan + file reads,
@@ -754,6 +759,10 @@ class PixelBufferApp:
             # adjacent render lanes; the pipeline fuses their gather +
             # composite and carves byte-identical per-tile results
             supertile=config.supertile,
+            # burst continuation (r19): zoom bursts chain coalesce
+            # windows so a 100-tile zoom executes as a handful of
+            # device programs instead of one per window
+            burst_continuation=batching.burst_continuation,
         )
         self.bus = EventBus()
         self.bus.consumer(GET_TILE_EVENT, self.worker.handle)

@@ -328,9 +328,10 @@ class DeviceEncodeDispatcher:
         filter + deflate program runs as ONE dispatch and the
         readback worker frames RGB8 PNGs. Same queue semantics as
         ``submit``; with a serving mesh the group shards across chips
-        through ``sharded_render_filter_deflate`` instead (masked and
-        staged groups stay single-device — the shard_map chain does
-        not carry them)."""
+        through ``sharded_render_filter_deflate`` instead — masks
+        included, as a sharded operand (only staged device-resident
+        groups stay single-device, their arrays already live on one
+        chip)."""
         return self._enqueue(
             self._stage_render_group,
             planes, index_tables, color_luts, rows, row_bytes,
@@ -451,14 +452,20 @@ class DeviceEncodeDispatcher:
             # would surface at a later block_until_ready outside the
             # breaker/probe/shrink machinery and record a phantom
             # success; chips supply the parallelism there, so losing
-            # the submit-thread overlap costs nothing. Dynamic mode
-            # downgrades to rle: the two-pass host hop doesn't
-            # compose with the one-program shard_map chain.
-            if deflate_mode == "dynamic":
-                deflate_mode = "rle"
+            # the submit-thread overlap costs nothing.
             self._register_mesh_shape(
                 tiles, rows, row_bytes, bpp, filter_mode, deflate_mode
             )
+            if deflate_mode == "dynamic":
+                # two sharded programs with the host Huffman-plan hop
+                # between: the plan runs per shard's pulled counts
+                # inside the managed dispatch, so mesh lanes keep
+                # content-adaptive codes instead of downgrading to rle
+                return self._readback.submit(
+                    self._tid_bound(self._mesh_dynamic_group),
+                    tiles, rows, row_bytes, bpp, filter_mode,
+                    lanes, sizes, bit_depth, color_type,
+                )
             return self._readback.submit(
                 self._tid_bound(self._mesh_group),
                 tiles, rows, row_bytes, bpp, filter_mode, deflate_mode,
@@ -512,16 +519,18 @@ class DeviceEncodeDispatcher:
     ):
         import jax
 
-        if self.mesh_manager is not None and mask is None and not staged:
+        if self.mesh_manager is not None and not staged:
             # same rationale as the raw-tile mesh path: block inside
             # the managed dispatch so a sick chip degrades the mesh.
-            # Masked and staged (device-resident) groups stay on the
-            # single-device path below — the shard_map render chain
-            # carries neither, and correctness beats width here.
+            # Masked groups ride along since the ROI mask became a
+            # sharded operand of the render chain (the (B, H, W)
+            # batch shards with its lanes); only staged
+            # (device-resident) groups stay single-device — their
+            # arrays already live on one chip.
             return self._readback.submit(
                 self._tid_bound(self._mesh_render_group),
                 planes, index_tables, color_luts, rows, row_bytes,
-                filter_mode, deflate_mode, lanes, sizes,
+                filter_mode, deflate_mode, lanes, sizes, mask,
             )
         from ..render.engine import fused_render_filter_deflate_batch
 
@@ -553,11 +562,13 @@ class DeviceEncodeDispatcher:
 
     def _mesh_render_group(
         self, planes, index_tables, color_luts, rows, row_bytes,
-        filter_mode, deflate_mode, lanes, sizes,
+        filter_mode, deflate_mode, lanes, sizes, mask=None,
     ):
         """One sharded render group on the readback worker (same
         pow2-then-mesh-width lane padding and blocking-dispatch
-        semantics as ``_mesh_group``)."""
+        semantics as ``_mesh_group``). ``mask`` (optional) is the
+        (B, H, W) uint8 ROI batch — padded and sharded exactly like
+        its lanes, so masked groups keep the full mesh width."""
         import jax
         import jax.numpy as jnp
 
@@ -569,28 +580,37 @@ class DeviceEncodeDispatcher:
         t0 = time.perf_counter()
         stamps = {}
 
+        def _pad_lanes(arr, padded_b):
+            b = arr.shape[0]
+            if padded_b == b:
+                return arr
+            return jnp.pad(
+                arr, ((0, padded_b - b),) + ((0, 0),) * (arr.ndim - 1)
+            )
+
         def run(mesh):
             n = mesh.shape["data"]
             b = planes.shape[0]
             padded_b = _mesh_padded_lanes(b, n)
-            batch = jnp.asarray(planes)
-            if padded_b != b:
-                batch = jnp.pad(
-                    batch,
-                    ((0, padded_b - b),) + ((0, 0),) * (batch.ndim - 1),
-                )
+            batch = _pad_lanes(jnp.asarray(planes), padded_b)
             sharded = shard_batch(mesh, batch)
+            mask_sh = None
+            if mask is not None:
+                mask_sh = shard_batch(
+                    mesh, _pad_lanes(jnp.asarray(mask), padded_b)
+                )
             jax.block_until_ready(sharded)  # ompb-lint: disable=jax-hotpath -- H2D stage boundary on the readback worker
             stamps["h2d"] = time.perf_counter()
             out = sharded_render_filter_deflate(
                 mesh, sharded, index_tables, color_luts, rows,
                 row_bytes, filter_mode=filter_mode,
                 deflate_mode=deflate_mode, packer=self._packer,
+                mask=mask_sh,
             )
             return jax.block_until_ready(out)  # ompb-lint: disable=jax-hotpath -- readback worker: the one thread that waits on device completion
 
         streams, lengths = self.mesh_manager.dispatch(
-            run, real_lanes=len(lanes)
+            run, real_lanes=len(lanes), tag="render"
         )
         t_ready = time.perf_counter()
         t_h2d = stamps.get("h2d", t0)
@@ -649,7 +669,7 @@ class DeviceEncodeDispatcher:
             return jax.block_until_ready(out)  # ompb-lint: disable=jax-hotpath -- readback worker: the one thread that waits on device completion
 
         streams, lengths = self.mesh_manager.dispatch(
-            run, real_lanes=len(lanes)
+            run, real_lanes=len(lanes), tag="tiles"
         )
         t_ready = time.perf_counter()
         t_h2d = stamps.get("h2d", t0)
@@ -664,6 +684,199 @@ class DeviceEncodeDispatcher:
             streams, lengths, t_ready, lanes, sizes, bit_depth,
             color_type,
         )
+
+    def _mesh_dynamic_group(
+        self, tiles, rows, row_bytes, bpp, filter_mode,
+        lanes, sizes, bit_depth, color_type,
+    ):
+        """Dynamic-Huffman on the mesh: the two-pass chain with the
+        host Huffman-plan hop threaded BETWEEN two sharded programs —
+        pass 1 (filter + histogram) sharded, the (B, 286) counts
+        pulled (a few KB), the per-lane code tables built on host, and
+        pass 2 (emit) sharded with every table array sharded alongside
+        its lanes. Both passes run inside ONE managed dispatch: a chip
+        failing in either pass (or the hop's pull) degrades the mesh
+        through the same probe-shrink-retry, and the retry re-runs the
+        whole two-pass chain on the survivors. Pad lanes keep the
+        prefilled fixed tables, exactly like the single-device path,
+        so mesh dynamic bytes == single-device dynamic bytes."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.device_deflate import build_dynamic_tables
+        from ..parallel.sharding import (
+            shard_batch,
+            sharded_dynamic_emit,
+            sharded_filter_histogram,
+        )
+
+        t0 = time.perf_counter()
+        stamps = {}
+
+        def run(mesh):
+            n = mesh.shape["data"]
+            b = tiles.shape[0]
+            padded_b = _mesh_padded_lanes(b, n)
+            batch = jnp.asarray(tiles)
+            if padded_b != b:
+                batch = jnp.pad(
+                    batch,
+                    ((0, padded_b - b),) + ((0, 0),) * (batch.ndim - 1),
+                )
+            sharded = shard_batch(mesh, batch)
+            jax.block_until_ready(sharded)  # ompb-lint: disable=jax-hotpath -- H2D stage boundary on the readback worker
+            stamps["h2d"] = time.perf_counter()
+            flat, counts, extras = sharded_filter_histogram(
+                mesh, sharded, rows, row_bytes, bpp,
+                filter_mode=filter_mode,
+            )
+            counts_np, extras_np = jax.device_get((counts, extras))  # ompb-lint: disable=jax-hotpath -- readback worker: the dynamic host hop (pass-1 counts, a few KB)
+            stamps["hist"] = time.perf_counter()
+            tables = build_dynamic_tables(counts_np, extras_np, real=b)
+            out = sharded_dynamic_emit(
+                mesh, flat, tables, packer=self._packer
+            )
+            return jax.block_until_ready(out)  # ompb-lint: disable=jax-hotpath -- readback worker: the one thread that waits on device completion
+
+        streams, lengths = self.mesh_manager.dispatch(
+            run, real_lanes=len(lanes), tag="dynamic"
+        )
+        t_ready = time.perf_counter()
+        t_h2d = stamps.get("h2d", t0)
+        t_hist = stamps.get("hist", t_h2d)
+        self._note_launch(t_h2d)
+        _observe_stage(t_h2d - t0, "h2d")
+        _observe_stage(t_hist - t_h2d, "hist")
+        _observe_stage(t_ready - t_hist, "emit")
+        self._note_compute_done(t_ready, t_ready - t_h2d)
+        return self._pull_and_frame(
+            streams, lengths, t_ready, lanes, sizes, bit_depth,
+            color_type,
+        )
+
+    # -- mesh-fused super-tile (readback worker) -----------------------
+
+    def submit_supertile(
+        self,
+        stack,
+        index_tables,
+        color_luts,
+        rel_rects: Sequence[Tuple[int, int, int, int]],
+        tile_w: int,
+        tile_h: int,
+        filter_mode: str,
+        deflate_mode: str,
+        lanes: Sequence[int],
+    ) -> "concurrent.futures.Future":
+        """Enqueue one mesh-fused SUPER-TILE group: ``stack`` is the
+        staged (C, H, W) unsigned bounding-rect stack (host ndarray),
+        ``rel_rects`` the lanes' (x, y, w, h) rectangles relative to
+        it — one homogeneous (tile_w, tile_h) size class. The whole
+        composite + carve + filter + deflate chain runs as ONE sharded
+        program over per-chip overlapped sub-rect windows
+        (render/supertile.plan_mesh_partition carves them INSIDE the
+        managed dispatch, so a probe-shrink retry re-plans for the
+        surviving width). Resolves to {lane_index: png_bytes}."""
+        return self._enqueue(
+            self._stage_supertile_group,
+            stack, index_tables, color_luts, list(rel_rects),
+            tile_w, tile_h, filter_mode, deflate_mode, list(lanes),
+        )
+
+    def _stage_supertile_group(
+        self, stack, index_tables, color_luts, rel_rects,
+        tile_w, tile_h, filter_mode, deflate_mode, lanes,
+    ):
+        # mesh-only entry point (the pipeline routes single-device
+        # groups through composite_carve_batch + submit instead);
+        # like every sharded group it runs wholly on the readback
+        # worker so the blocking dispatch stays inside MeshManager
+        return self._readback.submit(
+            self._tid_bound(self._mesh_supertile_group),
+            stack, index_tables, color_luts, rel_rects,
+            tile_w, tile_h, filter_mode, deflate_mode, lanes,
+        )
+
+    def _mesh_supertile_group(
+        self, stack, index_tables, color_luts, rel_rects,
+        tile_w, tile_h, filter_mode, deflate_mode, lanes,
+    ):
+        """One mesh-fused super-tile on the readback worker: plan the
+        per-chip overlapped windows, slice them out of the staged
+        stack, and run composite + carve + filter + deflate as one
+        sharded program. The result rows come back chip-major with
+        pow2 slot padding interleaved, so the pull selects the real
+        rows through the partition's row map instead of the leading-
+        rows convention ``_pull_and_frame`` assumes."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.png import frame_png
+        from ..parallel.sharding import sharded_supertile_carve_deflate
+        from ..render.supertile import plan_mesh_partition
+
+        t0 = time.perf_counter()
+        stamps = {}
+        c, stack_h, stack_w = stack.shape
+
+        def run(mesh):
+            # plan INSIDE the managed dispatch: a probe-shrink retry
+            # re-invokes run() with the survivors' mesh, and the
+            # partition must match the actual width
+            n = mesh.shape["data"]
+            origins, (sub_h, sub_w), coords, rows_map = (
+                plan_mesh_partition(rel_rects, stack_h, stack_w, n)
+            )
+            sub = np.stack([
+                stack[:, sy : sy + sub_h, sx : sx + sub_w]
+                for (sy, sx) in origins
+            ])
+            sub_dev = jnp.asarray(sub)
+            coords_dev = jnp.asarray(coords)
+            jax.block_until_ready(sub_dev)  # ompb-lint: disable=jax-hotpath -- H2D stage boundary on the readback worker
+            stamps["h2d"] = time.perf_counter()
+            out = sharded_supertile_carve_deflate(
+                mesh, sub_dev, index_tables, color_luts, coords_dev,
+                tile_h, tile_w, filter_mode=filter_mode,
+                deflate_mode=deflate_mode, packer=self._packer,
+            )
+            out = jax.block_until_ready(out)  # ompb-lint: disable=jax-hotpath -- readback worker: the one thread that waits on device completion
+            return out, rows_map
+
+        (streams, lengths), rows_map = self.mesh_manager.dispatch(
+            run, real_lanes=len(lanes), tag="supertile"
+        )
+        t_ready = time.perf_counter()
+        t_h2d = stamps.get("h2d", t0)
+        self._note_launch(t_h2d)
+        _observe_stage(t_h2d - t0, "h2d")
+        _observe_stage(t_ready - t_h2d, "compute")
+        self._note_compute_done(t_ready, t_ready - t_h2d)
+        # custom pull: the real rows are scattered chip-major through
+        # the slot padding, so pull the (tiny) lengths first, then the
+        # kept rows' streams bounded by their true max
+        sel = np.asarray(rows_map, dtype=np.int64)
+        lengths_np = np.asarray(jax.device_get(lengths))[sel]  # ompb-lint: disable=jax-hotpath -- readback worker: lengths pull, a few bytes per lane
+        full_cap = streams.shape[1]
+        max_len = int(lengths_np.max()) if len(lanes) else 0
+        cap = min(full_cap, 1 << max(max_len - 1, 0).bit_length())
+        streams_np = np.asarray(
+            jax.device_get(streams[:, :cap])  # ompb-lint: disable=jax-hotpath -- readback worker: the one bounded streams pull for the group
+        )[sel]
+        with self._stats_lock:
+            self._dd_cap[(tile_w, tile_h)] = min(
+                full_cap, 1 << max(2 * max_len - 1, 0).bit_length()
+            )
+        t_d2h = time.perf_counter()
+        _observe_stage(t_d2h - t_ready, "d2h")
+        out: Dict[int, bytes] = {}
+        for j, lane in enumerate(lanes):
+            out[lane] = frame_png(
+                streams_np[j, : int(lengths_np[j])].tobytes(),
+                tile_w, tile_h, 8, 2,
+            )
+        _observe_stage(time.perf_counter() - t_d2h, "frame")
+        return out
 
     # -- mesh-resize jit warmup ----------------------------------------
 
@@ -708,7 +921,13 @@ class DeviceEncodeDispatcher:
         import jax
         import jax.numpy as jnp
 
-        from ..parallel.sharding import shard_batch, sharded_filter_deflate
+        from ..ops.device_deflate import build_dynamic_tables
+        from ..parallel.sharding import (
+            shard_batch,
+            sharded_dynamic_emit,
+            sharded_filter_deflate,
+            sharded_filter_histogram,
+        )
 
         for key in shapes:
             (lane_shape, dtype_str, pow2_b, rows, row_bytes, bpp,
@@ -723,11 +942,28 @@ class DeviceEncodeDispatcher:
                     (padded_b,) + lane_shape, dtype=np.dtype(dtype_str)
                 )
                 sharded = shard_batch(mesh, batch)
-                out = sharded_filter_deflate(
-                    mesh, sharded, rows, row_bytes, bpp,
-                    filter_mode=filter_mode, deflate_mode=deflate_mode,
-                    packer=self._packer,
-                )
+                if deflate_mode == "dynamic":
+                    # the serving path is TWO sharded programs; warm
+                    # both (sharded_filter_deflate would compile a
+                    # program dynamic groups never run)
+                    flat, counts, extras = sharded_filter_histogram(
+                        mesh, sharded, rows, row_bytes, bpp,
+                        filter_mode=filter_mode,
+                    )
+                    counts_np, extras_np = jax.device_get((counts, extras))  # ompb-lint: disable=jax-hotpath -- background warmup thread: compiles ahead of the serving path
+                    tables = build_dynamic_tables(
+                        counts_np, extras_np, real=0
+                    )
+                    out = sharded_dynamic_emit(
+                        mesh, flat, tables, packer=self._packer
+                    )
+                else:
+                    out = sharded_filter_deflate(
+                        mesh, sharded, rows, row_bytes, bpp,
+                        filter_mode=filter_mode,
+                        deflate_mode=deflate_mode,
+                        packer=self._packer,
+                    )
                 jax.block_until_ready(out)  # ompb-lint: disable=jax-hotpath -- background warmup thread: compiles ahead of the serving path
                 with self._warm_lock:
                     self._warmed.add((width, key))

@@ -174,6 +174,7 @@ class TilePipeline:
         queue_depth: int = 2,
         compilation_cache_dir: Optional[str] = None,
         lut_dir: Optional[str] = None,
+        supertile_mesh: bool = True,
     ):
         self.pixels_service = pixels_service
         self.png_filter = png_filter
@@ -233,6 +234,11 @@ class TilePipeline:
         # accelerator is visible (tests inject one via `pipeline.mesh =
         # make_mesh(...)`, or force single-device with `= None`)
         self.mesh = "auto"
+        # r23: whether super-tile groups fuse ON the mesh (the sharded
+        # composite+carve+deflate chain). False reverts to the r19
+        # behavior of per-lane sharding winning over fusion (config
+        # `supertile.mesh` — the escape hatch, not the expectation)
+        self.supertile_mesh = bool(supertile_mesh)
         # Allocation guard the reference lacks (its tile-size policy
         # beans only steer pyramid writing; a full-plane request still
         # allocates w*h*bpp unchecked, TileRequestHandler.java:98-103).
@@ -620,29 +626,44 @@ class TilePipeline:
     # -- hybrid-resolution degradation (resilience/scheduler) ----------
 
     @staticmethod
-    def _degrade_plan(rt: ResolvedTile):
-        """The coarse-read + upscale plan for a degraded lane: the
-        covering region at ``rt.degrade_level`` and the per-axis
-        nearest-neighbor index maps back to the requested (h, w).
-        Pure integer math from the two levels' actual extents, so
-        non-power-of-two pyramids map correctly; for the standard 2x
-        stride pyramid this is exactly pixel (y, x) -> coarse
-        (y//2, x//2)."""
-        sx0, sy0 = rt.buffer.level_size(rt.level)
-        sx1, sy1 = rt.buffer.level_size(rt.degrade_level)
-        cx0 = rt.x * sx1 // sx0
-        cy0 = rt.y * sy1 // sy0
-        cx1 = min(sx1, ((rt.x + rt.w) * sx1 + sx0 - 1) // sx0)
-        cy1 = min(sy1, ((rt.y + rt.h) * sy1 + sy0 - 1) // sy0)
+    def _degrade_plan_rect(buffer, level, degrade_level, x, y, w, h):
+        """The coarse-read + upscale plan for ANY rectangle at
+        ``level`` served from ``degrade_level``: the covering coarse
+        region and the per-axis nearest-neighbor index maps back to
+        (h, w). Pure integer math from the two levels' actual
+        extents, so non-power-of-two pyramids map correctly; for the
+        standard 2x stride pyramid this is exactly pixel (y, x) ->
+        coarse (y//2, x//2). Rect-parameterized (not just per-lane)
+        because the fused degraded super-tile plans ITS bounding
+        rectangle through the same math — each output pixel's coarse
+        index is the absolute ``Y * sy1 // sy0``, independent of the
+        rectangle it was planned inside, which is what makes the
+        fused degraded gather byte-identical to per-lane degraded
+        reads."""
+        sx0, sy0 = buffer.level_size(level)
+        sx1, sy1 = buffer.level_size(degrade_level)
+        cx0 = x * sx1 // sx0
+        cy0 = y * sy1 // sy0
+        cx1 = min(sx1, ((x + w) * sx1 + sx0 - 1) // sx0)
+        cy1 = min(sy1, ((y + h) * sy1 + sy0 - 1) // sy0)
         cx1 = max(cx1, cx0 + 1)
         cy1 = max(cy1, cy0 + 1)
         xs = np.minimum(
-            (rt.x + np.arange(rt.w)) * sx1 // sx0, cx1 - 1
+            (x + np.arange(w)) * sx1 // sx0, cx1 - 1
         ) - cx0
         ys = np.minimum(
-            (rt.y + np.arange(rt.h)) * sy1 // sy0, cy1 - 1
+            (y + np.arange(h)) * sy1 // sy0, cy1 - 1
         ) - cy0
         return cx0, cy0, cx1 - cx0, cy1 - cy0, ys, xs
+
+    @classmethod
+    def _degrade_plan(cls, rt: ResolvedTile):
+        """One lane's coarse-read + upscale plan (the rect helper on
+        the lane's own rectangle)."""
+        return cls._degrade_plan_rect(
+            rt.buffer, rt.level, rt.degrade_level,
+            rt.x, rt.y, rt.w, rt.h,
+        )
 
     def _read_degraded(self, rt: ResolvedTile) -> np.ndarray:
         """Serve the requested region from the next-lower pyramid
@@ -1185,17 +1206,19 @@ class TilePipeline:
 
         pending: List[Tuple[List[int], object]] = []
         stacks: Dict[int, RenderLane] = {}
-        # -- super-tile fusion (r19): spatially adjacent lanes the
-        # batcher stamped execute as ONE plane gather + ONE composite,
-        # carved back into per-lane encodes. Handled lanes leave
-        # ``idxs``; any lane (or whole group) the fusion declines
-        # falls through to the independent path below unchanged. With
-        # a serving mesh, lanes keep the per-lane sharded path — the
-        # fused composite is a single-device program, and idling n-1
-        # chips to fuse would be a de-optimization.
+        # -- super-tile fusion (r19, mesh-fused since r23): spatially
+        # adjacent lanes the batcher stamped execute as ONE plane
+        # gather + ONE composite, carved back into per-lane encodes.
+        # Handled lanes leave ``idxs``; any lane (or whole group) the
+        # fusion declines falls through to the independent path below
+        # unchanged. On a serving mesh the fused chain itself
+        # shard_maps over per-chip sub-rects of the bounding
+        # rectangle (every chip composites ITS window), so fusion no
+        # longer idles n-1 chips; `supertile.mesh: false` restores
+        # the old per-lane-sharded preference.
         fused_done: set = set()
         mesh = self._get_mesh() if self.use_device else None
-        if mesh is None:
+        if mesh is None or self.supertile_mesh:
             st_groups: Dict[int, List[int]] = {}
             st_order: List[int] = []
             for i in idxs:
@@ -1507,13 +1530,11 @@ class TilePipeline:
                         batch[j, :, :h, :w] = stacks[i].stack
                 mask_batch = None
                 if has_mask:
-                    # bucket pad masks to 0: pad pixels composite to
-                    # black, and their bytes are sliced away anyway
-                    mask_batch = np.zeros(
-                        (len(lanes), bh, bw), dtype=np.uint8
+                    from ..render.masks import bucket_mask_batch
+
+                    mask_batch = bucket_mask_batch(
+                        [stacks[i].mask for i in lanes], bh, bw
                     )
-                    for j, i in enumerate(lanes):
-                        mask_batch[j, :h, :w] = stacks[i].mask
                 disp = self._get_dispatcher()
                 with TRACER.start_span("render_device"):
                     fut = disp.submit_render(
@@ -1618,12 +1639,16 @@ class TilePipeline:
         out and fed to the existing per-lane encode paths. Returns
         the lane indices this fusion HANDLED (result written or fused
         group queued); everything else — a lane that re-validates out
-        (degraded permit, spent deadline, failed resolve) or a whole
-        group the fusion declines (over budget, unrenderable spec,
-        gather failure) — is left for the independent path, so a
-        split lane never poisons its neighbors. Registered per-lane
-        carved stacks back the host-mirror fallback of the fused
-        device group (byte-identical by the engine contract)."""
+        (off-modal degrade level, spent deadline, failed resolve) or
+        a whole group the fusion declines (over budget, unrenderable
+        spec, gather failure) — is left for the independent path, so
+        a split lane never poisons its neighbors. Degraded groups
+        fuse per resolved pyramid level (one coarse gather + one
+        upscale, byte-identical to per-lane degraded reads by the
+        absolute-index argument in ``_degrade_plan_rect``).
+        Registered per-lane carved stacks back the host-mirror
+        fallback of the fused device group (byte-identical by the
+        engine contract)."""
         from ..render import engine as rengine
         from ..render import supertile as stile
         from ..render.engine import (
@@ -1639,11 +1664,21 @@ class TilePipeline:
             rt, ctx = resolved[i], ctxs[i]
             if rt is None or results[i] is not None:
                 continue  # failed/expired resolve, or already marked
-            if rt.degrade_level is not None or ctx.degraded:
-                continue  # degraded permits never fuse with full-res
             if ctx.deadline is not None and ctx.deadline.expired:
                 continue
             live.append(i)
+        # degraded lanes fuse per PYRAMID LEVEL: the stamp key carries
+        # only the degraded flag (pre-resolve), but the resolved
+        # degrade level can differ per lane (and resolve may clear the
+        # flag entirely when no coarser level exists) — keep the modal
+        # level's lanes, return the rest to the independent path
+        by_level: Dict[Optional[int], List[int]] = {}
+        for i in live:
+            by_level.setdefault(resolved[i].degrade_level, []).append(i)
+        if len(by_level) > 1:
+            keep = max(by_level.values(), key=len)
+            stile.SUPERTILE_FALLBACK.inc(len(live) - len(keep))
+            live = keep
         if len(live) < 2:
             stile.SUPERTILE_FALLBACK.inc(len(live))
             return set()
@@ -1683,23 +1718,41 @@ class TilePipeline:
             stile.SUPERTILE_FALLBACK.inc(len(live))
             return set()
         # ONE plane gather over the bounding rectangle, through the
-        # HBM plane cache when the planes are resident
+        # HBM plane cache when the planes are resident. A degraded
+        # group gathers the COARSE covering rect of the bounding
+        # rectangle and upscales once — each output pixel's coarse
+        # index is absolute (see _degrade_plan_rect), so the fused
+        # upscale is byte-identical to per-lane degraded reads.
         buf = rt0.buffer
-        coords = [
-            (z, ch.index, t, bx, by, bw_, bh_)
-            for ch in chans for (z, t) in zts
-        ]
+        dlevel = rt0.degrade_level
+        upscale = None
+        if dlevel is not None:
+            cx0, cy0, crw, crh, uys, uxs = self._degrade_plan_rect(
+                buf, rt0.level, dlevel, bx, by, bw_, bh_
+            )
+            coords = [
+                (z, ch.index, t, cx0, cy0, crw, crh)
+                for ch in chans for (z, t) in zts
+            ]
+            upscale = (uys, uxs, crh, crw)
+        else:
+            coords = [
+                (z, ch.index, t, bx, by, bw_, bh_)
+                for ch in chans for (z, t) in zts
+            ]
         use_hbm = (
-            self.use_device
+            upscale is None
+            and self.use_device
             and self.use_plane_cache
             and getattr(buf, "samples", 1) == 1
             and dtype.itemsize <= 4
         )
+        read_level = rt0.level if dlevel is None else dlevel
         slots: List[Optional[np.ndarray]] = [None] * len(coords)
         missing, owners = [], []
         for j, coord in enumerate(coords):
             arr = (
-                self._plane_cache_region(buf, rt0.level, coord)
+                self._plane_cache_region(buf, read_level, coord)
                 if use_hbm else None
             )
             if arr is not None:
@@ -1709,7 +1762,7 @@ class TilePipeline:
                 owners.append(j)
         try:
             if missing:
-                fetched = buf.read_tiles(missing, level=rt0.level)
+                fetched = buf.read_tiles(missing, level=read_level)
                 for j, arr in zip(owners, fetched):
                     slots[j] = arr
         except _UNAVAILABLE as e:
@@ -1728,11 +1781,17 @@ class TilePipeline:
             stile.SUPERTILE_FALLBACK.inc(len(live))
             return set()
         try:
-            stack, tspec, tdtype = self._stage_stack(
-                np.stack(slots).reshape(
+            if upscale is not None:
+                uys, uxs, crh, crw = upscale
+                raw = np.stack(slots).reshape(
+                    len(chans), len(zts), crh, crw
+                )[:, :, uys[:, None], uxs[None, :]]
+            else:
+                raw = np.stack(slots).reshape(
                     len(chans), len(zts), bh_, bw_
-                ),
-                spec, chans, dtype, device_project=use_fused,
+                )
+            stack, tspec, tdtype = self._stage_stack(
+                raw, spec, chans, dtype, device_project=use_fused,
             )
         except Exception:
             log.exception(
@@ -1768,6 +1827,41 @@ class TilePipeline:
                 import jax
 
                 tables, luts = self._render_tables_for(tspec, tdtype)
+                disp = self._get_dispatcher()
+                size_groups: Dict[Tuple[int, int], List[int]] = {}
+                for j, i in enumerate(live):
+                    rt = resolved[i]
+                    size_groups.setdefault((rt.w, rt.h), []).append(j)
+                if (
+                    self.supertile_mesh
+                    and disp.mesh_manager is not None
+                ):
+                    # mesh-fused chain: composite + carve + filter +
+                    # deflate shard over per-chip overlapped sub-rects
+                    # of the bounding stack (one sharded program per
+                    # homogeneous size class); byte-identical to the
+                    # single-device fused path by the same pointwise
+                    # carve argument, pinned in tests/test_mesh_fusion
+                    with TRACER.start_span("supertile_mesh"):
+                        for (w, h), js in size_groups.items():
+                            lane_ids = [live[j] for j in js]
+                            rel_rects = [
+                                (rel[j][0], rel[j][1], w, h)
+                                for j in js
+                            ]
+                            try:
+                                fut = disp.submit_supertile(
+                                    stack, tables, luts, rel_rects,
+                                    w, h, fmode, "rle", lane_ids,
+                                )
+                            except Exception as e:
+                                # this subgroup alone degrades through
+                                # the normal drain fallback
+                                fut = concurrent.futures.Future()
+                                fut.set_exception(e)
+                            pending.append((lane_ids, fut))
+                    stile.SUPERTILE_LANES.inc(len(live), path="mesh")
+                    return set(live)
                 bw_b, bh_b = bucket
                 with TRACER.start_span("supertile_device"):
                     stack_dev = jax.device_put(stack)
@@ -1775,13 +1869,6 @@ class TilePipeline:
                         stack_dev, tables, luts,
                         [(ry, rx) for (rx, ry) in rel], bh_b, bw_b,
                     )
-                    disp = self._get_dispatcher()
-                    size_groups: Dict[Tuple[int, int], List[int]] = {}
-                    for j, i in enumerate(live):
-                        rt = resolved[i]
-                        size_groups.setdefault(
-                            (rt.w, rt.h), []
-                        ).append(j)
                     for (w, h), js in size_groups.items():
                         lane_ids = [live[j] for j in js]
                         try:

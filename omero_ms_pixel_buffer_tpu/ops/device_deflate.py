@@ -855,16 +855,20 @@ def _dyn_lane_tokens(payload, lit_b, lit_n, ml_b, ml_n):
     return bits, nbits
 
 
-@partial(jax.jit, static_argnames=("packer", "interpret"))
-def _zlib_dynamic(
+def dynamic_emit_local(
     payloads, hdr_b, hdr_n, lit_b, lit_n, ml_b, ml_n, eob_b, eob_n,
     packer: str = "scan", interpret: bool = False,
 ):
-    """Pass 2: emit header ++ body ++ explicit EOB through the per-lane
-    tables and pack. Capacity argument: the host plan only selects
-    dynamic when its exact total (header included) beats fixed, so
-    every lane's bits fit the fixed worst-case ``_packing_maxbits``
-    and the stream cap stays ``max_stream_len(L)``."""
+    """Un-jitted pass-2 core: emit header ++ body ++ explicit EOB
+    through the per-lane tables and pack. Traceable under jit, vmap,
+    and shard_map — every table operand is (B, ...)-shaped along the
+    lane axis, so parallel/sharding.py shards ALL of them with the
+    payloads and each chip emits its slice with its lanes' own codes
+    (what lets mesh lanes keep dynamic instead of downgrading to
+    rle). Capacity argument: the host plan only selects dynamic when
+    its exact total (header included) beats fixed, so every lane's
+    bits fit the fixed worst-case ``_packing_maxbits`` and the stream
+    cap stays ``max_stream_len(L)``."""
     body_b, body_n = jax.vmap(_dyn_lane_tokens)(
         payloads, lit_b, lit_n, ml_b, ml_n
     )
@@ -881,6 +885,11 @@ def _zlib_dynamic(
     return jax.vmap(partial(_frame_lane, eob_bits=0))(
         payloads, packed, body_bits
     )
+
+
+_zlib_dynamic = partial(jax.jit, static_argnames=("packer", "interpret"))(
+    dynamic_emit_local
+)
 
 
 def zlib_dynamic_batch(

@@ -241,12 +241,20 @@ class MeshManager:
                 )
         return healed
 
-    def dispatch(self, fn, real_lanes: Optional[int] = None):
+    def dispatch(
+        self,
+        fn,
+        real_lanes: Optional[int] = None,
+        tag: Optional[str] = None,
+    ):
         """Run ``fn(mesh)`` on the healthy mesh; on failure, probe the
         chips, shrink to the survivors, and retry once. Successful
         dispatches record per-device lane accounting in
         ``last_dispatch`` and a success on every participating
-        breaker."""
+        breaker. ``tag`` names the program family ("tiles" / "render"
+        / "dynamic" / "supertile") in ``last_dispatch`` so tests and
+        the multichip dryrun can assert WHICH mesh chain actually
+        executed, not just that one did."""
         from ..resilience.faultinject import INJECTOR
 
         mesh = self.mesh()
@@ -275,6 +283,7 @@ class MeshManager:
                 lane_counts(real_lanes, int(n))
                 if real_lanes is not None else None
             ),
+            "tag": tag,
         }
         return out
 

@@ -202,6 +202,29 @@ def _sharded_render_filter_deflate(
     return fn(planes, index_tables, color_luts)
 
 
+@partial(jax.jit, static_argnums=(0, 5, 6, 7, 8, 9, 10))
+def _sharded_render_filter_deflate_masked(
+    mesh, planes, index_tables, color_luts, mask, rows, row_bytes,
+    filter_mode, deflate_mode, packer, axis,
+):
+    from ..ops.device_deflate import _interpret_for
+    from ..render.engine import render_filter_deflate_local
+
+    interpret = _interpret_for(packer)
+    fn = shard_map(
+        lambda blk, tab, lut, msk: render_filter_deflate_local(
+            blk, tab, lut, rows, row_bytes, filter_mode, deflate_mode,
+            packer, interpret, mask=msk,
+        ),
+        mesh=mesh,
+        # the (B, H, W) ROI mask batch shards WITH its lanes; only the
+        # per-channel tables replicate
+        in_specs=(P(axis), P(), P(), P(axis)),
+        out_specs=(P(axis), P(axis)),
+    )
+    return fn(planes, index_tables, color_luts, mask)
+
+
 def sharded_render_filter_deflate(
     mesh: Mesh,
     planes: jax.Array,
@@ -213,6 +236,7 @@ def sharded_render_filter_deflate(
     deflate_mode: str = "rle",
     packer: Optional[str] = None,
     axis: str = "data",
+    mask=None,
 ) -> tuple:
     """The multi-chip RENDER dispatch: the fused composite + filter +
     deflate chain (render/engine.render_filter_deflate_local) mapped
@@ -223,14 +247,204 @@ def sharded_render_filter_deflate(
 
     planes (B, C, H, W) unsigned with B divisible by the mesh axis
     (pad with ``pad_batch``) -> ((B, cap) uint8 streams, (B,) int32
-    lengths), both batch-sharded."""
+    lengths), both batch-sharded. ``mask`` (optional) is a (B, H, W)
+    uint8 ROI batch sharded along with its lanes — the mask multiply
+    is pointwise int, so masked mesh bytes stay identical to the
+    single-device and host-mirror bytes (masked groups no longer
+    split to one chip)."""
     from ..ops.device_deflate import default_packer
 
     packer = packer or default_packer()
+    if mask is not None:
+        return _sharded_render_filter_deflate_masked(
+            mesh, planes, jnp.asarray(index_tables),
+            jnp.asarray(color_luts), jnp.asarray(mask), rows,
+            row_bytes, filter_mode, deflate_mode, packer, axis,
+        )
     return _sharded_render_filter_deflate(
         mesh, planes, jnp.asarray(index_tables),
         jnp.asarray(color_luts), rows, row_bytes, filter_mode,
         deflate_mode, packer, axis,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Two-pass dynamic deflate on the mesh — the host Huffman-plan hop rides
+# BETWEEN two sharded programs, so mesh lanes keep content-adaptive codes
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnums=(0, 2, 3, 4, 5, 6))
+def _sharded_filter_histogram(
+    mesh, tiles, rows, row_bytes, bpp, filter_mode, axis
+):
+    from ..ops.device_deflate import _filter_histogram_core
+
+    fn = shard_map(
+        lambda blk: _filter_histogram_core(
+            blk, rows, row_bytes, bpp, filter_mode
+        ),
+        mesh=mesh,
+        in_specs=P(axis),
+        out_specs=(P(axis), P(axis), P(axis)),
+    )
+    return fn(tiles)
+
+
+def sharded_filter_histogram(
+    mesh: Mesh,
+    tiles: jax.Array,
+    rows: int,
+    row_bytes: int,
+    bpp: int,
+    filter_mode: str = "up",
+    axis: str = "data",
+) -> tuple:
+    """Dynamic pass 1 over the mesh: byteswap + PNG filter + flatten +
+    per-lane symbol histogram as ONE sharded program. tiles (B, H,
+    W[, S]) with B divisible by the axis -> ((B, L) uint8 payloads,
+    (B, 286) int32 counts, (B,) extra-bit totals), all batch-sharded.
+    The payloads STAY device-resident for pass 2; only the counts (a
+    few KB) cross to the host for the per-lane Huffman plan."""
+    return _sharded_filter_histogram(
+        mesh, tiles, rows, row_bytes, bpp, filter_mode, axis
+    )
+
+
+@partial(jax.jit, static_argnums=(0, 10, 11))
+def _sharded_dynamic_emit(
+    mesh, flat, hdr_b, hdr_n, lit_b, lit_n, ml_b, ml_n, eob_b, eob_n,
+    packer, axis,
+):
+    from ..ops.device_deflate import _interpret_for, dynamic_emit_local
+
+    interpret = _interpret_for(packer)
+    fn = shard_map(
+        lambda p, hb, hn, lb, ln, mb, mn, eb, en: dynamic_emit_local(
+            p, hb, hn, lb, ln, mb, mn, eb, en, packer, interpret
+        ),
+        mesh=mesh,
+        # every emit table is (B, ...)-shaped along the lane axis, so
+        # each chip carries ITS lanes' codes — no replication at all
+        in_specs=tuple([P(axis)] * 9),
+        out_specs=(P(axis), P(axis)),
+    )
+    return fn(flat, hdr_b, hdr_n, lit_b, lit_n, ml_b, ml_n, eob_b, eob_n)
+
+
+def sharded_dynamic_emit(
+    mesh: Mesh,
+    flat: jax.Array,
+    tables: tuple,
+    packer: Optional[str] = None,
+    axis: str = "data",
+) -> tuple:
+    """Dynamic pass 2 over the mesh: the per-lane-table emit
+    (ops/device_deflate.dynamic_emit_local) sharded along the lane
+    axis, with the 8 host-built table arrays sharded alongside their
+    lanes. Per-lane math is chip-independent, so mesh dynamic bytes
+    are identical to the single-device two-pass bytes on the same
+    lanes."""
+    from ..ops.device_deflate import default_packer
+
+    packer = packer or default_packer()
+    table_dev = tuple(
+        jax.device_put(t, NamedSharding(mesh, P(axis))) for t in tables
+    )
+    return _sharded_dynamic_emit(
+        mesh, flat, *table_dev, packer, axis
+    )
+
+
+# ---------------------------------------------------------------------------
+# Mesh-fused super-tile: composite + carve + filter + deflate, sharded
+# over per-chip overlapped sub-rects of the bounding rectangle
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnums=(0, 5, 6, 7, 8, 9, 10, 11, 12))
+def _sharded_supertile_carve_deflate(
+    mesh, sub_stacks, index_tables, color_luts, coords, bh, bw,
+    rows, row_bytes, filter_mode, deflate_mode, packer, axis,
+):
+    from jax import lax
+
+    from ..ops.device_deflate import _interpret_for, _streams_core
+    from ..ops.png import _filter_batch
+    from ..render.engine import render_local
+
+    interpret = _interpret_for(packer)
+
+    def local(blk, coords_blk, tab, lut):
+        # blk: (1, C, Hs, Ws) — this chip's overlapped sub-rect of the
+        # super-tile; coords_blk: (1, L, 2) local (y, x) tile origins
+        rgb = render_local(blk, tab, lut)[0]
+        # pad beyond the sub-rect so an edge tile's static-size carve
+        # never clamps (dynamic_slice would silently shift the origin);
+        # pad pixels can only reach a carved tile's own pad region,
+        # whose bytes the stream build slices away
+        rgb = jnp.pad(rgb, ((0, bh), (0, bw), (0, 0)))
+
+        def one(y0, x0):
+            return lax.dynamic_slice(rgb, (y0, x0, 0), (bh, bw, 3))
+
+        carved = jax.vmap(one)(coords_blk[0, :, 0], coords_blk[0, :, 1])
+        scanrows = carved.reshape(carved.shape[0], bh, bw * 3)
+        filtered = _filter_batch(scanrows, 3, filter_mode)
+        flat = filtered[:, :rows, :row_bytes].reshape(
+            filtered.shape[0], -1
+        )
+        return _streams_core(flat, deflate_mode, packer, interpret)
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(), P()),
+        out_specs=(P(axis), P(axis)),
+    )
+    return fn(sub_stacks, coords, index_tables, color_luts)
+
+
+def sharded_supertile_carve_deflate(
+    mesh: Mesh,
+    sub_stacks: jax.Array,
+    index_tables,
+    color_luts,
+    coords: jax.Array,
+    bh: int,
+    bw: int,
+    filter_mode: str = "up",
+    deflate_mode: str = "rle",
+    packer: Optional[str] = None,
+    axis: str = "data",
+) -> tuple:
+    """The mesh-fused super-tile chain: each chip composites ITS
+    overlapped sub-rect of the super-tile bounding rectangle, carves
+    its lanes' (bh, bw) tiles out with a vmapped ``dynamic_slice``,
+    PNG-filters, and deflates — composite through zlib stream as ONE
+    sharded program, with only the per-channel tables replicated.
+
+    ``sub_stacks`` is (n_chips, C, Hs, Ws) unsigned — the per-chip
+    sub-rect windows (overlap between neighboring chips' windows IS
+    the halo: the composite itself is pointwise, so the halo exists
+    purely so every lane's rectangle lies wholly inside one chip's
+    window). ``coords`` is (n_chips, L, 2) int32 per-chip local
+    (y, x) tile origins, slot-padded with (0, 0) dummies. Returns
+    ((n_chips*L, cap) uint8 streams, (n_chips*L,) int32 lengths) in
+    chip-major slot order — the caller keeps only its real slots.
+
+    Byte identity is the single-device fused argument verbatim: the
+    composite is pointwise (a pixel's value cannot depend on which
+    sub-rect rendered it), PNG filters reference only up/left inside
+    the carved tile, and the stream consumes exactly the tile's
+    sliced scanline bytes."""
+    from ..ops.device_deflate import default_packer
+
+    packer = packer or default_packer()
+    return _sharded_supertile_carve_deflate(
+        mesh, sub_stacks, jnp.asarray(index_tables),
+        jnp.asarray(color_luts), coords, bh, bw, bh, 1 + bw * 3,
+        filter_mode, deflate_mode, packer, axis,
     )
 
 

@@ -273,6 +273,19 @@ def mask_signature(shapes: Tuple[ShapeSpec, ...]) -> str:
     return ",".join(s.token() for s in shapes)
 
 
+def bucket_mask_batch(masks, bh: int, bw: int) -> np.ndarray:
+    """Assemble per-lane (h, w) rasters into one (B, bh, bw) uint8
+    bucket batch, pad pixels 0: pad pixels composite to black, and
+    their bytes are sliced away by the stream build anyway. Shared by
+    the single-device fused render dispatch and the mesh chain — the
+    batch is exactly what shards along the lane axis, so masked
+    groups no longer split to a single device."""
+    out = np.zeros((len(masks), bh, bw), dtype=np.uint8)
+    for j, m in enumerate(masks):
+        out[j, : m.shape[0], : m.shape[1]] = m
+    return out
+
+
 class MaskRasterCache:
     """Byte-budgeted LRU of per-tile mask rasters, keyed
     (image namespace, shape-set signature, region). Shapes arrive per

@@ -24,8 +24,11 @@ Three pieces live here, used by two layers:
 - ``assign_supertiles`` — adjacency bucketing, called by the
   dispatch batcher (dispatch/batcher.py) on every coalesced batch:
   groups candidate render lanes by fuse key (same image / spec /
-  resolution / plane; degraded, masked, and expired lanes never
-  fuse), clusters each group's rectangles into spatial neighborhoods
+  resolution / plane / degrade flag; masked and expired lanes never
+  fuse, degraded lanes fuse only with other degraded lanes — the
+  pipeline re-checks the resolved pyramid levels agree before
+  executing), clusters each group's rectangles into spatial
+  neighborhoods
   (adapter ``BurstHint`` grids take an O(n) grid walk; hintless lanes
   pay a pairwise touch sweep), splits clusters by the configured
   pixel budget, and stamps each surviving group onto its lanes'
@@ -96,16 +99,16 @@ class SuperTileGroup:
 def _fuse_key(ctx) -> Optional[tuple]:
     """The same-spec bucketing key, or None when the lane must never
     fuse. Deliberately narrow (KNOWN_GAPS documents the scope):
-    render PNG/JPEG lanes only, full resolution only (a degraded
-    permit reads a coarser level — fusing it with full-res lanes
-    would gather the wrong pyramid rung), no ROI masks (per-tile
-    rasters serve through the per-lane paths), explicit regions only.
-    No session component — like ``handle_batch``'s per-image read
-    grouping, every lane still authorizes itself in ``resolve()``."""
+    render PNG/JPEG lanes only, no ROI masks (per-tile rasters serve
+    through the per-lane paths), explicit regions only. Degraded
+    lanes carry the flag IN the key — they fuse with each other (the
+    pipeline re-validates that the resolved degrade LEVELS agree, so
+    lanes reading different pyramid rungs still split), never with
+    full-res lanes. No session component — like ``handle_batch``'s
+    per-image read grouping, every lane still authorizes itself in
+    ``resolve()``."""
     spec = ctx.render
     if spec is None or ctx.analysis is not None:
-        return None
-    if ctx.degraded:
         return None
     if getattr(spec, "masks", None):
         return None
@@ -116,7 +119,7 @@ def _fuse_key(ctx) -> Optional[tuple]:
         return None
     return (
         ctx.image_id, ctx.resolution, ctx.z, ctx.t, ctx.format,
-        spec.signature(),
+        spec.signature(), bool(ctx.degraded),
     )
 
 
@@ -203,28 +206,69 @@ def bounding_rect(
     return (x0, y0, x1 - x0, y1 - y0)
 
 
+def _fits(
+    trial: List[int], rects: List[tuple], max_pixels: int,
+    min_coverage: float,
+) -> bool:
+    bx, by, bw, bh = bounding_rect([rects[j] for j in trial])
+    area = bw * bh
+    covered = sum(rects[j][2] * rects[j][3] for j in trial)
+    return area <= max_pixels and covered >= min_coverage * area
+
+
 def _split_by_budget(
     comp: List[int],
     rects: List[tuple],
     max_pixels: int,
     min_coverage: float,
+    hint: Optional[BurstHint] = None,
 ) -> List[List[int]]:
-    """Greedy row-major split of one spatial component: accumulate
-    lanes while the running bounding rectangle stays inside the pixel
-    budget AND the covered fraction stays above ``min_coverage`` (a
-    sparse diagonal would otherwise gather mostly pixels nobody
-    asked for)."""
+    """Split one spatial component to fit the pixel budget while the
+    covered fraction stays above ``min_coverage`` (a sparse diagonal
+    would otherwise gather mostly pixels nobody asked for).
+
+    With a ``BurstHint`` the cuts are tile-GRID-aligned: whole grid
+    rows accumulate until the next row would bust the budget, and a
+    row too wide on its own splits at grid columns — fragments stay
+    rectangular viewport bands instead of the arbitrary-lane greedy
+    cut (KNOWN_GAPS "Pixel-budget ceiling"), so each fragment fuses
+    as a denser super-tile. Hintless components keep the greedy
+    row-major accumulation. Either way a fragment is simply a smaller
+    super-tile, so carved bytes stay identical by the pointwise
+    contract."""
     order = sorted(comp, key=lambda i: (rects[i][1], rects[i][0]))
     groups: List[List[int]] = []
-    cur: List[int] = []
+    if hint is not None and hint.tile_w > 0 and hint.tile_h > 0:
+        # bucket the component into grid rows, then accumulate whole
+        # rows; a single over-budget row recurses hintless (its lanes
+        # are already one band, so the greedy cut IS column-aligned)
+        rows: Dict[int, List[int]] = {}
+        for i in order:
+            rows.setdefault(rects[i][1] // hint.tile_h, []).append(i)
+        cur: List[int] = []
+        for _, row in sorted(rows.items()):
+            if cur and not _fits(
+                cur + row, rects, max_pixels, min_coverage
+            ):
+                groups.append(cur)
+                cur = []
+            if not cur and not _fits(
+                row, rects, max_pixels, min_coverage
+            ):
+                groups.extend(
+                    _split_by_budget(
+                        row, rects, max_pixels, min_coverage
+                    )
+                )
+                continue
+            cur += row
+        if cur:
+            groups.append(cur)
+        return groups
+    cur = []
     for i in order:
         trial = cur + [i]
-        bx, by, bw, bh = bounding_rect([rects[j] for j in trial])
-        area = bw * bh
-        covered = sum(rects[j][2] * rects[j][3] for j in trial)
-        if cur and (
-            area > max_pixels or covered < min_coverage * area
-        ):
+        if cur and not _fits(trial, rects, max_pixels, min_coverage):
             groups.append(cur)
             cur = [i]
         else:
@@ -261,16 +305,17 @@ def assign_supertiles(
         if any(w * h > max_pixels for (_, _, w, h) in rects):
             continue
         hints = {getattr(ctxs[i], "burst", None) for i in lane_ids}
+        hint = next(iter(hints)) if len(hints) == 1 else None
         comps = None
-        if len(hints) == 1:
-            hint = next(iter(hints))
-            if hint is not None:
-                comps = _grid_components(rects, hint)
+        if hint is not None:
+            comps = _grid_components(rects, hint)
+            if comps is None:
+                hint = None  # off-grid lanes: no grid-aligned cuts
         if comps is None:
             comps = _components(rects)
         for comp in comps:
             for group in _split_by_budget(
-                comp, rects, max_pixels, min_coverage
+                comp, rects, max_pixels, min_coverage, hint=hint
             ):
                 if len(group) < min_lanes:
                     continue
@@ -334,3 +379,90 @@ def carve_host(
     super-tile RGB (pixels identical to the device carve's real
     region by the engine's pointwise contract)."""
     return rgb[y : y + h, x : x + w]
+
+
+# ---------------------------------------------------------------------------
+# Mesh partition planning: per-chip overlapped sub-rect windows
+# ---------------------------------------------------------------------------
+
+
+def plan_mesh_partition(
+    rel_rects: Sequence[Tuple[int, int, int, int]],
+    stack_h: int,
+    stack_w: int,
+    n_chips: int,
+) -> Tuple[
+    List[Tuple[int, int]], Tuple[int, int], np.ndarray, List[int]
+]:
+    """Carve a super-tile's lanes into per-chip overlapped sub-rect
+    windows of the staged bounding stack, for the mesh-fused chain
+    (parallel/sharding.sharded_supertile_carve_deflate).
+
+    ``rel_rects`` are the lanes' (x, y, w, h) rectangles RELATIVE to
+    the bounding rect (one homogeneous (w, h) size class — the caller
+    partitions by size first). Lanes sort row-major and split into
+    balanced contiguous chunks, one per chip; each chip's window is
+    the bounding rect of its lanes extended to the common (sub_h,
+    sub_w) by sliding the origin WITHIN the full stack — so windows
+    overlap rather than zero-fill, and the overlap between neighboring
+    chips' windows IS the halo (sized by whatever the carve footprint
+    needs; the composite itself is pointwise, so the halo exists
+    purely so each lane's rectangle lies wholly inside one chip's
+    window).
+
+    Returns ``(origins, (sub_h, sub_w), coords, rows)``:
+
+    - ``origins``: n_chips (sy, sx) window origins into the stack;
+    - ``(sub_h, sub_w)``: the common window size (fits inside the
+      stack by construction, so slicing never clamps);
+    - ``coords``: (n_chips, L, 2) int32 window-local (y, x) tile
+      origins with L = pow2(max lanes/chip), dummy slots at (0, 0)
+      (their carved bytes are simply never read back);
+    - ``rows``: for each input lane (in ``rel_rects`` order) its
+      global output row ``chip * L + slot`` in the sharded program's
+      chip-major result.
+    """
+    n = len(rel_rects)
+    order = sorted(
+        range(n), key=lambda i: (rel_rects[i][1], rel_rects[i][0])
+    )
+    base, rem = divmod(n, n_chips)
+    chunks: List[List[int]] = []
+    pos = 0
+    for c in range(n_chips):
+        size = base + (1 if c < rem else 0)
+        chunks.append(order[pos : pos + size])
+        pos += size
+    cap = max((len(ch) for ch in chunks), default=1) or 1
+    L = 1 << (cap - 1).bit_length()
+    sub_h = sub_w = 1
+    boxes: List[Optional[Tuple[int, int, int, int]]] = []
+    for ch in chunks:
+        if not ch:
+            boxes.append(None)
+            continue
+        box = bounding_rect([rel_rects[i] for i in ch])
+        boxes.append(box)
+        sub_w = max(sub_w, box[2])
+        sub_h = max(sub_h, box[3])
+    sub_h = min(sub_h, stack_h)
+    sub_w = min(sub_w, stack_w)
+    origins: List[Tuple[int, int]] = []
+    coords = np.zeros((n_chips, L, 2), dtype=np.int32)
+    rows = [0] * n
+    for c, (ch, box) in enumerate(zip(chunks, boxes)):
+        if box is None:
+            origins.append((0, 0))
+            continue
+        # slide the origin back inside the stack instead of padding:
+        # the window reads real neighbor pixels (the halo), which the
+        # pointwise composite renders identically everywhere
+        sy = max(0, min(box[1], stack_h - sub_h))
+        sx = max(0, min(box[0], stack_w - sub_w))
+        origins.append((sy, sx))
+        for slot, i in enumerate(ch):
+            x, y, _, _ = rel_rects[i]
+            coords[c, slot, 0] = y - sy
+            coords[c, slot, 1] = x - sx
+            rows[i] = c * L + slot
+    return origins, (sub_h, sub_w), coords, rows

@@ -45,6 +45,21 @@ class SessionStoreConfig:
 
 
 @dataclasses.dataclass
+class BurstContinuationConfig:
+    """The backend.batching.burst-continuation: block (r19) — when a
+    short coalesce window catches lanes that share a burst identity
+    (image + render spec + resolution + session + burst tile grid),
+    the window extends by up to ``window_ms`` so the rest of the zoom
+    burst joins the SAME batch, and the identity carries across
+    dispatches so a straggling 100-tile zoom executes as a handful of
+    device programs instead of one per window. The extension is
+    deadline-bounded at half the tightest remaining lane budget."""
+
+    enabled: bool = True
+    window_ms: float = 25.0
+
+
+@dataclasses.dataclass
 class BatchingConfig:
     """TPU batch-executor tuning (no reference analog; replaces the
     worker-pool sizing knob as the throughput control)."""
@@ -57,6 +72,10 @@ class BatchingConfig:
     coalesce_window_ms: float = 2.0
     # Encode on device (Pallas deflate) vs host zlib.
     device_encode: bool = True
+    # Cross-window burst affinity (see BurstContinuationConfig).
+    burst_continuation: BurstContinuationConfig = dataclasses.field(
+        default_factory=BurstContinuationConfig
+    )
 
 
 @dataclasses.dataclass
@@ -554,12 +573,18 @@ class SupertileConfig:
     allocation ceiling); ``min_lanes`` is the smallest neighborhood
     worth fusing; ``coverage`` is the minimum fraction of the
     bounding rect the member tiles must cover (sparse neighborhoods
-    would gather mostly pixels nobody asked for)."""
+    would gather mostly pixels nobody asked for). ``mesh`` shard_maps
+    the fused gather+composite+carve+deflate across the serving mesh
+    (the r19 mesh-fusion plane); False reverts to the pre-fusion
+    preference where an active mesh sends lanes down the per-lane
+    sharded path instead — the escape hatch, byte-identical either
+    way."""
 
     enabled: bool = True
     max_pixels: int = 4 << 20  # 4 Mpx ~ a 2048x2048 viewport
     min_lanes: int = 2
     coverage: float = 0.5
+    mesh: bool = True
 
 
 @dataclasses.dataclass
@@ -1414,7 +1439,7 @@ class Config:
         silently default."""
         st = raw.get("supertile") or {}
         unknown = set(st) - {
-            "enabled", "max-pixels", "min-lanes", "coverage",
+            "enabled", "max-pixels", "min-lanes", "coverage", "mesh",
         }
         if unknown:
             raise ConfigError(
@@ -1445,6 +1470,36 @@ class Config:
             max_pixels=_num("max-pixels", 4 << 20, 65536, int),
             min_lanes=_num("min-lanes", 2, 2, int),
             coverage=coverage,
+            mesh=bool(st.get("mesh", True)),
+        )
+
+    @staticmethod
+    def _parse_burst_continuation(raw: dict) -> BurstContinuationConfig:
+        """Validate the backend.batching.burst-continuation: block —
+        unknown keys and nonsense fail at startup."""
+        bc = raw.get("burst-continuation") or {}
+        unknown = set(bc) - {"enabled", "window-ms"}
+        if unknown:
+            raise ConfigError(
+                "Unknown keys in 'backend.batching.burst-continuation'"
+                f" block: {sorted(unknown)}"
+            )
+        try:
+            window = float(bc.get("window-ms", 25.0))
+        except (TypeError, ValueError):
+            raise ConfigError(
+                "Invalid value for "
+                "'backend.batching.burst-continuation.window-ms': "
+                f"{bc.get('window-ms')!r}"
+            ) from None
+        if window < 0:
+            raise ConfigError(
+                "'backend.batching.burst-continuation.window-ms' "
+                "must be >= 0"
+            )
+        return BurstContinuationConfig(
+            enabled=bool(bc.get("enabled", True)),
+            window_ms=window,
         )
 
     @staticmethod
@@ -1530,6 +1585,9 @@ class Config:
                     batching_raw.get("coalesce-window-ms", 2.0)
                 ),
                 device_encode=bool(batching_raw.get("device-encode", True)),
+                burst_continuation=cls._parse_burst_continuation(
+                    batching_raw
+                ),
             ),
             png=PngConfig(
                 filter=png_raw.get("filter", "up"),

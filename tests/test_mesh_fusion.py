@@ -1,0 +1,634 @@
+"""Mesh fusion plane suite (the r23 tentpole).
+
+Pins the mesh-fused super-tile chain: gather + projection + composite
++ carve + filter + deflate as ONE shard_mapped program over per-chip
+overlapped sub-rect windows of the bounding stack. Identity matrix:
+host == single-device fused == 2-way mesh == 8-way mesh, bytes, ETags
+and result-cache keys all equal. Plus the satellites riding the same
+refactor — ROI masks as a sharded operand (masked groups no longer
+split to single-device), dynamic-Huffman deflate staying dynamic on
+the mesh (byte-exact decode + ratio vs rle), and burst-continuation
+batching (window chaining, the deadline bound, and invalidated-mid-
+burst lanes splitting out cleanly).
+"""
+
+import asyncio
+import io
+import time
+import zlib
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from omero_ms_pixel_buffer_tpu.auth.omero_session import AllowListValidator
+from omero_ms_pixel_buffer_tpu.cache.result_cache import make_etag
+from omero_ms_pixel_buffer_tpu.dispatch.batcher import BatchingTileWorker
+from omero_ms_pixel_buffer_tpu.errors import GatewayTimeoutError
+from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
+from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+    ImageRegistry,
+    PixelsService,
+)
+from omero_ms_pixel_buffer_tpu.models.tile_pipeline import TilePipeline
+from omero_ms_pixel_buffer_tpu.render import supertile as stile
+from omero_ms_pixel_buffer_tpu.render.model import RenderSpec
+from omero_ms_pixel_buffer_tpu.render.supertile import (
+    BurstHint,
+    assign_supertiles,
+    plan_mesh_partition,
+)
+from omero_ms_pixel_buffer_tpu.resilience.breaker import BOARD
+from omero_ms_pixel_buffer_tpu.resilience.deadline import Deadline
+from omero_ms_pixel_buffer_tpu.resilience.faultinject import (
+    INJECTOR,
+    always,
+)
+from omero_ms_pixel_buffer_tpu.tile_ctx import RegionDef, TileCtx
+from omero_ms_pixel_buffer_tpu.utils.config import Config, ConfigError
+
+rng = np.random.default_rng(31)
+
+# (T, C, Z, Y, X) — two channels, four z planes
+IMG = rng.integers(0, 4096, (1, 2, 4, 96, 128), dtype=np.uint16)
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    INJECTOR.clear()
+    yield
+    INJECTOR.clear()
+    BOARD.reset()
+
+
+@pytest.fixture(scope="module")
+def service(tmp_path_factory):
+    root = tmp_path_factory.mktemp("mesh-fusion")
+    path = str(root / "img.ome.tiff")
+    write_ome_tiff(path, IMG, tile_size=(64, 64))
+    registry = ImageRegistry()
+    registry.add(1, path)
+    svc = PixelsService(registry)
+    yield svc
+    svc.close()
+
+
+def _spec(**extra):
+    params = {"c": "1|0:4095$FF0000,2|0:4095$00FF00"}
+    params.update(extra)
+    return RenderSpec.from_params(params)
+
+
+def _ctx(spec, x, y, w=32, h=32, z=1, burst=None, **kw):
+    return TileCtx(
+        image_id=1, z=z, c=0, t=0, region=RegionDef(x, y, w, h),
+        format=spec.format, omero_session_key="k", render=spec,
+        burst=burst, **kw,
+    )
+
+
+def _grid(spec, tile=32, cols=3, rows=2, **kw):
+    return [
+        _ctx(spec, tile * c, tile * r, tile, tile, **kw)
+        for r in range(rows) for c in range(cols)
+    ]
+
+
+def _mesh_pipe(service, width, **kw):
+    """A device pipeline over the first ``width`` virtual chips;
+    ``width=None`` forces single-device stages."""
+    pipe = TilePipeline(
+        service, engine="device", device_deflate=True, **kw
+    )
+    if width is None:
+        pipe.mesh = None
+    else:
+        import jax
+
+        from omero_ms_pixel_buffer_tpu.parallel.mesh import make_mesh
+
+        pipe.mesh = make_mesh(("data",), devices=jax.devices()[:width])
+    return pipe
+
+
+def _host_ref(service, ctxs_fn):
+    pipe = TilePipeline(service, engine="host")
+    try:
+        return [pipe.handle(c) for c in ctxs_fn()]
+    finally:
+        pipe.close()
+
+
+# ---------------------------------------------------------------------------
+# The identity matrix: host == single-device fused == 2-way == 8-way
+# ---------------------------------------------------------------------------
+
+
+class TestFusionIdentityMatrix:
+    @pytest.mark.parametrize("width", [1, 2, 8])
+    def test_mesh_width_byte_identity(self, service, width):
+        """The tentpole pin: the mesh-fused chain at every width
+        serves bytes (hence ETags and shared cache entries) identical
+        to the host mirror AND the single-device fused path, and the
+        dispatch accounting proves the fused supertile program is
+        what ran."""
+        spec = _spec()
+        ref = _host_ref(service, lambda: _grid(spec))
+        assert all(b is not None for b in ref)
+
+        single = _mesh_pipe(service, None)
+        try:
+            ctxs = _grid(spec)
+            assign_supertiles(ctxs)
+            fused_single = single.handle_batch(ctxs)
+            assert fused_single == ref
+        finally:
+            single.close()
+
+        mesh = _mesh_pipe(service, width)
+        try:
+            before = dict(stile.SUPERTILE_LANES._values)
+            ctxs = _grid(spec)
+            assign_supertiles(ctxs)
+            out = mesh.handle_batch(ctxs)
+            assert out == ref
+            after = dict(stile.SUPERTILE_LANES._values)
+            key = (("path", "mesh"),)
+            assert after.get(key, 0) - before.get(key, 0) == 6, (
+                "fused group did not take the mesh path"
+            )
+            last = mesh.last_mesh_dispatch
+            assert last is not None and last["executed"]
+            assert last["tag"] == "supertile"
+            assert last["n_devices"] == width
+        finally:
+            mesh.close()
+        # identical bytes carry identical strong ETags, and identical
+        # ctxs carry identical cache keys — the widths share cache
+        # entries end to end
+        for a, b in zip(out, ref):
+            assert make_etag(a) == make_etag(b)
+        assert [c.cache_key() for c in _grid(spec)] == [
+            c.cache_key() for c in _grid(spec)
+        ]
+
+    def test_mixed_sizes_and_projection(self, service):
+        """Edge-row tile sizes (per-size sharded programs) and a
+        projection spec, both on the full 8-way mesh."""
+        spec = _spec(p="intmax|0:3")
+
+        def ctxs_fn():
+            out = []
+            for y, h in ((0, 48), (48, 48)):
+                for x, w in ((0, 48), (48, 48), (96, 32)):
+                    out.append(_ctx(spec, x, y, w, h, z=0))
+            return out
+
+        ref = _host_ref(service, ctxs_fn)
+        assert all(b is not None for b in ref)
+        mesh = _mesh_pipe(service, 8, buckets=(64,))
+        try:
+            ctxs = ctxs_fn()
+            assert assign_supertiles(ctxs) == 6
+            assert mesh.handle_batch(ctxs) == ref
+            assert mesh.last_mesh_dispatch["tag"] == "supertile"
+        finally:
+            mesh.close()
+
+    def test_degraded_group_fuses_on_mesh(self, service):
+        """Degraded lanes fuse with each other (per pyramid level) and
+        the fused coarse-gather+upscale is byte-identical to per-lane
+        degraded reads — on the mesh."""
+        spec = _spec()
+
+        def ctxs_fn():
+            return _grid(spec, cols=2, rows=2, degraded=1)
+
+        ref = _host_ref(service, ctxs_fn)
+        assert all(b is not None for b in ref)
+        mesh = _mesh_pipe(service, 8)
+        try:
+            ctxs = ctxs_fn()
+            assign_supertiles(ctxs)
+            assert all(c.supertile is not None for c in ctxs), (
+                "degraded lanes should fuse with each other"
+            )
+            assert mesh.handle_batch(ctxs) == ref
+        finally:
+            mesh.close()
+
+    def test_escape_hatch_restores_per_lane_sharding(self, service):
+        """``supertile.mesh: false`` — lanes serve per-lane sharded on
+        the mesh, byte-identical, no fused supertile dispatch."""
+        spec = _spec()
+        ref = _host_ref(service, lambda: _grid(spec))
+        mesh = _mesh_pipe(service, 8, supertile_mesh=False)
+        try:
+            before = dict(stile.SUPERTILE_LANES._values)
+            ctxs = _grid(spec)
+            assign_supertiles(ctxs)
+            assert mesh.handle_batch(ctxs) == ref
+            after = dict(stile.SUPERTILE_LANES._values)
+            key = (("path", "mesh"),)
+            assert after.get(key, 0) == before.get(key, 0)
+            assert mesh.last_mesh_dispatch["tag"] == "render"
+        finally:
+            mesh.close()
+
+    @pytest.mark.resilience
+    def test_mesh_fusion_fault_falls_back_identical(self, service):
+        """Chaos on the fused seam with the mesh active: the group
+        serves through the host carve, byte-identical."""
+        spec = _spec()
+        ref = _host_ref(service, lambda: _grid(spec))
+        mesh = _mesh_pipe(service, 8)
+        try:
+            INJECTOR.install(
+                "render.supertile", always(RuntimeError("fused down"))
+            )
+            ctxs = _grid(spec)
+            assign_supertiles(ctxs)
+            assert mesh.handle_batch(ctxs) == ref
+            assert INJECTOR.calls("render.supertile") >= 1
+        finally:
+            mesh.close()
+
+
+class TestMeshPartitionPlanner:
+    def test_windows_contain_their_chunks(self):
+        rects = [(x * 32, y * 32, 32, 32) for y in range(4) for x in range(4)]
+        origins, (sh, sw), coords, rows = plan_mesh_partition(
+            rects, 128, 128, 4
+        )
+        assert len(origins) == 4
+        order = sorted(range(16), key=lambda i: (rects[i][1], rects[i][0]))
+        per = 4
+        for c, (sy, sx) in enumerate(origins):
+            assert 0 <= sy <= 128 - sh and 0 <= sx <= 128 - sw
+            for slot, i in enumerate(order[c * per : (c + 1) * per]):
+                x, y, w, h = rects[i]
+                ry, rx = coords[c, slot]
+                # the shifted coords land the SAME absolute pixels
+                assert (sy + ry, sx + rx) == (y, x)
+                assert ry + h <= sh and rx + w <= sw
+                assert rows[i] == c * coords.shape[1] + slot
+
+    def test_uneven_chunks_pad_slots(self):
+        rects = [(0, 0, 32, 32), (32, 0, 32, 32), (64, 0, 32, 32)]
+        origins, _, coords, rows = plan_mesh_partition(rects, 96, 96, 2)
+        assert len(origins) == 2
+        assert coords.shape[1] >= 2  # pow2 slot padding
+        assert sorted(rows) == sorted(
+            set(rows)
+        ), "row map must be collision-free"
+
+
+# ---------------------------------------------------------------------------
+# Satellite: ROI masks as a sharded operand
+# ---------------------------------------------------------------------------
+
+
+class TestMaskedShardedIdentity:
+    def test_masked_group_serves_sharded(self, service):
+        roi = (
+            '[{"type":"rect","x":8,"y":8,"w":30,"h":20},'
+            '{"type":"ellipse","cx":40,"cy":24,"rx":12,"ry":9}]'
+        )
+        spec = _spec(roi=roi)
+
+        def ctxs_fn():
+            return [
+                _ctx(spec, 0, 0, 64, 48),
+                _ctx(spec, 64, 0, 64, 48),
+                _ctx(spec, 0, 48, 64, 48),
+            ]
+
+        ref = _host_ref(service, ctxs_fn)
+        assert all(b is not None for b in ref)
+        mesh = _mesh_pipe(service, 8)
+        try:
+            assert mesh.handle_batch(ctxs_fn()) == ref
+            last = mesh.last_mesh_dispatch
+            assert last is not None and last["executed"], (
+                "masked group split to single-device"
+            )
+            assert last["tag"] == "render"
+        finally:
+            mesh.close()
+
+    def test_masked_and_plain_mix_on_mesh(self, service):
+        roi = '[{"type":"rect","x":0,"y":0,"w":20,"h":20}]'
+        masked, plain = _spec(roi=roi), _spec()
+
+        def ctxs_fn():
+            return [
+                _ctx(masked, 0, 0, 64, 48),
+                _ctx(plain, 0, 0, 64, 48),
+            ]
+
+        ref = _host_ref(service, ctxs_fn)
+        mesh = _mesh_pipe(service, 2)
+        try:
+            assert mesh.handle_batch(ctxs_fn()) == ref
+        finally:
+            mesh.close()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: dynamic-Huffman deflate stays dynamic on the mesh
+# ---------------------------------------------------------------------------
+
+
+def _raw_ctx(x=0, y=0, w=64, h=64, z=0):
+    return TileCtx(
+        image_id=1, z=z, c=0, t=0, region=RegionDef(x, y, w, h),
+        format="png", omero_session_key="k",
+    )
+
+
+class TestDynamicDeflateOnMesh:
+    def test_mesh_dynamic_byte_identical_and_decodes(self, service):
+        """Raw PNG tile groups keep the two-pass dynamic-Huffman
+        chain on the mesh (no rle downgrade): bytes identical to the
+        single-device dynamic path, pixels decode exactly, and the
+        dispatch tag proves the histogram+emit chain ran sharded."""
+        ctxs = [
+            _raw_ctx(64 * (i % 2), 0 if i < 2 else 64 - 32, 64, 32, z=i % 4)
+            for i in range(4)
+        ]
+        single = _mesh_pipe(service, None, buckets=(64,))
+        mesh = _mesh_pipe(service, 8, buckets=(64,))
+        try:
+            ref = single.handle_batch(list(ctxs))
+            out = mesh.handle_batch(list(ctxs))
+            assert all(b is not None for b in ref)
+            assert out == ref
+            last = mesh.last_mesh_dispatch
+            assert last is not None and last["executed"]
+            assert last["tag"] == "dynamic", (
+                "dynamic group downgraded off the two-pass chain"
+            )
+            for c, png in zip(ctxs, out):
+                arr = np.array(Image.open(io.BytesIO(png)))
+                r = c.region
+                np.testing.assert_array_equal(
+                    arr,
+                    IMG[0, 0, c.z, r.y : r.y + r.height,
+                        r.x : r.x + r.width],
+                )
+        finally:
+            single.close()
+            mesh.close()
+
+    def test_mesh_dynamic_ratio_not_worse_than_rle(self, service):
+        ctxs = [_raw_ctx(0, 0, 64, 64), _raw_ctx(64, 0, 64, 64)]
+        dyn = _mesh_pipe(service, 8, buckets=(64,))
+        rle = _mesh_pipe(
+            service, 8, buckets=(64,), device_deflate_mode="rle"
+        )
+        try:
+            dyn_out = dyn.handle_batch(list(ctxs))
+            rle_out = rle.handle_batch(list(ctxs))
+            assert sum(map(len, dyn_out)) <= sum(map(len, rle_out)), (
+                "dynamic-on-mesh compresses no worse than rle"
+            )
+        finally:
+            dyn.close()
+            rle.close()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: burst-continuation batching
+# ---------------------------------------------------------------------------
+
+
+def _cfg(extra=None):
+    raw = {"session-store": {"type": "memory"}}
+    raw.update(extra or {})
+    return Config.from_dict(raw)
+
+
+class _Counting:
+    """handle_batch stand-in that records batch sizes — each call is
+    one would-be device program."""
+
+    def __init__(self):
+        self.batches = []
+
+    def handle(self, ctx):
+        return b"x"
+
+    def handle_batch(self, ctxs):
+        self.batches.append(len(ctxs))
+        return [b"x"] * len(ctxs)
+
+
+class _FakeLoop:
+    def __init__(self, t=100.0):
+        self._t = t
+
+    def time(self):
+        return self._t
+
+
+class TestBurstContinuationUnit:
+    def _worker(self, bc=None):
+        return BatchingTileWorker(
+            _Counting(), AllowListValidator(), workers=1,
+            burst_continuation=bc,
+        )
+
+    def test_burst_key_requires_hint_and_spec(self):
+        w = self._worker()
+        spec = _spec()
+        hint = BurstHint(32, 32)
+        assert w._burst_key(_ctx(spec, 0, 0)) is None  # no hint
+        assert w._burst_key(_raw_ctx()) is None  # no render spec
+        k1 = w._burst_key(_ctx(spec, 0, 0, burst=hint))
+        k2 = w._burst_key(_ctx(spec, 32, 0, burst=hint))
+        assert k1 == k2 is not None  # position-independent
+        assert k1 != w._burst_key(_ctx(_spec(m="g"), 0, 0, burst=hint))
+
+    def test_extension_fires_on_shared_key(self):
+        bc = _cfg().backend.batching.burst_continuation
+        w = self._worker(bc)
+        spec, hint = _spec(), BurstHint(32, 32)
+        batch = [
+            (_ctx(spec, 0, 0, burst=hint), None),
+            (_ctx(spec, 32, 0, burst=hint), None),
+        ]
+        assert w._burst_extension(batch, _FakeLoop()) == pytest.approx(
+            0.025
+        )
+        # a lone keyed lane does not extend...
+        assert w._burst_extension(batch[:1], _FakeLoop()) is None
+        # ...unless the key carries over from the previous dispatch
+        w._last_burst = (w._burst_key(batch[0][0]), 100.0 - 0.010)
+        assert w._burst_extension(batch[:1], _FakeLoop()) is not None
+        # and a stale carry (older than the window) does not count
+        w._last_burst = (w._burst_key(batch[0][0]), 100.0 - 0.300)
+        assert w._burst_extension(batch[:1], _FakeLoop()) is None
+
+    def test_extension_deadline_bounded(self):
+        bc = _cfg().backend.batching.burst_continuation
+        w = self._worker(bc)
+        spec, hint = _spec(), BurstHint(32, 32)
+        a = _ctx(spec, 0, 0, burst=hint)
+        b = _ctx(spec, 32, 0, burst=hint)
+        b.deadline = Deadline.after(0.010)
+        ext = w._burst_extension([(a, None), (b, None)], _FakeLoop())
+        # never more than half the tightest remaining budget
+        assert ext is not None and ext <= 0.005
+        b.deadline = Deadline.after(0)
+        time.sleep(0.001)
+        assert (
+            w._burst_extension([(a, None), (b, None)], _FakeLoop())
+            is None
+        )
+
+    def test_disabled_or_absent_never_extends(self):
+        spec, hint = _spec(), BurstHint(32, 32)
+        batch = [
+            (_ctx(spec, 0, 0, burst=hint), None),
+            (_ctx(spec, 32, 0, burst=hint), None),
+        ]
+        assert self._worker()._burst_extension(batch, _FakeLoop()) is None
+        bc = _cfg({
+            "backend": {"batching": {
+                "burst-continuation": {"enabled": False},
+            }},
+        }).backend.batching.burst_continuation
+        assert (
+            self._worker(bc)._burst_extension(batch, _FakeLoop()) is None
+        )
+
+
+class TestBurstContinuationChaining:
+    def _run_burst(self, loop, bc, n=8, stagger=0.015):
+        """n staggered burst lanes, each arriving after the 2ms base
+        window of its predecessor — without continuation every lane is
+        its own batch (program); with it the burst chains."""
+        pipeline = _Counting()
+        worker = BatchingTileWorker(
+            pipeline, AllowListValidator(), max_batch=32,
+            coalesce_window_ms=2.0, workers=1,
+            burst_continuation=bc,
+        )
+        spec, hint = _spec(), BurstHint(32, 32)
+
+        async def run():
+            await worker.start()
+            sends = []
+            for i in range(n):
+                sends.append(asyncio.ensure_future(
+                    worker.handle(_ctx(spec, 32 * i, 0, burst=hint))
+                ))
+                await asyncio.sleep(stagger)
+            out = await asyncio.gather(*sends)
+            await worker.close()
+            return out
+
+        out = loop.run_until_complete(run())
+        assert all(b[0] == b"x" for b in out)
+        return pipeline.batches
+
+    def test_burst_chains_into_few_programs(self, loop):
+        bc = _cfg({
+            "backend": {"batching": {
+                "burst-continuation": {"window-ms": 250.0},
+            }},
+        }).backend.batching.burst_continuation
+        batches = self._run_burst(loop, bc)
+        # lane 0 may dispatch alone before the carry exists; the rest
+        # of the burst must chain — the ≤ 1/4-programs acceptance pin
+        # at test scale
+        assert len(batches) <= 2, batches
+        assert sum(batches) == 8
+
+    def test_without_continuation_one_program_per_window(self, loop):
+        batches = self._run_burst(loop, None)
+        assert len(batches) == 8, batches
+
+    def test_invalidated_mid_burst_splits_out(self, loop):
+        """A lane whose budget dies during the extension answers 504
+        at dispatch; the rest of the chained burst serves."""
+        bc = _cfg({
+            "backend": {"batching": {
+                "burst-continuation": {"window-ms": 120.0},
+            }},
+        }).backend.batching.burst_continuation
+        pipeline = _Counting()
+        worker = BatchingTileWorker(
+            pipeline, AllowListValidator(), max_batch=32,
+            coalesce_window_ms=2.0, workers=1,
+            burst_continuation=bc,
+        )
+        spec, hint = _spec(), BurstHint(32, 32)
+
+        async def run():
+            await worker.start()
+            a = asyncio.ensure_future(
+                worker.handle(_ctx(spec, 0, 0, burst=hint))
+            )
+            b = asyncio.ensure_future(
+                worker.handle(_ctx(spec, 32, 0, burst=hint))
+            )
+            await asyncio.sleep(0.005)
+            doomed = _ctx(spec, 64, 0, burst=hint)
+            doomed.deadline = Deadline.after(0.001)
+            d = asyncio.ensure_future(worker.handle(doomed))
+            out = await asyncio.gather(*[a, b, d], return_exceptions=True)
+            await worker.close()
+            return out
+
+        out = loop.run_until_complete(run())
+        assert out[0][0] == b"x" and out[1][0] == b"x"
+        assert isinstance(out[2], GatewayTimeoutError)
+
+
+# ---------------------------------------------------------------------------
+# Config knobs
+# ---------------------------------------------------------------------------
+
+
+class TestFusionConfig:
+    def test_defaults(self):
+        cfg = _cfg()
+        assert cfg.supertile.mesh is True
+        bc = cfg.backend.batching.burst_continuation
+        assert bc.enabled is True and bc.window_ms == 25.0
+
+    def test_supertile_mesh_parses(self):
+        assert _cfg({"supertile": {"mesh": False}}).supertile.mesh is False
+
+    def test_burst_continuation_parses(self):
+        bc = _cfg({
+            "backend": {"batching": {
+                "burst-continuation": {
+                    "enabled": False, "window-ms": 40,
+                },
+            }},
+        }).backend.batching.burst_continuation
+        assert bc.enabled is False and bc.window_ms == 40.0
+
+    @pytest.mark.parametrize("block", [
+        {"burst-continuation": {"windowms": 10}},
+        {"burst-continuation": {"window-ms": "soon"}},
+        {"burst-continuation": {"window-ms": -1}},
+    ])
+    def test_invalid_burst_continuation_fails_startup(self, block):
+        with pytest.raises(ConfigError):
+            _cfg({"backend": {"batching": block}})
+
+    def test_shipped_config_parses(self):
+        import os
+
+        import yaml
+
+        path = os.path.join(
+            os.path.dirname(__file__), "..", "conf", "config.yaml"
+        )
+        with open(path) as fh:
+            cfg = Config.from_dict(yaml.safe_load(fh))
+        assert cfg.supertile.mesh is True
+        assert cfg.backend.batching.burst_continuation.enabled is True

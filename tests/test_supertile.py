@@ -359,6 +359,94 @@ class TestBurstSplit:
             pipe.close()
 
 
+class TestGridAlignedSplit:
+    def test_budget_split_prefers_grid_rows(self):
+        """With a burst grid hint, a pixel-budget split cuts BETWEEN
+        grid rows instead of slicing greedily through one."""
+        hint = BurstHint(32, 32)
+        ctxs = _grid(_spec(), cols=3, rows=2, burst=hint)
+        # budget: exactly one 3-tile grid row
+        assign_supertiles(ctxs, max_pixels=3 * 32 * 32)
+        groups = {}
+        for c in ctxs:
+            assert c.supertile is not None
+            groups.setdefault(id(c.supertile), []).append(c.region.y)
+        assert len(groups) == 2
+        for ys in groups.values():
+            assert len(set(ys)) == 1, "split cut through a grid row"
+
+    def test_over_budget_row_recurses_greedy(self):
+        """A single grid row larger than the budget still splits
+        (hintless recursion) instead of dropping the lanes."""
+        hint = BurstHint(32, 32)
+        ctxs = _grid(_spec(), cols=4, rows=1, burst=hint)
+        assign_supertiles(ctxs, max_pixels=2 * 32 * 32)
+        tokens = {id(c.supertile) for c in ctxs}
+        assert None not in [c.supertile for c in ctxs]
+        assert len(tokens) == 2
+
+    def test_split_fragments_carve_byte_identical(self, service):
+        """Pin: grid-aligned split fragments still carve bytes equal
+        to independent tiles, host and device engines."""
+        spec = _spec()
+        hint = BurstHint(32, 32)
+        ref = _independent(service, lambda: _grid(spec))
+        for engine, dd in (("host", False), ("device", True)):
+            pipe = TilePipeline(service, engine=engine, device_deflate=dd)
+            pipe.mesh = None
+            try:
+                ctxs = _grid(spec, burst=hint)
+                assign_supertiles(ctxs, max_pixels=3 * 32 * 32)
+                assert len({id(c.supertile) for c in ctxs}) == 2
+                assert pipe.handle_batch(ctxs) == ref, engine
+            finally:
+                pipe.close()
+
+
+class TestDegradedFusion:
+    def test_degraded_lanes_fuse_with_each_other(self, service):
+        """Degraded lanes fuse per pyramid level (the r23 satellite):
+        the fused coarse gather + single upscale serves bytes equal to
+        per-lane degraded reads, and the group genuinely fused."""
+        spec = _spec()
+        ref = _independent(
+            service, lambda: _grid(spec, cols=2, rows=2, degraded=1)
+        )
+        assert all(b is not None for b in ref)
+        pipe = TilePipeline(service, engine="host")
+        try:
+            ctxs = _grid(spec, cols=2, rows=2, degraded=1)
+            assert assign_supertiles(ctxs) == 4
+            assert pipe.handle_batch(ctxs) == ref
+        finally:
+            pipe.close()
+
+    def test_degraded_never_mixes_with_full_res(self):
+        spec = _spec()
+        ctxs = _grid(spec, cols=2, rows=1) + _grid(
+            spec, cols=2, rows=1, degraded=1
+        )
+        assign_supertiles(ctxs)
+        full = {id(c.supertile) for c in ctxs[:2]}
+        deg = {id(c.supertile) for c in ctxs[2:]}
+        assert None not in [c.supertile for c in ctxs]
+        assert full.isdisjoint(deg)
+
+    def test_degraded_device_fused_identical(self, service):
+        spec = _spec()
+        ref = _independent(
+            service, lambda: _grid(spec, cols=2, rows=2, degraded=1)
+        )
+        pipe = TilePipeline(service, engine="device", device_deflate=True)
+        pipe.mesh = None
+        try:
+            ctxs = _grid(spec, cols=2, rows=2, degraded=1)
+            assign_supertiles(ctxs)
+            assert pipe.handle_batch(ctxs) == ref
+        finally:
+            pipe.close()
+
+
 class TestDegradedIsolation:
     def test_degraded_lane_never_fuses_and_serves_degraded_bytes(
         self, service
