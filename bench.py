@@ -1747,6 +1747,165 @@ def bench_session(cache_dir: str) -> dict:
     return out
 
 
+def bench_ingest(cache_dir: str) -> dict:
+    """Ingest plane (r24) section — write-while-serve, two pins:
+
+    - ``read_p99``: one node serving a tile read loop, first alone
+      (baseline), then with a writer PUTting tiles through
+      ``/image/{id}/tile`` the whole time. Every read must succeed and
+      the concurrent read p99 must stay within 1.5x of the read-only
+      baseline (with a small absolute floor so a sub-millisecond
+      warm-cache baseline doesn't turn the ratio into noise). Pin
+      ``ingest_ok_read_p99``.
+    - ``invalidation``: after each committed write, the FIRST read of
+      the written region must return the new bytes — the epoch bump
+      and purge ride the commit response, so staleness is bounded by
+      one epoch round, not a cache TTL. Pin
+      ``ingest_ok_invalidation``: zero stale first-reads.
+    """
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from omero_ms_pixel_buffer_tpu.auth.stores import MemorySessionStore
+    from omero_ms_pixel_buffer_tpu.http.server import PixelBufferApp
+    from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+        ImageRegistry,
+        PixelsService,
+    )
+    from omero_ms_pixel_buffer_tpu.io.zarr import write_ngff
+    from omero_ms_pixel_buffer_tpu.utils.config import Config
+
+    headers = {"Cookie": "sessionid=bench-cookie"}
+    img_path = os.path.join(cache_dir, "ingest_fixture.zarr")
+    rng_local = np.random.default_rng(31)
+    img = rng_local.integers(
+        0, 4096, (1, 1, 1, 256, 256), dtype=np.uint16
+    )
+    if not os.path.exists(img_path):
+        write_ngff(
+            img_path, img, chunks=(64, 64), levels=1,
+            zarr_format=3, shards=(128, 128),
+        )
+
+    n_reads = int(os.environ.get("BENCH_INGEST_READS", "300"))
+    n_writes = int(os.environ.get("BENCH_INGEST_WRITES", "40"))
+
+    async def drive() -> dict:
+        registry = ImageRegistry()
+        registry.add(1, img_path)
+        config = Config.from_dict({
+            "session-store": {"type": "memory"},
+            "backend": {"batching": {"coalesce-window-ms": 1.0}},
+            "ingest": {"enabled": True},
+        })
+        app_obj = PixelBufferApp(
+            config,
+            pixels_service=PixelsService(registry),
+            session_store=MemorySessionStore(
+                {"bench-cookie": "bench-key"}
+            ),
+        )
+        client = TestClient(
+            TestServer(app_obj.make_app()),
+            loop=asyncio.get_running_loop(),
+        )
+        await client.start_server()
+        tiles = [(x, y) for x in (0, 64, 128) for y in (0, 64, 128)]
+        try:
+            async def read_loop(n, latencies, statuses):
+                for i in range(n):
+                    x, y = tiles[i % len(tiles)]
+                    t0 = time.perf_counter()
+                    r = await client.get(
+                        f"/tile/1/0/0/0?x={x}&y={y}&w=64&h=64",
+                        headers=headers,
+                    )
+                    await r.read()
+                    statuses.append(r.status)
+                    latencies.append(
+                        (time.perf_counter() - t0) * 1000.0
+                    )
+
+            # baseline: the read loop alone
+            base_lat: list = []
+            base_status: list = []
+            await read_loop(n_reads, base_lat, base_status)
+
+            # concurrent: same loop with a writer alongside
+            write_status: list = []
+
+            async def write_loop():
+                tile = np.full((64, 64), 7, dtype=np.uint16)
+                for i in range(n_writes):
+                    tile[...] = i
+                    r = await client.put(
+                        f"/image/1/tile/0/0/0"
+                        f"?x={(i % 3) * 64}&y=64&w=64&h=64",
+                        data=tile.astype(">u2").tobytes(),
+                        headers=headers,
+                    )
+                    await r.read()
+                    write_status.append(r.status)
+                    await asyncio.sleep(0)
+
+            conc_lat: list = []
+            conc_status: list = []
+            writer = asyncio.ensure_future(write_loop())
+            await read_loop(n_reads, conc_lat, conc_status)
+            await writer
+
+            # invalidation: first read after each commit must be fresh
+            stale = 0
+            for i in range(n_writes):
+                tile = np.full((64, 64), 100 + i, dtype=np.uint16)
+                wire = tile.astype(">u2").tobytes()
+                r = await client.put(
+                    "/image/1/tile/0/0/0?x=128&y=128&w=64&h=64",
+                    data=wire, headers=headers,
+                )
+                await r.read()
+                assert r.status == 200
+                r = await client.get(
+                    "/tile/1/0/0/0?x=128&y=128&w=64&h=64",
+                    headers=headers,
+                )
+                if await r.read() != wire:
+                    stale += 1
+
+            def p99(lat):
+                lat = sorted(lat)
+                return round(
+                    lat[min(len(lat) - 1, int(len(lat) * 0.99))], 2
+                )
+
+            return {
+                "reads": n_reads,
+                "writes": n_writes,
+                "baseline_read_p99_ms": p99(base_lat),
+                "concurrent_read_p99_ms": p99(conc_lat),
+                "read_errors": sum(
+                    1 for s in base_status + conc_status if s >= 500
+                ),
+                "write_errors": sum(
+                    1 for s in write_status if s != 200
+                ),
+                "stale_first_reads": stale,
+            }
+        finally:
+            await client.close()
+
+    out = asyncio.run(drive())
+    out["ingest_ok_read_p99"] = (
+        out["read_errors"] == 0
+        and out["write_errors"] == 0
+        and out["concurrent_read_p99_ms"] <= max(
+            1.5 * out["baseline_read_p99_ms"], 25.0
+        )
+    )
+    out["ingest_ok_invalidation"] = out["stale_first_reads"] == 0
+    return out
+
+
 def bench_overload(
     cache_dir: str,
     duration_s: float = 4.0,
@@ -3269,6 +3428,17 @@ def main():
             session_stats = {"error": f"{type(e).__name__}: {e}"}
             log(f"session bench failed: {e!r}")
 
+    # --- ingest plane (r24): read p99 under concurrent writes +
+    # write-to-fresh-read staleness (ingest_ok_* pins) -----------------
+    ingest_stats: dict = {}
+    if os.environ.get("BENCH_INGEST", "1") != "0":
+        try:
+            ingest_stats = bench_ingest(cache_dir)
+            log(f"ingest: {ingest_stats}")
+        except Exception as e:
+            ingest_stats = {"error": f"{type(e).__name__}: {e}"}
+            log(f"ingest bench failed: {e!r}")
+
     # --- batched read plane (r14): cold remote reads over a loopback
     # HTTP object store — sequential vs parallel+coalesced, sharded
     # byte identity, requests-per-tile (io_ok_* pins)
@@ -3374,6 +3544,8 @@ def main():
         record["decentralized"] = decentralized_stats
     if session_stats:
         record["session"] = session_stats
+    if ingest_stats:
+        record["ingest"] = ingest_stats
     if overload_stats:
         record["overload"] = overload_stats
     if io_stats:
@@ -3515,6 +3687,16 @@ def main():
         )
         comparison["session_drain_serving_errors"] = (
             session_stats["drain"]["serving_errors"]
+        )
+    if ingest_stats and "concurrent_read_p99_ms" in ingest_stats:
+        comparison["ingest_read_p99_ms"] = (
+            ingest_stats["concurrent_read_p99_ms"]
+        )
+        comparison["ingest_baseline_read_p99_ms"] = (
+            ingest_stats["baseline_read_p99_ms"]
+        )
+        comparison["ingest_stale_first_reads"] = (
+            ingest_stats["stale_first_reads"]
         )
     record["engine_comparison"] = comparison
     print(json.dumps(record))

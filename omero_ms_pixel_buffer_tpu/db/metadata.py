@@ -104,6 +104,11 @@ USER_GROUPS_QUERY = (
 USER_READ = 1 << 8
 GROUP_READ = 1 << 4
 WORLD_READ = 1 << 0
+# Write rights live two bits above read in each role nibble (the
+# canonical longs above: -40 'rwrw--' sets bit 6, group write).
+USER_WRITE = 1 << 10
+GROUP_WRITE = 1 << 6
+WORLD_WRITE = 1 << 2
 _PRIVATE = -120  # default when the group row is missing
 
 
@@ -140,6 +145,30 @@ def can_read(
     if group_id in groups and permissions & GROUP_READ:
         return True
     return bool(permissions & WORLD_READ)
+
+
+def can_write(
+    user_ctx: Optional[tuple], owner_id: Optional[int],
+    group_id: Optional[int], permissions: int,
+) -> bool:
+    """OMERO's write rule for one object (the ingest plane's ACL):
+    admins and group leaders write anything in scope; owners write
+    their own data (USER_WRITE — set in every canonical permission
+    long); members need GROUP_WRITE ('rwrw--', -40); WORLD_WRITE is
+    never set by stock OMERO but evaluated for completeness. Shares
+    the restricted-admin over-grant documented on ``can_read``."""
+    if user_ctx is None:
+        return False
+    user_id, groups, is_admin = user_ctx
+    if is_admin:
+        return True
+    if group_id in groups and groups[group_id]:
+        return True  # group leader
+    if owner_id == user_id and permissions & USER_WRITE:
+        return True
+    if group_id in groups and permissions & GROUP_WRITE:
+        return True
+    return bool(permissions & WORLD_WRITE)
 
 
 class OmeroPostgresMetadataResolver:
@@ -395,6 +424,33 @@ class OmeroPostgresMetadataResolver:
                     else None
                 )
         return self._run(self.get_pixels_async(image_id, session_key))
+
+    async def can_write_image_async(
+        self, image_id: int, session_key: Optional[str]
+    ) -> bool:
+        """Whether the caller's session may WRITE the image's pixels
+        (the ingest plane's permission check). An unknown image is
+        False — the handler 404s before this is consulted, but the
+        check must fail closed either way. Without
+        ``enforce_permissions`` any authenticated session writes
+        (matching the read posture)."""
+        row = await self._pixels_row(int(image_id))
+        if row is None:
+            return False
+        if not self.enforce_permissions:
+            return True
+        _meta, owner_id, group_id, perms = row
+        ctx = await self._session_context(session_key)
+        return can_write(ctx, owner_id, group_id, perms)
+
+    def can_write_image(
+        self, image_id: int, session_key: Optional[str]
+    ) -> bool:
+        """Sync adapter of ``can_write_image_async`` (same background
+        loop as ``get_pixels``)."""
+        return self._run(
+            self.can_write_image_async(image_id, session_key)
+        )
 
     def get_pixels_unchecked(
         self, image_id: int

@@ -21,6 +21,7 @@ Replaces the reference's front verticle
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import logging
 import threading
 import time
@@ -67,6 +68,7 @@ from ..resilience import AdmissionController, Deadline
 from ..resilience import configure as configure_resilience
 from ..resilience.breaker import BOARD
 from ..resilience.scheduler import (
+    PRIORITY_INTERACTIVE,
     PRIORITY_NAMES,
     SloScheduler,
     SweepDetector,
@@ -799,6 +801,26 @@ class PixelBufferApp:
                 max_images=sp.max_annotation_images,
                 max_per_image=sp.max_annotations_per_image,
             )
+        # ingest plane (ingest/, r24): the authenticated write path.
+        # Off by default — the service stays a read-only viewer
+        # backend unless the operator opens the surface. Writes go
+        # through the SAME PixelsService the readers use, so the ACL
+        # resolver, buffer cache, and invalidation machinery all see
+        # one image identity.
+        self.ingest = None
+        ig = config.ingest
+        if ig.enabled:
+            from ..ingest import IngestPlane
+
+            self.ingest = IngestPlane(
+                self.pixels_service,
+                max_inflight_shards=ig.max_inflight_shards,
+                staging_bytes=ig.staging_bytes,
+            )
+        # local epoch fallback when no cluster epoch registry exists:
+        # a post-commit token so open buffers' shard-index memos still
+        # invalidate (io/zarr.py note_epoch keys on change, not order)
+        self._ingest_epoch_seq = 0
         if cc.enabled:
             admission = None
             if cc.tinylfu.enabled:
@@ -1006,6 +1028,13 @@ class PixelBufferApp:
             from ..cluster.replicate import MAX_TRANSFER_BYTES
 
             max_body = max(max_body, MAX_TRANSFER_BYTES + 65536)
+        if self.ingest is not None:
+            # ingest bodies carry raw pixels; anything larger than the
+            # staging bound would be refused by the assembler anyway,
+            # so cap the transport at the same number
+            max_body = max(
+                max_body, self.config.ingest.staging_bytes + 65536
+            )
         app = web.Application(
             middlewares=middlewares, client_max_size=max_body
         )
@@ -1023,6 +1052,19 @@ class PixelBufferApp:
         app.router.add_get(
             "/tile/{imageId}/{z}/{c}/{t}", self.handle_get_tile
         )
+        if self.ingest is not None:
+            # ingest plane (r24): the write surface. Behind the session
+            # middleware (cookie auth) like every /image-scoped route;
+            # deliberately NOT a SERVING_PREFIXES lane — the scheduler
+            # pin lives in-handler (acquire(degradable=False), no sweep
+            # or prefetch training), same posture as the session plane
+            app.router.add_put(
+                "/image/{imageId}/tile/{z}/{c}/{t}",
+                self.handle_ingest_tile,
+            )
+            app.router.add_post(
+                "/image/{imageId}/planes", self.handle_ingest_planes
+            )
         if self.cache_plane is not None:
             app.router.add_post(
                 "/internal/purge/{imageId}", self.handle_internal_purge
@@ -1381,6 +1423,7 @@ class PixelBufferApp:
             "analysis": analysis_health,
             "protocols": getattr(self, "_protocols_enabled", {}),
             "session": self._session_snapshot(),
+            "ingest": self._ingest_snapshot(),
             "device_queue": device_queue,
             "io": io_snapshot(),
             "request_budget_ms": self.request_budget_s * 1000.0,
@@ -1767,6 +1810,19 @@ class PixelBufferApp:
         change), the open buffer, and device planes. Callable from any
         thread; also the inbound target of a peer purge (which must
         NOT re-fan-out, or two replicas would purge-ping-pong)."""
+        epoch = None
+        plane = self.cache_plane
+        if plane is not None and plane.epochs is not None:
+            epoch = plane.epochs.known(image_id)
+        if epoch is not None:
+            # r24: stamp the epoch onto the OPEN buffer BEFORE the
+            # pipeline purge pops it from the service cache —
+            # concurrent requests still holding the buffer object get
+            # shard-index-memo misses on their next footer lookup
+            # instead of serving pre-commit offsets (io/zarr.py)
+            note = getattr(self.pixels_service, "note_epoch", None)
+            if note is not None:
+                note(image_id, epoch)
         if self.result_cache is not None:
             self.result_cache.invalidate_image(image_id)
         if self.prefetcher is not None:
@@ -1780,10 +1836,6 @@ class PixelBufferApp:
             # what makes a purge on replica A reach a viewer whose
             # channel lives on replica B without any new fan-out
             # machinery. Thread-safe (resolver refresh thread included).
-            epoch = None
-            plane = self.cache_plane
-            if plane is not None and plane.epochs is not None:
-                epoch = plane.epochs.known(image_id)
             self.session_channels.push_delta(image_id, epoch=epoch)
 
     def _invalidate_image(self, image_id: int) -> None:
@@ -2197,6 +2249,210 @@ class PixelBufferApp:
             return web.Response(status=404, text="no such annotation")
         self._annotation_changed(image_id, sub_epoch)
         return web.json_response({"deleted": True, "epoch": sub_epoch})
+
+    # -- ingest plane (ingest/, r24) ------------------------------------
+
+    def _ingest_snapshot(self) -> dict:
+        if self.ingest is None:
+            return {"enabled": False}
+        out = self.ingest.snapshot()
+        out["enabled"] = True
+        return out
+
+    async def _ingest_allowed(
+        self, image_id: int, session_key: str
+    ) -> bool:
+        """Write-permission check against the metadata resolver. A
+        resolver without a write surface (the plain filesystem
+        registry — no ACL model at all) allows writes, matching the
+        read posture; a permission-scoped resolver (db/metadata)
+        answers from the OMERO permissions long (can_write)."""
+        resolver = getattr(
+            self.pixels_service, "metadata_resolver", None
+        )
+        can_write = getattr(resolver, "can_write_image", None)
+        if can_write is None:
+            return True
+        loop = asyncio.get_running_loop()
+        return bool(
+            await loop.run_in_executor(
+                None, can_write, image_id, session_key
+            )
+        )
+
+    async def _ingest_commit(
+        self,
+        request: web.Request,
+        image_id: int,
+        tiles: list,
+    ) -> web.Response:
+        """The shared write path: ACL -> scheduler (pinned
+        non-degradable, never trains sweep/prefetch) -> stage+commit
+        on a worker thread -> epoch bump FIRST, then every purge and
+        the session delta frames (the r17 write-side contract)."""
+        from ..ingest import IngestError
+
+        session_key = request.get("omero.session_key", "")
+        if not await self._ingest_allowed(image_id, session_key):
+            return web.Response(
+                status=403, text=f"Cannot write Image:{image_id}"
+            )
+        sched = self.scheduler
+        permit = None
+        deadline = Deadline.after(self.request_budget_s)
+        if sched is not None:
+            # the ingest scheduler pin: writes are interactive-class
+            # but NEVER degradable (a "degraded" write makes no
+            # sense), and they must not train the viewer-facing
+            # models — a linear acquisition scan IS the canonical
+            # sweep shape, and feeding it to the sweep detector or
+            # prefetcher would demote/chase the writer's own session
+            try:
+                permit = await sched.acquire(
+                    PRIORITY_INTERACTIVE, deadline, degradable=False
+                )
+            except TileError as e:
+                return self._failure_response(request, e)
+        try:
+            plane = self.ingest
+
+            def _commit() -> dict:
+                with obs_recorder.ambient_stage("ingest"):
+                    return plane.write_tiles(
+                        image_id, tiles, session_key=session_key
+                    )
+
+            loop = asyncio.get_running_loop()
+            # copy_context: the obs ambient record is a contextvar and
+            # run_in_executor does not propagate it on its own — the
+            # "ingest" stage stamp must land on THIS request's record
+            cvctx = contextvars.copy_context()
+            try:
+                stats = await loop.run_in_executor(
+                    None, lambda: cvctx.run(_commit)
+                )
+            except IngestError as e:
+                return web.Response(status=e.code, text=e.message)
+            except TileError as e:
+                return self._failure_response(request, e)
+            except Exception as e:
+                # a store/codec failure mid-commit is a dependency
+                # problem, not a missing image — never the generic
+                # 404 mapping. Nothing partial became visible: each
+                # object publishes atomically and the fault points
+                # fire BEFORE the publish.
+                log.warning("ingest commit failed: %s", e)
+                return web.Response(
+                    status=503, text=f"ingest commit failed: {e}"
+                )
+        finally:
+            if permit is not None:
+                # writes never train the read service-time EWMA: a
+                # multi-second shard rebuild would inflate the
+                # estimate and engage read degradation spuriously
+                sched.release(permit, train=False)
+        # commit is durable: bump the image epoch FIRST (r17 — every
+        # consistency decision downstream keys on it), then purge
+        # every local tier, then the best-effort cluster fan-out
+        epoch = None
+        cache_plane = self.cache_plane
+        if cache_plane is not None and cache_plane.epochs is not None:
+            await cache_plane.epochs.bump(image_id)
+            epoch = cache_plane.epochs.known(image_id)
+        else:
+            # no epoch registry: synthesize a local token so open
+            # buffers' shard-index memos still invalidate
+            self._ingest_epoch_seq += 1
+            note = getattr(self.pixels_service, "note_epoch", None)
+            if note is not None:
+                note(image_id, self._ingest_epoch_seq)
+        self._invalidate_image(image_id)
+        if self.session_channels is not None:
+            # tile-granular delta on top of _invalidate_local's
+            # whole-image frame: subscribed viewers re-fetch just the
+            # written tiles instead of their whole viewport
+            self.session_channels.push_delta(
+                image_id,
+                epoch=self._session_epoch(image_id),
+                tiles=[t[:7] for t in tiles],
+            )
+        body = {"image": image_id, "epoch": epoch}
+        body.update(stats)
+        return web.json_response(body)
+
+    async def handle_ingest_tile(self, request: web.Request) -> web.Response:
+        """PUT /image/{imageId}/tile/{z}/{c}/{t}?x&y&w&h — one raw
+        tile write: body is w*h big-endian pixels of the image's
+        dtype (the byte order the raw /tile read surface serves, so
+        PUT bytes round-trip to GET bytes exactly). Readable back
+        byte-identical through every read surface the moment the
+        response returns."""
+        try:
+            image_id = int(request.match_info["imageId"])
+            z = int(request.match_info["z"])
+            c = int(request.match_info["c"])
+            t = int(request.match_info["t"])
+            x = int(request.query["x"])
+            y = int(request.query["y"])
+            w = int(request.query["w"])
+            h = int(request.query["h"])
+        except (KeyError, TypeError, ValueError):
+            return web.Response(
+                status=400,
+                text="expected /image/{id}/tile/{z}/{c}/{t}?x&y&w&h "
+                "with integer values",
+            )
+        raw = await request.read()
+        return await self._ingest_commit(
+            request, image_id, [(z, c, t, x, y, w, h, raw)]
+        )
+
+    async def handle_ingest_planes(self, request: web.Request) -> web.Response:
+        """POST /image/{imageId}/planes?planes=z:c:t,z:c:t,... —
+        batched whole-plane append: the body is the listed planes'
+        raw big-endian pixels concatenated in order, each a full
+        size_x * size_y plane. One commit, one epoch bump — the
+        batch's natural unit for an acquisition loop appending a
+        z-stack or timepoint."""
+        try:
+            image_id = int(request.match_info["imageId"])
+        except (TypeError, ValueError):
+            return web.Response(status=400, text="bad image id")
+        spec = request.query.get("planes", "")
+        coords = []
+        try:
+            for part in spec.split(","):
+                z, c, t = (int(v) for v in part.split(":"))
+                coords.append((z, c, t))
+        except (TypeError, ValueError):
+            return web.Response(
+                status=400,
+                text="expected ?planes=z:c:t[,z:c:t...] "
+                "with integer coordinates",
+            )
+        session_key = request.get("omero.session_key", "")
+        loop = asyncio.get_running_loop()
+        meta = await loop.run_in_executor(
+            None, self.pixels_service.get_pixels, image_id, session_key
+        )
+        if meta is None:
+            return web.Response(
+                status=404, text=f"Cannot find Image:{image_id}"
+            )
+        raw = await request.read()
+        if not raw or len(raw) % len(coords):
+            return web.Response(
+                status=400,
+                text=f"body ({len(raw)} bytes) is not {len(coords)} "
+                "equal whole planes",
+            )
+        step = len(raw) // len(coords)
+        tiles = [
+            (z, c, t, 0, 0, meta.size_x, meta.size_y,
+             raw[i * step:(i + 1) * step])
+            for i, (z, c, t) in enumerate(coords)
+        ]
+        return await self._ingest_commit(request, image_id, tiles)
 
     async def handle_internal_purge(self, request: web.Request) -> web.Response:
         """Inbound half of the purge fan-out. Requires the peer

@@ -1,0 +1,38 @@
+"""Ingest plane (r24): Zarr v3 shard write/append while serving.
+
+The service grew up as a read-only viewer backend; this package is the
+storage half acquisition pipelines need (ROADMAP 3c, the Iris paper's
+"one mutating image, many viewers" scenario): authenticated HTTP
+writes land as Zarr chunks / ``sharding_indexed`` shards through the
+SAME codec machinery the read path decodes with, and every commit
+rides the r17 epoch contract — bump the image epoch FIRST, then purge
+every cache tier, fan out over the cluster purge path, and push an
+invalidation frame on subscribed session channels — so a concurrent
+reader only ever sees fully-old or fully-new bytes (stale-until-
+epoch-bump is the one allowed window).
+
+- ``ShardAssembler`` — stages incoming tiles into full inner chunks
+  (read-modify-write against the live array), then commits each
+  touched object atomically: chunk objects for unsharded arrays, a
+  rebuilt body + crc32c-checksummed (offset, nbytes) index for
+  sharded ones. Commit atomicity comes from the store (FileStore
+  write-then-rename, S3 single-PUT/multipart semantics).
+- ``IngestPlane`` — per-image write serialization, staging/inflight
+  bounds (config ``ingest:``), fault points (``ingest.commit``,
+  ``ingest.index``) and counters for /healthz.
+
+The HTTP surface (PUT /image/{id}/tile/..., POST /image/{id}/planes)
+lives in http/server.py; scheduling policy there is pinned: writes
+``acquire(degradable=False)`` and never train the sweep detector or
+the prefetcher — a linear acquisition scan IS the canonical sweep
+shape, and demoting the writer's session would shed its own viewers'
+pans.
+"""
+
+from .assembler import (  # noqa: F401
+    IngestError,
+    IngestPlane,
+    ShardAssembler,
+)
+
+__all__ = ["IngestError", "IngestPlane", "ShardAssembler"]

@@ -283,11 +283,19 @@ class FetchPool:
         headers: dict,
         timeout_s: float,
         breaker=NULL_BREAKER,
+        method: str = "GET",
+        body: Optional[bytes] = None,
     ) -> Tuple[int, bytes]:
-        """One GET over a pooled connection: (status, body). The
+        """One request over a pooled connection: (status, body). The
         ``breaker`` gate is for direct callers; the store paths pass
         ``NULL_BREAKER`` because ``resilient_get`` already gated (a
-        second ``allow()`` would double-count half-open probes)."""
+        second ``allow()`` would double-count half-open probes).
+        ``method``/``body`` extend the pool to the ingest plane's
+        writes (PUT/POST) over the same keep-alive sockets; a non-GET
+        retried on a reused-socket failure is safe for S3/object-store
+        semantics (idempotent full-object PUT) because the retry only
+        fires when the request never reached the server (the socket
+        died while idle)."""
         breaker.allow()
         INJECTOR.fire("io.fetch-pool")
         parsed = urllib.parse.urlsplit(url)
@@ -316,9 +324,9 @@ class FetchPool:
                     )
                     conn = cls(parsed.netloc, timeout=timeout_s)
                 try:
-                    conn.request("GET", path, headers=headers)
+                    conn.request(method, path, body=body, headers=headers)
                     resp = conn.getresponse()
-                    body = resp.read()  # drain so the socket is reusable
+                    data = resp.read()  # drain so the socket is reusable
                 except (http.client.HTTPException, OSError) as e:
                     conn.close()
                     with self._lock:
@@ -328,7 +336,9 @@ class FetchPool:
                     # outage and belongs to the caller's retry policy
                     if reused and attempt == 0:
                         continue
-                    raise StoreError(f"GET {url} failed: {e}") from None
+                    raise StoreError(
+                        f"{method} {url} failed: {e}"
+                    ) from None
                 with self._lock:
                     self._in_use[key] -= 1
                     idle = self._idle.setdefault(key, [])
@@ -336,8 +346,8 @@ class FetchPool:
                         idle.append(conn)
                     else:
                         conn.close()
-                return resp.status, body
-            raise StoreError(f"GET {url} failed")  # pragma: no cover
+                return resp.status, data
+            raise StoreError(f"{method} {url} failed")  # pragma: no cover
         finally:
             sem.release()
 

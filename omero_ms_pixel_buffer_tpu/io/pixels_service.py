@@ -298,6 +298,23 @@ class PixelsService:
                 pass  # invalidation must never fail the caller
         return getattr(buf, "cache_ns", None)
 
+    def note_epoch(self, image_id: int, epoch: Optional[int]) -> None:
+        """Stamp the image epoch onto the OPEN buffer's shard-index
+        memo without popping it (r24). ``invalidate`` already purges
+        when the buffer is dropped; this covers concurrent requests
+        still holding the buffer mid-read — their next footer lookup
+        misses instead of serving pre-commit offsets."""
+        with self._lock:
+            buf = self._cache.get(int(image_id))
+        if buf is None:
+            return
+        note = getattr(buf, "note_epoch", None)
+        if note is not None:
+            try:
+                note(epoch)
+            except Exception:
+                pass  # invalidation must never fail the caller
+
     def close(self) -> None:
         with self._lock:
             for buf in self._cache.values():

@@ -37,6 +37,12 @@ coalesced, parallel fetch over one shared bounded per-host connection
 pool. A failed ranged request degrades to a single whole-key GET;
 ``io.parallel-fetch: false`` restores the sequential path.
 
+The ingest plane (r24) adds the write half: ``FileStore.put`` is
+write-then-rename (a reader sees the whole old object or the whole
+new one, never a torn prefix) and ``S3Store.put`` is a SigV4-signed
+PUT — multipart past a size threshold — atomic at S3 semantics.
+``HTTPStore`` stays read-only (a static origin has no write contract).
+
 ``make_store(uri)`` picks by scheme.
 """
 
@@ -47,6 +53,7 @@ import datetime
 import hashlib
 import hmac
 import os
+import tempfile
 import time
 import urllib.parse
 from typing import List, Optional, Sequence, Tuple
@@ -199,6 +206,32 @@ class FileStore:
     ) -> List[Optional[bytes]]:
         return fetch_many(self, requests, stats=stats)
 
+    def put(self, key: str, data: bytes) -> None:
+        """Atomic whole-object write: the bytes land in a same-
+        directory temp file (fsync'd), then ``os.replace`` onto the
+        key — a concurrent reader observes either the complete old
+        object or the complete new one, never a torn prefix (the
+        ingest plane's commit contract)."""
+        path = os.path.join(self.root, validate_key(key))
+        parent = os.path.dirname(path) or "."
+        os.makedirs(parent, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(
+            prefix=os.path.basename(path) + ".", suffix=".tmp",
+            dir=parent,
+        )
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
     def describe(self) -> str:
         return self.root
 
@@ -310,6 +343,17 @@ def _sign(key: bytes, msg: str) -> bytes:
     return hmac.new(key, msg.encode(), hashlib.sha256).digest()
 
 
+def _canonical_query(query: Optional[dict]) -> str:
+    """RFC 3986 canonical query string (SigV4 rules: sorted keys,
+    percent-encoding with unreserved chars kept). Used for BOTH the
+    signature and the wire URL so the two can never diverge."""
+    return "&".join(
+        f"{urllib.parse.quote(str(k), safe='-_.~')}"
+        f"={urllib.parse.quote(str(v), safe='-_.~')}"
+        for k, v in sorted((query or {}).items())
+    )
+
+
 def sigv4_headers(
     method: str,
     host: str,
@@ -322,16 +366,20 @@ def sigv4_headers(
     now: Optional[datetime.datetime] = None,
     service: str = "s3",
     extra_headers: Optional[dict] = None,
+    query: Optional[dict] = None,
 ) -> dict:
-    """AWS Signature Version 4 headers for a request with no query
-    string. Exposed standalone so tests can verify signatures
-    server-side. ``extra_headers`` (e.g. ``range`` for a ranged GET)
-    are included in the signature — S3 accepts signed Range headers,
-    and signing everything we send keeps the canonical request
-    unambiguous."""
+    """AWS Signature Version 4 headers. Exposed standalone so tests
+    can verify signatures server-side. ``extra_headers`` (e.g.
+    ``range`` for a ranged GET) are included in the signature — S3
+    accepts signed Range headers, and signing everything we send keeps
+    the canonical request unambiguous. ``query`` carries the request's
+    query parameters into the canonical request (multipart uploads
+    sign ``uploads`` / ``partNumber`` / ``uploadId``); the caller must
+    send the SAME parameters on the wire."""
     now = now or datetime.datetime.now(datetime.timezone.utc)
     amz_date = now.strftime("%Y%m%dT%H%M%SZ")
     datestamp = now.strftime("%Y%m%d")
+    canonical_query = _canonical_query(query)
     headers = {
         "host": host,
         "x-amz-content-sha256": payload_sha256,
@@ -348,8 +396,8 @@ def sigv4_headers(
         f"{k}:{headers[k]}\n" for k in sorted(headers)
     )
     canonical_request = "\n".join(
-        [method, canonical_uri, "", canonical_headers, signed,
-         payload_sha256]
+        [method, canonical_uri, canonical_query, canonical_headers,
+         signed, payload_sha256]
     )
     scope = f"{datestamp}/{region}/{service}/aws4_request"
     string_to_sign = "\n".join(
@@ -482,6 +530,44 @@ class S3Store:
             return None
         return fresh
 
+    def _signed_request(
+        self,
+        method: str,
+        key: str,
+        body: Optional[bytes] = None,
+        creds: Optional[Tuple] = None,
+        extra_headers: Optional[dict] = None,
+        point: str = "store.s3",
+        query: Optional[dict] = None,
+    ) -> Tuple[int, bytes]:
+        """One SigV4-signed request through the shared pool. Writes
+        (PUT/POST) sign the payload sha256 and the query string
+        (multipart uploads); GETs keep the historical empty-payload
+        signature."""
+        url, canonical_path = self._url_and_path(key)
+        if query:
+            url += "?" + _canonical_query(query)
+        access, secret, token = creds if creds is not None else self._creds
+        headers: dict = dict(extra_headers or {})
+        if access and secret:
+            host = urllib.parse.urlparse(url).netloc
+            headers = sigv4_headers(
+                method, host, canonical_path, self.region,
+                access, secret, token,
+                payload_sha256=(
+                    hashlib.sha256(body or b"").hexdigest()
+                    if method != "GET" else _EMPTY_SHA256
+                ),
+                extra_headers=extra_headers, query=query,
+            )
+        return _get_with_retry(
+            lambda: POOL.request(
+                url, headers, self.timeout_s, method=method, body=body
+            ),
+            breaker=self.breaker, point=point,
+            name=f"s3://{self.bucket}",
+        )
+
     def _signed_get(
         self,
         key: str,
@@ -489,19 +575,9 @@ class S3Store:
         extra_headers: Optional[dict] = None,
         point: str = "store.s3",
     ) -> Tuple[int, bytes]:
-        url, canonical_path = self._url_and_path(key)
-        access, secret, token = creds if creds is not None else self._creds
-        headers: dict = dict(extra_headers or {})
-        if access and secret:
-            host = urllib.parse.urlparse(url).netloc
-            headers = sigv4_headers(
-                "GET", host, canonical_path, self.region,
-                access, secret, token, extra_headers=extra_headers,
-            )
-        return _get_with_retry(
-            lambda: POOL.request(url, headers, self.timeout_s),
-            breaker=self.breaker, point=point,
-            name=f"s3://{self.bucket}",
+        return self._signed_request(
+            "GET", key, creds=creds, extra_headers=extra_headers,
+            point=point,
         )
 
     def get(self, key: str) -> Optional[bytes]:
@@ -584,6 +660,100 @@ class S3Store:
         stats: Optional[FetchStats] = None,
     ) -> List[Optional[bytes]]:
         return fetch_many(self, requests, stats=stats)
+
+    # one multipart part must be >= 5 MiB (S3 minimum, except the
+    # last); bodies past the threshold upload in parts so a shard
+    # bigger than one request's comfort zone still commits atomically
+    # (S3 materializes the key only at CompleteMultipartUpload)
+    multipart_threshold = 64 << 20
+    multipart_part_size = 16 << 20
+
+    def put(self, key: str, data: bytes) -> None:
+        """SigV4-signed whole-object write. Single PUT below
+        ``multipart_threshold``; multipart above it. Both are atomic
+        at S3 semantics: the key serves either the previous object or
+        the complete new one — an aborted upload never surfaces. Part
+        ETags are computed locally (MD5 of the part — S3's documented
+        ETag for non-SSE-KMS parts) because the shared pool returns
+        (status, body) only; SSE-KMS buckets would need response-
+        header capture (out of scope, KNOWN_GAPS)."""
+        validate_key(key)
+        if len(data) <= self.multipart_threshold:
+            status, body = self._signed_request(
+                "PUT", key, body=data, point="store.s3",
+            )
+            if status != 200:
+                raise StoreError(
+                    f"S3 PUT {status} for s3://{self.bucket}/{key}"
+                )
+            return
+        self._multipart_put(key, data)
+
+    def _multipart_put(self, key: str, data: bytes) -> None:
+        status, body = self._signed_request(
+            "POST", key, body=b"", query={"uploads": ""},
+            point="store.s3",
+        )
+        if status != 200:
+            raise StoreError(
+                f"S3 CreateMultipartUpload {status} for "
+                f"s3://{self.bucket}/{key}"
+            )
+        text = body.decode("utf-8", "replace")
+        lo = text.find("<UploadId>")
+        hi = text.find("</UploadId>")
+        if lo < 0 or hi < 0:
+            raise StoreError(
+                f"S3 CreateMultipartUpload returned no UploadId for "
+                f"s3://{self.bucket}/{key}"
+            )
+        upload_id = text[lo + len("<UploadId>"):hi]
+        try:
+            etags = []
+            psize = self.multipart_part_size
+            for n, off in enumerate(range(0, len(data), psize), 1):
+                part = data[off:off + psize]
+                status, _ = self._signed_request(
+                    "PUT", key, body=part,
+                    query={"partNumber": n, "uploadId": upload_id},
+                    point="store.s3",
+                )
+                if status != 200:
+                    raise StoreError(
+                        f"S3 UploadPart {status} (part {n}) for "
+                        f"s3://{self.bucket}/{key}"
+                    )
+                etags.append(hashlib.md5(part).hexdigest())
+            complete = "".join(
+                f"<Part><PartNumber>{n}</PartNumber>"
+                f"<ETag>&quot;{etag}&quot;</ETag></Part>"
+                for n, etag in enumerate(etags, 1)
+            )
+            payload = (
+                "<CompleteMultipartUpload>"
+                f"{complete}</CompleteMultipartUpload>"
+            ).encode()
+            status, body = self._signed_request(
+                "POST", key, body=payload,
+                query={"uploadId": upload_id}, point="store.s3",
+            )
+            # S3 can answer 200 with an <Error> body for a failed
+            # complete — treat any Error element as failure
+            if status != 200 or b"<Error>" in body:
+                raise StoreError(
+                    f"S3 CompleteMultipartUpload {status} for "
+                    f"s3://{self.bucket}/{key}"
+                )
+        except BaseException:
+            # best-effort abort so half-uploaded parts don't accrue
+            try:
+                self._signed_request(
+                    "DELETE", key, query={"uploadId": upload_id},
+                    point="store.s3",
+                )
+            except Exception:
+                pass
+            raise
 
     def describe(self) -> str:
         return f"s3://{self.bucket}/{self.prefix}"

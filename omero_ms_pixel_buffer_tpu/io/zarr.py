@@ -256,12 +256,16 @@ class ZarrArray:
         self.codecs: Optional[list] = None  # v3 pipeline when set
         self.sharding: Optional[_ShardInfo] = None
         # shard key -> (parsed index array | None for absent shard,
-        # stamp); bounded LRU with a process-wide TTL so a rewritten
-        # shard's new footer is observed without a restart;
-        # lock-shared by the batch planner's threads
+        # stamp, epoch token); bounded LRU with a process-wide TTL so
+        # a rewritten shard's new footer is observed without a
+        # restart, and keyed by the image epoch (r24) so an ingest
+        # commit or cluster-propagated rewrite invalidates it
+        # IMMEDIATELY — no TTL wait; lock-shared by the batch
+        # planner's threads
         self._shard_indexes: "OrderedDict[str, tuple]" = OrderedDict()
         self._shard_lock = threading.Lock()
         self._shard_clock = time.monotonic  # test injection point
+        self._memo_epoch: Optional[int] = None  # last noted image epoch
         raw_meta = store.get(self._key(".zarray"))
         if raw_meta is not None:
             self._init_v2(json.loads(raw_meta))
@@ -464,8 +468,14 @@ class ZarrArray:
             hit = self._shard_indexes.get(key, _MISSING)
             if hit is _MISSING:
                 return _MISSING
-            index, stamp = hit
-            if ttl > 0 and self._shard_clock() - stamp > ttl:
+            index, stamp, epoch_tok = hit
+            # epoch mismatch: the image advanced since this footer was
+            # read (ingest commit / external rewrite) — a stale
+            # (offset, nbytes) table on a rewritten object means
+            # corrupt reads, so this is a miss regardless of TTL
+            if epoch_tok != self._memo_epoch or (
+                ttl > 0 and self._shard_clock() - stamp > ttl
+            ):
                 del self._shard_indexes[key]
                 return _MISSING
             self._shard_indexes.move_to_end(key)
@@ -473,10 +483,26 @@ class ZarrArray:
 
     def _store_shard_index(self, key: str, index) -> None:
         with self._shard_lock:
-            self._shard_indexes[key] = (index, self._shard_clock())
+            self._shard_indexes[key] = (
+                index, self._shard_clock(), self._memo_epoch
+            )
             self._shard_indexes.move_to_end(key)
             while len(self._shard_indexes) > 512:
                 self._shard_indexes.popitem(last=False)
+
+    def note_epoch(self, epoch: Optional[int]) -> int:
+        """Key the shard-index memo by image epoch (r24): when the
+        noted epoch ADVANCES, every memoized footer is dropped at once
+        (entries also carry their epoch, so a concurrent reader mid-
+        transition can never resurrect an old-epoch footer). Returns
+        the number of entries dropped. Idempotent per epoch value."""
+        with self._shard_lock:
+            if epoch == self._memo_epoch:
+                return 0
+            self._memo_epoch = epoch
+            n = len(self._shard_indexes)
+            self._shard_indexes.clear()
+            return n
 
     def purge_shard_indexes(self) -> int:
         """Drop every memoized shard index (image invalidation);
@@ -485,6 +511,10 @@ class ZarrArray:
             n = len(self._shard_indexes)
             self._shard_indexes.clear()
             return n
+
+    def _drop_shard_index(self, key: str) -> None:
+        with self._shard_lock:
+            self._shard_indexes.pop(key, None)
 
     def _load_shard_index(
         self, shard_idx: Tuple[int, ...]
@@ -642,10 +672,98 @@ class ZarrArray:
     def read_chunk(self, idx: Tuple[int, ...]) -> Optional[np.ndarray]:
         """Decode one chunk (full chunk shape, padded at array edges) or
         None when the chunk key is absent (fill_value)."""
-        raw = self._chunk_payload(idx)
-        if raw is None:
-            return None
-        return self._decode_chunk(raw, idx)
+        try:
+            raw = self._chunk_payload(idx)
+            if raw is None:
+                return None
+            return self._decode_chunk(raw, idx)
+        except ZarrError:
+            if self.sharding is None:
+                raise
+            # A concurrent commit may have replaced the shard object
+            # under our memoized footer (r24). The index lives INSIDE
+            # the object and write-then-rename is atomic, so on-disk
+            # state is always self-consistent — only the memo can be
+            # stale. Drop it and re-resolve once: the fresh footer and
+            # the data range come from the same object generation, so
+            # the retry reads fully-new bytes, never a mix. A second
+            # failure is genuine corruption and raises strictly.
+            shard_idx, _ = self._locate_inner(idx)
+            self._drop_shard_index(self._chunk_key(shard_idx))
+            raw = self._chunk_payload(idx)
+            if raw is None:
+                return None
+            return self._decode_chunk(raw, idx)
+
+    def encode_chunk(self, chunk: np.ndarray) -> bytes:
+        """One full-shape chunk -> its on-disk payload: the exact
+        forward image of the decode path (same codec chain, same
+        framing), so bytes written by the ingest plane read back
+        identically through every engine. Byte order is coerced to
+        the array's on-disk dtype."""
+        if tuple(chunk.shape) != tuple(self.chunks):
+            raise ZarrError(
+                f"encode_chunk expects shape {self.chunks}, "
+                f"got {tuple(chunk.shape)}"
+            )
+        raw = np.ascontiguousarray(
+            chunk.astype(self.dtype, copy=False)
+        ).tobytes()
+        if self.codecs is not None:  # v3 pipeline, forward order
+            for name, conf in self.codecs:
+                if name == "gzip":
+                    raw = gzip.compress(raw, int(conf.get("level", 5)))
+                elif name == "zstd":
+                    if _zstd is None:  # pragma: no cover
+                        raise ZarrError("zstd unavailable")
+                    raw = _zstd.ZstdCompressor(
+                        level=int(conf.get("level", 3))
+                    ).compress(raw)
+                elif name == "blosc":
+                    shuffle = conf.get("shuffle", "shuffle")
+                    if shuffle == "bitshuffle":
+                        raise ZarrError(
+                            "blosc bitshuffle encode is not supported"
+                        )
+                    from ..ops.blosc import blosc_compress
+
+                    raw = blosc_compress(
+                        raw, typesize=self.dtype.itemsize,
+                        cname=conf.get("cname", "lz4"),
+                        shuffle=(shuffle != "noshuffle"),
+                    )
+                elif name == "crc32c":
+                    raw += struct.pack("<I", crc32c(raw))
+                else:  # unreachable (validated at init)
+                    raise ZarrError(f"Unsupported v3 codec: {name}")
+            return raw
+        if self.compressor:  # v2 compressor dict
+            cid = self.compressor["id"]
+            level = int(self.compressor.get("level", 6) or 6)
+            if cid == "zlib":
+                return zlib.compress(raw, level)
+            if cid == "gzip":
+                return gzip.compress(raw, level)
+            if cid == "zstd":
+                if _zstd is None:  # pragma: no cover
+                    raise ZarrError("zstd unavailable")
+                return _zstd.ZstdCompressor(level=level).compress(raw)
+            if cid == "lz4":
+                from ..ops.lz4 import lz4_block_compress
+
+                return struct.pack("<i", len(raw)) + lz4_block_compress(
+                    raw
+                )
+            if cid == "blosc":
+                from ..ops.blosc import blosc_compress
+
+                return blosc_compress(
+                    raw, typesize=self.dtype.itemsize,
+                    cname=self.compressor.get("cname", "lz4"),
+                    shuffle=bool(self.compressor.get("shuffle", 1)),
+                )
+            raise ZarrError(f"Unsupported compressor: {cid}")
+        return raw
 
     # -- the batch planner (r14) ----------------------------------------
 
@@ -898,6 +1016,13 @@ class ZarrPixelBuffer(PixelBuffer):
         image invalidation so a rewritten shard is observed without
         waiting out the TTL)."""
         return sum(a.purge_shard_indexes() for a in self.levels)
+
+    def note_epoch(self, epoch: Optional[int]) -> int:
+        """Propagate the image epoch to every level's shard-index
+        memo (r24): an advanced epoch drops all memoized footers, so
+        a commit is observed by an ALREADY-OPEN buffer with no TTL
+        wait and no buffer re-open."""
+        return sum(a.note_epoch(epoch) for a in self.levels)
 
     def level_size(self, level: Optional[int] = None) -> Tuple[int, int]:
         lv = self._resolution_level if level is None else level

@@ -71,6 +71,7 @@ STAGES = (
     "device",      # device encode queue: submit -> group resolution
     "encode",      # host encode + container framing
     "frame",       # HTTP response assembly
+    "ingest",      # ingest plane: shard assembly + store commit
 )
 _STAGE_INDEX = {name: i for i, name in enumerate(STAGES)}
 _N = len(STAGES)

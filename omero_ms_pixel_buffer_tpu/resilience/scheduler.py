@@ -456,10 +456,17 @@ class SloScheduler:
         (shed — queue genuinely full and this request is the least
         valuable work in sight) or ``GatewayTimeoutError`` (the
         deadline expired while waiting). ``degradable=False`` (raw/
-        TIFF measurement surfaces) means the grant is never flagged
-        for the hybrid-resolution fallback — so ``slo_degraded_total``
-        counts only requests that can actually degrade, and those
-        full-resolution serves keep training the service-time EWMA."""
+        TIFF measurement surfaces, and every ingest write — r24: a
+        "degraded" write makes no sense) means the grant is never
+        flagged for the hybrid-resolution fallback — so
+        ``slo_degraded_total`` counts only requests that can actually
+        degrade, and those full-resolution serves keep training the
+        service-time EWMA. Ingest callers additionally release with
+        ``train=False`` and never feed the sweep detector or the
+        prefetcher: a linear acquisition scan IS the canonical sweep
+        shape, and a multi-second shard rebuild in the EWMA would
+        engage read degradation spuriously (the pin
+        tests/test_ingest.py holds the HTTP layer to)."""
         priority = min(max(int(priority), 0), PRIORITY_BULK)
         self.classified[priority] += 1
         if self._waiting_total == 0 and self.admission.try_slot():

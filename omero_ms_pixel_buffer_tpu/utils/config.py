@@ -599,6 +599,22 @@ class MeshConfig:
 
 
 @dataclasses.dataclass
+class IngestConfig:
+    """The ingest: block — the r24 write path (ingest/assembler.py).
+
+    Off by default: the service stays a pure read-only viewer backend
+    unless an operator explicitly opens the write surface. The bounds
+    cap a single request's staged state: ``max_inflight_shards`` is
+    the most distinct store objects (shards, or chunks when unsharded)
+    one commit may touch; ``staging_bytes`` bounds the decoded chunks
+    held in RAM while tiles assemble."""
+
+    enabled: bool = False
+    max_inflight_shards: int = 64
+    staging_bytes: int = 256 << 20
+
+
+@dataclasses.dataclass
 class JaxConfig:
     """The jax: block — runtime knobs for the accelerator toolchain.
 
@@ -676,6 +692,7 @@ class Config:
         default_factory=SupertileConfig
     )
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    ingest: IngestConfig = dataclasses.field(default_factory=IngestConfig)
     jax: JaxConfig = dataclasses.field(default_factory=JaxConfig)
     logging: LoggingConfig = dataclasses.field(default_factory=LoggingConfig)
     # Filesystem image registry (stands in for the OMERO Postgres
@@ -1503,6 +1520,43 @@ class Config:
         )
 
     @staticmethod
+    def _parse_ingest(raw: dict) -> IngestConfig:
+        """Validate the ingest: block — same posture as the other
+        blocks: unknown keys and nonsense fail at startup, never
+        silently default (a typo'd `enabled` must not leave a write
+        surface closed — or open — by surprise)."""
+        ig = raw.get("ingest") or {}
+        unknown = set(ig) - {
+            "enabled", "max-inflight-shards", "staging-bytes",
+        }
+        if unknown:
+            raise ConfigError(
+                f"Unknown keys in 'ingest' block: {sorted(unknown)}"
+            )
+
+        def _num(key: str, default, minimum, cast=int):
+            try:
+                value = cast(ig.get(key, default))
+            except (TypeError, ValueError):
+                raise ConfigError(
+                    f"Invalid value for 'ingest.{key}': "
+                    f"{ig.get(key)!r}"
+                ) from None
+            if value < minimum:
+                raise ConfigError(
+                    f"'ingest.{key}' must be >= {minimum}"
+                )
+            return value
+
+        return IngestConfig(
+            enabled=bool(ig.get("enabled", False)),
+            max_inflight_shards=_num("max-inflight-shards", 64, 1),
+            # floor: one 4 MiB chunk — anything smaller could never
+            # stage a single chunk and would reject every write
+            staging_bytes=_num("staging-bytes", 256 << 20, 4 << 20),
+        )
+
+    @staticmethod
     def _parse_mesh(raw: dict) -> MeshConfig:
         """Validate the mesh: block."""
         ms = raw.get("mesh") or {}
@@ -1643,6 +1697,7 @@ class Config:
             protocols=cls._parse_protocols(raw),
             supertile=cls._parse_supertile(raw),
             mesh=cls._parse_mesh(raw),
+            ingest=cls._parse_ingest(raw),
             jax=cls._parse_jax(raw),
             logging=LoggingConfig(
                 file=log_raw.get("file"),

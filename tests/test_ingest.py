@@ -1,0 +1,848 @@
+"""Ingest plane (ingest/, r24): Zarr shard write/append while serving.
+
+The contracts the write path must hold:
+
+- **Write -> read byte identity**: the big-endian bytes a client PUTs
+  come back exactly from the raw /tile surface, and every derived
+  surface (render, DZI, IIIF, the pyramid levels) reflects the write
+  the moment the response returns — no TTL wait, no restart.
+- **Read-modify-write**: a tile write never clobbers neighboring
+  pixels in partially-covered chunks, and untouched inner chunks of a
+  rewritten shard carry over byte-for-byte (sentinels included, both
+  ``index_location`` spellings).
+- **Epoch ordering**: a read racing a commit sees fully-old or
+  fully-new bytes, never a mix — commit publishes whole objects
+  atomically and the epoch bump precedes every purge.
+- **Torn-write chaos**: a fault at ``ingest.commit`` / ``ingest.index``
+  aborts before anything becomes visible; concurrent readers keep
+  serving the old bytes and the write surfaces a 5xx, not silence.
+- **Stale-index-memo regression** (the r14 gap): the per-array shard
+  index memo is epoch-keyed — after a commit, a reader holding the
+  same open buffer misses its memo instead of serving pre-commit
+  offsets, with the TTL clock frozen to prove TTL is uninvolved.
+- **Scheduler pin**: writes acquire non-degradable, release without
+  training the read EWMA, and never feed the sweep detector.
+- **Cross-replica** (``-m resilience``): a write on replica A
+  invalidates replica B's tiers via the epoch fan-out and lands as a
+  delta frame on B's live channels.
+"""
+
+import asyncio
+import json
+import os
+import shutil
+import socket
+
+import numpy as np
+import pytest
+from aiohttp import ClientSession, WSMsgType, web
+from aiohttp.test_utils import TestClient, TestServer
+
+from omero_ms_pixel_buffer_tpu.auth.stores import MemorySessionStore
+from omero_ms_pixel_buffer_tpu.http.server import PixelBufferApp
+from omero_ms_pixel_buffer_tpu.ingest import (
+    IngestError,
+    IngestPlane,
+    ShardAssembler,
+)
+from omero_ms_pixel_buffer_tpu.io.ometiff import write_ome_tiff
+from omero_ms_pixel_buffer_tpu.io.pixels_service import (
+    ImageRegistry,
+    PixelsService,
+)
+from omero_ms_pixel_buffer_tpu.io.zarr import (
+    ZarrPixelBuffer,
+    write_ngff,
+)
+from omero_ms_pixel_buffer_tpu.resilience.faultinject import (
+    INJECTOR,
+    Fail,
+    always,
+    first_n,
+)
+from omero_ms_pixel_buffer_tpu.utils.config import Config, ConfigError
+
+rng = np.random.default_rng(24)
+IMG = rng.integers(0, 4096, (1, 2, 2, 96, 128), dtype=np.uint16)
+AUTH = {"Cookie": "sessionid=ck"}
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    INJECTOR.clear()
+    yield
+    INJECTOR.clear()
+
+
+def _write_zarr(tmp_path, name="img.zarr", shards=(64, 64), levels=2):
+    root = str(tmp_path / name)
+    write_ngff(
+        root, IMG, chunks=(32, 32), levels=levels, zarr_format=3,
+        shards=shards,
+    )
+    return root
+
+
+def _wire(arr2d):
+    """A tile body in the ingest wire format: raw big-endian pixels —
+    the same byte order the raw read surface serves, so PUT and GET
+    bodies compare directly."""
+    return np.asarray(arr2d).astype(">u2").tobytes()
+
+
+async def _make_app(tmp_path, config_extra=None, registry=None,
+                    image_path=None):
+    if registry is None:
+        registry = ImageRegistry()
+        registry.add(1, image_path or _write_zarr(tmp_path))
+    raw = {
+        "session-store": {"type": "memory"},
+        "backend": {"batching": {"coalesce-window-ms": 1.0}},
+        "ingest": {"enabled": True},
+    }
+    if config_extra:
+        raw.update(config_extra)
+    config = Config.from_dict(raw)
+    app_obj = PixelBufferApp(
+        config,
+        pixels_service=PixelsService(registry),
+        session_store=MemorySessionStore({"ck": "omero-key-1"}),
+    )
+    client = TestClient(
+        TestServer(app_obj.make_app()), loop=asyncio.get_running_loop()
+    )
+    await client.start_server()
+    return app_obj, client
+
+
+async def _get_raw(client, image_id, z, c, t, x, y, w, h, extra=""):
+    r = await client.get(
+        f"/tile/{image_id}/{z}/{c}/{t}?x={x}&y={y}&w={w}&h={h}{extra}",
+        headers=AUTH,
+    )
+    assert r.status == 200, (r.status, await r.text())
+    return await r.read()
+
+
+# ---------------------------------------------------------------------------
+# config: the ingest: block
+# ---------------------------------------------------------------------------
+
+class TestIngestConfig:
+    BASE = {"session-store": {"type": "memory"}}
+
+    def test_defaults_off(self):
+        cfg = Config.from_dict(dict(self.BASE))
+        assert cfg.ingest.enabled is False
+        assert cfg.ingest.max_inflight_shards == 64
+        assert cfg.ingest.staging_bytes == 256 << 20
+
+    def test_unknown_key_fails_startup(self):
+        with pytest.raises(ConfigError, match="ingest"):
+            Config.from_dict({
+                **self.BASE, "ingest": {"enabled": True, "max-shards": 2},
+            })
+
+    def test_bad_values_fail(self):
+        with pytest.raises(ConfigError):
+            Config.from_dict({
+                **self.BASE, "ingest": {"max-inflight-shards": "lots"},
+            })
+        with pytest.raises(ConfigError):
+            Config.from_dict({
+                **self.BASE, "ingest": {"staging-bytes": 0},
+            })
+
+    async def test_disabled_removes_routes(self, tmp_path):
+        _app, client = await _make_app(
+            tmp_path, config_extra={"ingest": {"enabled": False}}
+        )
+        try:
+            # 405, not 404: the catch-all OPTIONS route owns every
+            # unmatched path — either way, no write handler is bound
+            r = await client.put(
+                "/image/1/tile/0/0/0?x=0&y=0&w=32&h=32",
+                data=b"\0" * 2048, headers=AUTH,
+            )
+            assert r.status in (404, 405)
+            r = await client.post(
+                "/image/1/planes?planes=0:0:0", data=b"\0",
+                headers=AUTH,
+            )
+            assert r.status in (404, 405)
+        finally:
+            await client.close()
+
+
+# ---------------------------------------------------------------------------
+# auth matrix
+# ---------------------------------------------------------------------------
+
+class _DenyWriteRegistry(ImageRegistry):
+    """A metadata plane with a write surface that always refuses —
+    the permission-scoped resolver shape (db/metadata can_write_image)
+    without a database. The scoped ``get_pixels`` signature is what
+    promotes it to the service's metadata plane."""
+
+    def get_pixels(self, image_id, session_key=None):
+        return super().get_pixels(image_id)
+
+    def can_write_image(self, image_id, session_key):
+        return False
+
+
+class TestIngestAuth:
+    async def test_unauthenticated_403(self, tmp_path):
+        _app, client = await _make_app(tmp_path)
+        try:
+            r = await client.put(
+                "/image/1/tile/0/0/0?x=0&y=0&w=32&h=32",
+                data=_wire(np.zeros((32, 32), np.uint16)),
+            )
+            assert r.status == 403
+            r = await client.post("/image/1/planes?planes=0:0:0", data=b"")
+            assert r.status == 403
+        finally:
+            await client.close()
+
+    async def test_write_denied_resolver_403(self, tmp_path):
+        registry = _DenyWriteRegistry()
+        registry.add(1, _write_zarr(tmp_path))
+        _app, client = await _make_app(tmp_path, registry=registry)
+        try:
+            r = await client.put(
+                "/image/1/tile/0/0/0?x=0&y=0&w=32&h=32",
+                data=_wire(np.zeros((32, 32), np.uint16)), headers=AUTH,
+            )
+            assert r.status == 403
+            assert "Cannot write" in await r.text()
+        finally:
+            await client.close()
+
+
+# ---------------------------------------------------------------------------
+# write -> read byte identity
+# ---------------------------------------------------------------------------
+
+class TestWriteReadIdentity:
+    async def test_put_bytes_equal_get_bytes(self, tmp_path):
+        app_obj, client = await _make_app(tmp_path)
+        try:
+            tile = rng.integers(0, 4096, (40, 48), dtype=np.uint16)
+            wire = _wire(tile)
+            r = await client.put(
+                "/image/1/tile/0/0/0?x=16&y=16&w=48&h=40",
+                data=wire, headers=AUTH,
+            )
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            assert body["tiles"] == 1 and body["objects"] >= 1
+            back = await _get_raw(client, 1, 0, 0, 0, 16, 16, 48, 40)
+            assert back == wire  # THE acceptance bytes
+            # neighbors preserved (read-modify-write on shared chunks)
+            north = await _get_raw(client, 1, 0, 0, 0, 0, 0, 128, 16)
+            assert north == IMG[0, 0, 0, :16, :].astype(">u2").tobytes()
+        finally:
+            await client.close()
+
+    async def test_pyramid_levels_follow_the_write(self, tmp_path):
+        app_obj, client = await _make_app(tmp_path)
+        try:
+            tile = rng.integers(0, 4096, (40, 48), dtype=np.uint16)
+            r = await client.put(
+                "/image/1/tile/0/0/0?x=16&y=16&w=48&h=40",
+                data=_wire(tile), headers=AUTH,
+            )
+            assert r.status == 200
+            expect = IMG[0, 0, 0].copy()
+            expect[16:56, 16:64] = tile
+            got = np.frombuffer(
+                await _get_raw(
+                    client, 1, 0, 0, 0, 0, 0, 64, 48,
+                    extra="&resolution=1",
+                ),
+                dtype=">u2",
+            ).reshape(48, 64)
+            # the same stride-2 law write_ngff uses for its pyramid
+            assert np.array_equal(got, expect[::2, ::2])
+        finally:
+            await client.close()
+
+    async def test_planes_batch_append(self, tmp_path):
+        app_obj, client = await _make_app(tmp_path)
+        try:
+            planes = rng.integers(0, 4096, (2, 96, 128), dtype=np.uint16)
+            r = await client.post(
+                "/image/1/planes?planes=1:0:0,1:1:0",
+                data=planes.astype(">u2").tobytes(), headers=AUTH,
+            )
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            assert body["tiles"] == 2
+            for c in (0, 1):
+                got = await _get_raw(client, 1, 1, c, 0, 0, 0, 128, 96)
+                assert got == planes[c].astype(">u2").tobytes()
+        finally:
+            await client.close()
+
+    async def test_every_read_surface_serves_the_new_bytes(
+        self, tmp_path
+    ):
+        """render + DZI + IIIF after a write: 200s with CHANGED bodies
+        versus the pre-write responses — the caches did not serve the
+        old rendering (no TTL involved; the test completes in far less
+        than the default TTL)."""
+        _app, client = await _make_app(tmp_path)
+        try:
+            urls = [
+                "/tile/1/0/0/0?x=0&y=0&w=64&h=64&format=png",
+                "/render/1/0/0/0?x=0&y=0&w=64&h=64",
+                "/iiif/1/full/128,96/0/default.png",
+            ]
+            # DZI deepest level = full resolution
+            r = await client.get("/dzi/1.dzi", headers=AUTH)
+            assert r.status == 200
+            urls.append("/dzi/1_files/7/0_0.png")
+            before = {}
+            for url in urls:
+                r = await client.get(url, headers=AUTH)
+                assert r.status == 200, (url, r.status, await r.text())
+                before[url] = await r.read()
+            tile = np.full((64, 64), 4095, dtype=np.uint16)
+            r = await client.put(
+                "/image/1/tile/0/0/0?x=0&y=0&w=64&h=64",
+                data=_wire(tile), headers=AUTH,
+            )
+            assert r.status == 200
+            for url in urls:
+                r = await client.get(url, headers=AUTH)
+                assert r.status == 200, (url, r.status)
+                after = await r.read()
+                assert after != before[url], url
+        finally:
+            await client.close()
+
+    async def test_engines_serve_identical_written_bytes(self, tmp_path):
+        """Two service processes — host engine and jax engine — over
+        the store one of them wrote: raw readback is byte-identical."""
+        root = _write_zarr(tmp_path)
+        app_w, client_w = await _make_app(tmp_path, image_path=root)
+        app_h, client_h = await _make_app(
+            tmp_path, image_path=root,
+            config_extra={"backend": {
+                "engine": "host",
+                "batching": {"coalesce-window-ms": 1.0},
+            }},
+        )
+        try:
+            tile = rng.integers(0, 4096, (64, 64), dtype=np.uint16)
+            r = await client_w.put(
+                "/image/1/tile/0/1/0?x=32&y=16&w=64&h=64",
+                data=_wire(tile), headers=AUTH,
+            )
+            assert r.status == 200
+            a = await _get_raw(client_w, 1, 0, 1, 0, 32, 16, 64, 64)
+            b = await _get_raw(client_h, 1, 0, 1, 0, 32, 16, 64, 64)
+            assert a == b == _wire(tile)
+        finally:
+            await client_w.close()
+            await client_h.close()
+
+
+# ---------------------------------------------------------------------------
+# shard append edge cases
+# ---------------------------------------------------------------------------
+
+def _open_buffer(root):
+    # cache_bytes=0: direct shard tests must observe the STORE, not a
+    # per-instance decoded-chunk cache
+    return ZarrPixelBuffer(root, image_id=1, cache_bytes=0)
+
+
+class TestShardEdgeCases:
+    def test_partial_edge_shard(self, tmp_path):
+        """96x128 with 64x64 shards: the bottom and right shards are
+        partial (out-of-grid inner positions must stay sentinels)."""
+        root = _write_zarr(tmp_path)
+        buf = _open_buffer(root)
+        asm = ShardAssembler(buf)
+        tile = rng.integers(0, 4096, (32, 64), dtype=np.uint16)
+        # lands in the bottom-right partial shard
+        asm.stage_tile(0, 0, 0, 64, 64, 64, 32, tile)
+        asm.commit()
+        buf2 = _open_buffer(root)
+        got = buf2.get_tile_at(0, 0, 0, 0, 64, 64, 64, 32)
+        assert np.array_equal(got, tile)
+        # the rest of the plane is untouched
+        full = buf2.get_tile_at(0, 0, 0, 0, 0, 0, 128, 96)
+        expect = IMG[0, 0, 0].copy()
+        expect[64:96, 64:128] = tile
+        assert np.array_equal(full, expect)
+
+    def test_sentinels_preserved_in_sparse_shard(self, tmp_path):
+        """Writing ONE chunk of an otherwise-absent shard leaves every
+        other index entry at the absent sentinel — a reader of those
+        positions gets fill_value, not garbage offsets."""
+        root = _write_zarr(tmp_path, levels=1)
+        # wipe the chunk objects: all-absent array, metadata intact
+        shutil.rmtree(os.path.join(root, "0", "c"))
+        buf = _open_buffer(root)
+        asm = ShardAssembler(buf)
+        tile = rng.integers(0, 4096, (32, 32), dtype=np.uint16)
+        asm.stage_tile(0, 0, 0, 0, 0, 32, 32, tile)
+        asm.commit()
+        buf2 = _open_buffer(root)
+        got = buf2.get_tile_at(0, 0, 0, 0, 0, 0, 32, 32)
+        assert np.array_equal(got, tile)
+        # unwritten chunk inside the SAME shard: absent -> fill_value
+        other = buf2.get_tile_at(0, 0, 0, 0, 32, 32, 32, 32)
+        assert (other == buf2.levels[0].fill_value).all()
+
+    def test_index_location_start_spelling(self, tmp_path):
+        """A shard layout with the index at the FRONT: offsets are
+        index-relative on disk; the assembler writes them the same way
+        the reader parses them."""
+        root = _write_zarr(tmp_path, levels=1)
+        zmeta = os.path.join(root, "0", "zarr.json")
+        doc = json.loads(open(zmeta).read())
+        changed = False
+        for codec in doc["codecs"]:
+            if codec.get("name") == "sharding_indexed":
+                codec["configuration"]["index_location"] = "start"
+                changed = True
+        assert changed
+        open(zmeta, "w").write(json.dumps(doc))
+        # the existing objects are end-spelled: drop them so the array
+        # is empty under the new spelling
+        shutil.rmtree(os.path.join(root, "0", "c"))
+        buf = _open_buffer(root)
+        assert buf.levels[0].sharding.index_at_end is False
+        asm = ShardAssembler(buf)
+        tile = rng.integers(0, 4096, (48, 80), dtype=np.uint16)
+        asm.stage_tile(0, 1, 0, 16, 8, 80, 48, tile)
+        asm.commit()
+        # second write to the SAME shard must parse the start-spelled
+        # index it just wrote (carry-over path)
+        asm2 = ShardAssembler(_open_buffer(root))
+        patch = rng.integers(0, 4096, (8, 8), dtype=np.uint16)
+        asm2.stage_tile(0, 1, 0, 0, 0, 8, 8, patch)
+        asm2.commit()
+        got = _open_buffer(root).get_tile_at(0, 0, 1, 0, 16, 8, 80, 48)
+        assert np.array_equal(got, tile)
+        got2 = _open_buffer(root).get_tile_at(0, 0, 1, 0, 0, 0, 8, 8)
+        assert np.array_equal(got2, patch)
+
+    def test_unsharded_and_v2_arrays_write_too(self, tmp_path):
+        root = str(tmp_path / "v2.zarr")
+        write_ngff(root, IMG, chunks=(32, 32), levels=1, zarr_format=2)
+        buf = _open_buffer(root)
+        asm = ShardAssembler(buf)
+        tile = rng.integers(0, 4096, (40, 40), dtype=np.uint16)
+        asm.stage_tile(1, 0, 0, 24, 24, 40, 40, tile)
+        asm.commit()
+        got = _open_buffer(root).get_tile_at(0, 1, 0, 0, 24, 24, 40, 40)
+        assert np.array_equal(got, tile)
+
+    def test_non_zarr_image_409(self, tmp_path):
+        path = str(tmp_path / "img.ome.tiff")
+        write_ome_tiff(path, IMG, tile_size=(64, 64))
+        registry = ImageRegistry()
+        registry.add(1, path)
+        plane = IngestPlane(PixelsService(registry))
+        with pytest.raises(IngestError) as ei:
+            plane.write_tiles(
+                1, [(0, 0, 0, 0, 0, 8, 8, b"\0" * 128)]
+            )
+        assert ei.value.code == 409
+
+    def test_staging_and_shard_bounds_413(self, tmp_path):
+        root = _write_zarr(tmp_path)
+        registry = ImageRegistry()
+        registry.add(1, root)
+        svc = PixelsService(registry)
+        tiny = IngestPlane(svc, staging_bytes=1024)
+        body = _wire(np.zeros((32, 32), np.uint16))
+        with pytest.raises(IngestError) as ei:
+            tiny.write_tiles(1, [(0, 0, 0, 0, 0, 32, 32, body)])
+        assert ei.value.code == 413
+        narrow = IngestPlane(svc, max_inflight_shards=1)
+        wide = _wire(np.zeros((96, 128), np.uint16))
+        with pytest.raises(IngestError) as ei:
+            narrow.write_tiles(1, [(0, 0, 0, 0, 0, 128, 96, wide)])
+        assert ei.value.code == 413
+
+
+# ---------------------------------------------------------------------------
+# request validation
+# ---------------------------------------------------------------------------
+
+class TestIngestValidation:
+    async def test_client_errors(self, tmp_path):
+        _app, client = await _make_app(tmp_path)
+        try:
+            cases = [
+                # missing query params
+                ("PUT", "/image/1/tile/0/0/0", b""),
+                # out-of-bounds tile
+                ("PUT", "/image/1/tile/0/0/0?x=100&y=0&w=64&h=64",
+                 b"\0" * 8192),
+                # body length mismatch
+                ("PUT", "/image/1/tile/0/0/0?x=0&y=0&w=32&h=32",
+                 b"\0" * 7),
+                # out-of-bounds plane
+                ("PUT", "/image/1/tile/9/0/0?x=0&y=0&w=32&h=32",
+                 b"\0" * 2048),
+                # malformed planes spec
+                ("POST", "/image/1/planes?planes=zebra", b"\0" * 16),
+                # body not divisible into the listed planes
+                ("POST", "/image/1/planes?planes=0:0:0,1:0:0",
+                 b"\0" * 7),
+            ]
+            for method, url, body in cases:
+                r = await client.request(
+                    method, url, data=body, headers=AUTH
+                )
+                assert r.status == 400, (url, r.status, await r.text())
+            r = await client.put(
+                "/image/99/tile/0/0/0?x=0&y=0&w=32&h=32",
+                data=b"\0" * 2048, headers=AUTH,
+            )
+            assert r.status == 404
+        finally:
+            await client.close()
+
+
+# ---------------------------------------------------------------------------
+# stale shard-index memo (the r14 gap, closed in r24)
+# ---------------------------------------------------------------------------
+
+class TestShardIndexMemoEpoch:
+    def test_memo_is_epoch_keyed_with_frozen_clock(self, tmp_path):
+        """TTL uninvolved by construction: the memo clock is frozen,
+        so only the epoch stamp can explain the refresh."""
+        root = _write_zarr(tmp_path, levels=1)
+        reader = _open_buffer(root)
+        arr = reader.levels[0]
+        arr._shard_clock = lambda: 1000.0  # frozen: TTL never expires
+        before = reader.get_tile_at(0, 0, 0, 0, 0, 0, 64, 64)
+        assert np.array_equal(before, IMG[0, 0, 0, :64, :64])
+        assert arr._shard_indexes  # footer memoized
+        # a second process-side writer rewrites the shard
+        writer = _open_buffer(root)
+        asm = ShardAssembler(writer)
+        tile = rng.integers(0, 4096, (64, 64), dtype=np.uint16)
+        asm.stage_tile(0, 0, 0, 0, 0, 64, 64, tile)
+        asm.commit()
+        # the reader's open buffer: same memo, same frozen clock.
+        # note_epoch purges exactly once per new epoch value.
+        assert reader.note_epoch(7) > 0
+        after = reader.get_tile_at(0, 0, 0, 0, 0, 0, 64, 64)
+        assert np.array_equal(after, tile)
+        assert reader.note_epoch(7) == 0  # same epoch: no re-purge
+
+    def test_pixels_service_note_epoch_reaches_open_buffer(
+        self, tmp_path
+    ):
+        root = _write_zarr(tmp_path, levels=1)
+        registry = ImageRegistry()
+        registry.add(1, root)
+        svc = PixelsService(registry)
+        buf = svc.get_pixel_buffer(1)
+        for arr in buf.levels:
+            arr._shard_clock = lambda: 1000.0
+        buf.get_tile_at(0, 0, 0, 0, 0, 0, 64, 64)
+        assert buf.levels[0]._shard_indexes
+        svc.note_epoch(1, 3)
+        assert not buf.levels[0]._shard_indexes
+        # unknown image / closed buffer: silently a no-op
+        svc.note_epoch(999, 3)
+
+    async def test_http_write_purges_reader_memo(self, tmp_path):
+        """End to end: a PUT through the service invalidates the open
+        buffer the read path is already holding — the follow-up read
+        serves the new bytes with the memo TTL frozen."""
+        app_obj, client = await _make_app(tmp_path)
+        try:
+            old = await _get_raw(client, 1, 0, 0, 0, 0, 0, 64, 64)
+            buf = app_obj.pixels_service.get_pixel_buffer(1)
+            for arr in buf.levels:
+                arr._shard_clock = lambda: 1000.0
+            tile = rng.integers(0, 4096, (64, 64), dtype=np.uint16)
+            r = await client.put(
+                "/image/1/tile/0/0/0?x=0&y=0&w=64&h=64",
+                data=_wire(tile), headers=AUTH,
+            )
+            assert r.status == 200
+            new = await _get_raw(client, 1, 0, 0, 0, 0, 0, 64, 64)
+            assert new == _wire(tile)
+            assert new != old
+        finally:
+            await client.close()
+
+
+# ---------------------------------------------------------------------------
+# scheduler pin
+# ---------------------------------------------------------------------------
+
+class TestSchedulerPin:
+    async def test_writes_acquire_nondegradable_and_never_train(
+        self, tmp_path
+    ):
+        app_obj, client = await _make_app(tmp_path)
+        try:
+            sched = app_obj.scheduler
+            assert sched is not None
+            acquired, released, observed = [], [], []
+            real_acquire, real_release = sched.acquire, sched.release
+
+            async def spy_acquire(priority, deadline, degradable=True):
+                acquired.append(degradable)
+                return await real_acquire(
+                    priority, deadline, degradable=degradable
+                )
+
+            def spy_release(permit, train=True):
+                released.append(train)
+                return real_release(permit, train=train)
+
+            sched.acquire, sched.release = spy_acquire, spy_release
+            real_observe = app_obj.sweep_detector.observe
+            app_obj.sweep_detector.observe = (
+                lambda *a, **k: observed.append(a) or real_observe(*a, **k)
+            )
+            tile = _wire(np.zeros((32, 32), np.uint16))
+            for i in range(4):
+                r = await client.put(
+                    f"/image/1/tile/0/0/0?x={i * 32}&y=0&w=32&h=32",
+                    data=tile, headers=AUTH,
+                )
+                assert r.status == 200
+            assert acquired == [False] * 4   # never degradable
+            assert released == [False] * 4   # never trains the EWMA
+            assert observed == []            # never a sweep sample
+        finally:
+            await client.close()
+
+
+# ---------------------------------------------------------------------------
+# torn-write chaos + epoch ordering
+# ---------------------------------------------------------------------------
+
+class TestTornWriteChaos:
+    async def test_commit_fault_serves_zero_mixed_reads(self, tmp_path):
+        """A fault at the publish point: the write 503s and every
+        byte of the image still reads as the ORIGINAL fixture — the
+        fault fired before anything became visible."""
+        _app, client = await _make_app(tmp_path)
+        try:
+            before = await _get_raw(client, 1, 0, 0, 0, 0, 0, 128, 96)
+            INJECTOR.install(
+                "ingest.commit", first_n(1, RuntimeError("disk died"))
+            )
+            tile = rng.integers(0, 4096, (64, 64), dtype=np.uint16)
+            r = await client.put(
+                "/image/1/tile/0/0/0?x=0&y=0&w=64&h=64",
+                data=_wire(tile), headers=AUTH,
+            )
+            assert r.status == 503
+            assert INJECTOR.calls("ingest.commit") == 1
+            after = await _get_raw(client, 1, 0, 0, 0, 0, 0, 128, 96)
+            assert after == before  # fully old — not one byte moved
+            # healed: the same write lands
+            r = await client.put(
+                "/image/1/tile/0/0/0?x=0&y=0&w=64&h=64",
+                data=_wire(tile), headers=AUTH,
+            )
+            assert r.status == 200
+            got = await _get_raw(client, 1, 0, 0, 0, 0, 0, 64, 64)
+            assert got == _wire(tile)
+        finally:
+            await client.close()
+
+    async def test_index_fault_aborts_before_publish(self, tmp_path):
+        _app, client = await _make_app(tmp_path)
+        try:
+            before = await _get_raw(client, 1, 0, 0, 0, 0, 0, 128, 96)
+            INJECTOR.install(
+                "ingest.index", always(RuntimeError("index torn"))
+            )
+            r = await client.put(
+                "/image/1/tile/0/0/0?x=0&y=0&w=64&h=64",
+                data=_wire(np.zeros((64, 64), np.uint16)), headers=AUTH,
+            )
+            assert r.status == 503
+            after = await _get_raw(client, 1, 0, 0, 0, 0, 0, 128, 96)
+            assert after == before
+        finally:
+            await client.close()
+
+    async def test_reads_racing_commits_never_mix(self, tmp_path):
+        """Epoch-ordering drive: a reader hammering one region while a
+        writer alternates two known patterns — every read is entirely
+        pattern A or entirely pattern B (or the original), never a
+        blend. Chaos on every third commit keeps failed writes in the
+        mix; they must read as the previous state."""
+        _app, client = await _make_app(tmp_path)
+        try:
+            a = np.full((64, 64), 1111, dtype=np.uint16)
+            b = np.full((64, 64), 2222, dtype=np.uint16)
+            legal = {
+                _wire(a), _wire(b),
+                IMG[0, 0, 0, :64, :64].astype(">u2").tobytes(),
+            }
+            INJECTOR.install(
+                "ingest.commit",
+                lambda n: (
+                    Fail(RuntimeError("chaos")) if n % 3 == 2 else None
+                ),
+            )
+            stop = asyncio.Event()
+            mixed = []
+
+            async def reader():
+                while not stop.is_set():
+                    got = await _get_raw(
+                        client, 1, 0, 0, 0, 0, 0, 64, 64
+                    )
+                    if got not in legal:
+                        mixed.append(got)
+                    await asyncio.sleep(0)
+
+            task = asyncio.create_task(reader())
+            for i in range(12):
+                pattern = a if i % 2 == 0 else b
+                r = await client.put(
+                    "/image/1/tile/0/0/0?x=0&y=0&w=64&h=64",
+                    data=_wire(pattern), headers=AUTH,
+                )
+                assert r.status in (200, 503)
+            stop.set()
+            await task
+            assert mixed == []  # zero mixed-bytes reads
+        finally:
+            await client.close()
+
+
+# ---------------------------------------------------------------------------
+# cross-replica: write on A, B invalidates + delta frame
+# ---------------------------------------------------------------------------
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def _boot_replica(img_path, members, self_url, port, l2_uri):
+    registry = ImageRegistry()
+    registry.add(1, img_path)
+    config = Config.from_dict({
+        "session-store": {"type": "memory"},
+        "backend": {"batching": {"coalesce-window-ms": 1.0}},
+        "cache": {"prefetch": {"enabled": False}},
+        "ingest": {"enabled": True},
+        "cluster": {
+            "members": members,
+            "self": self_url,
+            "peer-timeout-ms": 3000,
+            "l2": {"uri": l2_uri},
+        },
+    })
+    app_obj = PixelBufferApp(
+        config,
+        pixels_service=PixelsService(registry),
+        session_store=MemorySessionStore({"ck": "omero-key-1"}),
+    )
+    runner = web.AppRunner(app_obj.make_app())
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", port)
+    await site.start()
+    return app_obj, runner
+
+
+class TestCrossReplica:
+    @pytest.mark.resilience
+    async def test_write_on_a_invalidates_b_and_pushes_delta(
+        self, tmp_path
+    ):
+        """THE r24 acceptance drive: a tile written on replica A is
+        served fresh by replica B immediately — B's RAM/L2 entries die
+        via the epoch bump and purge fan-out, not a TTL — and a live
+        channel held open on B receives the invalidation frame."""
+        from omero_ms_pixel_buffer_tpu.cache.plane.resp_stub import (
+            InMemoryRespServer,
+        )
+
+        img_path = _write_zarr(tmp_path)
+        resp = InMemoryRespServer()
+        await resp.start()
+        ports = [_free_port() for _ in range(2)]
+        members = [f"http://127.0.0.1:{p}" for p in ports]
+        nodes = []
+        for i, port in enumerate(ports):
+            nodes.append(await _boot_replica(
+                img_path, members, members[i], port, resp.uri
+            ))
+        try:
+            (app_a, _), (app_b, _) = nodes
+            url_a, url_b = members
+            async with ClientSession() as http:
+                # warm B's caches with the old bytes
+                r = await http.get(
+                    url_b + "/tile/1/0/0/0?x=0&y=0&w=64&h=64",
+                    headers=AUTH,
+                )
+                assert r.status == 200
+                old = await r.read()
+                # hold a live channel on B
+                ws = await asyncio.wait_for(
+                    http.ws_connect(
+                        url_b + "/session/1/live", headers=AUTH
+                    ),
+                    10,
+                )
+                hello = json.loads(
+                    (await asyncio.wait_for(ws.receive(), 10)).data
+                )
+                assert hello["type"] == "hello"
+                # write on A
+                tile = np.full((64, 64), 3333, dtype=np.uint16)
+                r = await http.put(
+                    url_a + "/image/1/tile/0/0/0?x=0&y=0&w=64&h=64",
+                    data=_wire(tile), headers=AUTH,
+                )
+                assert r.status == 200, await r.text()
+                # the delta frame reaches B's channel as a push (the
+                # ping interval and TTL are both far longer)
+                frame = None
+                for _ in range(10):
+                    msg = await asyncio.wait_for(ws.receive(), 10)
+                    assert msg.type == WSMsgType.TEXT
+                    frame = json.loads(msg.data)
+                    if frame.get("type") == "invalidate":
+                        break
+                assert frame is not None
+                assert frame["type"] == "invalidate"
+                assert frame["image"] == 1
+                # B serves the NEW bytes now — no TTL wait
+                deadline = asyncio.get_event_loop().time() + 10
+                fresh = None
+                while asyncio.get_event_loop().time() < deadline:
+                    r = await http.get(
+                        url_b + "/tile/1/0/0/0?x=0&y=0&w=64&h=64",
+                        headers=AUTH,
+                    )
+                    assert r.status == 200
+                    fresh = await r.read()
+                    if fresh == _wire(tile):
+                        break
+                    await asyncio.sleep(0.1)
+                assert fresh == _wire(tile)
+                assert fresh != old
+                await ws.close()
+        finally:
+            for _app, runner in nodes:
+                await runner.cleanup()
+            await resp.close()

@@ -402,6 +402,12 @@ _RESILIENCE_SCOPE = (
     # exactly where someone adds a webhook or an upstream subscribe
     # next; the scope pin means it arrives wrapped
     "omero_ms_pixel_buffer_tpu/session/",
+    # the ingest plane (r24): shard commits go through the store
+    # layer (FileStore rename / S3 SigV4 PUT) with ingest.commit and
+    # ingest.index fault points; any future direct network call added
+    # to the write path must carry the same breaker/fault/timeout
+    # wrapping as the read edges it races
+    "omero_ms_pixel_buffer_tpu/ingest/",
 )
 
 _NET_PRIMITIVES: List[Tuple[Optional[str], str, str]] = [

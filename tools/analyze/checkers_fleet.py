@@ -469,6 +469,10 @@ _INGRESS_SCOPE = (
     "omero_ms_pixel_buffer_tpu/cluster/",
     "omero_ms_pixel_buffer_tpu/cache/plane/",
     "omero_ms_pixel_buffer_tpu/http/",
+    # the ingest plane (r24): client-supplied tile bytes cross this
+    # boundary into shard rewrites — decode/verify helpers added here
+    # must sit behind the same trust-surface guard as the HTTP layer
+    "omero_ms_pixel_buffer_tpu/ingest/",
 )
 _INGRESS_NAMES = {"decode_transfer", "decode_entry_epoch", "decode_entry"}
 _VERIFY_NAMES = {"body_matches", "verify_entry_bytes"}
